@@ -1,0 +1,21 @@
+"""mxnet_tpu: a TPU-native deep learning framework.
+
+A ground-up rebuild of the capability surface of Apache MXNet 0.11
+(reference at /root/reference, analysed in SURVEY.md) designed for
+TPU/XLA: imperative NDArray and symbolic Symbol APIs, Module training,
+KVStore-style distribution over XLA collectives, Gluon-style imperative
+blocks — with compute expressed as pure JAX so whole graphs compile into
+single XLA modules instead of per-op kernel dispatch.
+"""
+__version__ = '0.1.0'
+
+from . import base
+from .base import MXNetError, NameManager, Prefix
+from . import context
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from . import random as rnd
+from . import autograd

@@ -1,0 +1,247 @@
+"""Imperative autograd.
+
+TPU-native counterpart of the reference AutogradRuntime
+(src/ndarray/autograd.{h,cc}; SURVEY.md §2.1): a thread-local tape
+records every imperative op invoked under `record()`; `backward()`
+replays the tape in reverse, computing per-node VJPs with jax.vjp over
+the same registry compute functions the forward ran.  Where the
+reference builds an nnvm graph from AGNodes and binds a transient
+GraphExecutor (autograd.h:110 ComputeGradient), here each node's VJP is
+a direct JAX transform — no separate graph representation is needed.
+"""
+import threading
+from contextlib import contextmanager
+
+import jax
+import jax.numpy as jnp
+
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, 'recording'):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+class _TapeNode:
+    __slots__ = ('op', 'attrs', 'inputs', 'auxs', 'outputs', 'op_ctx')
+
+    def __init__(self, op, attrs, inputs, auxs, outputs, op_ctx):
+        self.op = op
+        self.attrs = attrs
+        self.inputs = inputs      # list of NDArray (args only)
+        self.auxs = auxs          # list of NDArray (non-differentiable)
+        self.outputs = outputs    # list of NDArray
+        self.op_ctx = op_ctx
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    old = _st().recording
+    _st().recording = flag
+    return old
+
+
+def set_training(flag):
+    old = _st().training
+    _st().training = flag
+    return old
+
+
+@contextmanager
+def record(train_mode=True):
+    """Record imperative ops for differentiation
+    (reference python/mxnet/autograd.py record)."""
+    st = _st()
+    old_rec, old_train = st.recording, st.training
+    st.recording, st.training = True, train_mode
+    try:
+        yield
+    finally:
+        st.recording, st.training = old_rec, old_train
+
+
+@contextmanager
+def pause(train_mode=False):
+    st = _st()
+    old_rec, old_train = st.recording, st.training
+    st.recording, st.training = False, train_mode
+    try:
+        yield
+    finally:
+        st.recording, st.training = old_rec, old_train
+
+
+@contextmanager
+def train_mode():
+    old = set_training(True)
+    try:
+        yield
+    finally:
+        set_training(old)
+
+
+@contextmanager
+def predict_mode():
+    old = set_training(False)
+    try:
+        yield
+    finally:
+        set_training(old)
+
+
+def mark_variable(arr, grad_req='write'):
+    # Marking is a per-array flag (grad_req != None); no global registry,
+    # so marked arrays are GC'd normally (no device-memory pinning).
+    if arr.grad_req is None:
+        arr.grad_req = grad_req
+
+
+def mark_variables(variables, gradients=None, grad_reqs='write'):
+    if gradients is None:
+        gradients = [None] * len(variables)
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v.grad_req = req
+        v._grad = g if g is not None else None
+
+
+def record_op(op, attrs, inputs, auxs, outputs, op_ctx):
+    _st().tape.append(_TapeNode(op, attrs, inputs, auxs, outputs, op_ctx))
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from `heads` through the tape
+    (reference MXAutogradBackwardEx, c_api_ndarray.cc:621)."""
+    from .ndarray import NDArray
+    st = _st()
+    tape = st.tape
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    grad_map = {}
+
+    def add_grad(arr, g):
+        k = id(arr)
+        if k in grad_map:
+            grad_map[k] = grad_map[k] + g
+        else:
+            grad_map[k] = g
+
+    for h, hg in zip(heads, head_grads):
+        if hg is None:
+            g = jnp.ones(h.shape, dtype=h.dtype)
+        else:
+            g = hg._data if isinstance(hg, NDArray) else jnp.asarray(hg)
+        add_grad(h, g)
+
+    # Map output-array identity -> producing node index
+    for node in reversed(tape):
+        outs_with_grad = [id(o) in grad_map for o in node.outputs]
+        if not any(outs_with_grad):
+            continue
+        cotangents = tuple(
+            grad_map.get(id(o), jnp.zeros(o.shape, dtype=o.dtype))._data
+            if isinstance(grad_map.get(id(o)), NDArray)
+            else grad_map.get(id(o), jnp.zeros(o.shape, dtype=o.dtype))
+            for o in node.outputs)
+        op, attrs, op_ctx = node.op, node.attrs, node.op_ctx
+        if isinstance(op, _CustomFunctionOp):
+            gs = op.fn.backward(*[NDArray(c) for c in cotangents])
+            if not isinstance(gs, (list, tuple)):
+                gs = [gs]
+            for x, g in zip(node.inputs, gs):
+                add_grad(x, g._data if isinstance(g, NDArray) else g)
+            continue
+        in_data = tuple(x._data for x in node.inputs)
+        aux_data = [x._data for x in node.auxs]
+
+        def fwd(*args):
+            outs, _ = op.apply(attrs, list(args), aux_data, op_ctx)
+            return tuple(outs)
+
+        _, vjp_fn = jax.vjp(fwd, *in_data)
+        in_grads = vjp_fn(cotangents)
+        for x, g in zip(node.inputs, in_grads):
+            add_grad(x, g)
+
+    # write accumulated grads into marked variables reachable from this
+    # backward pass (heads + every tape-node input)
+    id2arr = {}
+    for h in heads:
+        id2arr[id(h)] = h
+    for node in tape:
+        for x in node.inputs:
+            id2arr[id(x)] = x
+    for k, g in grad_map.items():
+        arr = id2arr.get(k)
+        if arr is None or arr.grad_req in (None, 'null'):
+            continue
+        if isinstance(g, NDArray):
+            g = g._data
+        if arr._grad is None:
+            arr._grad = NDArray(g, arr._ctx)
+        elif arr.grad_req == 'add':
+            arr._grad._data = arr._grad._data + g
+        else:
+            arr._grad._data = g
+
+    if not retain_graph:
+        st.tape = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Compute and return gradients of heads w.r.t. variables."""
+    from .ndarray import NDArray
+    for v in variables:
+        if v.grad_req is None:
+            v.grad_req = 'write'
+        v._grad = None
+    backward(heads, head_grads, retain_graph=bool(retain_graph))
+    return [v._grad for v in variables]
+
+
+class Function:
+    """Custom differentiable function
+    (reference python/mxnet/autograd.py Function)."""
+
+    def __call__(self, *inputs):
+        with pause():
+            outputs = self.forward(*inputs)
+        outs = [outputs] if not isinstance(outputs, (list, tuple)) else list(outputs)
+        if is_recording():
+            _st().tape.append(_TapeNode(_CustomFunctionOp(self), {},
+                                        list(inputs), [], outs, None))
+        return outputs
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+
+class _CustomFunctionOp:
+    """Adapter so Function.backward plugs into the tape replay."""
+    num_aux = 0
+    mutable_aux = False
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.name = '_custom_function'
+
+    def apply(self, attrs, in_data, aux_data, op_ctx):
+        raise RuntimeError('custom function is not re-playable')

@@ -1,0 +1,178 @@
+"""Foundation utilities for the TPU-native framework.
+
+Plays the role of dmlc-core's logging/registry/parameter layer in the
+reference (see /root/reference include/dmlc usage surface, SURVEY.md §2.9):
+error type, name management, attribute parsing and the generic
+registry powering optimizers / metrics / initializers
+(reference: python/mxnet/base.py, python/mxnet/registry.py:1-158).
+"""
+import ast
+import threading
+
+string_types = (str,)
+numeric_types = (float, int)
+integer_types = (int,)
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (name kept for API parity with
+    the reference's python/mxnet/base.py:43)."""
+
+
+class _NameManager:
+    """Automatic op naming, mirroring python/mxnet/name.py.
+
+    Thread-local current manager; `with NameManager():` scopes a fresh
+    counter space.
+    """
+    _current = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = '%s%d' % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        self._old = getattr(_NameManager._current, 'value', None)
+        _NameManager._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        _NameManager._current.value = self._old
+
+
+NameManager = _NameManager
+
+
+def current_name_manager():
+    mgr = getattr(_NameManager._current, 'value', None)
+    if mgr is None:
+        mgr = _NameManager()
+        _NameManager._current.value = mgr
+    return mgr
+
+
+class Prefix(_NameManager):
+    """Name manager that always attaches a prefix (python/mxnet/name.py:70)."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def attr_value(v):
+    """Serialize an attribute value to a string (for JSON round trips),
+    matching the reference convention that all graph attrs are strings
+    (nnvm JSON format)."""
+    if isinstance(v, str):
+        return v
+    return str(v)
+
+
+def parse_attr_value(s):
+    """Parse an attribute string back into a Python value."""
+    if not isinstance(s, str):
+        return s
+    ls = s.strip()
+    low = ls.lower()
+    if low == 'true':
+        return True
+    if low == 'false':
+        return False
+    if low in ('none', 'null'):
+        return None
+    try:
+        return ast.literal_eval(ls)
+    except (ValueError, SyntaxError):
+        return s
+
+
+# ---------------------------------------------------------------------------
+# Generic class registry (reference: python/mxnet/registry.py)
+# ---------------------------------------------------------------------------
+
+_REGISTRIES = {}
+
+
+def get_registry(base_class):
+    return dict(_REGISTRIES.get(base_class, {}))
+
+
+def get_register_func(base_class, nickname):
+    """Returns a decorator registering subclasses of `base_class` under
+    lowercase names (reference registry.py:55-88)."""
+    if base_class not in _REGISTRIES:
+        _REGISTRIES[base_class] = {}
+    registry = _REGISTRIES[base_class]
+
+    def register(klass, name=None):
+        assert issubclass(klass, base_class), \
+            "Can only register subclass of %s" % base_class.__name__
+        if name is None:
+            name = klass.__name__
+        name = name.lower()
+        registry[name] = klass
+        klass.__register_name__ = name
+        return klass
+
+    register.__name__ = 'register_%s' % nickname
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    register = get_register_func(base_class, nickname)
+
+    def alias(*aliases):
+        def reg(klass):
+            for name in aliases:
+                register(klass, name)
+            return klass
+        return reg
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Returns a creator: accepts an instance, a name, or 'name,k=v' spec
+    string (reference registry.py:119-158)."""
+    if base_class not in _REGISTRIES:
+        _REGISTRIES[base_class] = {}
+    registry = _REGISTRIES[base_class]
+
+    def create(*args, **kwargs):
+        if len(args) and isinstance(args[0], base_class):
+            return args[0]
+        if len(args) and isinstance(args[0], str):
+            name = args[0]
+            args = args[1:]
+        elif nickname in kwargs and isinstance(kwargs[nickname], str):
+            name = kwargs.pop(nickname)
+        else:
+            raise ValueError("%s is not valid" % nickname)
+        if ',' in name:
+            parts = name.split(',')
+            name = parts[0]
+            for kv in parts[1:]:
+                if not kv:
+                    continue
+                k, v = kv.split('=')
+                kwargs[k] = parse_attr_value(v)
+        name = name.lower()
+        if name not in registry:
+            raise ValueError("%s is not registered for %s" % (name, nickname))
+        return registry[name](*args, **kwargs)
+
+    create.__name__ = 'create_%s' % nickname
+    return create

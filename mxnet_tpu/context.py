@@ -1,0 +1,120 @@
+"""Device contexts.
+
+Mirrors the reference's python/mxnet/context.py:1-206 (`mx.cpu()`,
+`mx.gpu()`, `Context.default_ctx`), redesigned for TPU: the accelerator
+context is `tpu`, and `gpu` is kept as a compatibility alias so
+reference-era scripts run unchanged (BASELINE.json north star: "--gpus
+swapped for a TPU context list").  A Context resolves to a concrete
+`jax.Device`; computation placement is done with explicit device/sharding
+arguments rather than a thread-global device stack, which is the JAX way —
+`with ctx:` scoping is still provided for API parity.
+"""
+import threading
+
+
+class Context:
+    """A device context descriptor.
+
+    Parameters
+    ----------
+    device_type : {'cpu', 'tpu', 'gpu', 'cpu_pinned'}
+        'gpu' and 'cpu_pinned' are accepted for reference-script
+        compatibility; 'gpu' resolves to the accelerator backend
+        ('tpu' when present), 'cpu_pinned' to 'cpu'.
+    device_id : int
+    """
+    _default_ctx = threading.local()
+    devtype2str = {1: 'cpu', 2: 'gpu', 3: 'cpu_pinned', 4: 'tpu'}
+    devstr2type = {'cpu': 1, 'gpu': 2, 'cpu_pinned': 3, 'tpu': 4}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (isinstance(other, Context) and
+                self.device_typeid == other.device_typeid and
+                self.device_id == other.device_id)
+
+    def __str__(self):
+        return '%s(%d)' % (self.device_type, self.device_id)
+
+    def __repr__(self):
+        return self.__str__()
+
+    def __enter__(self):
+        self._old_ctx = getattr(Context._default_ctx, 'value', None)
+        Context._default_ctx.value = self
+        return self
+
+    def __exit__(self, *args):
+        Context._default_ctx.value = self._old_ctx
+
+    # -- JAX resolution ----------------------------------------------------
+    def jax_device(self):
+        """Resolve to a concrete jax.Device.
+
+        'tpu'/'gpu' pick from the default (accelerator) backend when one
+        exists, else fall back to CPU devices so accelerator-context code
+        runs in CPU test environments (the reference's cpu(0)/cpu(1)
+        multi-device-testing trick, tests/python/unittest/test_multi_device_exec.py).
+        """
+        import jax
+        dt = self.device_type
+        if dt in ('cpu', 'cpu_pinned'):
+            try:
+                devs = jax.devices('cpu')
+            except RuntimeError:
+                devs = jax.devices()
+        else:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+def cpu(device_id=0):
+    return Context('cpu', device_id)
+
+
+def tpu(device_id=0):
+    return Context('tpu', device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: accelerator context (TPU-backed)."""
+    return Context('gpu', device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context('cpu_pinned', device_id)
+
+
+def num_devices():
+    """Number of accelerator devices visible (reference: mx.context.num_gpus)."""
+    import jax
+    return len(jax.devices())
+
+
+num_gpus = num_devices
+
+
+def current_context():
+    ctx = getattr(Context._default_ctx, 'value', None)
+    if ctx is None:
+        ctx = Context('cpu', 0)
+        Context._default_ctx.value = ctx
+    return ctx
+
+
+Context.default_ctx = property(lambda self: current_context())

@@ -1,0 +1,12 @@
+"""Operator registry and implementations (see registry.py).
+
+Importing this package registers all operators, mirroring the reference's
+static registration of NNVM ops at library load
+(src/operator/*.cc NNVM_REGISTER_OP sites, SURVEY.md §2.3).
+"""
+from . import registry
+from . import tensor
+from . import nn
+from . import random_ops
+
+from .registry import get, exists, list_ops, register, OpDef, OpContext

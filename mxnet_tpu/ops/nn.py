@@ -1,0 +1,593 @@
+"""Neural-network layer operators.
+
+TPU-native re-implementation of the reference's src/operator/*.{cc,cu}
+layer zoo (convolution, batch_norm, pooling, activation, dropout, loss
+output ops… SURVEY.md §2.3).  Where the reference hand-picks cuDNN
+algorithms and manages per-op workspaces, here every layer is a pure JAX
+function: convs/matmuls lower to MXU ops via lax.conv_general_dilated /
+tensordot, and XLA fuses the elementwise epilogues (bias, activation,
+batch-norm scale) into them — the fusion the reference could only get
+from cuDNN fused paths.
+
+Loss ops (SoftmaxOutput & friends) replicate the reference's semantics of
+*ignoring the incoming head gradient* (softmax_output-inl.h backward is
+`softmax(x) - onehot(label)` regardless of out_grad) via jax.custom_vjp,
+so `Executor.backward()` with no head grads behaves exactly like the
+reference executor.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import (register, astuple, asbool, asint, asfloat,
+                       normalize_axis)
+from ..base import parse_attr_value
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected — reference src/operator/fully_connected-inl.h
+# ---------------------------------------------------------------------------
+
+def _fc_names(attrs):
+    if asbool(attrs.get('no_bias', False)):
+        return ['data', 'weight']
+    return ['data', 'weight', 'bias']
+
+
+def _fc_infer_shape(attrs, in_shapes):
+    num_hidden = asint(attrs['num_hidden'])
+    flatten = asbool(attrs.get('flatten', True))
+    if in_shapes[0] is not None and in_shapes[1] is None:
+        d = in_shapes[0]
+        in_dim = int(np.prod(d[1:])) if flatten else d[-1]
+        in_shapes[1] = (num_hidden, in_dim)
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        in_shapes[2] = (num_hidden,)
+    return in_shapes
+
+
+@register('FullyConnected', input_names=_fc_names,
+          infer_shape=_fc_infer_shape, hint='fullyconnected')
+def _fully_connected(attrs, data, weight, bias=None):
+    flatten = asbool(attrs.get('flatten', True))
+    if flatten:
+        x = data.reshape(data.shape[0], -1)
+    else:
+        x = data
+    out = jnp.tensordot(x, weight.T, axes=1)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Activation — reference src/operator/activation-inl.h
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    'relu': jax.nn.relu,
+    'sigmoid': jax.nn.sigmoid,
+    'tanh': jnp.tanh,
+    'softrelu': jax.nn.softplus,
+    'softsign': jax.nn.soft_sign,
+}
+
+
+@register('Activation', input_names=('data',), hint='activation')
+def _activation(attrs, data):
+    return _ACTS[str(parse_attr_value(attrs['act_type']))](data)
+
+
+@register('LeakyReLU', input_names=lambda attrs: (
+    ['data', 'gamma'] if str(parse_attr_value(attrs.get('act_type', 'leaky'))) == 'prelu'
+    else ['data']), hint='leakyrelu',
+    infer_shape=lambda attrs, s: (
+        s if len(s) < 2 or s[1] is not None or s[0] is None
+        else [s[0], (s[0][1],)]))
+def _leaky_relu(attrs, data, gamma=None):
+    act = str(parse_attr_value(attrs.get('act_type', 'leaky')))
+    slope = asfloat(attrs.get('slope', 0.25))
+    if act == 'prelu':
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act == 'elu':
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    # leaky / rrelu(test-mode uses mean slope)
+    if act == 'rrelu':
+        lo = asfloat(attrs.get('lower_bound', 0.125))
+        hi = asfloat(attrs.get('upper_bound', 0.334))
+        slope = (lo + hi) / 2.0
+    return jnp.where(data >= 0, data, slope * data)
+
+
+# ---------------------------------------------------------------------------
+# Softmax family — reference src/operator/tensor/nn/softmax.cc
+# ---------------------------------------------------------------------------
+
+@register('softmax', input_names=('data',))
+def _softmax(attrs, data):
+    axis = asint(attrs.get('axis', -1))
+    t = parse_attr_value(attrs.get('temperature', None))
+    x = data / t if t else data
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register('log_softmax', input_names=('data',))
+def _log_softmax(attrs, data):
+    axis = asint(attrs.get('axis', -1))
+    return jax.nn.log_softmax(data, axis=axis)
+
+
+@register('SoftmaxActivation', input_names=('data',), hint='softmaxactivation')
+def _softmax_activation(attrs, data):
+    mode = str(parse_attr_value(attrs.get('mode', 'instance')))
+    if mode == 'channel':
+        return jax.nn.softmax(data, axis=1)
+    flat = data.reshape(data.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(data.shape)
+
+
+# ---------------------------------------------------------------------------
+# Loss output ops — custom VJPs reproducing reference backward semantics
+# ---------------------------------------------------------------------------
+
+def _softmax_out_fwd_impl(params, data, label):
+    multi_output, preserve_shape = params[3], params[5]
+    if preserve_shape:
+        return jax.nn.softmax(data, axis=-1)
+    if multi_output or data.ndim > 2:
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _softmax_output_fn(params, data, label):
+    return _softmax_out_fwd_impl(params, data, label)
+
+
+def _softmax_output_bwd(params, res, g):
+    grad_scale, ignore_label, use_ignore, multi_output, normalization, preserve_shape = params
+    out, label = res
+    if preserve_shape or (not multi_output and out.ndim <= 2):
+        axis = out.ndim - 1
+    else:
+        axis = 1
+    k = out.shape[axis]
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, k, dtype=out.dtype)
+    onehot = jnp.moveaxis(onehot, -1, axis)
+    grad = out - onehot
+    valid = None
+    if use_ignore:
+        mask = (lab != int(ignore_label)).astype(out.dtype)
+        grad = grad * jnp.expand_dims(mask, axis)
+        valid = jnp.maximum(mask.sum(), 1.0)
+    grad = grad * grad_scale
+    if normalization == 'batch':
+        grad = grad / out.shape[0]
+    elif normalization == 'valid':
+        n = valid if valid is not None else float(np.prod(lab.shape))
+        grad = grad / n
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_output_fn.defvjp(
+    lambda params, data, label: (_softmax_out_fwd_impl(params, data, label),
+                                 (_softmax_out_fwd_impl(params, data, label), label)),
+    _softmax_output_bwd)
+
+
+@register('SoftmaxOutput', input_names=('data', 'label'),
+          aliases=('Softmax',), hint='softmaxoutput',
+          infer_shape=lambda attrs, s: (
+              s if s[0] is None or s[1] is not None
+              else [s[0], _softmax_label_shape(attrs, s[0])]))
+def _softmax_output(attrs, data, label):
+    params = (asfloat(attrs.get('grad_scale', 1.0)),
+              asfloat(attrs.get('ignore_label', -1.0)),
+              asbool(attrs.get('use_ignore', False)),
+              asbool(attrs.get('multi_output', False)),
+              str(parse_attr_value(attrs.get('normalization', 'null'))),
+              asbool(attrs.get('preserve_shape', False)))
+    return _softmax_output_fn(params, data, label)
+
+
+def _softmax_label_shape(attrs, dshape):
+    if asbool(attrs.get('multi_output', False)) or len(dshape) > 2:
+        return (dshape[0],) + tuple(dshape[2:])
+    return (dshape[0],)
+
+
+def _make_regression(name, fwd, grad):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+    def fn(grad_scale, data, label):
+        return fwd(data)
+
+    def fwd_rule(grad_scale, data, label):
+        out = fwd(data)
+        return out, (out, data, label)
+
+    def bwd_rule(grad_scale, res, g):
+        out, data, label = res
+        lab = label.reshape(out.shape)
+        # no batch normalization here — the optimizer's rescale_grad
+        # (1/batch) carries it, as in the reference convention
+        return (grad(out, data, lab) * grad_scale, jnp.zeros_like(label))
+
+    fn.defvjp(fwd_rule, bwd_rule)
+
+    @register(name, input_names=('data', 'label'), hint=name.lower(),
+              infer_shape=lambda attrs, s: (
+                  s if s[0] is None or s[1] is not None else [s[0], s[0]]))
+    def op(attrs, data, label):
+        return fn(asfloat(attrs.get('grad_scale', 1.0)), data, label)
+    return op
+
+
+# Reference src/operator/regression_output-inl.h: backward ignores head
+# grads; grad = f(out) - label (linear/logistic), sign(out - label) (MAE).
+_make_regression('LinearRegressionOutput', lambda x: x,
+                 lambda out, data, lab: out - lab)
+_make_regression('LogisticRegressionOutput', jax.nn.sigmoid,
+                 lambda out, data, lab: out - lab)
+_make_regression('MAERegressionOutput', lambda x: x,
+                 lambda out, data, lab: jnp.sign(out - lab))
+
+
+@register('softmax_cross_entropy', input_names=('data', 'label'))
+def _softmax_cross_entropy(attrs, data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    lab = label.astype(jnp.int32)
+    nll = -jnp.take_along_axis(logp, lab[:, None], axis=-1)
+    return nll.sum().reshape((1,))
+
+
+# ---------------------------------------------------------------------------
+# Convolution — reference src/operator/convolution-inl.h (+cudnn autotune);
+# here a single lax.conv_general_dilated that XLA tiles onto the MXU.
+# ---------------------------------------------------------------------------
+
+def _conv_names(attrs):
+    if asbool(attrs.get('no_bias', False)):
+        return ['data', 'weight']
+    return ['data', 'weight', 'bias']
+
+
+def _conv_infer_shape(attrs, in_shapes):
+    kernel = astuple(attrs['kernel'])
+    num_filter = asint(attrs['num_filter'])
+    num_group = asint(attrs.get('num_group', 1))
+    if in_shapes[0] is not None and in_shapes[1] is None:
+        c = in_shapes[0][1]
+        in_shapes[1] = (num_filter, c // num_group) + kernel
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        in_shapes[2] = (num_filter,)
+    return in_shapes
+
+
+_CONV_DN = {1: ('NCW', 'OIW', 'NCW'),
+            2: ('NCHW', 'OIHW', 'NCHW'),
+            3: ('NCDHW', 'OIDHW', 'NCDHW')}
+
+
+@register('Convolution', input_names=_conv_names,
+          infer_shape=_conv_infer_shape, hint='convolution')
+def _convolution(attrs, data, weight, bias=None):
+    kernel = astuple(attrs['kernel'])
+    nd = len(kernel)
+    stride = astuple(attrs.get('stride', (1,) * nd), nd)
+    dilate = astuple(attrs.get('dilate', (1,) * nd), nd)
+    pad = astuple(attrs.get('pad', (0,) * nd), nd)
+    num_group = asint(attrs.get('num_group', 1))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=_CONV_DN[nd],
+        feature_group_count=num_group)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _deconv_infer_shape(attrs, in_shapes):
+    kernel = astuple(attrs['kernel'])
+    num_filter = asint(attrs['num_filter'])
+    num_group = asint(attrs.get('num_group', 1))
+    if in_shapes[0] is not None and in_shapes[1] is None:
+        c = in_shapes[0][1]
+        in_shapes[1] = (c, num_filter // num_group) + kernel
+    if len(in_shapes) > 2 and in_shapes[2] is None:
+        in_shapes[2] = (num_filter,)
+    return in_shapes
+
+
+@register('Deconvolution', input_names=_conv_names,
+          infer_shape=_deconv_infer_shape, hint='deconvolution')
+def _deconvolution(attrs, data, weight, bias=None):
+    """Transposed convolution (reference src/operator/deconvolution-inl.h).
+    Weight layout (C_in, num_filter//group, *kernel); output size
+    (i-1)*s + k - 2p + adj."""
+    kernel = astuple(attrs['kernel'])
+    nd = len(kernel)
+    stride = astuple(attrs.get('stride', (1,) * nd), nd)
+    pad = astuple(attrs.get('pad', (0,) * nd), nd)
+    adj = astuple(attrs.get('adj', (0,) * nd), nd)
+    num_group = asint(attrs.get('num_group', 1))
+    ci = weight.shape[0]
+    # (I, O/g, *k) -> grouped (O, I/g, *k) with spatial flip
+    w = weight.reshape((num_group, ci // num_group) + weight.shape[1:])
+    w = jnp.swapaxes(w, 1, 2)  # (g, O/g, I/g, *k)
+    w = w.reshape((-1,) + w.shape[2:])  # (O, I/g, *k)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    padding = [(k - 1 - p, k - 1 - p + a)
+               for k, p, a in zip(kernel, pad, adj)]
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, dimension_numbers=_CONV_DN[nd],
+        feature_group_count=num_group)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling — reference src/operator/pooling-inl.h via lax.reduce_window
+# ---------------------------------------------------------------------------
+
+@register('Pooling', input_names=('data',), hint='pooling',
+          aliases=('Pooling_v1',))
+def _pooling(attrs, data):
+    pool_type = str(parse_attr_value(attrs.get('pool_type', 'max')))
+    global_pool = asbool(attrs.get('global_pool', False))
+    nspatial = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == 'max':
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == 'sum':
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = astuple(attrs['kernel'])
+    stride = astuple(attrs.get('stride', (1,) * nspatial), nspatial)
+    pad = astuple(attrs.get('pad', (0,) * nspatial), nspatial)
+    convention = str(parse_attr_value(attrs.get('pooling_convention', 'valid')))
+    pads = []
+    for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
+        size = data.shape[2 + i]
+        if convention == 'full':
+            out = int(np.ceil((size + 2 * p - k) / s)) + 1
+        else:
+            out = (size + 2 * p - k) // s + 1
+        hi = max((out - 1) * s + k - size - p, p)
+        pads.append((p, hi))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padcfg = ((0, 0), (0, 0)) + tuple(pads)
+    if pool_type == 'max':
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, jnp.asarray(init, data.dtype),
+                                 lax.max, window, strides, padcfg)
+    out = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
+                            window, strides, padcfg)
+    if pool_type == 'avg':
+        # cuDNN COUNT_INCLUDE_PADDING semantics (reference default)
+        out = out / float(np.prod(kernel))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm — reference src/operator/batch_norm-inl.h (aux moving stats)
+# ---------------------------------------------------------------------------
+
+def _bn_infer_shape(attrs, in_shapes):
+    if in_shapes[0] is not None:
+        axis = normalize_axis(attrs.get('axis', 1), len(in_shapes[0]))
+        c = (in_shapes[0][axis],)
+        for i in range(1, len(in_shapes)):
+            if in_shapes[i] is None:
+                in_shapes[i] = c
+    return in_shapes
+
+
+def _bn_compute(attrs, inputs, auxs, op_ctx):
+    data, gamma, beta = inputs
+    moving_mean, moving_var = auxs
+    eps = asfloat(attrs.get('eps', 1e-3))
+    momentum = asfloat(attrs.get('momentum', 0.9))
+    fix_gamma = asbool(attrs.get('fix_gamma', True))
+    use_global = asbool(attrs.get('use_global_stats', False))
+    output_mean_var = asbool(attrs.get('output_mean_var', False))
+    axis = normalize_axis(attrs.get('axis', 1), data.ndim)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    bshape = tuple(shape)
+    if fix_gamma:
+        gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    if op_ctx.is_train and not use_global:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        smean, svar = lax.stop_gradient(mean), lax.stop_gradient(var)
+        new_mean = moving_mean * momentum + smean * (1 - momentum)
+        new_var = moving_var * momentum + svar * (1 - momentum)
+        out = (data - mean.reshape(bshape)) * lax.rsqrt(
+            var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+        outs = [out, mean, var] if output_mean_var else [out]
+        return outs, [new_mean, new_var]
+    out = (data - moving_mean.reshape(bshape)) * lax.rsqrt(
+        moving_var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+    outs = [out, moving_mean, moving_var] if output_mean_var else [out]
+    return outs, [moving_mean, moving_var]
+
+
+register('BatchNorm', input_names=('data', 'gamma', 'beta',
+                                   'moving_mean', 'moving_var'),
+         num_aux=2, mutable_aux=True, mode_dependent=True,
+         infer_shape=_bn_infer_shape, hint='batchnorm',
+         num_outputs=lambda attrs: 3 if asbool(attrs.get('output_mean_var', False)) else 1,
+         output_names=lambda attrs: (['output', 'mean', 'var']
+                                     if asbool(attrs.get('output_mean_var', False))
+                                     else ['output']),
+         aliases=('BatchNorm_v1',), simple=False)(_bn_compute)
+
+
+def _in_infer_shape(attrs, in_shapes):
+    if in_shapes[0] is not None:
+        c = (in_shapes[0][1],)
+        for i in (1, 2):
+            if in_shapes[i] is None:
+                in_shapes[i] = c
+    return in_shapes
+
+
+@register('InstanceNorm', input_names=('data', 'gamma', 'beta'),
+          infer_shape=_in_infer_shape, hint='instancenorm')
+def _instance_norm(attrs, data, gamma, beta):
+    eps = asfloat(attrs.get('eps', 1e-3))
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return ((data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape)
+            + beta.reshape(bshape))
+
+
+@register('L2Normalization', input_names=('data',), hint='l2normalization')
+def _l2_normalization(attrs, data):
+    eps = asfloat(attrs.get('eps', 1e-10))
+    mode = str(parse_attr_value(attrs.get('mode', 'instance')))
+    if mode == 'instance':
+        red = tuple(range(1, data.ndim))
+    elif mode == 'channel':
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / norm
+
+
+@register('LRN', input_names=('data',), hint='lrn')
+def _lrn(attrs, data):
+    """Local response norm across channels
+    (reference src/operator/lrn-inl.h)."""
+    nsize = asint(attrs['nsize'])
+    alpha = asfloat(attrs.get('alpha', 1e-4))
+    beta = asfloat(attrs.get('beta', 0.75))
+    knorm = asfloat(attrs.get('knorm', 2.0))
+    sq = jnp.square(data)
+    half = nsize // 2
+    acc = lax.reduce_window(sq, jnp.asarray(0, data.dtype), lax.add,
+                            (1, nsize, 1, 1), (1, 1, 1, 1),
+                            ((0, 0), (half, half), (0, 0), (0, 0)))
+    return data / jnp.power(knorm + alpha / nsize * acc, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout — reference src/operator/dropout-inl.h
+# ---------------------------------------------------------------------------
+
+def _dropout_compute(attrs, inputs, auxs, op_ctx):
+    data, = inputs
+    p = asfloat(attrs.get('p', 0.5))
+    mode = str(parse_attr_value(attrs.get('mode', 'training')))
+    if (op_ctx.is_train or mode == 'always') and p > 0:
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(op_ctx.rng, keep, data.shape)
+        return [jnp.where(mask, data / keep, jnp.zeros_like(data))], []
+    return [data], []
+
+
+register('Dropout', input_names=('data',), needs_rng=True,
+         mode_dependent=True, hint='dropout', simple=False)(_dropout_compute)
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops — reference src/operator/sequence_{last,mask,reverse}-inl.h
+# Layout (max_sequence_length, batch, ...)
+# ---------------------------------------------------------------------------
+
+def _seq_names(attrs):
+    if asbool(attrs.get('use_sequence_length', False)):
+        return ['data', 'sequence_length']
+    return ['data']
+
+
+@register('SequenceLast', input_names=_seq_names, hint='sequencelast')
+def _sequence_last(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    batch = jnp.arange(data.shape[1])
+    return data[idx, batch]
+
+
+@register('SequenceMask', input_names=_seq_names, hint='sequencemask')
+def _sequence_mask(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return data
+    value = asfloat(attrs.get('value', 0.0))
+    steps = jnp.arange(data.shape[0])
+    mask = steps[:, None] < sequence_length.astype(jnp.int32)[None, :]
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register('SequenceReverse', input_names=_seq_names, hint='sequencereverse')
+def _sequence_reverse(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps[:, None] < lens, lens - 1 - steps[:, None],
+                    steps[:, None])
+    batch = jnp.arange(data.shape[1])[None, :]
+    return data[src, batch]
+
+
+# ---------------------------------------------------------------------------
+# UpSampling — reference src/operator/upsampling-inl.h (nearest)
+# ---------------------------------------------------------------------------
+
+@register('UpSampling', input_names=lambda attrs: (
+    ['arg%d' % i for i in range(asint(attrs.get('num_args', 1)))]
+    if str(parse_attr_value(attrs.get('sample_type', 'nearest'))) == 'nearest'
+    else ['data', 'weight']), hint='upsampling')
+def _upsampling(attrs, *args):
+    scale = asint(attrs['scale'])
+    sample_type = str(parse_attr_value(attrs.get('sample_type', 'nearest')))
+    if sample_type == 'nearest':
+        outs = []
+        for data in args:
+            x = jnp.repeat(data, scale, axis=2)
+            x = jnp.repeat(x, scale, axis=3)
+            outs.append(x)
+        if len(outs) == 1:
+            return outs[0]
+        return jnp.concatenate(outs, axis=1)
+    data = args[0]
+    n, c, h, w = data.shape
+    return jax.image.resize(data, (n, c, h * scale, w * scale),
+                            method='bilinear')
+
+
+@register('Crop', input_names=lambda attrs: (
+    ['data', 'crop_like'] if asint(attrs.get('num_args', 1)) > 1 else ['data']),
+    hint='crop')
+def _crop(attrs, data, crop_like=None):
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = astuple(attrs['h_w'], 2)
+    center = asbool(attrs.get('center_crop', False))
+    if center:
+        oh = (data.shape[2] - th) // 2
+        ow = (data.shape[3] - tw) // 2
+    else:
+        offset = astuple(attrs.get('offset', (0, 0)), 2)
+        oh, ow = offset
+    return data[:, :, oh:oh + th, ow:ow + tw]

@@ -1,0 +1,115 @@
+"""Random sampling operators.
+
+Reference: src/operator/random/sample_op.{cc,cu} (SURVEY.md §2.3) — CUDA
+curand kernels behind `mx.nd.random_*`.  Here each sampler is a pure
+function of an explicit PRNG key (JAX counter-based RNG), so samplers
+participate in XLA fusion and are reproducible under jit; the key is
+supplied by the executor / global state (random.py).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, astuple, asfloat
+from ..base import parse_attr_value
+
+
+def _shape_dtype(attrs):
+    shape = attrs.get('shape', ())
+    shape = astuple(shape) if shape not in (None, '') else ()
+    d = attrs.get('dtype', None) or np.float32
+    return shape, np.dtype(d)
+
+
+def _reg_sampler(name, draw, aliases=()):
+    def compute(attrs, inputs, auxs, op_ctx, _draw=draw):
+        shape, dtype = _shape_dtype(attrs)
+        return [_draw(attrs, op_ctx.rng, shape, dtype)], []
+    register(name, input_names=(), needs_rng=True, aliases=aliases,
+             hint=name.lstrip('_'), simple=False)(compute)
+
+
+_reg_sampler('_random_uniform',
+             lambda attrs, key, shape, dtype: jax.random.uniform(
+                 key, shape, dtype=dtype,
+                 minval=asfloat(attrs.get('low', 0.0)),
+                 maxval=asfloat(attrs.get('high', 1.0))),
+             aliases=('uniform', 'random_uniform'))
+
+_reg_sampler('_random_normal',
+             lambda attrs, key, shape, dtype: (
+                 jax.random.normal(key, shape, dtype=dtype)
+                 * asfloat(attrs.get('scale', 1.0))
+                 + asfloat(attrs.get('loc', 0.0))),
+             aliases=('normal', 'random_normal'))
+
+_reg_sampler('_random_gamma',
+             lambda attrs, key, shape, dtype: (
+                 jax.random.gamma(key, asfloat(attrs.get('alpha', 1.0)),
+                                  shape, dtype=dtype)
+                 * asfloat(attrs.get('beta', 1.0))),
+             aliases=('random_gamma',))
+
+_reg_sampler('_random_exponential',
+             lambda attrs, key, shape, dtype: (
+                 jax.random.exponential(key, shape, dtype=dtype)
+                 / asfloat(attrs.get('lam', 1.0))),
+             aliases=('random_exponential', 'exponential'))
+
+_reg_sampler('_random_poisson',
+             lambda attrs, key, shape, dtype: jax.random.poisson(
+                 key, asfloat(attrs.get('lam', 1.0)), shape).astype(dtype),
+             aliases=('random_poisson', 'poisson'))
+
+
+def _neg_binomial(attrs, key, shape, dtype):
+    k = asfloat(attrs.get('k', 1.0))
+    p = asfloat(attrs.get('p', 1.0))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * (1.0 - p) / p
+    return jax.random.poisson(kp, lam, shape).astype(dtype)
+
+
+_reg_sampler('_random_negative_binomial', _neg_binomial,
+             aliases=('random_negative_binomial', 'negative_binomial'))
+
+
+def _gen_neg_binomial(attrs, key, shape, dtype):
+    mu = asfloat(attrs.get('mu', 1.0))
+    alpha = asfloat(attrs.get('alpha', 1.0))
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(kg, r, shape) * (mu * alpha)
+    return jax.random.poisson(kp, lam, shape).astype(dtype)
+
+
+_reg_sampler('_random_generalized_negative_binomial', _gen_neg_binomial,
+             aliases=('random_generalized_negative_binomial',
+                      'generalized_negative_binomial'))
+
+
+def _multinomial_compute(attrs, inputs, auxs, op_ctx):
+    data, = inputs
+    shape = attrs.get('shape', 1)
+    n = int(np.prod(astuple(shape))) if shape not in (None, '') else 1
+    get_prob = parse_attr_value(attrs.get('get_prob', False))
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    out = jax.random.categorical(op_ctx.rng, logits, axis=-1,
+                                 shape=(n,) + data.shape[:-1])
+    out = jnp.moveaxis(out, 0, -1)
+    if data.ndim == 1:
+        out = out.reshape((n,)) if n > 1 else out.reshape(())
+    out = out.astype(np.dtype(attrs.get('dtype', None) or np.int32))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32), axis=-1)
+        return [out, lp.reshape(out.shape)], []
+    return [out], []
+
+
+register('_sample_multinomial', input_names=('data',), needs_rng=True,
+         num_outputs=lambda attrs: 2 if parse_attr_value(
+             attrs.get('get_prob', False)) else 1,
+         aliases=('sample_multinomial', 'multinomial'),
+         simple=False)(_multinomial_compute)

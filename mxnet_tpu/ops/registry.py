@@ -1,0 +1,235 @@
+"""Operator registry — the single source of truth for all ops.
+
+TPU-native redesign of the reference's NNVM op registry
+(/root/reference include/mxnet/op_attr_types.h:224 FCompute,
+src/operator/ registration sites; SURVEY.md §2.3).  Instead of per-op
+CUDA kernels dispatched through a dependency engine, every op here is a
+pure JAX function over `jax.Array`s.  The registry drives:
+
+  * imperative `nd.<op>` wrappers (codegen like python/mxnet/ndarray.py:2624)
+  * symbolic `sym.<op>` node constructors (python/mxnet/symbol.py:2352)
+  * shape/type inference (nnvm InferShape/InferType passes)
+  * autograd (jax.vjp through the same compute functions; loss ops carry
+    custom VJPs reproducing MXNet head-grad-ignoring semantics)
+
+Because compute is pure JAX, the whole graph lowers to one XLA module —
+memory planning, kernel fusion and async scheduling are XLA's job
+(replacing PlanMemory / ThreadedEngine / mshadow in the reference).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class OpContext:
+    """Per-invocation execution context: train/test mode and PRNG key."""
+    __slots__ = ('is_train', 'rng')
+
+    def __init__(self, is_train=False, rng=None):
+        self.is_train = is_train
+        self.rng = rng
+
+
+class OpDef:
+    """A registered operator.
+
+    Canonical compute signature:
+        fcompute(attrs, inputs, auxs, op_ctx) -> (outputs, new_auxs)
+    where inputs/auxs/outputs are lists of jax arrays; attrs is a dict of
+    parsed python values.
+
+    infer_shape(attrs, in_shapes) -> completed in_shapes (list, None where
+    still unknown).  Forward output-shape inference is generic via
+    jax.eval_shape; per-op infer_shape only needs to back-fill parameter
+    shapes (the reference's bidirectional InferShape, e.g. FullyConnected
+    inferring weight=(num_hidden, D)).
+    """
+
+    def __init__(self, name, fcompute, input_names=('data',), num_aux=0,
+                 num_outputs=1, output_names=None, infer_shape=None,
+                 infer_dtype=None, needs_rng=False, mode_dependent=False,
+                 mutable_aux=False, hint=None):
+        self.name = name
+        self.fcompute = fcompute
+        self._input_names = input_names
+        self.num_aux = num_aux
+        self._num_outputs = num_outputs
+        self._output_names = output_names
+        self.infer_shape_fn = infer_shape
+        self.infer_dtype_fn = infer_dtype
+        self.needs_rng = needs_rng
+        self.mode_dependent = mode_dependent
+        self.mutable_aux = mutable_aux
+        self.hint = hint or name.lstrip('_').lower()
+
+    # -- metadata ----------------------------------------------------------
+    def input_names(self, attrs):
+        names = self._input_names
+        if callable(names):
+            names = names(attrs)
+        return list(names)
+
+    def arg_names(self, attrs):
+        """Non-aux input names."""
+        names = self.input_names(attrs)
+        if self.num_aux:
+            return names[:-self.num_aux]
+        return names
+
+    def aux_names(self, attrs):
+        names = self.input_names(attrs)
+        if self.num_aux:
+            return names[-self.num_aux:]
+        return []
+
+    def num_outputs(self, attrs):
+        n = self._num_outputs
+        if callable(n):
+            n = n(attrs)
+        return n
+
+    def output_names(self, attrs):
+        if self._output_names is None:
+            n = self.num_outputs(attrs)
+            if n == 1:
+                return ['output']
+            return ['output%d' % i for i in range(n)]
+        names = self._output_names
+        if callable(names):
+            names = names(attrs)
+        return list(names)
+
+    # -- compute -----------------------------------------------------------
+    def apply(self, attrs, inputs, auxs, op_ctx):
+        outs, new_auxs = self.fcompute(attrs, list(inputs), list(auxs), op_ctx)
+        return list(outs), list(new_auxs)
+
+    # -- inference ---------------------------------------------------------
+    def infer_shape(self, attrs, in_shapes, in_dtypes=None):
+        """Returns (completed_in_shapes, out_shapes). Unknown shapes are
+        None; raises if forward inference is impossible with what's known."""
+        in_shapes = list(in_shapes)
+        if self.infer_shape_fn is not None:
+            in_shapes = self.infer_shape_fn(attrs, in_shapes)
+        if any(s is None for s in in_shapes):
+            return in_shapes, None
+        n_arg = len(in_shapes) - self.num_aux
+        if in_dtypes is None:
+            in_dtypes = [np.float32] * len(in_shapes)
+        args = [jax.ShapeDtypeStruct(tuple(s), dt)
+                for s, dt in zip(in_shapes[:n_arg], in_dtypes[:n_arg])]
+        auxs = [jax.ShapeDtypeStruct(tuple(s), dt)
+                for s, dt in zip(in_shapes[n_arg:], in_dtypes[n_arg:])]
+        ctx = OpContext(is_train=False,
+                        rng=jax.ShapeDtypeStruct((2,), np.uint32) if self.needs_rng else None)
+        outs, _ = jax.eval_shape(
+            lambda a, x: self.apply(attrs, x, a, ctx), auxs, args)
+        return in_shapes, [tuple(o.shape) for o in outs]
+
+    def infer_dtype(self, attrs, in_dtypes):
+        in_dtypes = list(in_dtypes)
+        if self.infer_dtype_fn is not None:
+            return self.infer_dtype_fn(attrs, in_dtypes)
+        known = [d for d in in_dtypes if d is not None]
+        d = np.dtype(known[0]) if known else np.dtype(np.float32)
+        in_dtypes = [d if x is None else x for x in in_dtypes]
+        return in_dtypes, [d] * self.num_outputs(attrs)
+
+
+_OP_REGISTRY = {}
+_OP_ALIASES = {}
+
+
+def register(name, input_names=('data',), num_aux=0, num_outputs=1,
+             output_names=None, infer_shape=None, infer_dtype=None,
+             needs_rng=False, mode_dependent=False, mutable_aux=False,
+             aliases=(), hint=None, simple=True):
+    """Decorator registering an op.
+
+    With simple=True (default) the decorated function has signature
+    `fn(attrs, *inputs) -> out | tuple(outs)` and is adapted to the
+    canonical form.  With simple=False the function must use the canonical
+    signature `fn(attrs, inputs, auxs, op_ctx) -> (outs, new_auxs)`.
+    """
+    def do_register(fn):
+        if simple:
+            inner = fn
+
+            @functools.wraps(fn)
+            def fcompute(attrs, inputs, auxs, op_ctx):
+                out = inner(attrs, *inputs)
+                if not isinstance(out, (tuple, list)):
+                    out = (out,)
+                return list(out), []
+        else:
+            fcompute = fn
+        op = OpDef(name, fcompute, input_names=input_names, num_aux=num_aux,
+                   num_outputs=num_outputs, output_names=output_names,
+                   infer_shape=infer_shape, infer_dtype=infer_dtype,
+                   needs_rng=needs_rng, mode_dependent=mode_dependent,
+                   mutable_aux=mutable_aux, hint=hint)
+        _OP_REGISTRY[name] = op
+        for alias in aliases:
+            _OP_ALIASES[alias] = name
+        fn.op = op
+        return fn
+    return do_register
+
+
+def get(name):
+    if name in _OP_REGISTRY:
+        return _OP_REGISTRY[name]
+    if name in _OP_ALIASES:
+        return _OP_REGISTRY[_OP_ALIASES[name]]
+    raise KeyError('Operator %s is not registered' % name)
+
+
+def exists(name):
+    return name in _OP_REGISTRY or name in _OP_ALIASES
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY.keys()) + sorted(_OP_ALIASES.keys())
+
+
+# ---------------------------------------------------------------------------
+# Shared helpers for op implementations
+# ---------------------------------------------------------------------------
+
+def astuple(v, n=None):
+    """Parse kernel/stride/pad style attrs: accepts int, tuple, or
+    '(1, 2)' string (the reference parses these via dmlc::Parameter
+    TShape fields)."""
+    from ..base import parse_attr_value
+    v = parse_attr_value(v)
+    if isinstance(v, (int, float)):
+        v = (int(v),) * (n or 1)
+    v = tuple(int(x) for x in v)
+    if n is not None and len(v) == 1:
+        v = v * n
+    return v
+
+
+def asbool(v):
+    from ..base import parse_attr_value
+    v = parse_attr_value(v)
+    if isinstance(v, str):
+        return v.lower() in ('true', '1')
+    return bool(v)
+
+
+def asint(v):
+    from ..base import parse_attr_value
+    return int(parse_attr_value(v))
+
+
+def asfloat(v):
+    from ..base import parse_attr_value
+    return float(parse_attr_value(v))
+
+
+def normalize_axis(axis, ndim):
+    axis = asint(axis)
+    return axis + ndim if axis < 0 else axis

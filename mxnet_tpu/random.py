@@ -1,0 +1,38 @@
+"""Global PRNG state.
+
+The reference threads per-device mshadow PRNG streams through the
+ResourceManager (src/resource.cc kRandom, SURVEY.md §2.1) and seeds them
+via `mx.random.seed` (c_api MXRandomSeed).  The TPU-native design uses
+JAX's functional counter-based PRNG: a single root key advanced by
+splitting.  Imperative ops draw fresh subkeys from this module; compiled
+executors fold a per-step key into the XLA module so random ops
+(Dropout, samplers) are reproducible and fusion-friendly.
+"""
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, 'key'):
+        _state.key = jax.random.PRNGKey(0)
+    return _state.key
+
+
+def seed(seed_state):
+    """Seed the global PRNG (reference python/mxnet/random.py seed)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Draw a fresh subkey, advancing the global state."""
+    key, sub = jax.random.split(_get())
+    _state.key = key
+    return sub
+
+
+# Convenience samplers (populated by ndarray codegen import in __init__):
+# uniform, normal, gamma, exponential, poisson, negative_binomial,
+# generalized_negative_binomial, multinomial — see ndarray.py.

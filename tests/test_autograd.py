@@ -1,0 +1,124 @@
+"""Imperative autograd tests (model: reference
+tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_grad():
+    x = nd.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2
+        z = y.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp([0.5, 1.0]),
+                               rtol=1e-5)
+
+
+def test_grad_accumulation_two_uses():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2 * 2 + 3])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20, 200])
+
+
+def test_is_training_flags():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_dropout_respects_mode():
+    x = nd.ones((1000,))
+    out_predict = nd.Dropout(x, p=0.5)
+    np.testing.assert_allclose(out_predict.asnumpy(), x.asnumpy())
+    with autograd.record():
+        out_train = nd.Dropout(x, p=0.5)
+    frac = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+
+
+def test_mark_variables_grad_fn():
+    x = nd.array([3.0])
+    w = nd.array([4.0])
+    autograd.mark_variables([x, w], [nd.zeros((1,)), nd.zeros((1,))])
+    with autograd.record():
+        y = x * w
+    autograd.backward([y])
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+    np.testing.assert_allclose(w.grad.asnumpy(), [3.0])
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    g = autograd.grad([(nd.exp(x)).sum()], [x])  # not recorded -> zeros/None
+    x2 = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = nd.tanh(x2)
+    gs = autograd.grad([y], [x2])
+    np.testing.assert_allclose(gs[0].asnumpy(), 1 - np.tanh([1.0, 2.0]) ** 2,
+                               rtol=1e-5)
+
+
+def test_softmax_output_backward_semantics():
+    # SoftmaxOutput backward = softmax - onehot(label), ignoring head grads
+    data = nd.array([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    label = nd.array([2.0, 0.0])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward()
+    sm = np.exp(data.asnumpy()) / np.exp(data.asnumpy()).sum(1, keepdims=True)
+    expect = sm.copy()
+    expect[0, 2] -= 1
+    expect[1, 0] -= 1
+    np.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            import mxnet_tpu.ndarray as ndm
+            y = 1 / (1 + ndm.exp(-x))
+            self._saved = y
+            return y
+
+        def backward(self, dy):
+            y = self._saved
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-np.array([0.0, 1.0])))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
