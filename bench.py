@@ -1,0 +1,95 @@
+"""Headline benchmark: ResNet-50 training throughput on one TPU chip.
+
+Baseline (BASELINE.md): reference MXNet trains ResNet-50 at 109 img/s on
+1x K80 (batch 32).  Here the whole fwd+bwd step is one XLA module and
+the SGD update a second (fused, donated), so per-step host work is two
+dispatches regardless of graph size.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env knobs: BENCH_BATCH (default tries 256,128,64), BENCH_STEPS,
+BENCH_MODEL (default resnet-50 / num_layers).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def run(batch, steps, warmup, num_layers=50):
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    ctx = mx.tpu() if any(d.platform != 'cpu' for d in jax.devices()) \
+        else mx.cpu()
+    sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers)
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 3, 224, 224))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
+    mod.init_params(initializer=mx.init.Xavier(rnd_type='gaussian',
+                                               factor_type='in',
+                                               magnitude=2))
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9, 'wd': 1e-4})
+    rng = np.random.RandomState(0)
+    data = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32),
+                       ctx=ctx)
+    label = mx.nd.array((rng.rand(batch) * 1000).astype(np.float32),
+                        ctx=ctx)
+    db = mx.io.DataBatch(data=[data], label=[label])
+
+    def step():
+        mod.forward_backward(db)
+        mod.update()
+
+    for _ in range(warmup):
+        step()
+    _block(mod)
+    tic = time.time()
+    for _ in range(steps):
+        step()
+    _block(mod)
+    dt = time.time() - tic
+    return batch * steps / dt
+
+
+def _block(mod):
+    import jax
+    w = mod._exec_group.executor.arg_dict['fc1_weight']
+    jax.block_until_ready(w._data)
+
+
+def main():
+    batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \
+        else [256, 128, 64]
+    steps = int(os.environ.get('BENCH_STEPS', 20))
+    warmup = int(os.environ.get('BENCH_WARMUP', 3))
+    best = None
+    err = None
+    for b in batches:
+        try:
+            ips = run(b, steps, warmup)
+            if best is None or ips > best:
+                best = ips
+            break  # largest fitting batch wins
+        except Exception as e:  # OOM at this batch -> try smaller
+            err = e
+            if 'RESOURCE_EXHAUSTED' not in str(e) and \
+                    'Out of memory' not in str(e):
+                raise
+    if best is None:
+        raise err
+    baseline = 109.0  # ResNet-50, 1x K80, BASELINE.md
+    print(json.dumps({
+        'metric': 'resnet50_train_throughput_1chip',
+        'value': round(best, 2),
+        'unit': 'images/sec',
+        'vs_baseline': round(best / baseline, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

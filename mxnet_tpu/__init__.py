@@ -19,3 +19,24 @@ from . import ndarray as nd
 from . import random
 from . import random as rnd
 from . import autograd
+from . import attribute
+from .attribute import AttrScope
+from . import symbol
+from . import symbol as sym
+from . import executor
+from .executor import Executor
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import io
+from . import callback
+from . import kvstore
+from . import model
+from . import module
+from . import module as mod
+from .module import Module
+from . import parallel
+from .io import DataBatch, DataIter, NDArrayIter, DataDesc

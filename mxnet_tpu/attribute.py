@@ -1,0 +1,40 @@
+"""AttrScope: scoped attributes attached to symbols at construction.
+
+Reference: python/mxnet/attribute.py (used for ctx_group model
+parallelism — SURVEY.md §2.4 strategy #4).  In the TPU build, ctx_group
+attrs map to sharding annotations instead of PlaceDevice copies.
+"""
+import threading
+
+
+class AttrScope:
+    _current = threading.local()
+
+    def __init__(self, **kwargs):
+        self._attr = {k: str(v) for k, v in kwargs.items()}
+        self._old = None
+
+    def get(self, attr):
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        self._old = getattr(AttrScope._current, 'value', None)
+        merged = dict(self._old._attr) if self._old else {}
+        merged.update(self._attr)
+        self._attr = merged
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, *args):
+        AttrScope._current.value = self._old
+
+
+def current():
+    scope = getattr(AttrScope._current, 'value', None)
+    if scope is None:
+        scope = AttrScope()
+        AttrScope._current.value = scope
+    return scope

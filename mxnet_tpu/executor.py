@@ -1,0 +1,362 @@
+"""Executor: compiled execution of a Symbol.
+
+TPU-native replacement for the reference GraphExecutor
+(src/executor/graph_executor.cc; SURVEY.md §3.2).  `bind` lowers the
+whole symbol DAG into one pure JAX function and compiles it with
+jax.jit: the reference's Gradient pass becomes jax.vjp over that
+function, PlanMemory/InitCachedOps/InitOpSegs collapse into XLA buffer
+assignment and fusion, and the per-node engine push loop (RunOps,
+graph_executor.cc:1236) disappears — one XLA execution per
+forward/backward instead of O(#nodes) kernel dispatches.
+
+Semantics kept from the reference:
+  * arg/grad/aux NDArray dictionaries owned by the executor
+  * grad_req write/add/null per argument
+  * aux states (BatchNorm moving stats) updated by train-mode forward
+  * backward() with no head grads relies on loss ops' internal gradients
+    (custom VJPs — see ops/nn.py)
+"""
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from . import random as _random
+from .base import MXNetError
+from .ops.registry import OpContext
+
+
+class Executor:
+    def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict,
+                 grad_req_dict):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_dict = arg_dict        # OrderedDict name -> NDArray
+        self.grad_dict = grad_dict      # name -> NDArray (or absent)
+        self.aux_dict = aux_dict        # OrderedDict name -> NDArray
+        self._grad_req = grad_req_dict  # name -> 'write'|'add'|'null'
+        self._arg_names = list(arg_dict.keys())
+        self._aux_names = list(aux_dict.keys())
+        self._diff_names = [n for n in self._arg_names
+                            if grad_req_dict.get(n, 'null') != 'null']
+        self.outputs = []
+        self._key = _random.next_key()
+        self._monitor_callback = None
+        self._build()
+
+    # ------------------------------------------------------------------
+    def _build(self):
+        sym = self._symbol
+        topo = sym._topo()
+        node_index = {id(n): i for i, n in enumerate(topo)}
+        arg_pos = {n: i for i, n in enumerate(self._arg_names)}
+        aux_pos = {n: i for i, n in enumerate(self._aux_names)}
+        out_entries = [(node_index[id(n)], i) for n, i in sym._outputs]
+
+        def run_graph(arg_vals, aux_vals, rng, is_train):
+            """Evaluate the DAG; returns (outputs, new_aux_tuple)."""
+            results = [None] * len(topo)   # per node: list of outputs
+            new_aux = list(aux_vals)
+            for ni, node in enumerate(topo):
+                if node.op is None:
+                    if node.name in arg_pos:
+                        results[ni] = [arg_vals[arg_pos[node.name]]]
+                    else:
+                        results[ni] = [new_aux[aux_pos[node.name]]]
+                    continue
+                op = node.op
+                n_aux = op.num_aux
+                in_entries = node.inputs
+                vals = [results[node_index[id(src)]][idx]
+                        for src, idx in in_entries]
+                args = vals[:len(vals) - n_aux] if n_aux else vals
+                auxs = vals[len(vals) - n_aux:] if n_aux else []
+                op_ctx = OpContext(
+                    is_train=is_train,
+                    rng=jax.random.fold_in(rng, ni) if op.needs_rng else None)
+                outs, updated = op.apply(node.attrs, args, auxs, op_ctx)
+                results[ni] = outs
+                if op.mutable_aux and is_train and updated:
+                    for (src, _), newv in zip(
+                            in_entries[len(vals) - n_aux:], updated):
+                        if src.op is None and src.name in aux_pos:
+                            new_aux[aux_pos[src.name]] = newv
+            outputs = tuple(results[ni][oi] for ni, oi in out_entries)
+            return outputs, tuple(new_aux)
+
+        self._n_outputs = len(out_entries)
+
+        @jax.jit
+        def fwd_train(arg_vals, aux_vals, rng):
+            return run_graph(arg_vals, aux_vals, rng, True)
+
+        @jax.jit
+        def fwd_eval(arg_vals, aux_vals, rng):
+            return run_graph(arg_vals, aux_vals, rng, False)
+
+        diff_idx = [arg_pos[n] for n in self._diff_names]
+
+        @jax.jit
+        def fwd_bwd(arg_vals, aux_vals, rng, head_grads):
+            arg_vals = list(arg_vals)
+
+            def f(diff_vals):
+                merged = list(arg_vals)
+                for i, v in zip(diff_idx, diff_vals):
+                    merged[i] = v
+                outs, new_aux = run_graph(tuple(merged), aux_vals, rng, True)
+                return outs, new_aux
+
+            diff_vals = tuple(arg_vals[i] for i in diff_idx)
+            (outs, vjp_fn, new_aux) = jax.vjp(f, diff_vals, has_aux=True)
+            grads, = vjp_fn(tuple(head_grads))
+            return outs, new_aux, grads
+
+        self._fwd_train = fwd_train
+        self._fwd_eval = fwd_eval
+        self._fwd_bwd = fwd_bwd
+        self._stash = None
+        # un-jitted graph functions (for AOT export / driver compile checks)
+        self.raw_forward = lambda arg_vals, aux_vals, rng: \
+            run_graph(arg_vals, aux_vals, rng, False)
+        self.raw_forward_train = lambda arg_vals, aux_vals, rng: \
+            run_graph(arg_vals, aux_vals, rng, True)
+
+    # ------------------------------------------------------------------
+    def _gather(self):
+        arg_vals = tuple(self.arg_dict[n]._data for n in self._arg_names)
+        aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
+        return arg_vals, aux_vals
+
+    def _set_args(self, kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                dst = self.arg_dict[k]
+                if isinstance(v, nd.NDArray):
+                    if v.shape != dst.shape:
+                        raise MXNetError(
+                            'forward: shape mismatch for %s: %s vs bound %s'
+                            % (k, v.shape, dst.shape))
+                    dst._data = v._data.astype(dst.dtype)
+                else:
+                    dst._data = jnp.asarray(v, dtype=dst.dtype)
+            elif isinstance(v, bool):
+                pass
+            else:
+                raise MXNetError('forward: unknown argument %s' % k)
+
+    def forward(self, is_train=False, **kwargs):
+        if kwargs:
+            self._set_args(kwargs)
+        arg_vals, aux_vals = self._gather()
+        self._key, sub = jax.random.split(self._key)
+        if is_train:
+            self._stash = (arg_vals, aux_vals, sub)
+            outs, new_aux = self._fwd_train(arg_vals, aux_vals, sub)
+            for n, v in zip(self._aux_names, new_aux):
+                self.aux_dict[n]._data = v
+        else:
+            outs, _ = self._fwd_eval(arg_vals, aux_vals, sub)
+        self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, out in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._stash is None:
+            raise MXNetError('backward called before forward(is_train=True)')
+        arg_vals, aux_vals, sub = self._stash
+        heads = self._default_head_grads(out_grads)
+        outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, sub, heads)
+        self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
+        for n, v in zip(self._aux_names, new_aux):
+            self.aux_dict[n]._data = v
+        self._write_grads(grads)
+
+    def forward_backward(self, out_grads=None, **kwargs):
+        """Fused train-mode forward+backward: ONE XLA execution per step
+        (the fast path Module uses; no reference counterpart — the
+        reference pays per-op dispatch on both passes)."""
+        if kwargs:
+            self._set_args(kwargs)
+        arg_vals, aux_vals = self._gather()
+        self._key, sub = jax.random.split(self._key)
+        self._stash = (arg_vals, aux_vals, sub)
+        heads = self._default_head_grads(out_grads)
+        outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, sub, heads)
+        self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
+        for n, v in zip(self._aux_names, new_aux):
+            self.aux_dict[n]._data = v
+        self._write_grads(grads)
+        return self.outputs
+
+    def _default_head_grads(self, out_grads):
+        if out_grads is None:
+            # loss ops ignore head grads (custom VJPs); ones is identity
+            # for them and matches reference backward() semantics
+            shapes = [o.shape for o in self.outputs] if self.outputs else None
+            if shapes is None:
+                arg_vals, aux_vals = self._gather()
+                outs = jax.eval_shape(
+                    lambda a, x, r: self._fwd_eval(x, a, r)[0],
+                    aux_vals, arg_vals, jax.ShapeDtypeStruct((2,), np.uint32))
+                return tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            return tuple(jnp.ones(o.shape,
+                                  self.outputs[i].dtype)
+                         for i, o in enumerate(self.outputs))
+        if isinstance(out_grads, nd.NDArray):
+            out_grads = [out_grads]
+        return tuple(g._data if isinstance(g, nd.NDArray) else jnp.asarray(g)
+                     for g in out_grads)
+
+    def _write_grads(self, grads):
+        for n, g in zip(self._diff_names, grads):
+            holder = self.grad_dict.get(n)
+            if holder is None:
+                continue
+            if self._grad_req.get(n) == 'add':
+                holder._data = holder._data + g
+            else:
+                holder._data = g
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return OrderedDict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._data = jnp.asarray(
+                    v.asnumpy() if isinstance(v, nd.NDArray) else v,
+                    dtype=self.arg_dict[k].dtype)
+            elif not allow_extra_params:
+                raise MXNetError('Found name "%s" not in arguments' % k)
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._data = jnp.asarray(
+                        v.asnumpy() if isinstance(v, nd.NDArray) else v,
+                        dtype=self.aux_dict[k].dtype)
+                elif not allow_extra_params:
+                    raise MXNetError('Found name "%s" not in aux states' % k)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor bound to new shapes (reference
+        executor.py reshape; used by bucketing/DataParallel resize)."""
+        sym = self._symbol
+        arg_shapes, _, aux_shapes = sym.infer_shape(**kwargs)
+        arg_dict = OrderedDict()
+        for name, shape in zip(sym.list_arguments(), arg_shapes):
+            cur = self.arg_dict[name]
+            if cur.shape == tuple(shape):
+                arg_dict[name] = cur
+            else:
+                arg_dict[name] = nd.zeros(shape, self._ctx, dtype=cur.dtype)
+        grad_dict = {}
+        for name, g in self.grad_dict.items():
+            shape = arg_shapes[sym.list_arguments().index(name)]
+            grad_dict[name] = g if g.shape == tuple(shape) else \
+                nd.zeros(shape, self._ctx, dtype=g.dtype)
+        aux_dict = OrderedDict()
+        for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+            cur = self.aux_dict[name]
+            aux_dict[name] = cur if cur.shape == tuple(shape) else \
+                nd.zeros(shape, self._ctx, dtype=cur.dtype)
+        return Executor(sym, self._ctx, arg_dict, grad_dict, aux_dict,
+                        dict(self._grad_req))
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _normalize_grad_req(grad_req, arg_names):
+        if isinstance(grad_req, str):
+            return {n: grad_req for n in arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(arg_names, grad_req))
+        out = {n: 'null' for n in arg_names}
+        out.update(grad_req or {})
+        return out
+
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req='write', type_dict=None,
+                     shared_exec=None, shape_kwargs=None):
+        """The reference simple_bind flow (graph_executor.cc:789):
+        infer shapes/types, allocate arg/grad/aux arrays, compile."""
+        shape_kwargs = shape_kwargs or {}
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        type_dict = type_dict or {}
+        req = Executor._normalize_grad_req(grad_req, arg_names)
+        arg_dict = OrderedDict()
+        grad_dict = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            dtype = type_dict.get(name, np.float32)
+            if shared_exec is not None and name in shared_exec.arg_dict and \
+                    shared_exec.arg_dict[name].shape == tuple(shape):
+                arg_dict[name] = shared_exec.arg_dict[name]
+            else:
+                arg_dict[name] = nd.zeros(shape, ctx, dtype=dtype)
+            if req.get(name, 'null') != 'null':
+                if shared_exec is not None and \
+                        name in shared_exec.grad_dict and \
+                        shared_exec.grad_dict[name].shape == tuple(shape):
+                    grad_dict[name] = shared_exec.grad_dict[name]
+                else:
+                    grad_dict[name] = nd.zeros(shape, ctx, dtype=dtype)
+        aux_dict = OrderedDict()
+        for name, shape in zip(aux_names, aux_shapes):
+            if shared_exec is not None and name in shared_exec.aux_dict and \
+                    shared_exec.aux_dict[name].shape == tuple(shape):
+                aux_dict[name] = shared_exec.aux_dict[name]
+            else:
+                aux_dict[name] = nd.zeros(shape, ctx, dtype=np.float32)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad=None, grad_req='write',
+              aux_states=None, shared_exec=None):
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        if isinstance(args, (list, tuple)):
+            arg_dict = OrderedDict(zip(arg_names, args))
+        else:
+            arg_dict = OrderedDict((n, args[n]) for n in arg_names)
+        req = Executor._normalize_grad_req(grad_req, arg_names)
+        if args_grad is None:
+            grad_dict = {n: nd.zeros(arg_dict[n].shape, ctx,
+                                     dtype=arg_dict[n].dtype)
+                         for n in arg_names if req.get(n, 'null') != 'null'}
+        elif isinstance(args_grad, (list, tuple)):
+            grad_dict = dict(zip(arg_names, args_grad))
+        else:
+            grad_dict = dict(args_grad)
+        if aux_states is None:
+            _, _, aux_shapes = symbol.infer_shape(
+                **{n: a.shape for n, a in arg_dict.items()})
+            aux_dict = OrderedDict(
+                (n, nd.zeros(s, ctx)) for n, s in zip(aux_names, aux_shapes))
+        elif isinstance(aux_states, (list, tuple)):
+            aux_dict = OrderedDict(zip(aux_names, aux_states))
+        else:
+            aux_dict = OrderedDict((n, aux_states[n]) for n in aux_names)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)

@@ -1,0 +1,184 @@
+"""KVStore: the distribution facade.
+
+Reference: include/mxnet/kvstore.h + src/kvstore/* + ps-lite
+(SURVEY.md §2.4, §5.8).  The reference aggregates gradients through a
+parameter server (ZeroMQ push/pull, server-side updater); the TPU-native
+design keeps the KVStore *API* (named keys, init/push/pull, updater,
+rank/size/barrier) as a facade so Module-level code ports unchanged, but
+the data path is entirely different:
+
+  * intra-process multi-device ("local"/"device"): gradients are already
+    summed inside the pjit-compiled step via an XLA all-reduce over the
+    mesh (Comm/CommDevice's role, comm.h:222 — collapsed into the
+    compiled graph; push/pull see a single aggregated gradient).
+  * multi-host ("dist_sync"/"dist_device_sync"): jax.distributed
+    processes run the same SPMD program; cross-host aggregation is the
+    same XLA all-reduce riding ICI/DCN.  rank/num_workers map to
+    process_index/process_count.  There are no server processes to run —
+    RunServer is a no-op kept for launcher compatibility.
+  * "dist_async" has no ICI analog (SURVEY.md §5.8) and is emulated as
+    dist_sync with a warning.
+"""
+import pickle
+import warnings
+
+from . import optimizer as opt
+from . import ndarray as nd
+from .base import MXNetError
+
+
+def _ctype_key_value(keys, vals):
+    if isinstance(keys, (int, str)):
+        keys = [keys]
+        vals = [vals]
+    out_vals = []
+    for v in vals:
+        out_vals.append(v if isinstance(v, list) else [v])
+    return keys, out_vals
+
+
+class KVStore:
+    """Single-controller key-value store over in-XLA collectives."""
+
+    def __init__(self, kv_type='local'):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._is_dist = 'dist' in kv_type
+        if 'async' in kv_type:
+            warnings.warn('dist_async has no TPU/ICI analog; running with '
+                          'synchronous all-reduce semantics (SURVEY.md §5.8)')
+
+    # -- core API ----------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError('key %s already initialized' % str(k))
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Push gradients.  Multi-device values are summed (the in-XLA
+        all-reduce has usually already produced identical replicas, in
+        which case the single representative is used)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError('key %s not initialized' % str(k))
+            merged = vlist[0]
+            if len(vlist) > 1:
+                for v in vlist[1:]:
+                    merged = merged + v
+            if self._updater is not None:
+                self._updater(self._key_index(k), merged, self._store[k])
+            else:
+                self._pending = getattr(self, '_pending', {})
+                self._pending[k] = merged
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError('key %s not initialized' % str(k))
+            src = self._store[k]
+            pending = getattr(self, '_pending', {})
+            if self._updater is None and k in pending:
+                src = pending[k]
+            for o in olist:
+                o._data = src._data
+
+    # -- updater / optimizer ----------------------------------------------
+    def _key_index(self, key):
+        return key if isinstance(key, int) else key
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        """In the reference this pickles the optimizer to server
+        processes (kvstore.py:239); here the optimizer state lives with
+        this store (conceptually: sharded optimizer state over the mesh)."""
+        # exercise the serialization path for parity with the reference
+        # (symbol handles are per-process, dropped before the wire —
+        # lr/wd multipliers were already extracted from it at creation)
+        sym_ref = getattr(optimizer, 'sym', None)
+        optimizer.sym = None
+        try:
+            optimizer = pickle.loads(pickle.dumps(optimizer))
+        finally:
+            if sym_ref is not None:
+                optimizer.sym = sym_ref
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    @property
+    def updater(self):
+        return self._updater
+
+    # -- optimizer state checkpointing (reference kvstore.py:323-346) -----
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError('Cannot save states for distributed training')
+        with open(fname, 'wb') as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError('Cannot load states for distributed training')
+        with open(fname, 'rb') as fin:
+            self._updater.set_states(fin.read())
+
+    # -- topology ----------------------------------------------------------
+    @property
+    def rank(self):
+        if self._is_dist:
+            import jax
+            return jax.process_index()
+        return 0
+
+    @property
+    def num_workers(self):
+        if self._is_dist:
+            import jax
+            return jax.process_count()
+        return 1
+
+    def get_rank(self):
+        return self.rank
+
+    def get_group_size(self):
+        return self.num_workers
+
+    @property
+    def num_dead_node(self):
+        # Failure detection is the runtime's job on TPU (no ps-lite
+        # heartbeats, SURVEY.md §5.3); a live process implies a live mesh.
+        return 0
+
+    def barrier(self):
+        if self._is_dist:
+            try:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices('kvstore_barrier')
+            except Exception:
+                pass
+
+    def send_command_to_servers(self, head, body):
+        pass  # no server processes in the TPU design
+
+    _send_command_to_servers = send_command_to_servers
+
+    def run_server(self, controller):
+        pass  # kept for launcher compatibility (reference RunServer)
+
+
+def create(name='local'):
+    """Create a KVStore (reference kvstore.py:411 / kvstore.cc:40).
+    Types: local, device, local_allreduce_*, dist_sync, dist_device_sync,
+    dist_async."""
+    if not isinstance(name, str):
+        raise TypeError('name must be a string')
+    return KVStore(name)

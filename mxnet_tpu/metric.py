@@ -1,0 +1,355 @@
+"""Evaluation metrics (reference python/mxnet/metric.py, 1132 LoC;
+SURVEY.md §2.7/§5.5).  Updated per batch from device outputs by the
+Module layer (executor_group.py:549 in the reference)."""
+import math
+
+import numpy as np
+
+from . import base
+from .ndarray import NDArray
+
+
+def check_label_shapes(labels, preds, shape=0):
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError('Shape of labels {} does not match shape of '
+                         'predictions {}'.format(label_shape, pred_shape))
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return 'EvalMetric: {}'.format(dict(self.get_name_value()))
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({'metric': self.__class__.__name__, 'name': self.name,
+                       'output_names': self.output_names,
+                       'label_names': self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float('nan'))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+
+register = base.get_register_func(EvalMetric, 'metric')
+alias = base.get_alias_func(EvalMetric, 'metric')
+_create = base.get_create_func(EvalMetric, 'metric')
+
+
+def create(metric, *args, **kwargs):
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    return _create(metric, *args, **kwargs)
+
+
+@register
+@alias('composite')
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name='composite', output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(m) for m in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        return self.metrics[index]
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int, np.generic)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+@register
+@alias('acc')
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name='accuracy', output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, axis=axis)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = pred_label.asnumpy() if isinstance(pred_label, NDArray) \
+                else np.asarray(pred_label)
+            lab = label.asnumpy() if isinstance(label, NDArray) \
+                else np.asarray(label)
+            if pred.shape != lab.shape:
+                pred = np.argmax(pred, axis=self.axis)
+            pred = pred.astype(np.int32).reshape(-1)
+            lab = lab.astype(np.int32).reshape(-1)
+            check_label_shapes(lab, pred)
+            self.sum_metric += (pred == lab).sum()
+            self.num_inst += len(pred)
+
+
+@register
+@alias('top_k_accuracy', 'top_k_acc')
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name='top_k_accuracy', output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, top_k=top_k)
+        self.top_k = top_k
+        assert self.top_k > 1, 'Please use Accuracy if top_k is no more than 1'
+        self.name += '_%d' % self.top_k
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = pred_label.asnumpy().astype(np.float32)
+            lab = label.asnumpy().astype(np.int32)
+            assert len(pred.shape) <= 2, 'Predictions should be no more than 2 dims'
+            pred = np.argsort(pred, axis=1)
+            num_samples = pred.shape[0]
+            num_classes = pred.shape[1]
+            top_k = min(num_classes, self.top_k)
+            for j in range(top_k):
+                self.sum_metric += (pred[:, num_classes - 1 - j].flat ==
+                                    lab.flat).sum()
+            self.num_inst += num_samples
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name='f1', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = pred.asnumpy()
+            label = label.asnumpy().astype(np.int32)
+            pred_label = np.argmax(pred, axis=1)
+            check_label_shapes(label, pred_label)
+            if len(np.unique(label)) > 2:
+                raise ValueError('F1 currently only supports binary '
+                                 'classification.')
+            true_pos = ((pred_label == 1) & (label == 1)).sum()
+            false_pos = ((pred_label == 1) & (label == 0)).sum()
+            false_neg = ((pred_label == 0) & (label == 1)).sum()
+            precision = true_pos / (true_pos + false_pos) \
+                if true_pos + false_pos > 0 else 0.
+            recall = true_pos / (true_pos + false_neg) \
+                if true_pos + false_neg > 0 else 0.
+            f1 = 2 * precision * recall / (precision + recall) \
+                if precision + recall > 0 else 0.
+            self.sum_metric += f1
+            self.num_inst += 1
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name='perplexity',
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.
+        num = 0
+        for label, pred in zip(labels, preds):
+            probs = pred.asnumpy()
+            lab = label.asnumpy().astype(np.int32).reshape(-1)
+            probs = probs.reshape(-1, probs.shape[-1])
+            picked = probs[np.arange(lab.shape[0]), lab]
+            if self.ignore_label is not None:
+                ignore = (lab == self.ignore_label)
+                picked = np.where(ignore, 1.0, picked)
+                num -= ignore.sum()
+            loss -= np.log(np.maximum(1e-10, picked)).sum()
+            num += lab.shape[0]
+        self.sum_metric += math.exp(loss / max(num, 1)) * max(num, 1)
+        self.num_inst += max(num, 1)
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name='mae', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += np.abs(label - pred).mean()
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name='mse', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += ((label - pred) ** 2.0).mean()
+            self.num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    def __init__(self, name='rmse', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            self.sum_metric += np.sqrt(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+@register
+@alias('ce')
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name='cross-entropy', output_names=None,
+                 label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            label = label.ravel()
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[np.arange(label.shape[0]), np.int64(label)]
+            self.sum_metric += (-np.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of the raw outputs (for make_loss graphs)."""
+
+    def __init__(self, name='loss', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def update(self, _, preds):
+        for pred in preds:
+            self.sum_metric += pred.asnumpy().sum()
+            self.num_inst += pred.size
+
+
+@register
+class Torch(Loss):
+    def __init__(self, name='torch', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find('<') != -1:
+                name = 'custom(%s)' % name
+        super().__init__(name, output_names, label_names,
+                         feval=feval, allow_extra_outputs=allow_extra_outputs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np_metric(numpy_feval, name=None, allow_extra_outputs=False):
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)

@@ -1,0 +1,115 @@
+"""Model helpers: kvstore setup and checkpointing.
+
+Reference: python/mxnet/model.py (967 LoC; SURVEY.md §2.7) — the
+_create_kvstore heuristics and save/load_checkpoint format glue used by
+Module and the legacy FeedForward flow.
+"""
+import logging
+
+from . import ndarray as nd
+from . import symbol as sym
+from . import kvstore as kvs
+
+
+BatchEndParam = None
+try:
+    from collections import namedtuple
+    BatchEndParam = namedtuple('BatchEndParams',
+                               ['epoch', 'nbatch', 'eval_metric', 'locals'])
+except ImportError:  # pragma: no cover
+    pass
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Decide kvstore + update_on_kvstore (reference model.py:57).
+    The >16M-params heuristic for turning off update_on_kvstore is kept."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and 'dist' not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == 'local':
+                max_size = max(p.size for p in arg_params.values()) \
+                    if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError('kvstore must be KVStore, str or None')
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Init params on the store, pull back (reference model.py:96)."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
+                              param_names):
+    """Push grad, pull weight per key (reference model.py:106)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list) and
+                                 grad_list[0] is None):
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Aggregate grads (optionally via store) then run the local updater
+    (reference model.py:118)."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list is None or (isinstance(grad_list, list) and
+                                 grad_list[0] is None):
+            continue
+        index_name = param_names[index] if param_names is not None else index
+        if kvstore:
+            kvstore.push(index_name, grad_list, priority=-index)
+            kvstore.pull(index_name, grad_list, priority=-index)
+        if isinstance(arg_list, list):
+            for k, (w, g) in enumerate(zip(arg_list, grad_list)):
+                updater(index * num_device + k, g, w)
+        else:
+            updater(index, grad_list, arg_list)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Write prefix-symbol.json + prefix-%04d.params
+    (reference model.py save_checkpoint; format §5.4)."""
+    if symbol is not None:
+        symbol.save('%s-symbol.json' % prefix)
+    save_dict = {('arg:%s' % k): v for k, v in arg_params.items()}
+    save_dict.update({('aux:%s' % k): v for k, v in aux_params.items()})
+    param_name = '%s-%04d.params' % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Load symbol + params (reference model.py load_checkpoint)."""
+    symbol = sym.load('%s-symbol.json' % prefix)
+    save_dict = nd.load('%s-%04d.params' % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(':', 1)
+        if tp == 'arg':
+            arg_params[name] = v
+        if tp == 'aux':
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)

@@ -1,0 +1,6 @@
+"""Module API (reference python/mxnet/module/; SURVEY.md §2.7)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .executor_group import DataParallelExecutorGroup

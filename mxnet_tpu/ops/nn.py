@@ -368,11 +368,14 @@ def _pooling(attrs, data):
     strides = (1, 1) + stride
     padcfg = ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == 'max':
-        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return lax.reduce_window(data, jnp.asarray(init, data.dtype),
-                                 lax.max, window, strides, padcfg)
-    out = lax.reduce_window(data, jnp.asarray(0, data.dtype), lax.add,
-                            window, strides, padcfg)
+        # scalar -inf init so JAX recognizes the differentiable
+        # reduce_window_max pattern
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) \
+            else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max,
+                                 window, strides, padcfg)
+    out = lax.reduce_window(data, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                            lax.add, window, strides, padcfg)
     if pool_type == 'avg':
         # cuDNN COUNT_INCLUDE_PADDING semantics (reference default)
         out = out / float(np.prod(kernel))
@@ -479,10 +482,11 @@ def _lrn(attrs, data):
     beta = asfloat(attrs.get('beta', 0.75))
     knorm = asfloat(attrs.get('knorm', 2.0))
     sq = jnp.square(data)
-    half = nsize // 2
-    acc = lax.reduce_window(sq, jnp.asarray(0, data.dtype), lax.add,
-                            (1, nsize, 1, 1), (1, 1, 1, 1),
-                            ((0, 0), (half, half), (0, 0), (0, 0)))
+    # pad so output channel count == input for both odd and even nsize
+    lo, hi = nsize // 2, (nsize - 1) // 2
+    acc = lax.reduce_window(sq, 0.0 if jnp.issubdtype(data.dtype, jnp.floating) else 0,
+                            lax.add, (1, nsize, 1, 1), (1, 1, 1, 1),
+                            ((0, 0), (lo, hi), (0, 0), (0, 0)))
     return data / jnp.power(knorm + alpha / nsize * acc, beta)
 
 

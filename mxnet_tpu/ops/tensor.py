@@ -41,8 +41,8 @@ _reg_binary('elemwise_sub', jnp.subtract, aliases=('_sub', '_minus', '_Minus'))
 _reg_binary('elemwise_mul', jnp.multiply, aliases=('_mul', '_Mul'))
 _reg_binary('elemwise_div', jnp.divide, aliases=('_div', '_Div'))
 _reg_binary('_power', jnp.power, aliases=('_Power',))
-_reg_binary('_maximum', jnp.maximum, aliases=('_Maximum',))
-_reg_binary('_minimum', jnp.minimum, aliases=('_Minimum',))
+_reg_binary('_maximum', jnp.maximum, aliases=('_Maximum', 'maximum'))
+_reg_binary('_minimum', jnp.minimum, aliases=('_Minimum', 'minimum'))
 _reg_binary('_hypot', jnp.hypot)
 _reg_binary('_mod', jnp.mod, aliases=('_Mod',))
 

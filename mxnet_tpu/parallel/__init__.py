@@ -1,0 +1,14 @@
+"""Parallelism: device meshes, collectives, and sharded execution.
+
+This package replaces the reference's entire distribution stack
+(SURVEY.md §2.4: Comm/CommDevice intra-node reduce, ps-lite parameter
+server, dmlc_tracker launcher) with the TPU-native design: a
+`jax.sharding.Mesh` over the slice, sharding annotations on the compiled
+step, and XLA collectives riding ICI.  It also provides the parallelism
+modes the reference never had (SURVEY.md §7 step 9): tensor parallelism,
+sequence/context parallelism (ring attention), and pipeline parallelism.
+"""
+from .mesh import (make_mesh, data_sharding, replicated, shard_batch,
+                   replicate_params, current_mesh, set_current_mesh)
+from .ring_attention import ring_attention
+from . import collectives

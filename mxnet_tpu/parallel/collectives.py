@@ -1,0 +1,54 @@
+"""Collective wrappers.
+
+The reference's communication layer is a parameter server
+(ps-lite ZPush/ZPull, SURVEY.md §2.4) plus a hand-rolled CUDA P2P
+reduce (comm.h:222).  On TPU every one of those patterns is an XLA
+collective over a named mesh axis; these wrappers exist so framework
+code and user custom ops have one obvious place to call them from
+inside shard_map/pjit-compiled code.
+"""
+import jax
+from jax import lax
+
+
+def allreduce_sum(x, axis_name):
+    """Gradient aggregation (the role of ps-lite server merge +
+    CommDevice tree reduce)."""
+    return lax.psum(x, axis_name)
+
+
+def allreduce_mean(x, axis_name):
+    return lax.pmean(x, axis_name)
+
+
+def allgather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)
+
+
+def barrier_all_hosts(name='mxnet_tpu_barrier'):
+    """Host-level barrier (the reference's ps::Postoffice::Barrier role
+    at bootstrap, kvstore_dist.h:56)."""
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)

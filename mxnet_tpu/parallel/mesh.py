@@ -1,0 +1,68 @@
+"""Device mesh utilities.
+
+The mesh is the TPU-native replacement for the reference's device lists
++ work_load_list (executor_group.py:233 decide_slices): instead of
+slicing a batch across per-GPU executors in Python, the batch is sharded
+over a named mesh axis and XLA partitions one compiled program
+(SPMD), inserting all-reduces over ICI where the reference ran
+CommDevice/ps-lite reductions.
+"""
+import threading
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_state = threading.local()
+
+
+def make_mesh(shape=None, axis_names=None, devices=None):
+    """Create a Mesh.
+
+    shape: dict axis->size (e.g. {'data': 4, 'model': 2}) or None for a
+    1-D 'data' mesh over all (or given) devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if shape is None:
+        axis_names = axis_names or ('data',)
+        if len(axis_names) != 1:
+            raise ValueError('shape required for multi-axis mesh')
+        return Mesh(np.asarray(devices), axis_names)
+    axis_names = tuple(shape.keys())
+    sizes = tuple(shape.values())
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError('mesh needs %d devices, have %d'
+                         % (n, len(devices)))
+    arr = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(arr, axis_names)
+
+
+def current_mesh():
+    return getattr(_state, 'mesh', None)
+
+
+def set_current_mesh(mesh):
+    _state.mesh = mesh
+
+
+def data_sharding(mesh, ndim=None, axis='data'):
+    """Batch-dim sharding: first axis over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh, array, axis='data'):
+    """Place a jax array batch-sharded over the mesh."""
+    spec = P(*([axis] + [None] * (array.ndim - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec))
+
+
+def replicate_params(mesh, arrays):
+    """Replicate parameter arrays across every mesh device."""
+    sh = NamedSharding(mesh, P())
+    return [jax.device_put(a, sh) for a in arrays]

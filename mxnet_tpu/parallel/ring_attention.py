@@ -1,0 +1,97 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+No reference counterpart — MXNet 0.11 has no attention or sequence
+parallelism at all (SURVEY.md §5.7); this is the new-design extension
+called for by §7 step 9.  The sequence axis is sharded over a mesh axis;
+keys/values rotate around the ring via lax.ppermute while each device
+accumulates its queries' attention online (flash-attention style
+running max / denominator), so peak memory is O(T_local²) and the
+K/V transfers ride ICI concurrently with compute.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _block_attn(q, k, v, scale, q_pos, k_pos, causal, m, l, o):
+    """One block's contribution with online-softmax accumulation."""
+    s = jnp.einsum('...qd,...kd->...qk', q, k) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # guard fully-masked rows (m_new == -inf)
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])
+    if causal:
+        p = jnp.where(q_pos[:, None] >= k_pos[None, :], p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - safe_m, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(m), corr, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum('...qk,...kd->...qd', p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Attention over a sequence sharded on `axis_name`.
+
+    Call inside shard_map/pjit-sharded code.  q,k,v: [..., T_local, D]
+    local shards; returns the local output shard [..., T_local, D].
+    """
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    t_local = q.shape[-2]
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q_pos = idx * t_local + jnp.arange(t_local)
+    perm = [(j, (j - 1) % n) for j in range(n)]  # send to previous; recv from next
+
+    def body(carry, _):
+        k_blk, v_blk, k_idx, m, l, o = carry
+        k_pos = k_idx * t_local + jnp.arange(t_local)
+        m, l, o = _block_attn(q, k_blk, v_blk, scale, q_pos, k_pos,
+                              causal, m, l, o)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_idx = lax.ppermute(k_idx, axis_name, perm)
+        return (k_blk, v_blk, k_idx, m, l, o), None
+
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], dtype=jnp.float32)
+    o0 = jnp.zeros(q.shape, dtype=jnp.float32)
+    if hasattr(lax, 'pvary'):
+        # mark accumulators as varying over the ring axis so scan carry
+        # types line up under JAX's manual-axes checking
+        m0, l0, o0 = (lax.pvary(t, (axis_name,)) for t in (m0, l0, o0))
+    (k, v, _, m, l, o), _ = lax.scan(
+        body, (k, v, idx, m0, l0, o0), None, length=n)
+    out = o / jnp.maximum(l, 1e-37)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False):
+    """Wrapper: full [B, H, T, D] arrays, T sharded over `seq_axis`."""
+    from jax import shard_map
+    spec = P(None, None, seq_axis, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def full_attention(q, k, v, causal=False, scale=None):
+    """Single-device reference implementation (for tests)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum('...qd,...kd->...qk', q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('...qk,...kd->...qd', p, v)

@@ -1,0 +1,533 @@
+"""Symbol: the symbolic graph API.
+
+TPU-native rebuild of the reference's nnvm::Symbol + python/mxnet/symbol.py
+(SURVEY.md §2.2, §2.7).  A Symbol is a set of output entries of a DAG of
+nodes; operator nodes reference the same op registry the imperative API
+uses, so symbolic and imperative execution share one compute definition.
+Where the reference lowers symbols through NNVM passes to per-op engine
+executors (graph_executor.cc:448), here `bind` lowers the whole DAG into
+one pure JAX function that XLA compiles as a single fused module — the
+InferShape/InferType passes survive (needed for parameter-shape
+back-fill), PlanMemory and op-exec attachment collapse into XLA.
+
+Arithmetic on symbols mirrors python/mxnet/symbol.py operator overloads;
+symbol JSON save/load mirrors the nnvm JSON layout (nodes / arg_nodes /
+heads) for checkpoint parity (Module.save_checkpoint writes
+prefix-symbol.json like the reference, §5.4).
+"""
+import json
+import sys
+
+import numpy as np
+
+from . import attribute
+from .base import (MXNetError, current_name_manager, attr_value,
+                   parse_attr_value)
+from .ops import registry as _reg
+
+_py_slice = slice
+
+
+class _Node:
+    """One graph node: an operator application or a variable (op=None)."""
+    __slots__ = ('op', 'name', 'attrs', 'inputs', 'user_attrs')
+
+    def __init__(self, op, name, attrs, inputs, user_attrs=None):
+        self.op = op              # OpDef or None for variables
+        self.name = name
+        self.attrs = attrs        # dict of python values (op hyperparams)
+        self.inputs = inputs      # list of (node, out_index)
+        self.user_attrs = user_attrs or {}
+
+    def num_outputs(self):
+        return 1 if self.op is None else self.op.num_outputs(self.attrs)
+
+
+class Symbol:
+    """A set of (node, output_index) entries."""
+    __slots__ = ('_outputs',)
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # list of (node, int)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def _topo(self):
+        """Topological order of all reachable nodes (inputs first)."""
+        order, seen = [], set()
+        stack = [(n, False) for n, _ in reversed(self._outputs)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for src, _ in reversed(node.inputs):
+                if id(src) not in seen:
+                    stack.append((src, False))
+        return order
+
+    def list_arguments(self):
+        out = []
+        for node in self._topo():
+            if node.op is None and not node.user_attrs.get('__is_aux__'):
+                out.append(node.name)
+        return out
+
+    def list_auxiliary_states(self):
+        out = []
+        for node in self._topo():
+            if node.op is None and node.user_attrs.get('__is_aux__'):
+                out.append(node.name)
+        return out
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.op is None]
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.op is None:
+                names.append(node.name)
+            else:
+                onames = node.op.output_names(node.attrs)
+                names.append('%s_%s' % (node.name, onames[idx]))
+        return names
+
+    def get_internals(self):
+        """Symbol grouping every internal output (reference
+        symbol.py get_internals)."""
+        entries = []
+        for node in self._topo():
+            for i in range(node.num_outputs()):
+                entries.append((node, i))
+        return Symbol(entries)
+
+    def get_children(self):
+        nodes = []
+        for node, _ in self._outputs:
+            nodes.extend(node.inputs)
+        if not nodes:
+            return None
+        return Symbol(nodes)
+
+    def __getitem__(self, index):
+        if isinstance(index, _py_slice):
+            return Symbol(self._outputs[index])
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError('cannot find output %s' % index)
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self._outputs)))
+
+    def __repr__(self):
+        name = self.name
+        return '<Symbol %s>' % (name if name else 'Grouped')
+
+    # -- attributes --------------------------------------------------------
+    def attr(self, key):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].user_attrs.get(key)
+        return None
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo():
+            # include __lr_mult__/__wd_mult__/__init__ etc. — the optimizer
+            # and Module.init_params read them from here (reference
+            # symbol.py attr_dict exposes all attrs)
+            attrs = dict(node.user_attrs)
+            attrs.pop('__is_aux__', None)
+            if node.op is not None:
+                attrs.update({k: attr_value(v) for k, v in node.attrs.items()})
+            if attrs:
+                out[node.name] = attrs
+        return out
+
+    def _set_attr(self, **kwargs):
+        for node, _ in self._outputs:
+            node.user_attrs.update({k: str(v) for k, v in kwargs.items()})
+
+    # -- shape / type inference (nnvm InferShape/InferType passes) --------
+    def infer_shape(self, *args, **kwargs):
+        arg_shapes, out_shapes, aux_shapes = self._infer_shape_impl(
+            False, *args, **kwargs)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, s in zip(arg_names, args):
+                if s is not None:
+                    known[name] = tuple(s)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = tuple(v)
+        shapes, out_shapes = self._run_shape_inference(known, partial)
+        arg_shapes = [shapes.get(n) for n in self.list_arguments()]
+        aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
+        if not partial and any(s is None for s in arg_shapes):
+            missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
+                       if s is None]
+            raise MXNetError('infer_shape: cannot fully infer shapes of '
+                             'arguments %s' % missing)
+        return arg_shapes, out_shapes, aux_shapes
+
+    def _run_shape_inference(self, var_shapes, partial=False):
+        """Fixed-point bidirectional shape inference over the DAG."""
+        topo = self._topo()
+        entry_shape = {}   # (id(node), idx) -> shape
+        var_shapes = dict(var_shapes)
+        for _ in range(3):  # fixed-point: forward fill + param backfill
+            changed = False
+            for node in topo:
+                if node.op is None:
+                    s = var_shapes.get(node.name)
+                    if s is not None and entry_shape.get((id(node), 0)) != s:
+                        entry_shape[(id(node), 0)] = tuple(s)
+                        changed = True
+                    continue
+                in_shapes = [entry_shape.get((id(src), i))
+                             for src, i in node.inputs]
+                try:
+                    in_shapes, out_shapes = node.op.infer_shape(
+                        node.attrs, in_shapes)
+                except Exception as e:
+                    raise MXNetError(
+                        'Error in operator %s: shape inference failed: %s'
+                        % (node.name, e)) from e
+                # back-fill newly inferred input (parameter) shapes
+                for (src, i), s in zip(node.inputs, in_shapes):
+                    if s is not None and entry_shape.get((id(src), i)) is None:
+                        entry_shape[(id(src), i)] = tuple(s)
+                        if src.op is None:
+                            var_shapes[src.name] = tuple(s)
+                        changed = True
+                if out_shapes is not None:
+                    for i, s in enumerate(out_shapes):
+                        if entry_shape.get((id(node), i)) != tuple(s):
+                            entry_shape[(id(node), i)] = tuple(s)
+                            changed = True
+            if not changed:
+                break
+        outs = [entry_shape.get((id(n), i)) for n, i in self._outputs]
+        if any(o is None for o in outs) and not partial:
+            raise MXNetError('infer_shape: output shapes could not be '
+                             'inferred (missing input shapes?)')
+        return var_shapes, outs
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, t in zip(arg_names, args):
+                if t is not None:
+                    known[name] = np.dtype(t)
+        for k, v in kwargs.items():
+            if v is not None:
+                known[k] = np.dtype(v)
+        default = np.dtype(np.float32)
+        arg_types = [known.get(n, default) for n in self.list_arguments()]
+        aux_types = [known.get(n, default) for n in self.list_auxiliary_states()]
+        out_types = [default for _ in self._outputs]
+        return arg_types, out_types, aux_types
+
+    # -- serialization (nnvm JSON layout) ---------------------------------
+    def tojson(self):
+        topo = self._topo()
+        node_ids = {id(n): i for i, n in enumerate(topo)}
+        nodes = []
+        arg_nodes = []
+        for i, node in enumerate(topo):
+            if node.op is None:
+                arg_nodes.append(i)
+            entry = {
+                'op': 'null' if node.op is None else node.op.name,
+                'name': node.name,
+                'inputs': [[node_ids[id(src)], idx, 0]
+                           for src, idx in node.inputs],
+            }
+            attrs = {k: attr_value(v) for k, v in node.attrs.items()} \
+                if node.op is not None else {}
+            uattrs = {k: v for k, v in node.user_attrs.items()}
+            if attrs:
+                entry['attrs'] = attrs
+            if uattrs:
+                entry['user_attrs'] = uattrs
+            nodes.append(entry)
+        heads = [[node_ids[id(n)], i, 0] for n, i in self._outputs]
+        return json.dumps({'nodes': nodes, 'arg_nodes': arg_nodes,
+                           'heads': heads,
+                           'attrs': {'mxnet_tpu_version': '0.1.0'}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, 'w') as f:
+            f.write(self.tojson())
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx, grad_req='write', type_dict=None,
+                    shared_exec=None, shared_data_arrays=None, **kwargs):
+        from .executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req=grad_req,
+                                     type_dict=type_dict,
+                                     shared_exec=shared_exec,
+                                     shape_kwargs=kwargs)
+
+    def bind(self, ctx, args, args_grad=None, grad_req='write',
+             aux_states=None, shared_exec=None):
+        from .executor import Executor
+        return Executor._bind(self, ctx, args, args_grad=args_grad,
+                              grad_req=grad_req, aux_states=aux_states,
+                              shared_exec=shared_exec)
+
+    def eval(self, ctx=None, **kwargs):
+        from .context import current_context
+        ctx = ctx or current_context()
+        ex = self.bind(ctx, kwargs)
+        return ex.forward()
+
+    def grad(self, wrt):  # pragma: no cover - legacy API
+        raise NotImplementedError('use bind().backward instead')
+
+    # -- arithmetic (reference symbol.py operator overloads) --------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _invoke_op(op, {'lhs': lhs, 'rhs': rhs}, {}, None)
+        if isinstance(other, (int, float)):
+            return _invoke_op(scalar_op, {'data': self},
+                              {'scalar': float(other)}, None)
+        raise TypeError('unsupported operand type %s' % type(other))
+
+    def __add__(self, other):
+        return self._binop(other, 'elemwise_add', '_plus_scalar')
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, 'elemwise_sub', '_minus_scalar')
+
+    def __rsub__(self, other):
+        if isinstance(other, (int, float)):
+            return _invoke_op('_rminus_scalar', {'data': self},
+                              {'scalar': float(other)}, None)
+        return self._binop(other, 'elemwise_sub', '_minus_scalar', True)
+
+    def __mul__(self, other):
+        return self._binop(other, 'elemwise_mul', '_mul_scalar')
+
+    __rmul__ = __mul__
+
+    def __div__(self, other):
+        return self._binop(other, 'elemwise_div', '_div_scalar')
+
+    __truediv__ = __div__
+
+    def __rdiv__(self, other):
+        if isinstance(other, (int, float)):
+            return _invoke_op('_rdiv_scalar', {'data': self},
+                              {'scalar': float(other)}, None)
+        return self._binop(other, 'elemwise_div', '_div_scalar', True)
+
+    __rtruediv__ = __rdiv__
+
+    def __pow__(self, other):
+        return self._binop(other, '_power', '_power_scalar')
+
+    def __neg__(self):
+        return _invoke_op('negative', {'data': self}, {}, None)
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+
+# ---------------------------------------------------------------------------
+# Construction
+# ---------------------------------------------------------------------------
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, **kwargs):
+    """Create a variable symbol (reference symbol.py:var)."""
+    user_attrs = attribute.current().get(attr or {})
+    if shape is not None:
+        user_attrs['__shape__'] = str(tuple(shape))
+    if lr_mult is not None:
+        user_attrs['__lr_mult__'] = str(lr_mult)
+    if wd_mult is not None:
+        user_attrs['__wd_mult__'] = str(wd_mult)
+    if dtype is not None:
+        user_attrs['__dtype__'] = str(np.dtype(dtype))
+    if init is not None:
+        user_attrs['__init__'] = init if isinstance(init, str) else \
+            init.dumps()
+    for k, v in kwargs.items():
+        user_attrs[k] = str(v)
+    node = _Node(None, name, {}, [], user_attrs)
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    entries = []
+    for s in symbols:
+        entries.extend(s._outputs)
+    return Symbol(entries)
+
+
+def _invoke_op(op_name, sym_kwargs, attrs, name, aux_syms=None):
+    """Create an operator node (the compose step of reference
+    symbol.py:_make_atomic_symbol_function)."""
+    op = _reg.get(op_name)
+    attrs = {k: v for k, v in attrs.items() if v is not None}
+    name = current_name_manager().get(name, op.hint)
+    input_names = op.input_names(attrs)
+    arg_names = op.arg_names(attrs)
+    aux_names = op.aux_names(attrs)
+    inputs = []
+    user_attrs = attribute.current().get({})
+    for in_name in input_names:
+        is_aux = in_name in aux_names
+        if in_name in sym_kwargs:
+            s = sym_kwargs[in_name]
+            if len(s._outputs) != 1:
+                raise MXNetError('input %s must have a single output'
+                                 % in_name)
+            entry = s._outputs[0]
+            if is_aux and entry[0].op is None:
+                entry[0].user_attrs['__is_aux__'] = True
+            inputs.append(entry)
+        else:
+            # auto-create missing parameter/aux variables: name_weight etc.
+            vattrs = dict(user_attrs)
+            if is_aux:
+                vattrs['__is_aux__'] = True
+            node = _Node(None, '%s_%s' % (name, in_name), {}, [], vattrs)
+            inputs.append((node, 0))
+    node = _Node(op, name, attrs, inputs, dict(user_attrs))
+    n_out = node.num_outputs()
+    sym = Symbol([(node, i) for i in range(n_out)])
+    return sym
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from tojson output."""
+    data = json.loads(json_str)
+    nodes_meta = data['nodes']
+    built = []
+    for meta in nodes_meta:
+        if meta['op'] == 'null':
+            node = _Node(None, meta['name'], {}, [],
+                         dict(meta.get('user_attrs', {})))
+        else:
+            op = _reg.get(meta['op'])
+            attrs = {k: parse_attr_value(v)
+                     for k, v in meta.get('attrs', {}).items()}
+            inputs = [(built[i], idx) for i, idx, _ in meta['inputs']]
+            node = _Node(op, meta['name'], attrs, inputs,
+                         dict(meta.get('user_attrs', {})))
+        built.append(node)
+    heads = [(built[i], idx) for i, idx, _ in data['heads']]
+    return Symbol(heads)
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return _invoke_op('_zeros', {}, {'shape': tuple(shape) if not
+                      isinstance(shape, int) else (shape,),
+                      'dtype': dtype}, kwargs.get('name'))
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _invoke_op('_ones', {}, {'shape': tuple(shape) if not
+                      isinstance(shape, int) else (shape,),
+                      'dtype': dtype}, kwargs.get('name'))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, **kwargs):
+    return _invoke_op('_arange', {}, {'start': start, 'stop': stop,
+                      'step': step, 'repeat': repeat, 'dtype': dtype},
+                      kwargs.get('name'))
+
+
+# ---------------------------------------------------------------------------
+# Operator codegen — mirror of _init_symbol_module (symbol.py:2352)
+# ---------------------------------------------------------------------------
+
+def _make_sym_func(op_name):
+    op = _reg.get(op_name)
+
+    def fn(*args, **kwargs):
+        name = kwargs.pop('name', None)
+        attr = kwargs.pop('attr', None)
+        sym_kwargs = {}
+        attrs = {}
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                sym_kwargs[k] = v
+            else:
+                attrs[k] = v
+        pos = [a for a in args if isinstance(a, Symbol)]
+        extra = [a for a in args if not isinstance(a, Symbol)]
+        if extra:
+            raise TypeError(
+                'Operator %s: positional arguments must be Symbols; pass '
+                'attributes as keywords (got %r)' % (op_name, extra))
+        # variadic ops (Concat, add_n, ...): infer num_args from call site
+        if len(pos) > 1 and callable(op._input_names):
+            attrs.setdefault('num_args', len(pos) + len(sym_kwargs))
+        input_names = op.input_names(attrs)
+        free = [n for n in input_names if n not in sym_kwargs]
+        if len(pos) > len(free):
+            raise TypeError('Operator %s: too many positional inputs '
+                            '(%d given, %d expected)' %
+                            (op_name, len(pos), len(free)))
+        for s, n in zip(pos, free):
+            sym_kwargs[n] = s
+        if attr:
+            with attribute.AttrScope(**attr):
+                return _invoke_op(op_name, sym_kwargs, attrs, name)
+        return _invoke_op(op_name, sym_kwargs, attrs, name)
+
+    fn.__name__ = op_name
+    fn.__doc__ = 'Auto-generated symbol constructor for operator %s.' % op_name
+    return fn
+
+
+def _init_module():
+    mod = sys.modules[__name__]
+    for name in _reg.list_ops():
+        if hasattr(mod, name):
+            continue
+        setattr(mod, name, _make_sym_func(name))
+
+
+_init_module()

@@ -2,11 +2,22 @@
 multi-device sharding paths are exercised without TPU hardware (the
 reference's analogous trick is cpu(0)/cpu(1) contexts in
 tests/python/unittest/test_multi_device_exec.py, and launcher=local
-multi-process for dist kvstore — SURVEY.md §4)."""
+multi-process for dist kvstore — SURVEY.md §4).
+
+Note: the axon TPU plugin's sitecustomize imports jax at interpreter
+start, freezing JAX_PLATFORMS before this file runs — so the platform
+must be forced via jax.config, not os.environ.
+"""
 import os
 
-os.environ['JAX_PLATFORMS'] = 'cpu'
 flags = os.environ.get('XLA_FLAGS', '')
 if 'xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
+import jax
+
+jax.config.update('jax_platforms', 'cpu')
+assert jax.default_backend() == 'cpu', 'tests must run on the CPU backend'
+assert jax.device_count() == 8, 'tests expect 8 virtual CPU devices'

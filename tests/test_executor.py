@@ -1,0 +1,160 @@
+"""Executor tests (model: reference tests/python/unittest/test_executor.py
++ numeric-gradient style checks from test_operator.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def test_bind_forward():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = a * b
+    ex = c.bind(mx.cpu(), {'a': nd.array([1.0, 2.0]), 'b': nd.array([3.0, 4.0])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [3, 8])
+
+
+def test_bind_backward():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = a * b
+    ex = c.bind(mx.cpu(), {'a': nd.array([1.0, 2.0]), 'b': nd.array([3.0, 4.0])})
+    ex.forward(is_train=True)
+    ex.backward(nd.array([1.0, 1.0]))
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(), [3, 4])
+    np.testing.assert_allclose(ex.grad_dict['b'].asnumpy(), [1, 2])
+
+
+def test_simple_bind_mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, name='fc1', num_hidden=16)
+    act = sym.Activation(fc1, act_type='relu')
+    fc2 = sym.FullyConnected(act, name='fc2', num_hidden=3)
+    out = sym.SoftmaxOutput(fc2, name='softmax')
+    ex = out.simple_bind(mx.cpu(), data=(8, 20))
+    assert ex.arg_dict['fc1_weight'].shape == (16, 20)
+    assert ex.grad_dict['fc1_weight'].shape == (16, 20)
+    ex.arg_dict['data'][:] = np.random.rand(8, 20)
+    ex.arg_dict['fc1_weight'][:] = np.random.rand(16, 20) * 0.1
+    ex.arg_dict['fc2_weight'][:] = np.random.rand(3, 16) * 0.1
+    ex.arg_dict['softmax_label'][:] = np.arange(8) % 3
+    outs = ex.forward(is_train=True)
+    assert outs[0].shape == (8, 3)
+    np.testing.assert_allclose(outs[0].asnumpy().sum(axis=1),
+                               np.ones(8), rtol=1e-5)
+    ex.backward()
+    g = ex.grad_dict['fc2_weight'].asnumpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_softmax_grad_matches_formula():
+    data = sym.Variable('data')
+    out = sym.SoftmaxOutput(data, name='softmax')
+    x = np.random.rand(4, 5).astype(np.float32)
+    label = (np.arange(4) % 5).astype(np.float32)
+    ex = out.simple_bind(mx.cpu(), data=(4, 5),
+                         grad_req={'data': 'write', 'softmax_label': 'null'})
+    ex.forward(is_train=True, data=nd.array(x),
+               softmax_label=nd.array(label))
+    ex.backward()
+    p = np.exp(x) / np.exp(x).sum(1, keepdims=True)
+    expect = p.copy()
+    expect[np.arange(4), label.astype(int)] -= 1
+    np.testing.assert_allclose(ex.grad_dict['data'].asnumpy(), expect,
+                               rtol=1e-4)
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable('a')
+    out = a * 2
+    ex = out.bind(mx.cpu(), {'a': nd.array([1.0])},
+                  grad_req='add')
+    ex.forward(is_train=True)
+    ex.backward(nd.array([1.0]))
+    ex.forward(is_train=True)
+    ex.backward(nd.array([1.0]))
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(), [4.0])
+
+    ex2 = out.bind(mx.cpu(), {'a': nd.array([1.0])}, grad_req='null')
+    ex2.forward(is_train=True)
+    ex2.backward(nd.array([1.0]))
+    assert ex2.grad_dict.get('a') is None
+
+
+def test_batchnorm_aux_update():
+    data = sym.Variable('data')
+    bn = sym.BatchNorm(data, name='bn', momentum=0.5, fix_gamma=False)
+    ex = bn.simple_bind(mx.cpu(), data=(16, 4))
+    ex.arg_dict['bn_gamma'][:] = 1
+    x = np.random.rand(16, 4).astype(np.float32) * 3 + 7
+    ex.forward(is_train=True, data=nd.array(x))
+    mm = ex.aux_dict['bn_moving_mean'].asnumpy()
+    # moving_mean = 0*0.5 + batch_mean*0.5
+    np.testing.assert_allclose(mm, x.mean(0) * 0.5, rtol=1e-4)
+    # eval mode uses moving stats, does not update them
+    ex.forward(is_train=False, data=nd.array(x))
+    np.testing.assert_allclose(ex.aux_dict['bn_moving_mean'].asnumpy(), mm,
+                               rtol=1e-6)
+
+
+def test_dropout_train_vs_eval():
+    data = sym.Variable('data')
+    d = sym.Dropout(data, p=0.5)
+    ex = d.simple_bind(mx.cpu(), data=(1000,), grad_req='null')
+    x = np.ones(1000, dtype=np.float32)
+    out_eval = ex.forward(is_train=False, data=nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out_eval, x)
+    out_train = ex.forward(is_train=True, data=nd.array(x))[0].asnumpy()
+    assert 0.3 < (out_train == 0).mean() < 0.7
+
+
+def test_numeric_gradient_conv():
+    """Finite-difference check of conv gradients (the reference's
+    check_numeric_gradient oracle, test_utils.py:439)."""
+    data = sym.Variable('data')
+    conv = sym.Convolution(data, name='conv', kernel=(2, 2), num_filter=2,
+                           no_bias=True)
+    loss = sym.make_loss(sym.sum(sym.square(conv)))
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 1, 2, 2).astype(np.float32)
+    ex = loss.bind(mx.cpu(), {'data': nd.array(x), 'conv_weight': nd.array(w)})
+    ex.forward(is_train=True)
+    ex.backward()
+    gw = ex.grad_dict['conv_weight'].asnumpy()
+    eps = 1e-3
+    fd = np.zeros_like(w)
+
+    def f(wv):
+        # reuse the same executor (same compiled XLA module)
+        return ex.forward(conv_weight=nd.array(wv.reshape(w.shape))
+                          )[0].asnumpy().sum()
+
+    for i in range(w.size):
+        wp = w.copy().reshape(-1)
+        wp[i] += eps
+        wm = w.copy().reshape(-1)
+        wm[i] -= eps
+        fd.reshape(-1)[i] = (f(wp) - f(wm)) / (2 * eps)
+    np.testing.assert_allclose(gw, fd, rtol=1e-2, atol=1e-2)
+
+
+def test_executor_reshape():
+    data = sym.Variable('data')
+    fc = sym.FullyConnected(data, name='fc', num_hidden=4)
+    ex = fc.simple_bind(mx.cpu(), data=(8, 10))
+    ex2 = ex.reshape(data=(16, 10))
+    assert ex2.arg_dict['data'].shape == (16, 10)
+    # weights shared
+    assert ex2.arg_dict['fc_weight'] is ex.arg_dict['fc_weight']
+    out = ex2.forward()
+    assert out[0].shape == (16, 4)
+
+
+def test_multi_output_executor():
+    data = sym.Variable('data')
+    parts = sym.SliceChannel(data, num_outputs=2, axis=1)
+    ex = parts.bind(mx.cpu(), {'data': nd.array(np.arange(8).reshape(2, 4))})
+    outs = ex.forward()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].asnumpy(), [[0, 1], [4, 5]])

@@ -1,0 +1,207 @@
+"""Module API tests — the end-to-end slice of SURVEY.md §7 step 5
+(model: reference tests/python/unittest/test_module.py +
+tests/python/train/test_mlp.py convergence runs)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _make_blobs(n=400, dim=10, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim) * 3
+    X = np.zeros((n, dim), dtype=np.float32)
+    y = np.zeros((n,), dtype=np.float32)
+    for i in range(n):
+        c = i % classes
+        X[i] = centers[c] + rng.randn(dim) * 0.5
+        y[i] = c
+    return X, y
+
+
+def _mlp_sym(classes=3):
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, name='fc1', num_hidden=32)
+    act = sym.Activation(fc1, act_type='relu')
+    fc2 = sym.FullyConnected(act, name='fc2', num_hidden=classes)
+    return sym.SoftmaxOutput(fc2, name='softmax')
+
+
+def test_module_fit_converges():
+    X, y = _make_blobs()
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=10,
+            optimizer_params={'learning_rate': 0.5})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=40), 'acc')
+    assert score[0][1] > 0.95, 'MLP failed to fit blobs: %s' % score
+
+
+def test_module_multi_device_data_parallel():
+    """Multi-context DP via mesh sharding (the reference tests this with
+    cpu(0)/cpu(1), test_multi_device_exec.py)."""
+    X, y = _make_blobs()
+    train = mx.io.NDArrayIter(X, y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=[mx.cpu(i) for i in range(4)])
+    mod.fit(train, num_epoch=8, optimizer_params={'learning_rate': 0.5})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=40), 'acc')
+    assert score[0][1] > 0.95, 'multi-device MLP failed: %s' % score
+
+
+def test_module_predict_and_pad():
+    X, y = _make_blobs(n=110)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=40)  # 110 -> pad 10 in last
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (110, 3)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _make_blobs()
+    train = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={'learning_rate': 0.5})
+    prefix = str(tmp_path / 'mlp')
+    mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=40)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        np.testing.assert_allclose(a1[k].asnumpy(), a2[k].asnumpy(),
+                                   rtol=1e-5)
+    # predictions identical
+    p1 = mod.predict(mx.io.NDArrayIter(X, y, batch_size=40)).asnumpy()
+    p2 = mod2.predict(mx.io.NDArrayIter(X, y, batch_size=40)).asnumpy()
+    np.testing.assert_allclose(p1, p2, rtol=1e-5)
+
+
+def test_module_update_on_kvstore_matches_local():
+    """push/pull-on-store and local-updater paths produce identical
+    updates (the reference asserts exact sync-SGD arithmetic in
+    tests/nightly/dist_sync_kvstore.py)."""
+    X, y = _make_blobs(n=80)
+
+    def run(kv):
+        mx.random.seed(7)
+        train = mx.io.NDArrayIter(X, y, batch_size=40)
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.fit(train, num_epoch=2, kvstore=kv,
+                optimizer_params={'learning_rate': 0.1},
+                initializer=mx.init.Xavier(),
+                force_init=True)
+        return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+    p_none = run(None)
+    p_local = run('local')  # single device -> kv is None internally
+    p_device = run('device')
+    for k in p_none:
+        np.testing.assert_allclose(p_none[k], p_local[k], rtol=1e-5)
+        np.testing.assert_allclose(p_none[k], p_device[k], rtol=1e-5)
+
+
+def test_lenet_trains():
+    """Conv net end-to-end (reference tests/python/train/test_conv.py
+    shape, synthetic data instead of MNIST download)."""
+    rng = np.random.RandomState(0)
+    n = 160
+    X = np.zeros((n, 1, 12, 12), dtype=np.float32)
+    y = np.zeros((n,), dtype=np.float32)
+    for i in range(n):
+        c = i % 2
+        X[i, 0] = rng.rand(12, 12) * 0.2
+        if c:
+            X[i, 0, 3:9, 3:9] += 1.0  # bright square for class 1
+        y[i] = c
+    data = sym.Variable('data')
+    c1 = sym.Convolution(data, name='c1', kernel=(3, 3), num_filter=8)
+    a1 = sym.Activation(c1, act_type='relu')
+    p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    fl = sym.Flatten(p1)
+    fc = sym.FullyConnected(fl, name='fc', num_hidden=2)
+    net = sym.SoftmaxOutput(fc, name='softmax')
+    train = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer_params={'learning_rate': 0.1})
+    score = mod.score(mx.io.NDArrayIter(X, y, batch_size=16), 'acc')
+    assert score[0][1] > 0.95, 'LeNet-style net failed: %s' % score
+
+
+def test_bucketing_module():
+    """Variable-length training via bucketing (reference
+    test_bucketing.py pattern, tiny scale)."""
+    def sym_gen(seq_len):
+        data = sym.Variable('data')
+        label = sym.Variable('softmax_label')
+        fc = sym.FullyConnected(data, name='fc_shared', num_hidden=8)
+        act = sym.Activation(fc, act_type='relu')
+        out = sym.FullyConnected(act, name='out_shared', num_hidden=2)
+        net = sym.SoftmaxOutput(out, label=label, name='softmax')
+        return net, ('data',), ('softmax_label',)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    rng = np.random.RandomState(0)
+
+    def make_batch(seq_len, batch=8):
+        X = rng.rand(batch, seq_len).astype(np.float32)
+        y = (X.sum(axis=1) > seq_len / 2).astype(np.float32)
+        return mx.io.DataBatch(
+            data=[nd.array(X)], label=[nd.array(y)], bucket_key=seq_len,
+            provide_data=[mx.io.DataDesc('data', (batch, seq_len))],
+            provide_label=[mx.io.DataDesc('softmax_label', (batch,))])
+
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 8))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={'learning_rate': 0.5})
+    for i in range(300):
+        batch = make_batch(8)
+        mod.forward_backward(batch)
+        mod.update()
+    metric = mx.metric.create('acc')
+    for _ in range(10):
+        batch = make_batch(8)
+        mod.forward(batch, is_train=False)
+        mod.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.65, metric.get()
+
+
+def test_optimizers_step():
+    """Each optimizer makes a step without error and reduces a quadratic."""
+    for name in ['sgd', 'adam', 'rmsprop', 'adagrad', 'adadelta', 'nag',
+                 'adamax', 'nadam', 'signum', 'ftrl']:
+        opt = mx.optimizer.create(name, rescale_grad=1.0)
+        w = nd.array([5.0])
+        state = opt.create_state(0, w)
+        for i in range(50):
+            g = 2 * w  # d/dw w^2
+            opt.update(0, w, g, state)
+        assert abs(w.asscalar()) < 5.0, '%s failed to descend' % name
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    sched.base_lr = 1.0
+    assert sched(5) == 1.0
+    assert sched(11) == 0.5
+    msched = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1)
+    msched.base_lr = 1.0
+    assert abs(msched(6) - 0.1) < 1e-9
+    assert abs(msched(11) - 0.01) < 1e-9
+
+
+def test_metrics():
+    acc = mx.metric.create('acc')
+    acc.update([nd.array([1, 0])], [nd.array([[0.3, 0.7], [0.6, 0.4]])])
+    assert acc.get()[1] == 1.0
+    mse = mx.metric.create('mse')
+    mse.update([nd.array([1.0, 2.0])], [nd.array([[1.5], [2.5]])])
+    assert abs(mse.get()[1] - 0.25) < 1e-6
+    comp = mx.metric.create(['acc', 'mse'])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)

@@ -1,0 +1,121 @@
+"""Symbol graph API tests (model: reference
+tests/python/unittest/test_symbol.py + test_infer_shape.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, name='fc1', num_hidden=128)
+    act1 = sym.Activation(fc1, name='relu1', act_type='relu')
+    fc2 = sym.FullyConnected(act1, name='fc2', num_hidden=10)
+    out = sym.SoftmaxOutput(fc2, name='softmax')
+    return out
+
+
+def test_compose_and_list_arguments():
+    net = _mlp()
+    args = net.list_arguments()
+    assert args == ['data', 'fc1_weight', 'fc1_bias', 'fc2_weight',
+                    'fc2_bias', 'softmax_label']
+    assert net.list_outputs() == ['softmax_output']
+    assert net.name == 'softmax'
+
+
+def test_auto_naming():
+    with mx.NameManager():
+        data = sym.Variable('data')
+        fc = sym.FullyConnected(data, num_hidden=4)
+        assert fc.name == 'fullyconnected0'
+        fc2 = sym.FullyConnected(fc, num_hidden=4)
+        assert fc2.name == 'fullyconnected1'
+
+
+def test_infer_shape_mlp():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 784))
+    args = net.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d['fc1_weight'] == (128, 784)
+    assert d['fc1_bias'] == (128,)
+    assert d['fc2_weight'] == (10, 128)
+    assert d['softmax_label'] == (32,)
+    assert out_shapes == [(32, 10)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv():
+    data = sym.Variable('data')
+    conv = sym.Convolution(data, name='conv', kernel=(3, 3), num_filter=8,
+                           pad=(1, 1))
+    bn = sym.BatchNorm(conv, name='bn')
+    pool = sym.Pooling(bn, kernel=(2, 2), stride=(2, 2), pool_type='max')
+    arg_shapes, out_shapes, aux_shapes = pool.infer_shape(data=(4, 3, 8, 8))
+    d = dict(zip(pool.list_arguments(), arg_shapes))
+    assert d['conv_weight'] == (8, 3, 3, 3)
+    assert d['conv_bias'] == (8,)
+    assert d['bn_gamma'] == (8,)
+    assert out_shapes == [(4, 8, 4, 4)]
+    assert pool.list_auxiliary_states() == ['bn_moving_mean', 'bn_moving_var']
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_infer_shape_partial():
+    net = _mlp()
+    arg_shapes, out_shapes, _ = net.infer_shape_partial()
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    # data/weights unknown; biases are inferable from num_hidden alone
+    assert d['data'] is None
+    assert d['fc1_weight'] is None
+    assert d['fc1_bias'] == (128,)
+
+
+def test_group_and_internals():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = a + b
+    g = sym.Group([c, a])
+    assert len(g) == 2
+    net = _mlp()
+    internals = net.get_internals()
+    assert 'fc1_output' in internals.list_outputs()
+    fc1 = internals['fc1_output']
+    assert fc1.list_arguments() == ['data', 'fc1_weight', 'fc1_bias']
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    _, out_shapes, _ = net2.infer_shape(data=(8, 100))
+    assert out_shapes == [(8, 10)]
+
+
+def test_symbol_arithmetic_eval():
+    a = sym.Variable('a')
+    b = sym.Variable('b')
+    c = 2 * a + b ** 2 - 1
+    ex = c.bind(mx.cpu(), {'a': mx.nd.array([1.0, 2.0]),
+                           'b': mx.nd.array([3.0, 4.0])})
+    out = ex.forward()[0]
+    np.testing.assert_allclose(out.asnumpy(), [2 * 1 + 9 - 1, 2 * 2 + 16 - 1])
+
+
+def test_variable_shape_attr():
+    v = sym.Variable('x', shape=(3, 4), lr_mult=2.0)
+    assert v.attr('__shape__') == str((3, 4))
+
+
+def test_slice_channel_multi_output():
+    data = sym.Variable('data')
+    s = sym.SliceChannel(data, num_outputs=3, axis=1)
+    assert len(s) == 3
+    assert s.list_outputs() == ['slicechannel0_output0',
+                                'slicechannel0_output1',
+                                'slicechannel0_output2'] or len(s.list_outputs()) == 3
+    _, out_shapes, _ = s.infer_shape(data=(2, 6, 4))
+    assert out_shapes == [(2, 2, 4)] * 3
