@@ -40,3 +40,4 @@ from . import module as mod
 from .module import Module
 from . import parallel
 from .io import DataBatch, DataIter, NDArrayIter, DataDesc
+from . import test_utils

@@ -142,8 +142,6 @@ class Executor:
                     dst._data = v._data.astype(dst.dtype)
                 else:
                     dst._data = jnp.asarray(v, dtype=dst.dtype)
-            elif isinstance(v, bool):
-                pass
             else:
                 raise MXNetError('forward: unknown argument %s' % k)
 

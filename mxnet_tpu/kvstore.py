@@ -103,14 +103,16 @@ class KVStore:
         this store (conceptually: sharded optimizer state over the mesh)."""
         # exercise the serialization path for parity with the reference
         # (symbol handles are per-process, dropped before the wire —
-        # lr/wd multipliers were already extracted from it at creation)
+        # lr/wd multipliers were already extracted from it at creation),
+        # but keep driving the caller's optimizer object so mid-training
+        # mutations (lr decay, set_wd_mult) stay effective, as the
+        # reference's local kvstore does.
         sym_ref = getattr(optimizer, 'sym', None)
         optimizer.sym = None
         try:
-            optimizer = pickle.loads(pickle.dumps(optimizer))
+            pickle.loads(pickle.dumps(optimizer))
         finally:
-            if sym_ref is not None:
-                optimizer.sym = sym_ref
+            optimizer.sym = sym_ref
         self._optimizer = optimizer
         self._updater = opt.get_updater(optimizer)
 

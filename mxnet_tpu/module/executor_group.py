@@ -161,6 +161,29 @@ class DataParallelExecutorGroup:
         if self.mesh is not None:
             self._apply_shardings()
 
+    def reshape(self, data_shapes, label_shapes=None):
+        """Rebind to new input shapes (reference executor_group.py reshape):
+        refreshes batch_size and re-applies mesh shardings so gradient
+        rescaling and device placement stay consistent."""
+        self.data_shapes = list(data_shapes)
+        self.label_shapes = list(label_shapes) if label_shapes else []
+        self.batch_size = (self.data_shapes[0][1]
+                           if isinstance(self.data_shapes[0], (list, tuple))
+                           else self.data_shapes[0].shape)[0]
+        if self.mesh is not None and \
+                self.batch_size % len(self.contexts) != 0:
+            raise MXNetError(
+                'batch size %d not divisible by %d devices'
+                % (self.batch_size, len(self.contexts)))
+        shapes = {}
+        for d in self.data_shapes + self.label_shapes:
+            name, shape = (d[0], d[1]) if isinstance(d, (list, tuple)) else \
+                (d.name, d.shape)
+            shapes[name] = shape
+        self.executor = self.executor.reshape(**shapes)
+        if self.mesh is not None:
+            self._apply_shardings()
+
     @property
     def param_arrays(self):
         return [self.executor.arg_dict[n] for n in self.param_names]

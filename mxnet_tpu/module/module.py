@@ -392,10 +392,4 @@ class Module(BaseModule):
         assert self.binded
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else []
-        shapes = {}
-        for d in self._data_shapes + self._label_shapes:
-            name, shape = (d[0], d[1]) if isinstance(d, (list, tuple)) else \
-                (d.name, d.shape)
-            shapes[name] = shape
-        self._exec_group.executor = self._exec_group.executor.reshape(
-            **shapes)
+        self._exec_group.reshape(self._data_shapes, self._label_shapes)

@@ -8,5 +8,7 @@ from . import registry
 from . import tensor
 from . import nn
 from . import random_ops
+from . import spatial
+from . import extra
 
 from .registry import get, exists, list_ops, register, OpDef, OpContext

@@ -1,0 +1,202 @@
+"""Remaining loss / regularization / misc operators.
+
+TPU-native equivalents of the reference's svm_output-inl.h,
+smooth_l1 (elemwise_binary_scalar_op_extended.cc),
+identity_attach_KL_sparse_reg-inl.h, and the linalg op family
+(src/operator/tensor/la_op.cc + linalg_impl.h; SURVEY.md §2.3).
+Loss outputs follow the framework convention of ignoring incoming head
+gradients via jax.custom_vjp (like SoftmaxOutput in ops/nn.py).
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, asbool, asint, asfloat
+from ..base import parse_attr_value
+
+
+# ---------------------------------------------------------------------------
+# SVMOutput — reference src/operator/svm_output-inl.h
+# forward = identity; backward = (squared) hinge-loss gradient, ignoring
+# head grads.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _svm_output_fn(params, data, label):
+    return data
+
+
+def _svm_bwd(params, res, g):
+    margin, reg_coef, use_linear = params
+    data, label = res
+    lab = label.astype(jnp.int32)
+    k = data.shape[-1]
+    onehot = jax.nn.one_hot(lab, k, dtype=data.dtype)
+    score_y = jnp.sum(data * onehot, axis=-1, keepdims=True)
+    viol = (margin + data - score_y) > 0            # includes j == y slot
+    viol = jnp.logical_and(viol, onehot == 0)
+    if use_linear:
+        gj = viol.astype(data.dtype) * reg_coef
+    else:
+        gj = viol.astype(data.dtype) * 2.0 * reg_coef * \
+            (margin + data - score_y)
+    gy = -gj.sum(axis=-1, keepdims=True)
+    grad = gj + onehot * gy
+    return grad, jnp.zeros_like(label)
+
+
+_svm_output_fn.defvjp(
+    lambda params, data, label: (data, (data, label)),
+    _svm_bwd)
+
+
+@register('SVMOutput', input_names=('data', 'label'), hint='svmoutput',
+          infer_shape=lambda attrs, s: (
+              s if s[0] is None or s[1] is not None
+              else [s[0], (s[0][0],)]))
+def _svm_output(attrs, data, label):
+    params = (asfloat(attrs.get('margin', 1.0)),
+              asfloat(attrs.get('regularization_coefficient', 1.0)),
+              asbool(attrs.get('use_linear', False)))
+    return _svm_output_fn(params, data, label)
+
+
+# ---------------------------------------------------------------------------
+# smooth_l1 — reference src/operator/tensor/elemwise_binary_scalar_op_extended.cc
+# f(x) = 0.5 (sigma x)^2        if |x| < 1/sigma^2
+#        |x| - 0.5/sigma^2      otherwise
+# ---------------------------------------------------------------------------
+
+@register('smooth_l1', input_names=('data',))
+def _smooth_l1(attrs, data):
+    sigma = asfloat(attrs.get('scalar', 1.0))
+    s2 = sigma * sigma
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * data * data,
+                     absx - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg — reference
+# src/operator/identity_attach_KL_sparse_reg-inl.h: identity forward; the
+# backward adds the KL-sparsity penalty gradient computed from a moving
+# average of the mean activation (aux state).
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _kl_sparse_fn(params, data, moving_avg):
+    return data
+
+
+def _kl_sparse_bwd(params, res, g):
+    rho, penalty = params
+    moving_avg = res
+    # d/da [rho log(rho/a) + (1-rho) log((1-rho)/(1-a))]
+    kl_grad = penalty * (-rho / moving_avg + (1.0 - rho) / (1.0 - moving_avg))
+    return g + kl_grad[None, :], jnp.zeros_like(moving_avg)
+
+
+_kl_sparse_fn.defvjp(
+    lambda params, data, moving_avg: (data, moving_avg),
+    _kl_sparse_bwd)
+
+
+def _kl_sparse_compute(attrs, inputs, auxs, op_ctx):
+    data = inputs[0]
+    moving_avg = auxs[0]
+    rho = asfloat(attrs.get('sparseness_target', 0.1))
+    penalty = asfloat(attrs.get('penalty', 0.001))
+    momentum = asfloat(attrs.get('momentum', 0.9))
+    if op_ctx.is_train:
+        avg = jax.nn.sigmoid(data).mean(axis=0)
+        moving_avg = momentum * moving_avg + (1.0 - momentum) * avg
+    out = _kl_sparse_fn((rho, penalty), data, moving_avg)
+    return [out], [moving_avg]
+
+
+register('IdentityAttachKLSparseReg', input_names=('data', 'moving_avg'),
+         num_aux=1, mode_dependent=True, mutable_aux=True, simple=False,
+         hint='identityattachklsparsereg',
+         infer_shape=lambda attrs, s: (
+             s if s[0] is None or s[1] is not None
+             else [s[0], (s[0][1],)]))(_kl_sparse_compute)
+
+
+# ---------------------------------------------------------------------------
+# Linear-algebra op family — reference src/operator/tensor/la_op.cc
+# (LAPACK gemm/potrf/potri/trmm/trsm/sumlogdiag).  On TPU these lower to
+# XLA's native triangular-solve / cholesky HLOs.
+# ---------------------------------------------------------------------------
+
+def _tr(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register('linalg_gemm', input_names=('A', 'B', 'C'), hint='linalg_gemm')
+def _linalg_gemm(attrs, a, b, c):
+    ta = asbool(attrs.get('transpose_a', False))
+    tb = asbool(attrs.get('transpose_b', False))
+    alpha = asfloat(attrs.get('alpha', 1.0))
+    beta = asfloat(attrs.get('beta', 1.0))
+    return alpha * jnp.matmul(_tr(a, ta), _tr(b, tb)) + beta * c
+
+
+@register('linalg_gemm2', input_names=('A', 'B'), hint='linalg_gemm2')
+def _linalg_gemm2(attrs, a, b):
+    ta = asbool(attrs.get('transpose_a', False))
+    tb = asbool(attrs.get('transpose_b', False))
+    alpha = asfloat(attrs.get('alpha', 1.0))
+    return alpha * jnp.matmul(_tr(a, ta), _tr(b, tb))
+
+
+@register('linalg_potrf', input_names=('A',), hint='linalg_potrf')
+def _linalg_potrf(attrs, a):
+    return jnp.linalg.cholesky(a)
+
+
+@register('linalg_potri', input_names=('A',), hint='linalg_potri')
+def _linalg_potri(attrs, a):
+    # input is the cholesky factor L; output inv(L L^T)
+    n = a.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=a.dtype), a.shape)
+    linv = jax.scipy.linalg.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register('linalg_trmm', input_names=('A', 'B'), hint='linalg_trmm')
+def _linalg_trmm(attrs, a, b):
+    ta = asbool(attrs.get('transpose', False))
+    rightside = asbool(attrs.get('rightside', False))
+    alpha = asfloat(attrs.get('alpha', 1.0))
+    at = _tr(a, ta)
+    return alpha * (jnp.matmul(b, at) if rightside else jnp.matmul(at, b))
+
+
+@register('linalg_trsm', input_names=('A', 'B'), hint='linalg_trsm')
+def _linalg_trsm(attrs, a, b):
+    ta = asbool(attrs.get('transpose', False))
+    rightside = asbool(attrs.get('rightside', False))
+    alpha = asfloat(attrs.get('alpha', 1.0))
+    if rightside:
+        # solve X A^(T) = alpha B  <=>  A^(T)^T X^T = alpha B^T
+        xt = jax.scipy.linalg.solve_triangular(
+            _tr(a, not ta), jnp.swapaxes(alpha * b, -1, -2), lower=ta)
+        return jnp.swapaxes(xt, -1, -2)
+    return jax.scipy.linalg.solve_triangular(_tr(a, ta), alpha * b,
+                                             lower=not ta)
+
+
+@register('linalg_sumlogdiag', input_names=('A',), hint='linalg_sumlogdiag')
+def _linalg_sumlogdiag(attrs, a):
+    diag = jnp.diagonal(a, axis1=-2, axis2=-1)
+    return jnp.log(diag).sum(axis=-1)
+
+
+@register('linalg_syrk', input_names=('A',), hint='linalg_syrk')
+def _linalg_syrk(attrs, a):
+    ta = asbool(attrs.get('transpose', False))
+    alpha = asfloat(attrs.get('alpha', 1.0))
+    at = _tr(a, ta)
+    return alpha * jnp.matmul(at, jnp.swapaxes(at, -1, -2))

@@ -113,3 +113,75 @@ register('_sample_multinomial', input_names=('data',), needs_rng=True,
              attrs.get('get_prob', False)) else 1,
          aliases=('sample_multinomial', 'multinomial'),
          simple=False)(_multinomial_compute)
+
+
+# ---------------------------------------------------------------------------
+# Multi-distribution samplers — reference src/operator/random/multisample_op.cc
+# (`sample_uniform` & friends): the distribution-parameter tensors give one
+# distribution per element; `shape` gives per-distribution sample counts,
+# appended to the parameter shape.
+# ---------------------------------------------------------------------------
+
+def _msample_shape(attrs, param):
+    shape = attrs.get('shape', ())
+    shape = astuple(shape) if shape not in (None, '', ()) else ()
+    return tuple(param.shape) + tuple(shape), shape
+
+
+def _expand(param, extra_ndim):
+    return param.reshape(param.shape + (1,) * extra_ndim)
+
+
+def _reg_msampler(name, input_names, draw):
+    def compute(attrs, inputs, auxs, op_ctx, _draw=draw):
+        full, extra = _msample_shape(attrs, inputs[0])
+        dtype = np.dtype(attrs.get('dtype', None) or np.float32)
+        params = [_expand(p, len(extra)) for p in inputs]
+        return [_draw(op_ctx.rng, params, full).astype(dtype)], []
+    register(name, input_names=input_names, needs_rng=True,
+             simple=False, hint=name)(compute)
+
+
+_reg_msampler('sample_uniform', ('low', 'high'),
+              lambda key, p, shape: jax.random.uniform(key, shape)
+              * (p[1] - p[0]) + p[0])
+
+_reg_msampler('sample_normal', ('mu', 'sigma'),
+              lambda key, p, shape: jax.random.normal(key, shape)
+              * p[1] + p[0])
+
+_reg_msampler('sample_gamma', ('alpha', 'beta'),
+              lambda key, p, shape: jax.random.gamma(
+                  key, jnp.broadcast_to(p[0], shape)) * p[1])
+
+_reg_msampler('sample_exponential', ('lam',),
+              lambda key, p, shape: jax.random.exponential(key, shape)
+              / p[0])
+
+_reg_msampler('sample_poisson', ('lam',),
+              lambda key, p, shape: jax.random.poisson(
+                  key, jnp.broadcast_to(p[0], shape), shape))
+
+
+def _msample_neg_binomial(key, p, shape):
+    k, prob = p
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, jnp.broadcast_to(k, shape)) \
+        * (1.0 - prob) / prob
+    return jax.random.poisson(kp, lam, shape)
+
+
+_reg_msampler('sample_negative_binomial', ('k', 'p'),
+              _msample_neg_binomial)
+
+
+def _msample_gen_neg_binomial(key, p, shape):
+    mu, alpha = p
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(kg, jnp.broadcast_to(r, shape)) * (mu * alpha)
+    return jax.random.poisson(kp, lam, shape)
+
+
+_reg_msampler('sample_generalized_negative_binomial', ('mu', 'alpha'),
+              _msample_gen_neg_binomial)

@@ -1,0 +1,205 @@
+"""Spatial / vision operators.
+
+TPU-native re-implementation of the reference's spatial op family:
+grid_generator, bilinear_sampler, spatial_transformer, roi_pooling,
+correlation (src/operator/{grid_generator,bilinear_sampler,
+spatial_transformer,roi_pooling,correlation}-inl.h; SURVEY.md §2.3).
+The reference hand-writes CUDA gather kernels; here sampling is
+expressed as gathers + elementwise weights so XLA lowers it to
+vectorized dynamic-gathers, and ROI pooling uses a masked-max
+formulation (two staged maxes) that keeps all shapes static for the MXU.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register, astuple, asbool, asint, asfloat
+from ..base import parse_attr_value
+
+
+# ---------------------------------------------------------------------------
+# GridGenerator — reference src/operator/grid_generator-inl.h
+# ---------------------------------------------------------------------------
+
+def _regular_grid(h, w, dtype):
+    """Normalized sampling grid in [-1, 1], row 0 = x, row 1 = y."""
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=dtype) if h > 1 else \
+        jnp.zeros((h,), dtype)
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=dtype) if w > 1 else \
+        jnp.zeros((w,), dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    return gx, gy
+
+
+@register('GridGenerator', input_names=('data',), hint='gridgenerator')
+def _grid_generator(attrs, data):
+    ttype = str(parse_attr_value(attrs['transform_type']))
+    if ttype == 'affine':
+        h, w = astuple(attrs['target_shape'], 2)
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        gx, gy = _regular_grid(h, w, data.dtype)
+        ones = jnp.ones_like(gx)
+        src = jnp.stack([gx, gy, ones], 0).reshape(3, h * w)
+        out = jnp.einsum('nij,jk->nik', theta, src)
+        return out.reshape(n, 2, h, w)
+    # 'warp': data is a flow field (n, 2, h, w) in pixels
+    n, _, h, w = data.shape
+    gx, gy = _regular_grid(h, w, data.dtype)
+    # pixel flow -> normalized offsets
+    fx = data[:, 0] * 2.0 / max(w - 1, 1)
+    fy = data[:, 1] * 2.0 / max(h - 1, 1)
+    return jnp.stack([gx[None] + fx, gy[None] + fy], 1)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler — reference src/operator/bilinear_sampler-inl.h
+# ---------------------------------------------------------------------------
+
+def _bilinear_sample(data, grid):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) normalized [-1,1] -> (N,C,Ho,Wo).
+    Out-of-boundary samples read as 0 (reference zero-pads)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yi, xi):
+        inb = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        # per-batch gather: data[n, :, yc[n], xc[n]]
+        v = jax.vmap(lambda img, y, x: img[:, y, x])(data, yc, xc)
+        return v * inb.astype(data.dtype)[:, None]
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (v00 * (1 - wx) * (1 - wy) + v01 * wx * (1 - wy) +
+            v10 * (1 - wx) * wy + v11 * wx * wy)
+
+
+@register('BilinearSampler', input_names=('data', 'grid'),
+          hint='bilinearsampler')
+def _bilinear_sampler(attrs, data, grid):
+    return _bilinear_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# SpatialTransformer — reference src/operator/spatial_transformer-inl.h
+# ---------------------------------------------------------------------------
+
+def _st_infer_shape(attrs, in_shapes):
+    if len(in_shapes) > 1 and in_shapes[1] is None and in_shapes[0] is not None:
+        in_shapes[1] = (in_shapes[0][0], 6)
+    return in_shapes
+
+
+@register('SpatialTransformer', input_names=('data', 'loc'),
+          infer_shape=_st_infer_shape, hint='spatialtransformer')
+def _spatial_transformer(attrs, data, loc):
+    h, w = astuple(attrs['target_shape'], 2)
+    n = data.shape[0]
+    theta = loc.reshape(n, 2, 3)
+    gx, gy = _regular_grid(h, w, data.dtype)
+    src = jnp.stack([gx, gy, jnp.ones_like(gx)], 0).reshape(3, h * w)
+    grid = jnp.einsum('nij,jk->nik', theta, src).reshape(n, 2, h, w)
+    return _bilinear_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling — reference src/operator/roi_pooling-inl.h
+# ---------------------------------------------------------------------------
+
+@register('ROIPooling', input_names=('data', 'rois'), hint='roipooling')
+def _roi_pooling(attrs, data, rois):
+    ph, pw = astuple(attrs['pooled_size'], 2)
+    scale = asfloat(attrs['spatial_scale'])
+    n, c, h, w = data.shape
+    r = rois.shape[0]
+    batch = rois[:, 0].astype(jnp.int32)
+    # reference rounds roi coords to the integer grid
+    x1 = jnp.round(rois[:, 1] * scale)
+    y1 = jnp.round(rois[:, 2] * scale)
+    x2 = jnp.round(rois[:, 3] * scale)
+    y2 = jnp.round(rois[:, 4] * scale)
+    roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+    bin_h = roi_h / ph            # (R,)
+    bin_w = roi_w / pw
+
+    hs = jnp.arange(h, dtype=data.dtype)
+    ws = jnp.arange(w, dtype=data.dtype)
+    pi = jnp.arange(ph, dtype=data.dtype)
+    pj = jnp.arange(pw, dtype=data.dtype)
+    # bin [start, end) per (roi, bin): floor(p*bin)+y1 .. ceil((p+1)*bin)+y1
+    hstart = jnp.clip(jnp.floor(pi[None] * bin_h[:, None]) + y1[:, None],
+                      0, h)                       # (R, PH)
+    hend = jnp.clip(jnp.ceil((pi[None] + 1) * bin_h[:, None]) + y1[:, None],
+                    0, h)
+    wstart = jnp.clip(jnp.floor(pj[None] * bin_w[:, None]) + x1[:, None],
+                      0, w)
+    wend = jnp.clip(jnp.ceil((pj[None] + 1) * bin_w[:, None]) + x1[:, None],
+                    0, w)
+    mask_h = ((hs[None, None] >= hstart[..., None]) &
+              (hs[None, None] < hend[..., None]))     # (R, PH, H)
+    mask_w = ((ws[None, None] >= wstart[..., None]) &
+              (ws[None, None] < wend[..., None]))     # (R, PW, W)
+
+    neg = jnp.asarray(-np.inf, data.dtype)
+    x = data[batch]                                   # (R, C, H, W)
+    # stage 1: max over W for each output column
+    xw = jnp.where(mask_w[:, None, None, :, :], x[:, :, :, None, :], neg)
+    xw = xw.max(axis=-1)                              # (R, C, H, PW)
+    # stage 2: max over H for each output row
+    xh = jnp.where(mask_h[:, None, :, :, None],       # (R,1,PH,H,1)
+                   xw[:, :, None, :, :], neg)         # (R,C,1,H,PW)
+    out = xh.max(axis=3)                              # (R, C, PH, PW)
+    # empty bins (hend<=hstart) pool to 0 in the reference
+    empty = jnp.isneginf(out)
+    return jnp.where(empty, 0.0, out).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Correlation — reference src/operator/correlation-inl.h (FlowNet)
+# ---------------------------------------------------------------------------
+
+@register('Correlation', input_names=('data1', 'data2'), hint='correlation')
+def _correlation(attrs, data1, data2):
+    kernel = asint(attrs.get('kernel_size', 1))
+    max_disp = asint(attrs.get('max_displacement', 1))
+    stride1 = asint(attrs.get('stride1', 1))
+    stride2 = asint(attrs.get('stride2', 1))
+    pad = asint(attrs.get('pad_size', 0))
+    is_mult = asbool(attrs.get('is_multiply', True))
+
+    n, c, h, w = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    border = max_disp + kernel // 2
+    out_h = int(np.ceil((ph - 2 * border) / float(stride1)))
+    out_w = int(np.ceil((pw - 2 * border) / float(stride1)))
+
+    krad = kernel // 2
+    ys = border + jnp.arange(out_h) * stride1
+    xs = border + jnp.arange(out_w) * stride1
+    outs = []
+    for dy in range(-(max_disp // stride2), max_disp // stride2 + 1):
+        for dx in range(-(max_disp // stride2), max_disp // stride2 + 1):
+            oy, ox = dy * stride2, dx * stride2
+            acc = 0.0
+            for ky in range(-krad, krad + 1):
+                for kx in range(-krad, krad + 1):
+                    a = p1[:, :, ys[:, None] + ky, xs[None] + kx]
+                    b = p2[:, :, ys[:, None] + ky + oy, xs[None] + kx + ox]
+                    acc = acc + (a * b if is_mult else jnp.abs(a - b))
+            outs.append(acc.sum(axis=1))
+    out = jnp.stack(outs, axis=1)          # (N, grid*grid, out_h, out_w)
+    return out / (c * kernel * kernel)

@@ -188,7 +188,6 @@ class NAG(SGD):
         else:
             mom = state
             mom *= self.momentum
-            grad += wd * weight * 0  # keep formula structure explicit
             mom += grad
             grad += self.momentum * mom
             weight -= lr * grad

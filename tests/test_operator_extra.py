@@ -1,0 +1,230 @@
+"""Spatial / loss / linalg / multisample operator tests, exercised through
+the test_utils oracles (model: reference tests/python/unittest/
+test_operator.py numeric-gradient style)."""
+import numpy as np
+import scipy.linalg  # noqa: F401  (availability check for trsm oracle)
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward, check_consistency,
+                                  default_context)
+
+
+def test_assert_almost_equal_reports_violation():
+    try:
+        assert_almost_equal(np.array([1.0, 2.0]), np.array([1.0, 3.0]),
+                            rtol=1e-3)
+    except AssertionError as e:
+        assert 'position' in str(e)
+    else:
+        raise AssertionError('expected failure')
+
+
+def test_check_numeric_gradient_fc():
+    data = sym.Variable('data')
+    fc = sym.FullyConnected(data, name='fc', num_hidden=3)
+    loc = {'data': np.random.rand(4, 5).astype(np.float32),
+           'fc_weight': np.random.rand(3, 5).astype(np.float32),
+           'fc_bias': np.random.rand(3).astype(np.float32)}
+    check_numeric_gradient(fc, loc, rtol=1e-2, atol=1e-2)
+
+
+def test_grid_generator_affine():
+    data = sym.Variable('data')
+    g = sym.GridGenerator(data, transform_type='affine', target_shape=(3, 4))
+    # identity transform reproduces the regular grid
+    theta = np.array([[1, 0, 0, 0, 1, 0]], dtype=np.float32)
+    ex = g.bind(default_context(), {'data': nd.array(theta)})
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (1, 2, 3, 4)
+    np.testing.assert_allclose(out[0, 0, 0], np.linspace(-1, 1, 4), atol=1e-6)
+    np.testing.assert_allclose(out[0, 1, :, 0], np.linspace(-1, 1, 3),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_identity():
+    data = sym.Variable('data')
+    grid = sym.Variable('grid')
+    out = sym.BilinearSampler(data, grid)
+    n, c, h, w = 2, 3, 5, 4
+    x = np.random.rand(n, c, h, w).astype(np.float32)
+    gy, gx = np.meshgrid(np.linspace(-1, 1, h), np.linspace(-1, 1, w),
+                         indexing='ij')
+    g = np.stack([gx, gy], 0)[None].repeat(n, 0).astype(np.float32)
+    ex = out.bind(default_context(), {'data': nd.array(x),
+                                      'grid': nd.array(g)})
+    y = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(y, x, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = sym.Variable('data')
+    loc = sym.Variable('loc')
+    st = sym.SpatialTransformer(data, loc, target_shape=(6, 5),
+                                transform_type='affine',
+                                sampler_type='bilinear')
+    x = np.random.rand(2, 3, 6, 5).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    ex = st.bind(default_context(), {'data': nd.array(x),
+                                     'loc': nd.array(theta)})
+    y = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(y, x, rtol=1e-5, atol=1e-5)
+
+
+def test_roi_pooling_forward():
+    x = np.arange(1 * 1 * 6 * 6, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 0, 5, 5]], dtype=np.float32)
+    data = sym.Variable('data')
+    r = sym.Variable('rois')
+    out = sym.ROIPooling(data, r, pooled_size=(2, 2), spatial_scale=1.0)
+    ex = out.bind(default_context(), {'data': nd.array(x),
+                                      'rois': nd.array(rois)})
+    y = ex.forward()[0].asnumpy()
+    # max over each 3x3 quadrant
+    expect = np.array([[[[14, 17], [32, 35]]]], dtype=np.float32)
+    np.testing.assert_allclose(y, expect)
+
+
+def test_roi_pooling_batch_index():
+    x = np.stack([np.zeros((1, 4, 4), np.float32),
+                  np.ones((1, 4, 4), np.float32)])
+    rois = np.array([[1, 0, 0, 3, 3]], dtype=np.float32)
+    out = sym.ROIPooling(sym.Variable('data'), sym.Variable('rois'),
+                         pooled_size=(1, 1), spatial_scale=1.0)
+    ex = out.bind(default_context(), {'data': nd.array(x),
+                                      'rois': nd.array(rois)})
+    assert ex.forward()[0].asnumpy().item() == 1.0
+
+
+def test_correlation_self_unit():
+    # correlating an array with itself at zero displacement = mean of squares
+    x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    d1, d2 = sym.Variable('a'), sym.Variable('b')
+    out = sym.Correlation(d1, d2, kernel_size=1, max_displacement=0,
+                          stride1=1, stride2=1, pad_size=0)
+    ex = out.bind(default_context(), {'a': nd.array(x), 'b': nd.array(x)})
+    y = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(y[0, 0], (x * x).mean(axis=1)[0], rtol=1e-5)
+
+
+def test_svm_output_grad():
+    data = sym.Variable('data')
+    label = sym.Variable('label')
+    out = sym.SVMOutput(data, label, margin=1.0,
+                        regularization_coefficient=0.5)
+    x = np.array([[0.1, 0.2, 0.9]], np.float32)
+    lab = np.array([2], np.float32)
+    ex = out.bind(default_context(), {'data': nd.array(x),
+                                      'label': nd.array(lab)},
+                  args_grad={'data': nd.zeros((1, 3))},
+                  grad_req={'data': 'write', 'label': 'null'})
+    y = ex.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(y, x)  # forward is identity
+    ex.backward()
+    g = ex.grad_dict['data'].asnumpy()
+    # violations: margin + x_j - x_y for j=0: 1+0.1-0.9=0.2>0; j=1: 0.3>0
+    expect = np.array([[2 * 0.5 * 0.2, 2 * 0.5 * 0.3,
+                        -(2 * 0.5 * 0.2 + 2 * 0.5 * 0.3)]], np.float32)
+    np.testing.assert_allclose(g, expect, rtol=1e-5)
+
+
+def test_smooth_l1():
+    data = sym.Variable('data')
+    out = sym.smooth_l1(data, scalar=1.0)
+    x = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32)
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    check_symbolic_forward(out, {'data': x}, [expect])
+    check_numeric_gradient(out, {'data': x}, rtol=1e-2, atol=1e-2)
+
+
+def test_linalg_gemm():
+    a = np.random.rand(2, 3, 4).astype(np.float32)
+    b = np.random.rand(2, 4, 5).astype(np.float32)
+    c = np.random.rand(2, 3, 5).astype(np.float32)
+    out = sym.linalg_gemm(sym.Variable('A'), sym.Variable('B'),
+                          sym.Variable('C'), alpha=2.0, beta=0.5)
+    expect = 2.0 * np.matmul(a, b) + 0.5 * c
+    check_symbolic_forward(out, {'A': a, 'B': b, 'C': c}, [expect],
+                           rtol=1e-4)
+
+
+def test_linalg_potrf_roundtrip():
+    m = np.random.rand(3, 3).astype(np.float32)
+    spd = (m @ m.T + 3 * np.eye(3)).astype(np.float32)
+    lsym = sym.linalg_potrf(sym.Variable('A'))
+    ex = lsym.bind(default_context(), {'A': nd.array(spd)})
+    L = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    # potri: inverse of spd from its factor
+    inv = sym.linalg_potri(sym.Variable('L'))
+    ex2 = inv.bind(default_context(), {'L': nd.array(L)})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy() @ spd, np.eye(3),
+                               atol=1e-3)
+
+
+def test_linalg_trsm():
+    m = np.tril(np.random.rand(4, 4) + np.eye(4)).astype(np.float32)
+    b = np.random.rand(4, 3).astype(np.float32)
+    out = sym.linalg_trsm(sym.Variable('A'), sym.Variable('B'), alpha=1.0)
+    ex = out.bind(default_context(), {'A': nd.array(m), 'B': nd.array(b)})
+    x = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(m @ x, b, rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_sumlogdiag():
+    m = np.diag([1.0, 2.0, 4.0]).astype(np.float32)
+    out = sym.linalg_sumlogdiag(sym.Variable('A'))
+    check_symbolic_forward(out, {'A': m},
+                           [np.array(np.log(8.0), np.float32)], rtol=1e-5)
+
+
+def test_sample_uniform_shapes():
+    low = nd.array(np.zeros(3, np.float32))
+    high = nd.array(np.array([1.0, 10.0, 100.0], np.float32))
+    out = nd.sample_uniform(low, high, shape=(50,))
+    assert out.shape == (3, 50)
+    v = out.asnumpy()
+    assert (v[0] <= 1.0).all() and v[2].max() > 10.0
+
+
+def test_sample_normal_moments():
+    mu = nd.array(np.array([0.0, 5.0], np.float32))
+    sigma = nd.array(np.array([1.0, 0.1], np.float32))
+    v = nd.sample_normal(mu, sigma, shape=(2000,)).asnumpy()
+    assert abs(v[0].mean()) < 0.2
+    assert abs(v[1].mean() - 5.0) < 0.1
+
+
+def test_sample_gamma_mean():
+    alpha = nd.array(np.array([2.0], np.float32))
+    beta = nd.array(np.array([3.0], np.float32))
+    v = nd.sample_gamma(alpha, beta, shape=(3000,)).asnumpy()
+    assert abs(v.mean() - 6.0) < 0.5
+
+
+def test_check_consistency_dtype():
+    data = sym.Variable('data')
+    fc = sym.FullyConnected(data, name='fc', num_hidden=4)
+    ctx = default_context()
+    check_consistency(
+        fc,
+        [{'ctx': ctx, 'data': (3, 6)},
+         {'ctx': ctx, 'data': (3, 6),
+          'type_dict': {'data': np.float32}}],
+        rtol=1e-3, atol=1e-3)
+
+
+def test_kl_sparse_reg_identity_forward():
+    data = sym.Variable('data')
+    out = sym.IdentityAttachKLSparseReg(data, sparseness_target=0.1,
+                                        penalty=0.001)
+    x = np.random.rand(4, 6).astype(np.float32)
+    ex = out.simple_bind(default_context(), data=(4, 6),
+                         grad_req={'data': 'write'})
+    y = ex.forward(is_train=True, data=nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(y, x)
+    ex.backward(nd.ones((4, 6)))
+    g = ex.grad_dict['data'].asnumpy()
+    assert g.shape == x.shape
+    assert not np.allclose(g, 1.0)  # KL term was added
