@@ -107,6 +107,11 @@ def parse_attr_value(s):
 _REGISTRIES = {}
 
 
+def _pretty_name(name):
+    """CamelCase -> lowercase alias used for auto-prefixes (gluon)."""
+    return name.lower()
+
+
 def get_registry(base_class):
     return dict(_REGISTRIES.get(base_class, {}))
 

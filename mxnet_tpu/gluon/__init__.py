@@ -1,0 +1,12 @@
+"""Gluon: the imperative neural-network API
+(reference python/mxnet/gluon/; SURVEY.md §2.7)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import utils
+from . import data
+from . import model_zoo

@@ -1,0 +1,438 @@
+"""Gluon Block / HybridBlock.
+
+TPU-native counterpart of the reference's gluon block system
+(/root/reference python/mxnet/gluon/block.py: Block:115, HybridBlock:283,
+hybridize->CachedOp _build_cache:361-376).  A Block runs imperative
+NDArray ops eagerly (each op recorded on the autograd tape); a
+hybridized HybridBlock compiles its whole forward into ONE jitted JAX
+function — the TPU-native equivalent of CachedOp graph replay, except
+the "replay" is an XLA executable, so per-op Python overhead vanishes
+and XLA fuses the entire block.  Backward through a hybridized block is
+one jax.vjp over the same jitted function (one tape node).
+"""
+import jax
+
+from .. import ndarray as nd
+from .. import autograd
+from ..base import _pretty_name
+from ..context import current_context
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+
+class _BlockScope(object):
+    """Name/parameter scoping for blocks (reference block.py _BlockScope)."""
+    _current = None
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    _global_counter = {}
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = _BlockScope._current
+        if current is None:
+            if prefix is None:
+                count = _BlockScope._global_counter.get(hint, 0)
+                prefix = '%s%d_' % (_pretty_name(hint), count)
+                _BlockScope._global_counter[hint] = count + 1
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, shared=params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = '%s%d_' % (_pretty_name(hint), count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, shared=parent._shared)
+        else:
+            params = ParameterDict(params.prefix, shared=params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        self._old_scope = _BlockScope._current
+        _BlockScope._current = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        _BlockScope._current = self._old_scope
+
+
+class Block(object):
+    """Base class for all neural network layers and models
+    (reference gluon/block.py:115)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ''
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith('_') \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = '{name}(\n{modstr}\n)'
+        modstr = '\n'.join('  ({key}): {block}'.format(
+            key=i, block='\n  '.join(repr(b).split('\n')))
+            for i, b in enumerate(self._children))
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self):
+        """Returns a ParameterDict of this block's and children's params."""
+        ret = ParameterDict(self._params.prefix)
+        ret.update(self.params)
+        for child in self._children:
+            ret.update(child.collect_params())
+        return ret
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing,
+                                   ignore_extra, restore_prefix=self.prefix)
+
+    def register_child(self, block):
+        self._children.append(block)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            old = getattr(self, name, None)
+            if isinstance(old, Block) and old in self._children:
+                self._children[self._children.index(old)] = value
+            else:
+                self.register_child(value)
+        super(Block, self).__setattr__(name, value)
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def cast(self, dtype):
+        for child in self._children:
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def hybridize(self, active=True):
+        for child in self._children:
+            child.hybridize(active)
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class _CachedFn(object):
+    """The compiled block function — the direct analog of the reference
+    CachedOp (c_api_ndarray.cc:464).
+
+    `full(flat)` takes [inputs..., params..., rng_key] and returns
+    (outputs, aux_updates) where aux_updates are the post-forward values
+    of the non-trainable (grad_req='null') parameters, e.g. BatchNorm
+    moving stats — the mutable-aux contract of the reference stateful
+    ops preserved across the jit boundary."""
+
+    def __init__(self, full, aux_params):
+        self.full = full
+        self.aux_params = aux_params   # list of (name, Parameter)
+
+
+class _CachedCallNode(object):
+    """Per-call tape node: closes over the rng key used in the forward so
+    autograd's vjp replays the identical compiled function."""
+    num_aux = 0
+    mutable_aux = False
+    name = '_cached_block'
+
+    def __init__(self, full, rng):
+        self.full = full
+        self.rng = rng
+
+    def apply(self, attrs, in_data, aux_data, op_ctx):
+        outs, _ = self.full(list(in_data) + [self.rng])
+        return list(outs), []
+
+
+class HybridBlock(Block):
+    """A Block whose forward is expressed over an abstract namespace F
+    (F = mx.nd imperatively, or a jit-traced version once hybridized).
+    Reference gluon/block.py:283."""
+
+    def __init__(self, prefix=None, params=None):
+        super(HybridBlock, self).__init__(prefix, params)
+        self._active = False
+        self._cached_fn = None
+        self._reg_params = {}
+
+    def __setattr__(self, name, value):
+        super(HybridBlock, self).__setattr__(name, value)
+        if isinstance(value, Parameter):
+            self._reg_params[name] = value
+
+    def register_child(self, block):
+        if not isinstance(block, HybridBlock):
+            raise ValueError(
+                "Children of HybridBlock must also be HybridBlock, but %s "
+                "has type %s." % (str(block), str(type(block))))
+        super(HybridBlock, self).register_child(block)
+        self._cached_fn = None
+
+    def hybridize(self, active=True):
+        self._active = active
+        self._cached_fn = None
+        super(HybridBlock, self).hybridize(active)
+
+    def cast(self, dtype):
+        self._cached_fn = None
+        super(HybridBlock, self).cast(dtype)
+
+    def infer_shape(self, *args):
+        """Run a deferred-shape-completing forward (shapes only)."""
+        self._deferred_infer_shape(*args)
+
+    def _deferred_infer_shape(self, *args):
+        # complete unknown parameter shapes by tracing with eval_shape
+        params = self.collect_params()
+        pending = [p for p in params.values() if p._deferred_init]
+        if not pending:
+            return
+        # run the imperative forward with zero-filled temporaries to let
+        # each layer back-fill its own parameter shapes (layers implement
+        # shape completion in their hybrid_forward input handling)
+        raise DeferredInitializationError(
+            'Parameters %s have unknown shape. Layers complete shapes on '
+            'first forward.' % [p.name for p in pending])
+
+    def _collect_params_with_prefix(self, prefix=''):
+        if prefix:
+            prefix += '.'
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for i, child in enumerate(self._children):
+            ret.update(child._collect_params_with_prefix(prefix + str(i)))
+        return ret
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, x, *args):
+        if not isinstance(x, nd.NDArray):
+            raise ValueError(
+                'HybridBlock forward input must be NDArray, got %s'
+                % type(x))
+        if self._active and not _TRACING:
+            return self._call_cached(x, *args)
+        ctx = x.context
+        params = {}
+        try:
+            for k, v in self._reg_params.items():
+                sub = _lookup_param_substitution(v)
+                params[k] = sub if sub is not None else v.data(ctx)
+        except DeferredInitializationError:
+            self._infer_param_shapes(x, *args)
+            for k, v in self._reg_params.items():
+                params[k] = v.data(ctx)
+        return self.hybrid_forward(nd, x, *args, **params)
+
+    def _infer_param_shapes(self, x, *args):
+        """Complete this layer's deferred parameter shapes from the input.
+        Leaf layers with deferred-init params override this
+        (reference: gluon parameter deferred init on first forward)."""
+        raise DeferredInitializationError(
+            '%s has parameters with unknown shape and does not implement '
+            'shape inference from inputs.' % type(self).__name__)
+
+    # -- hybridized path ---------------------------------------------------
+    def _call_cached(self, x, *args):
+        import jax.tree_util as jtu
+        ctx = x.context
+        try:
+            pdata = self._param_data(ctx)
+        except DeferredInitializationError:
+            # first forward runs imperatively so each leaf layer can
+            # complete its deferred shapes from its real input
+            self._active = False
+            try:
+                return self.forward(x, *args)
+            finally:
+                self._active = True
+        # flatten the FULL argument structure (nested lists of states
+        # etc.); NDArrays become traced inputs, everything else is static
+        # and part of the cache key
+        leaves, treedef = jtu.tree_flatten(
+            (x,) + args, is_leaf=lambda a: isinstance(a, nd.NDArray))
+        nd_pos = tuple(i for i, l in enumerate(leaves)
+                       if isinstance(l, nd.NDArray))
+        inputs = [leaves[i] for i in nd_pos]
+        static = tuple((i, l) for i, l in enumerate(leaves)
+                       if not isinstance(l, nd.NDArray))
+        is_train = autograd.is_training()
+        key = (treedef, nd_pos, repr(static), is_train)
+        if self._cached_fn is None:
+            self._cached_fn = {}
+        if key not in self._cached_fn:
+            self._cached_fn[key] = self._build_cache(
+                treedef, nd_pos, static, is_train)
+        cached = self._cached_fn[key]
+        from .. import random as _random
+        rngk = _random.next_key()
+        outs, aux_updates = cached.full(
+            [a._data for a in inputs] + pdata + [rngk])
+        if is_train:
+            for (_, p), new in zip(cached.aux_params, aux_updates):
+                p.data(ctx)._data = new
+        out_arrays = [nd.NDArray(o, ctx) for o in outs]
+        if autograd.is_recording():
+            node = _CachedCallNode(cached.full, rngk)
+            autograd.record_op(node, {}, inputs +
+                               self._param_arrays(ctx), [], out_arrays, None)
+        return jtu.tree_unflatten(cached.out_treedef, out_arrays)
+
+    def _param_list(self):
+        params = self._collect_params_with_prefix()
+        return sorted(params.items())
+
+    def _param_arrays(self, ctx):
+        return [p.data(ctx) for _, p in self._param_list()]
+
+    def _param_data(self, ctx):
+        return [p.data(ctx)._data for _, p in self._param_list()]
+
+    def _build_cache(self, treedef, nd_pos, static, is_train):
+        """Compile the whole forward into one jitted function of
+        (inputs..., params..., rng_key) — the CachedOp analog.  The
+        argument structure (treedef + static leaves) is part of the
+        cache key; only NDArray leaves are traced."""
+        import jax.tree_util as jtu
+        plist = self._param_list()
+        aux_params = [(k, p) for k, p in plist if p.grad_req == 'null']
+        n_in = len(nd_pos)
+        n_leaves = len(nd_pos) + len(static)
+        cached = _CachedFn(None, aux_params)
+
+        def pure_fn(flat):
+            from .. import random as _random
+            ps = flat[n_in:-1]
+            rng = flat[-1]
+            leaves = [None] * n_leaves
+            for i, pos in enumerate(nd_pos):
+                leaves[pos] = nd.NDArray(flat[i])
+            for pos, val in static:
+                leaves[pos] = val
+            call_args = jtu.tree_unflatten(treedef, leaves)
+            sub = {p: nd.NDArray(v) for (_, p), v in zip(plist, ps)}
+            token = _push_param_substitution(sub)
+            _random.push_key_override(rng)
+            old_tracing = _TRACING
+            _set_tracing(True)
+            try:
+                with autograd.pause(train_mode=is_train):
+                    out = self.forward(*call_args)
+            finally:
+                _set_tracing(old_tracing)
+                _random.pop_key_override()
+                _pop_param_substitution(token)
+            aux_updates = tuple(sub[p]._data for _, p in aux_params)
+            out_leaves, out_treedef = jtu.tree_flatten(
+                out, is_leaf=lambda a: isinstance(a, nd.NDArray))
+            cached.out_treedef = out_treedef  # static; fixed at trace time
+            return tuple(o._data for o in out_leaves), aux_updates
+
+        cached.full = jax.jit(pure_fn)
+        return cached
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+# tracing state: while True, hybridized blocks take the imperative path
+# (their ops are being traced into an enclosing jit)
+_TRACING = False
+
+
+def _set_tracing(value):
+    global _TRACING
+    _TRACING = value
+
+
+# parameter substitution stack used during jit tracing
+_SUBSTITUTION = []
+
+
+def _push_param_substitution(sub):
+    _SUBSTITUTION.append(sub)
+    return len(_SUBSTITUTION) - 1
+
+
+def _pop_param_substitution(token):
+    del _SUBSTITUTION[token:]
+
+
+def _lookup_param_substitution(param):
+    for sub in reversed(_SUBSTITUTION):
+        if param in sub:
+            return sub[param]
+    return None
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol into a callable Block
+    (reference gluon/block.py SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super(SymbolBlock, self).__init__(prefix='', params=params)
+        from .. import symbol as _sym
+        if isinstance(outputs, (list, tuple)):
+            outputs = _sym.Group(list(outputs))
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name if hasattr(i, 'name') else str(i)
+                             for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = outputs.list_auxiliary_states()
+        for name in arg_names:
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in aux_names:
+            self.params.get(name, grad_req='null', allow_deferred_init=True)
+
+    def forward(self, *args):
+        ctx = args[0].context
+        arg_dict = dict(zip(self._input_names, args))
+        for name, p in self.params.items():
+            arg_dict[name] = p.data(ctx)
+        outs = self._symbol.eval(ctx=ctx, **arg_dict)
+        if not isinstance(outs, (list, tuple)):
+            return outs
+        return outs[0] if len(outs) == 1 else list(outs)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError

@@ -1,0 +1,80 @@
+"""DataLoader (reference python/mxnet/gluon/data/dataloader.py).
+
+The reference uses multiprocessing workers feeding pickled batches; on
+TPU hosts Python-level decode work is overlapped with device compute via
+a thread pool (JAX dispatch is async, so the main thread is mostly
+free), avoiding fork-related issues with the runtime.
+"""
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ... import ndarray as nd
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch."""
+    if isinstance(data[0], nd.NDArray):
+        return nd.stack(*data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = np.asarray(data)
+    return nd.array(data, dtype=data.dtype)
+
+
+class DataLoader(object):
+    """Loads a Dataset and returns mini-batches."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    'batch_size must be specified unless batch_sampler '
+                    'is specified')
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    'shuffle must not be specified if sampler is '
+                    'specified')
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or 'keep')
+        elif batch_size is not None or shuffle or sampler is not None or \
+                last_batch is not None:
+            raise ValueError(
+                'batch_size, shuffle, sampler and last_batch must not '
+                'be specified if batch_sampler is specified.')
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+
+    def __iter__(self):
+        if self._num_workers <= 0:
+            for batch in self._batch_sampler:
+                yield self._batchify_fn(
+                    [self._dataset[i] for i in batch])
+            return
+        # bounded in-flight window for backpressure (the reference's
+        # prefetch queue depth); workers stay busy but finished batches
+        # don't pile up when the consumer is slower
+        def make(b):
+            return self._batchify_fn([self._dataset[i] for i in b])
+
+        window = 2 * self._num_workers
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            pending = []
+            for batch in self._batch_sampler:
+                pending.append(pool.submit(make, batch))
+                if len(pending) >= window:
+                    yield pending.pop(0).result()
+            for fut in pending:
+                yield fut.result()
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,6 @@
+"""Gluon recurrent layers (reference python/mxnet/gluon/rnn/:
+rnn_cell.py 803 LoC, rnn_layer.py 526 LoC)."""
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, DropoutCell, ZoneoutCell,
+                       ResidualCell, BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU

@@ -1,0 +1,271 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+TPU-native counterpart of the reference's cuDNN fused RNN
+(/root/reference src/operator/rnn-inl.h + python/mxnet/gluon/rnn/
+rnn_layer.py).  The whole multi-layer, optionally bidirectional
+recurrence is ONE lax.scan per direction-layer, so XLA compiles it into
+a single fused while-loop with the gate matmuls batched on the MXU —
+the structural equivalent of cuDNN's fused kernels.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import ndarray as nd
+from ... import autograd
+from ..block import Block
+from ..parameter import Parameter
+
+
+class _RNNLayer(Block):
+    """Shared implementation. Layout 'TNC' (seq, batch, feature) like
+    the reference default."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
+                 **kwargs):
+        super(_RNNLayer, self).__init__(**kwargs)
+        assert layout in ('TNC', 'NTC'), \
+            'Invalid layout %s; must be one of TNC or NTC' % layout
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4,
+                       'gru': 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in (['l', 'r'] if bidirectional else ['l']):
+                self._register_param(
+                    '%s%d_i2h_weight' % (j, i), (ng * nh, ni),
+                    i2h_weight_initializer)
+                self._register_param(
+                    '%s%d_h2h_weight' % (j, i), (ng * nh, nh),
+                    h2h_weight_initializer)
+                self._register_param(
+                    '%s%d_i2h_bias' % (j, i), (ng * nh,),
+                    i2h_bias_initializer)
+                self._register_param(
+                    '%s%d_h2h_bias' % (j, i), (ng * nh,),
+                    h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=nd.zeros, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            info.update(kwargs)
+            shape = info.pop('shape')
+            states.append(func(shape, **info))
+        return states
+
+    def _finish_deferred(self, in_units):
+        ng, nh = self._gates, self._hidden_size
+        ni = in_units
+        for i in range(self._num_layers):
+            for j in (['l', 'r'] if self._dir == 2 else ['l']):
+                for suffix, shape in (
+                        ('i2h_weight', (ng * nh, ni)),
+                        ('h2h_weight', (ng * nh, nh)),
+                        ('i2h_bias', (ng * nh,)),
+                        ('h2h_bias', (ng * nh,))):
+                    p = getattr(self, '%s%d_%s' % (j, i, suffix))
+                    if p._deferred_init:
+                        p.shape = shape
+                        p._finish_deferred_init()
+            ni = nh * self._dir
+
+    def forward(self, inputs, states=None):
+        if self._layout == 'NTC':
+            inputs = nd.swapaxes(inputs, dim1=0, dim2=1)
+        T, N, C = inputs.shape
+        self._finish_deferred(C)
+        ctx = inputs.context
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(N, ctx=ctx)
+        if isinstance(states, nd.NDArray):
+            states = [states]
+        # flatten params in deterministic order
+        pnames = []
+        for i in range(self._num_layers):
+            for j in (['l', 'r'] if self._dir == 2 else ['l']):
+                for suffix in ('i2h_weight', 'h2h_weight', 'i2h_bias',
+                               'h2h_bias'):
+                    pnames.append('%s%d_%s' % (j, i, suffix))
+        params = [getattr(self, n).data(ctx) for n in pnames]
+        inputs_all = [inputs] + params + list(states)
+        out_arrays = nd.invoke_fn(
+            _rnn_forward, inputs_all,
+            dict(mode=self._mode, num_layers=self._num_layers,
+                 dirs=self._dir, hidden=self._hidden_size,
+                 dropout=self._dropout,
+                 n_states=len(self.state_info(0))),
+            name='_fused_rnn')
+        outputs = out_arrays[0]
+        out_states = out_arrays[1:]
+        if self._layout == 'NTC':
+            outputs = nd.swapaxes(outputs, dim1=0, dim2=1)
+        if skip_states:
+            return outputs
+        return outputs, list(out_states)
+
+    def __call__(self, inputs, *args):
+        return self.forward(inputs, *args)
+
+
+def _cell_step(mode, hidden):
+    """Returns step(carry, x_gates_in, h2h_w, h2h_b) for one time step;
+    all gate i2h matmuls are precomputed batched over T (MXU-friendly)."""
+    if mode in ('rnn_relu', 'rnn_tanh'):
+        act = jax.nn.relu if mode == 'rnn_relu' else jnp.tanh
+
+        def step(carry, i2h, h2h_w, h2h_b):
+            (h,) = carry
+            h2h = h @ h2h_w.T + h2h_b
+            h_new = act(i2h + h2h)
+            return (h_new,), h_new
+        return step
+    if mode == 'lstm':
+        def step(carry, i2h, h2h_w, h2h_b):
+            h, c = carry
+            gates = i2h + h @ h2h_w.T + h2h_b
+            i_g, f_g, c_g, o_g = jnp.split(gates, 4, axis=-1)
+            i_g = jax.nn.sigmoid(i_g)
+            f_g = jax.nn.sigmoid(f_g)
+            c_g = jnp.tanh(c_g)
+            o_g = jax.nn.sigmoid(o_g)
+            c_new = f_g * c + i_g * c_g
+            h_new = o_g * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        return step
+    if mode == 'gru':
+        def step(carry, xgates, h2h_w, h2h_b):
+            (h,) = carry
+            hgates = h @ h2h_w.T + h2h_b
+            i_r, i_z, i_n = jnp.split(xgates, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(hgates, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            h_new = (1 - z) * n + z * h
+            return (h_new,), h_new
+        return step
+    raise ValueError(mode)
+
+
+def _rnn_forward(attrs, inputs, auxs, op_ctx):
+    """Pure fused multi-layer (bi)RNN: scan per layer-direction."""
+    mode = attrs['mode']
+    L, dirs, H = attrs['num_layers'], attrs['dirs'], attrs['hidden']
+    dropout = attrs['dropout']
+    n_states = attrs['n_states']
+    per_dir = 4
+    n_params = L * dirs * per_dir
+    x = inputs[0]
+    params = inputs[1:1 + n_params]
+    states = inputs[1 + n_params:]
+    # states layout: [h (L*dirs, N, H)] or [h, c] for lstm
+    step_fn = _cell_step(mode, H)
+    n_carry = 2 if mode == 'lstm' else 1
+    h0 = states[0]
+    c0 = states[1] if n_carry == 2 else None
+
+    out = x
+    final_h = []
+    final_c = []
+    pidx = 0
+    for layer in range(L):
+        dir_outs = []
+        for d in range(dirs):
+            i2h_w, h2h_w, i2h_b, h2h_b = params[pidx:pidx + 4]
+            pidx += 4
+            sidx = layer * dirs + d
+            seq = out if d == 0 else jnp.flip(out, axis=0)
+            # batch the input projection over all T at once -> one big
+            # matmul on the MXU instead of T small ones
+            xg = jnp.einsum('tnc,gc->tng', seq, i2h_w) + i2h_b
+            carry = (h0[sidx],) if n_carry == 1 else (h0[sidx], c0[sidx])
+
+            def scan_step(carry, xg_t, _w=h2h_w, _b=h2h_b):
+                new_carry, y = step_fn(carry, xg_t, _w, _b)
+                return new_carry, y
+
+            carry, ys = lax.scan(scan_step, carry, xg)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            dir_outs.append(ys)
+            final_h.append(carry[0])
+            if n_carry == 2:
+                final_c.append(carry[1])
+        out = dir_outs[0] if dirs == 1 else \
+            jnp.concatenate(dir_outs, axis=-1)
+        if dropout > 0 and layer != L - 1 and op_ctx.is_train \
+                and op_ctx.rng is not None:
+            keep = 1.0 - dropout
+            key = jax.random.fold_in(op_ctx.rng, layer)
+            mask = jax.random.bernoulli(key, keep, out.shape)
+            out = jnp.where(mask, out / keep, jnp.zeros_like(out))
+    outs = [out, jnp.stack(final_h)]
+    if n_carry == 2:
+        outs.append(jnp.stack(final_c))
+    return outs, []
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh or relu
+    (reference rnn_layer.py RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation='relu',
+                 layout='TNC', dropout=0, bidirectional=False,
+                 input_size=0, **kwargs):
+        super(RNN, self).__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, 'rnn_' + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (reference rnn_layer.py LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super(LSTM, self).__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, 'lstm', **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)},
+                {'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (reference rnn_layer.py GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout='TNC', dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super(GRU, self).__init__(
+            hidden_size, num_layers, layout, dropout, bidirectional,
+            input_size, 'gru', **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{'shape': (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]

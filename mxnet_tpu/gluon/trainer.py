@@ -1,0 +1,115 @@
+"""Gluon Trainer (reference python/mxnet/gluon/trainer.py:26).
+
+Applies an Optimizer to a set of Parameters.  Where the reference routes
+gradients through KVStore push/pull (trainer.py _init_kvstore:95
+reusing model._create_kvstore), the TPU build reduces across devices
+with the KVStore facade (XLA collectives / explicit device reduce) and
+runs the updater locally.
+"""
+from .. import optimizer as opt
+from .. import kvstore as kvs
+from .parameter import ParameterDict, Parameter
+
+
+class Trainer(object):
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore='device'):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                'First argument must be a list or dict of Parameters, '
+                'got %s.' % type(params))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    'First argument must be a list or dict of Parameters, '
+                    'got list of %s.' % type(param))
+            if param.grad_req != 'null':
+                self._params.append(param)
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get('rescale_grad', 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kv_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            assert contexts is None or contexts == ctx, \
+                'All Parameters must be initialized on the same set of ' \
+                'contexts, but Parameter %s is initialized on %s while ' \
+                'previous Parameters are initialized on %s.' % (
+                    param.name, str(ctx), str(contexts))
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                'optimizer_params must be None if optimizer is an ' \
+                'Optimizer instance'
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = param_dict
+        lr_mult = {i: p.lr_mult for i, p in enumerate(self._params)}
+        wd_mult = {i: p.wd_mult for i, p in enumerate(self._params)}
+        self._optimizer.set_lr_mult(lr_mult)
+        self._optimizer.set_wd_mult(wd_mult)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _init_kvstore(self):
+        if self._kv_type and len(self._contexts) > 1:
+            self._kvstore = kvs.create(self._kv_type)
+            for i, param in enumerate(self._params):
+                self._kvstore.init(i, param.data(self._contexts[0]))
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Apply one optimization step using recorded gradients, scaled
+        by 1/batch_size (reference trainer.py step:116)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null':
+                continue
+            grads = param.list_grad()
+            datas = param.list_data()
+            if self._kvstore is not None and len(grads) > 1:
+                # sum gradients across devices, broadcast back
+                self._kvstore.push(i, grads)
+                self._kvstore.pull(i, out=grads)
+                for upd, d, g in zip(self._updaters, datas, grads):
+                    upd(i, g, d)
+            else:
+                for upd, d, g in zip(self._updaters, datas, grads):
+                    upd(i, g, d)
+
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        with open(fname, 'wb') as f:
+            f.write(self._updaters[0].get_states())
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, 'rb') as f:
+            states = f.read()
+        for updater in self._updaters:
+            updater.set_states(states)

@@ -420,6 +420,30 @@ def invoke(op_name, inputs, attrs, out=None):
     return results
 
 
+def invoke_fn(fcompute, inputs, attrs=None, name='_fn'):
+    """Run an ad-hoc pure-JAX op through the imperative machinery:
+    tape-recorded and differentiable like any registered op.
+
+    `fcompute(attrs, in_arrays, aux_arrays, op_ctx) -> (outs, new_auxs)`
+    is the canonical registry compute signature.  Used by fused blocks
+    (gluon RNN layers) and the CustomOp bridge."""
+    attrs = attrs or {}
+    op = _reg.OpDef(name, fcompute,
+                    input_names=tuple('arg%d' % i
+                                      for i in range(len(inputs))),
+                    needs_rng=True)
+    op_ctx = _reg.OpContext(is_train=_autograd.is_training(),
+                            rng=_random.next_key())
+    in_data = [x._data for x in inputs]
+    outs, _ = op.apply(attrs, in_data, [], op_ctx)
+    ctx = inputs[0]._ctx if inputs else current_context()
+    results = [NDArray(o, ctx) for o in outs]
+    if _autograd.is_recording():
+        _autograd.record_op(op, dict(attrs), list(inputs), [],
+                            results, op_ctx)
+    return results
+
+
 def _attr_ctx(attrs):
     ctx = attrs.pop('ctx', None) if isinstance(attrs, dict) else None
     if isinstance(ctx, str):

@@ -528,6 +528,21 @@ def _batch_take(attrs, a, indices):
     return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
 
 
+@register('pick', input_names=('data', 'index'))
+def _pick(attrs, data, index):
+    """Pick elements along `axis` by per-position index
+    (reference src/operator/tensor/broadcast_reduce_op_index.cc pick;
+    axis defaults to -1 — flattened axis=None mode is not supported)."""
+    axis = int(parse_attr_value(attrs.get('axis', -1)))
+    keepdims = asbool(attrs.get('keepdims', False))
+    idx = index.astype(jnp.int32)
+    idx = jnp.expand_dims(idx, axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
 @register('one_hot', input_names=('indices',))
 def _one_hot(attrs, indices):
     depth = asint(attrs['depth'])

@@ -27,10 +27,30 @@ def seed(seed_state):
 
 
 def next_key():
-    """Draw a fresh subkey, advancing the global state."""
+    """Draw a fresh subkey, advancing the global state.
+
+    If a key override is active (jit tracing of a cached block — the key
+    is then a traced input of the XLA module), subkeys split from the
+    override instead of the global state."""
+    ov = getattr(_state, 'override', None)
+    if ov:
+        key, sub = jax.random.split(ov[-1])
+        ov[-1] = key
+        return sub
     key, sub = jax.random.split(_get())
     _state.key = key
     return sub
+
+
+def push_key_override(key):
+    """Route next_key() draws through `key` (traced) until pop."""
+    if not hasattr(_state, 'override'):
+        _state.override = []
+    _state.override.append(key)
+
+
+def pop_key_override():
+    _state.override.pop()
 
 
 # Convenience samplers (populated by ndarray codegen import in __init__):

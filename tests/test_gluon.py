@@ -1,0 +1,230 @@
+"""Gluon API tests (modeled on reference tests/python/unittest/
+test_gluon.py + test_nn.py coverage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu import autograd
+
+
+def test_parameter_basic():
+    p = gluon.Parameter('weight', shape=(4, 3))
+    p.initialize(init='xavier', ctx=[mx.cpu(0), mx.cpu(1)])
+    assert len(p.list_data()) == 2
+    assert len(p.list_grad()) == 2
+    assert p.data(mx.cpu(1)).context == mx.cpu(1)
+    assert p.data(mx.cpu(0)).shape == (4, 3)
+    assert p.var().name == 'weight'
+
+
+def test_paramdict():
+    params = gluon.ParameterDict('net_')
+    params.get('weight', shape=(10, 10))
+    assert list(params.keys()) == ['net_weight']
+    params.initialize(ctx=mx.cpu())
+    params.save('/tmp/test_paramdict.params')
+    params.load('/tmp/test_paramdict.params', mx.cpu())
+
+
+def test_dense_forward_backward():
+    net = nn.Dense(8, in_units=4, activation='relu')
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 4).astype(np.float32))
+    with autograd.record():
+        y = net(x)
+        loss = mx.nd.sum(y)
+    loss.backward()
+    w = net.weight
+    assert y.shape == (2, 8)
+    assert w.grad().shape == (8, 4)
+    assert np.isfinite(w.grad().asnumpy()).all()
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(5)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(3, 7).astype(np.float32))
+    y = net(x)
+    assert y.shape == (3, 5)
+    assert net.weight.shape == (5, 7)
+
+
+def test_sequential_and_trainer():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'))
+        net.add(nn.Dense(4))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    x = mx.nd.array(np.random.rand(8, 10).astype(np.float32))
+    label = mx.nd.array(np.random.randint(0, 4, (8,)).astype(np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net(x)  # trigger deferred shape init
+    w_before = net[0].weight.data().asnumpy().copy()
+    with autograd.record():
+        out = net(x)
+        loss = loss_fn(out, label)
+    loss.backward()
+    trainer.step(8)
+    w_after = net[0].weight.data().asnumpy()
+    assert not np.allclose(w_before, w_after)
+
+
+def test_hybridize_matches_imperative():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 8).astype(np.float32))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    y_hyb = net(x).asnumpy()
+    np.testing.assert_allclose(y_imp, y_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_backward():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(6, activation='tanh'))
+        net.add(nn.Dense(2))
+    net.initialize()
+    x = mx.nd.array(np.random.rand(3, 5).astype(np.float32))
+    # imperative grads
+    with autograd.record():
+        loss = mx.nd.sum(net(x))
+    loss.backward()
+    g_imp = net[0].weight.grad().asnumpy().copy()
+    # hybridized grads
+    net.hybridize()
+    with autograd.record():
+        loss = mx.nd.sum(net(x))
+    loss.backward()
+    g_hyb = net[0].weight.grad().asnumpy()
+    np.testing.assert_allclose(g_imp, g_hyb, rtol=1e-5, atol=1e-6)
+
+
+def test_conv2d_layer():
+    net = nn.Conv2D(4, kernel_size=3, padding=1, activation='relu')
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    y = net(x)
+    assert y.shape == (2, 4, 8, 8)
+    assert net.weight.shape == (4, 3, 3, 3)
+
+
+def test_batchnorm_updates_stats():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    x = mx.nd.array((np.random.rand(4, 3, 5, 5) * 10).astype(np.float32))
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_batchnorm_hybrid_updates_stats():
+    net = nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array((np.random.rand(4, 3, 5, 5) * 10).astype(np.float32))
+    before = net.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = net.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_pool_layers():
+    x = mx.nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    assert nn.MaxPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_embedding_flatten_dropout():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array(np.array([[1, 2], [3, 4]], dtype=np.float32))
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    f = nn.Flatten()
+    assert f(out).shape == (2, 8)
+    d = nn.Dropout(0.5)
+    y = d(out)  # predict mode: identity
+    np.testing.assert_allclose(y.asnumpy(), out.asnumpy())
+
+
+def test_losses():
+    pred = mx.nd.array(np.random.rand(4, 5).astype(np.float32))
+    label_idx = mx.nd.array(np.array([0, 1, 2, 3], dtype=np.float32))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label_idx)
+    assert l.shape == (4,)
+    # cross-check with numpy
+    logits = pred.asnumpy()
+    p = np.exp(logits - logits.max(1, keepdims=True))
+    p = p / p.sum(1, keepdims=True)
+    expected = -np.log(p[np.arange(4), label_idx.asnumpy().astype(int)])
+    np.testing.assert_allclose(l.asnumpy(), expected, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(pred, pred)
+    np.testing.assert_allclose(l2.asnumpy(), np.zeros(4), atol=1e-7)
+    l1 = gluon.loss.L1Loss(weight=2.0)(pred, pred * 0)
+    np.testing.assert_allclose(l1.asnumpy(),
+                               2 * np.abs(logits).mean(axis=1), rtol=1e-5)
+
+
+def test_block_save_load_params():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net.save_params('/tmp/test_gluon_dense.params')
+    net2 = nn.Dense(3, in_units=2, prefix=net.prefix)
+    net2.load_params('/tmp/test_gluon_dense.params')
+    x = mx.nd.array(np.random.rand(1, 2).astype(np.float32))
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy())
+
+
+def test_split_and_load():
+    data = np.random.rand(8, 3).astype(np.float32)
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0), mx.cpu(1)])
+    assert len(parts) == 2
+    assert parts[0].shape == (4, 3)
+    assert parts[1].context == mx.cpu(1)
+
+
+def test_constant_param():
+    c = gluon.Constant('const', np.array([1., 2., 3.]))
+    c.initialize()
+    np.testing.assert_allclose(c.data().asnumpy(), [1., 2., 3.])
+    assert c.grad_req == 'null'
+
+
+def test_hybridized_cell_with_states():
+    """Hybridizing a cell whose forward returns nested (out, [states])
+    must work (code-review regression)."""
+    from mxnet_tpu import gluon
+    cell = gluon.rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    states = cell.begin_state(2)
+    out_imp, st_imp = cell(x, states)
+    cell.hybridize()
+    out_hyb, st_hyb = cell(x, states)
+    np.testing.assert_allclose(out_imp.asnumpy(), out_hyb.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    assert len(st_hyb) == 2
+    np.testing.assert_allclose(st_imp[1].asnumpy(), st_hyb[1].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_block_attr_replacement():
+    net = nn.HybridSequential()
+    net.fc = nn.Dense(3)
+    net.fc = nn.Dense(5)
+    assert len(net._children) == 1
+    assert net._children[0]._units == 5

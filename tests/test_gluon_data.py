@@ -1,0 +1,111 @@
+"""Gluon data + RecordIO tests (reference tests/python/unittest/
+test_gluon_data.py, test_recordio.py)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, recordio
+
+
+def test_array_dataset_dataloader():
+    X = np.random.rand(10, 3).astype(np.float32)
+    y = np.arange(10).astype(np.float32)
+    dataset = gluon.data.ArrayDataset(X, y)
+    assert len(dataset) == 10
+    loader = gluon.data.DataLoader(dataset, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    data, label = batches[0]
+    assert data.shape == (4, 3)
+    assert label.shape == (4,)
+    np.testing.assert_allclose(batches[0][0].asnumpy(), X[:4])
+
+
+def test_dataloader_shuffle_discard():
+    dataset = gluon.data.ArrayDataset(np.arange(10).astype(np.float32))
+    loader = gluon.data.DataLoader(dataset, batch_size=3, shuffle=True,
+                                   last_batch='discard')
+    batches = list(loader)
+    assert len(batches) == 3
+    seen = np.concatenate([b.asnumpy() for b in batches])
+    assert len(set(seen.tolist())) == 9
+
+
+def test_dataset_transform():
+    dataset = gluon.data.SimpleDataset(list(range(5))).transform(
+        lambda x: x * 2)
+    assert dataset[2] == 4
+
+
+def test_samplers():
+    s = gluon.data.SequentialSampler(5)
+    assert list(s) == [0, 1, 2, 3, 4]
+    r = list(gluon.data.RandomSampler(5))
+    assert sorted(r) == [0, 1, 2, 3, 4]
+    b = gluon.data.BatchSampler(s, 2, 'rollover')
+    assert len(list(b)) == 2
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / 'test.rec')
+    rec = recordio.MXRecordIO(path, 'w')
+    for i in range(5):
+        rec.write(('record_%d' % i).encode())
+    rec.close()
+    rec = recordio.MXRecordIO(path, 'r')
+    for i in range(5):
+        assert rec.read() == ('record_%d' % i).encode()
+    assert rec.read() is None
+    rec.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / 'test_idx.rec')
+    idxp = str(tmp_path / 'test_idx.idx')
+    rec = recordio.MXIndexedRecordIO(idxp, path, 'w')
+    for i in range(6):
+        rec.write_idx(i, ('rec_%d' % i).encode())
+    rec.close()
+    rec = recordio.MXIndexedRecordIO(idxp, path, 'r')
+    assert rec.keys == list(range(6))
+    assert rec.read_idx(3) == b'rec_3'
+    assert rec.read_idx(0) == b'rec_0'
+    rec.close()
+
+
+def test_pack_unpack_label():
+    header = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7, 0)
+    payload = recordio.pack(header, b'imagedata')
+    h2, data = recordio.unpack(payload)
+    assert data == b'imagedata'
+    np.testing.assert_allclose(h2.label, [1.0, 2.0, 3.0])
+    assert h2.id == 7
+
+    header = recordio.IRHeader(0, 5.0, 9, 0)
+    h3, data3 = recordio.unpack(recordio.pack(header, b'xyz'))
+    assert h3.label == 5.0
+    assert data3 == b'xyz'
+
+
+def test_record_file_dataset(tmp_path):
+    path = str(tmp_path / 'ds.rec')
+    idxp = str(tmp_path / 'ds.idx')
+    rec = recordio.MXIndexedRecordIO(idxp, path, 'w')
+    for i in range(4):
+        rec.write_idx(i, ('item%d' % i).encode())
+    rec.close()
+    ds = gluon.data.RecordFileDataset(path)
+    assert len(ds) == 4
+    assert ds[1] == b'item1'
+
+
+def test_synthetic_vision_dataset():
+    ds = gluon.data.vision.SyntheticImageDataset(num_samples=20,
+                                                 shape=(8, 8, 3))
+    assert len(ds) == 20
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3)
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    data, labels = next(iter(loader))
+    assert data.shape == (5, 8, 8, 3)

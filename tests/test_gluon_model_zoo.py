@@ -1,0 +1,80 @@
+"""Gluon model zoo smoke tests (reference tests/python/unittest/
+test_gluon_model_zoo.py) — small inputs, structural checks."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import model_zoo
+from mxnet_tpu import autograd
+
+
+def _smoke(net, shape=(1, 3, 32, 32), classes=10):
+    net.initialize()
+    x = mx.nd.array(np.random.rand(*shape).astype(np.float32))
+    y = net(x)
+    assert y.shape == (shape[0], classes)
+    assert np.isfinite(y.asnumpy()).all()
+
+
+def test_resnet18_v1_thumbnail():
+    net = model_zoo.vision.get_resnet(1, 18, classes=10, thumbnail=True)
+    _smoke(net)
+
+
+def test_resnet18_v2_thumbnail():
+    net = model_zoo.vision.get_resnet(2, 18, classes=10, thumbnail=True)
+    _smoke(net)
+
+
+def test_resnet50_v1_structure():
+    net = model_zoo.vision.get_resnet(1, 50, classes=10, thumbnail=True)
+    _smoke(net)
+
+
+def test_squeezenet():
+    net = model_zoo.vision.squeezenet1_1(classes=10)
+    _smoke(net, shape=(1, 3, 64, 64))
+
+
+def test_densenet_small():
+    net = model_zoo.vision.DenseNet(8, 4, [2, 2], classes=10)
+    _smoke(net)
+
+
+def test_vgg11():
+    net = model_zoo.vision.vgg11(classes=10)
+    _smoke(net, shape=(1, 3, 32, 32))
+
+
+def test_alexnet():
+    net = model_zoo.vision.alexnet(classes=10)
+    _smoke(net, shape=(1, 3, 224, 224))
+
+
+def test_get_model_names():
+    with pytest.raises(ValueError):
+        model_zoo.get_model('no_such_model')
+    net = model_zoo.get_model('resnet18_v1', classes=4, thumbnail=True)
+    _smoke(net, classes=4)
+
+
+def test_model_zoo_train_step():
+    net = model_zoo.vision.get_resnet(1, 18, classes=4, thumbnail=True)
+    net.initialize()
+    from mxnet_tpu import gluon
+    x = mx.nd.array(np.random.rand(2, 3, 16, 16).astype(np.float32))
+    label = mx.nd.array(np.array([0, 1], dtype=np.float32))
+    net(x)
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        loss = loss_fn(net(x), label)
+    loss.backward()
+    trainer.step(2)
+    assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_pretrained_raises():
+    with pytest.raises(RuntimeError):
+        model_zoo.vision.resnet18_v1(pretrained=True)

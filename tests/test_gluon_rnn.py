@@ -1,0 +1,131 @@
+"""Gluon RNN tests (modeled on reference tests/python/unittest/
+test_gluon_rnn.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import autograd
+
+
+def test_rnn_cell_unroll():
+    cell = gluon.rnn.RNNCell(8, input_size=4)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    outputs, states = cell.unroll(3, x, layout='NTC', merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_lstm_cell_unroll_backward():
+    cell = gluon.rnn.LSTMCell(6)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 4, 3).astype(np.float32))
+    with autograd.record():
+        outputs, states = cell.unroll(4, x, layout='NTC',
+                                      merge_outputs=True)
+        loss = mx.nd.sum(outputs)
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert g.shape == (24, 3)
+    assert np.isfinite(g.asnumpy()).all()
+
+
+def test_gru_cell():
+    cell = gluon.rnn.GRUCell(5, input_size=2)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(3, 2).astype(np.float32))
+    states = cell.begin_state(3)
+    out, new_states = cell(x, states)
+    assert out.shape == (3, 5)
+    assert new_states[0].shape == (3, 5)
+
+
+def test_sequential_rnn_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(4))
+    stack.add(gluon.rnn.GRUCell(3))
+    stack.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 6).astype(np.float32))
+    outputs, states = stack.unroll(5, x, merge_outputs=True)
+    assert outputs.shape == (2, 5, 3)
+    assert len(states) == 3  # lstm h,c + gru h
+
+
+def test_bidirectional_cell():
+    bi = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(4),
+                                     gluon.rnn.LSTMCell(4))
+    bi.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 5).astype(np.float32))
+    outputs, states = bi.unroll(3, x, merge_outputs=True)
+    assert outputs.shape == (2, 3, 8)
+
+
+def test_residual_dropout_cells():
+    cell = gluon.rnn.ResidualCell(gluon.rnn.RNNCell(4, input_size=4))
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 3, 4).astype(np.float32))
+    outputs, _ = cell.unroll(3, x, merge_outputs=True)
+    assert outputs.shape == (2, 3, 4)
+
+    dcell = gluon.rnn.DropoutCell(0.5)
+    y, s = dcell(mx.nd.ones((2, 3)), [])
+    assert y.shape == (2, 3)
+
+
+def test_fused_lstm_layer():
+    layer = gluon.rnn.LSTM(7, num_layers=2)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(5, 2, 3).astype(np.float32))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 2, 7)
+    states = layer.begin_state(2)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 2, 7)
+    assert new_states[0].shape == (2, 2, 7)
+    assert new_states[1].shape == (2, 2, 7)
+
+
+def test_fused_lstm_matches_cell():
+    """Fused scan-based LSTM == per-step LSTMCell when sharing weights."""
+    np.random.seed(42)
+    T, N, C, H = 4, 2, 3, 5
+    layer = gluon.rnn.LSTM(H, num_layers=1, input_size=C)
+    layer.initialize()
+    x_np = np.random.rand(T, N, C).astype(np.float32)
+    x = mx.nd.array(x_np)
+    out = layer(x)
+
+    cell = gluon.rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    # copy weights from the fused layer
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    outs, _ = cell.unroll(T, mx.nd.array(x_np.transpose(1, 0, 2)),
+                          layout='NTC', merge_outputs=True)
+    np.testing.assert_allclose(out.asnumpy(),
+                               outs.asnumpy().transpose(1, 0, 2),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_gru_backward():
+    layer = gluon.rnn.GRU(4, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(3, 2, 5).astype(np.float32))
+    with autograd.record():
+        out = layer(x)
+        loss = mx.nd.sum(out)
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert g.shape == (12, 5)
+    assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_rnn_layer_ntc():
+    layer = gluon.rnn.RNN(6, num_layers=1, layout='NTC',
+                          activation='tanh')
+    layer.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 3).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (2, 5, 6)
