@@ -1,0 +1,11 @@
+"""mx.image: image loading + augmentation pipeline
+(reference python/mxnet/image/; SURVEY.md §2.5)."""
+from .image import (imdecode, imread, imresize, scale_down, resize_short,
+                    fixed_crop, random_crop, center_crop, random_size_crop,
+                    color_normalize,
+                    Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
+                    RandomSizedCropAug, CenterCropAug, RandomOrderAug,
+                    BrightnessJitterAug, ContrastJitterAug,
+                    SaturationJitterAug, ColorJitterAug, LightingAug,
+                    ColorNormalizeAug, HorizontalFlipAug, CastAug,
+                    CreateAugmenter, ImageIter)

@@ -1,0 +1,512 @@
+"""Image IO + augmentation.
+
+TPU-native counterpart of the reference's pure-python image pipeline
+(/root/reference python/mxnet/image/image.py, 1204 LoC: ImageIter +
+augmenter classes) and the image ops in src/io/image_io.cc
+(imdecode/imresize).  Decoding/augmentation is host-side work (cv2,
+numpy); augmented batches land in NDArrays that JAX transfers to the
+chip asynchronously, overlapping with device compute — the same
+producer/consumer split as the reference's prefetching iterators.
+"""
+import os
+import random as pyrandom
+
+import numpy as np
+
+from .. import ndarray as nd
+from .. import recordio
+from .. import io as mxio
+from ..base import MXNetError
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - cv2 is present in this image
+    cv2 = None
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer into an HWC uint8 NDArray
+    (reference image.py imdecode / src/io/image_io.cc)."""
+    if cv2 is None:
+        raise MXNetError('cv2 is required for imdecode')
+    arr = np.frombuffer(buf, dtype=np.uint8) \
+        if not isinstance(buf, np.ndarray) else buf
+    img = cv2.imdecode(arr, flag)
+    if img is None:
+        raise MXNetError('Failed to decode image')
+    if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+        img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return nd.array(img, dtype=np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, 'rb') as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def _asnp(src):
+    """numpy view of an image argument (host-side pipeline stays numpy)."""
+    return src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+
+
+def _like(out, src):
+    """Wrap result like the input: NDArray in -> NDArray out; numpy
+    stays numpy so augmenter chains never bounce through the device."""
+    if isinstance(src, nd.NDArray):
+        return nd.array(out, dtype=out.dtype)
+    return out
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to (w, h) (reference image_io.cc imresize)."""
+    img = _asnp(src)
+    out = cv2.resize(img, (w, h), interpolation=interp)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _like(out, src)
+
+
+def scale_down(src_size, size):
+    """Scale target size down so it fits in src_size, keeping ratio."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals `size`."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop a region, optionally resize to `size` (w, h)."""
+    img = _asnp(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _asnp(imresize(out, size[0], size[1], interp=interp))
+    return _like(out, src)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop of `size` (w, h); returns (cropped, (x0,y0,w,h))."""
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random crop with area in [min_area*A, A] and aspect in `ratio`."""
+    h, w = src.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = pyrandom.uniform(*ratio)
+        new_w = int(round(np.sqrt(new_area * new_ratio)))
+        new_h = int(round(np.sqrt(new_area / new_ratio)))
+        if pyrandom.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std over channels."""
+    img = _asnp(src).astype(np.float32)
+    mean = np.asarray(mean, np.float32)
+    out = img - mean
+    if std is not None:
+        out = out / np.asarray(std, np.float32)
+    return _like(out, src)
+
+
+# ---------------------------------------------------------------------------
+# Augmenters (reference image.py augmenter classes)
+# ---------------------------------------------------------------------------
+
+class Augmenter(object):
+    """Image augmenter base."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(ResizeAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [resize_short(src, self.size, self.interp)]
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(ForceResizeAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [imresize(src, self.size[0], self.size[1], self.interp)]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(RandomCropAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_crop(src, self.size, self.interp)[0]]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, min_area, ratio, interp=2):
+        super(RandomSizedCropAug, self).__init__(
+            size=size, min_area=min_area, ratio=ratio, interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return [random_size_crop(src, self.size, self.min_area,
+                                 self.ratio, self.interp)[0]]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super(CenterCropAug, self).__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return [center_crop(src, self.size, self.interp)[0]]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super(RandomOrderAug, self).__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        srcs = [src]
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            srcs = [out for s in srcs for out in t(s)]
+        return srcs
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super(BrightnessJitterAug, self).__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return [_like(_asnp(src).astype(np.float32) * alpha, src)]
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super(ContrastJitterAug, self).__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        img = _asnp(src).astype(np.float32)
+        gray = (img * self.coef).sum()
+        gray = (3.0 * (1.0 - alpha) / img.size) * gray
+        return [_like(img * alpha + gray, src)]
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super(SaturationJitterAug, self).__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        img = _asnp(src).astype(np.float32)
+        gray = (img * self.coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return [_like(img * alpha + gray, src)]
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Composite jitter in random order (reference ColorJitterAug)."""
+    ts = []
+    if brightness > 0:
+        ts.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        ts.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        ts.append(SaturationJitterAug(saturation))
+    return RandomOrderAug(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super(LightingAug, self).__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)) \
+            .astype(np.float32)
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return [_like(_asnp(src).astype(np.float32) + rgb, src)]
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super(ColorNormalizeAug, self).__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return [color_normalize(src, self.mean, self.std)]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super(HorizontalFlipAug, self).__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return [_like(np.ascontiguousarray(_asnp(src)[:, ::-1]), src)]
+        return [src]
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return [_like(_asnp(src).astype(np.float32), src)]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Standard augmenter list builder (reference image.py
+    CreateAugmenter — order preserved for convergence parity)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
+                                                           4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(np.atleast_1d(mean)) > 0:
+        assert std is None or len(np.atleast_1d(std)) > 0
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+# ---------------------------------------------------------------------------
+# ImageIter (reference image.py ImageIter)
+# ---------------------------------------------------------------------------
+
+class ImageIter(mxio.DataIter):
+    """Image iterator over .rec files or an image list + root dir, with
+    augmentation, partition sharding (num_parts/part_index), and
+    shuffling — the python analog of ImageRecordIter."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root='.',
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name='data', label_name='softmax_label',
+                 **kwargs):
+        super(ImageIter, self).__init__()
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self._data_name = data_name
+        self._label_name = label_name
+        self.imgrec = None
+        self.imglist = {}
+        self.seq = None
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + '.idx'
+            if os.path.isfile(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    idx_path, path_imgrec, 'r')
+                self.seq = list(self.imgrec.keys)
+            else:
+                if shuffle or num_parts > 1:
+                    raise ValueError(
+                        'shuffle/num_parts on a .rec file require the '
+                        '.idx sidecar (%s not found); regenerate with '
+                        'tools/im2rec.py' % idx_path)
+                self.imgrec = recordio.MXRecordIO(path_imgrec, 'r')
+                self.seq = None
+        if path_imglist:
+            with open(path_imglist) as fin:
+                imglist = {}
+                for line in fin:
+                    line = line.strip().split('\t')
+                    label = np.array([float(i) for i in line[1:-1]],
+                                     np.float32)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                self.imglist = imglist
+                self.seq = list(imglist.keys())
+        elif isinstance(imglist, list):
+            result = {}
+            for index, img in enumerate(imglist):
+                label = np.array(img[0], np.float32).reshape(-1)
+                result[index] = (label, img[1])
+            self.imglist = result
+            self.seq = list(result.keys())
+        self.path_root = path_root
+        if num_parts > 1 and self.seq is not None:
+            assert part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C:(part_index + 1) * C]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [mxio.DataDesc(self._data_name,
+                              (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [mxio.DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    @staticmethod
+    def _decode_np(buf, flag=1, to_rgb=True):
+        """Decode straight to numpy — the augmenter chain is host-side,
+        so no device round-trips until the batch is assembled."""
+        img = cv2.imdecode(np.frombuffer(buf, np.uint8), flag)
+        if img is None:
+            raise MXNetError('Failed to decode image')
+        if to_rgb and img.ndim == 3 and img.shape[2] == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img
+
+    def next_sample(self):
+        """Returns (label, decoded image as numpy HWC)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                return header.label, self._decode_np(img)
+            label, fname = self.imglist[idx]
+            with open(os.path.join(self.path_root, fname), 'rb') as f:
+                return label, self._decode_np(f.read())
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, self._decode_np(img)
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape,
+                              np.float32)
+        shape = (self.batch_size, self.label_width) \
+            if self.label_width > 1 else (self.batch_size,)
+        batch_label = np.zeros(shape, np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, data = self.next_sample()
+                for aug in self.auglist:
+                    data = aug(data)[0]
+                arr = _asnp(data)
+                if arr.ndim == 3:
+                    arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+                batch_data[i] = arr
+                label = np.atleast_1d(np.asarray(label, np.float32))
+                if self.label_width == 1:
+                    batch_label[i] = label[0]
+                else:
+                    batch_label[i] = label[:self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = self.batch_size - i
+        return mxio.DataBatch(
+            data=[nd.array(batch_data)], label=[nd.array(batch_label)],
+            pad=pad, index=None,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)

@@ -1,0 +1,157 @@
+"""Image pipeline tests (reference tests/python/unittest/test_image.py
+and test_io.py ImageRecordIter coverage)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+
+def _make_img(h=40, w=50, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def _encode(img):
+    import cv2
+    ret, buf = cv2.imencode('.png', img)
+    assert ret
+    return buf.tobytes()
+
+
+def test_imdecode_imresize():
+    img = _make_img()
+    dec = image.imdecode(_encode(img), to_rgb=False)
+    np.testing.assert_array_equal(dec.asnumpy(), img)
+    resized = image.imresize(dec, 20, 10)
+    assert resized.shape == (10, 20, 3)
+
+
+def test_crops():
+    img = mx.nd.array(_make_img(), dtype=np.uint8)
+    out, roi = image.center_crop(img, (24, 24))
+    assert out.shape == (24, 24, 3)
+    out, roi = image.random_crop(img, (16, 16))
+    assert out.shape == (16, 16, 3)
+    out = image.fixed_crop(img, 0, 0, 10, 12)
+    assert out.shape == (12, 10, 3)
+    out = image.resize_short(img, 30)
+    assert min(out.shape[:2]) == 30
+
+
+def test_color_normalize():
+    img = mx.nd.array(np.ones((4, 4, 3), np.float32) * 100)
+    out = image.color_normalize(img, mean=np.array([100., 100., 100.]),
+                                std=np.array([2., 2., 2.]))
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((4, 4, 3)))
+
+
+def test_augmenter_list():
+    augs = image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True)
+    img = mx.nd.array(_make_img(), dtype=np.uint8)
+    for aug in augs:
+        img = aug(img)[0]
+    assert img.shape == (24, 24, 3)
+    assert img.dtype == np.float32
+
+
+def _write_rec(tmp_path, n=12, size=32):
+    prefix = str(tmp_path / 'data')
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    for i in range(n):
+        img = _make_img(size, size, seed=i)
+        header = recordio.IRHeader(0, float(i % 3), i, 0)
+        rec.write_idx(i, recordio.pack(header, _encode(img)))
+    rec.close()
+    return prefix
+
+
+def test_image_iter_rec(tmp_path):
+    prefix = _write_rec(tmp_path)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=prefix + '.rec', shuffle=True)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4,)
+    n = 1
+    for batch in it:
+        n += 1
+    assert n == 3
+    it.reset()
+    assert it.next().data[0].shape == (4, 3, 24, 24)
+
+
+def test_image_record_iter(tmp_path):
+    prefix = _write_rec(tmp_path)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + '.rec', data_shape=(3, 28, 28), batch_size=3,
+        shuffle=False, rand_mirror=True, mean_r=123, mean_g=117,
+        mean_b=104)
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 28, 28)
+    it.reset()
+    batches = list(it)
+    assert len(batches) == 4
+
+
+def test_image_iter_sharding(tmp_path):
+    prefix = _write_rec(tmp_path)
+    it0 = image.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                          path_imgrec=prefix + '.rec', num_parts=2,
+                          part_index=0)
+    it1 = image.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                          path_imgrec=prefix + '.rec', num_parts=2,
+                          part_index=1)
+    l0 = np.concatenate([b.label[0].asnumpy() for b in it0])
+    l1 = np.concatenate([b.label[0].asnumpy() for b in it1])
+    assert len(l0) == len(l1) == 6
+
+
+def test_im2rec_tool(tmp_path):
+    import cv2
+    root = tmp_path / 'imgs' / 'class0'
+    root.mkdir(parents=True)
+    for i in range(3):
+        cv2.imwrite(str(root / ('img%d.png' % i)), _make_img(16, 16, i))
+    prefix = str(tmp_path / 'out')
+    tool = os.path.join(os.path.dirname(__file__), '..', 'tools',
+                        'im2rec.py')
+    subprocess.check_call([sys.executable, tool, '--list', '--recursive',
+                           prefix, str(tmp_path / 'imgs')])
+    assert os.path.isfile(prefix + '.lst')
+    subprocess.check_call([sys.executable, tool, prefix,
+                           str(tmp_path / 'imgs')])
+    assert os.path.isfile(prefix + '.rec')
+    it = image.ImageIter(batch_size=3, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec')
+    batch = it.next()
+    assert batch.data[0].shape == (3, 3, 16, 16)
+
+
+def test_mnist_iter(tmp_path):
+    import gzip
+    import struct
+    # write tiny fake mnist idx files
+    n = 20
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (n, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, n).astype(np.uint8)
+    ip = str(tmp_path / 'img.gz')
+    lp = str(tmp_path / 'lab.gz')
+    with gzip.open(ip, 'wb') as f:
+        f.write(struct.pack('>IIII', 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, 'wb') as f:
+        f.write(struct.pack('>II', 2049, n))
+        f.write(labels.tobytes())
+    it = mx.io.MNISTIter(image=ip, label=lp, batch_size=5, flat=True)
+    batch = it.next()
+    assert batch.data[0].shape == (5, 784)
+    it2 = mx.io.MNISTIter(image=ip, label=lp, batch_size=5, flat=False,
+                          shuffle=False)
+    assert it2.next().data[0].shape == (5, 1, 28, 28)
