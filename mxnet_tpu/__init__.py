@@ -40,6 +40,7 @@ from . import module as mod
 from .module import Module
 from . import parallel
 from .io import DataBatch, DataIter, NDArrayIter, DataDesc
+from . import engine
 from . import recordio
 from . import image
 from . import gluon

@@ -1,0 +1,250 @@
+"""mx.engine: the host-side dependency-scheduling engine.
+
+TPU-native counterpart of the reference engine API
+(reference include/mxnet/engine.h:93 Engine::Get()->PushAsync/
+WaitForVar/WaitForAll; SURVEY.md §2.1).  Device-side op scheduling
+belongs to XLA/PJRT on TPU, so this engine orders *host-side* work —
+IO pipeline stages, checkpoint writes, custom host ops — with the same
+read/write variable-dependency semantics the reference uses for
+everything.  Backed by the native C++ ThreadedEngine
+(src/engine/engine.cc) when built, else a Python thread-pool fallback
+with identical semantics (the reference's NaiveEngine analog is
+`ThreadedEngine(num_workers=0)`, which runs ops inline).
+"""
+import ctypes
+import os
+import threading
+
+from . import _core
+
+__all__ = ['Engine', 'get', 'push', 'new_variable', 'wait_for_var',
+           'wait_all']
+
+
+class _NativeEngine:
+    def __init__(self, num_workers):
+        self._lib = _core.lib(required=True)
+        self._handle = self._lib.MXTEngineCreate(num_workers)
+        self._cb_type = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+        self._fns = {}
+        self._cb_id = 0
+        self._mu = threading.Lock()
+        # ONE persistent trampoline for all pushes: the payload carries
+        # an id into _fns, so no CFUNCTYPE object is ever freed while a
+        # C worker thread may still be inside it
+        self._trampoline = self._cb_type(self._dispatch)
+
+    def _dispatch(self, payload):
+        cid = int(payload) if payload else 0
+        with self._mu:
+            fn = self._fns.pop(cid, None)
+        if fn is not None:
+            fn()
+
+    def new_variable(self):
+        return self._lib.MXTEngineNewVar(self._handle)
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        with self._mu:
+            self._cb_id += 1
+            cid = self._cb_id
+            self._fns[cid] = fn
+        cv = (ctypes.c_int64 * max(1, len(const_vars)))(*const_vars)
+        mv = (ctypes.c_int64 * max(1, len(mutable_vars)))(*mutable_vars)
+        _core.check_call(self._lib.MXTEnginePush(
+            self._handle, self._trampoline, ctypes.c_void_p(cid), cv,
+            len(const_vars), mv, len(mutable_vars)))
+
+    def wait_for_var(self, var):
+        _core.check_call(self._lib.MXTEngineWaitForVar(
+            self._handle, var))
+
+    def wait_all(self):
+        _core.check_call(self._lib.MXTEngineWaitAll(self._handle))
+
+    def delete_variable(self, var):
+        _core.check_call(self._lib.MXTEngineDeleteVar(self._handle, var))
+
+    def __del__(self):
+        if getattr(self, '_handle', None):
+            try:
+                self._lib.MXTEngineFree(self._handle)
+            except Exception:
+                pass
+            self._handle = None
+
+
+class _PyEngine:
+    """Pure-Python fallback with the same dependency semantics
+    (readers concurrent, writers exclusive, FIFO per var)."""
+
+    def __init__(self, num_workers):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(max_workers=max(1, num_workers)) \
+            if num_workers > 0 else None
+        self._mu = threading.Lock()
+        self._vars = {}
+        self._next = 1
+        self._pending = 0
+        self._all_done = threading.Condition(self._mu)
+
+    class _Var:
+        __slots__ = ('queue', 'readers', 'writing')
+
+        def __init__(self):
+            self.queue = []
+            self.readers = 0
+            self.writing = False
+
+    def new_variable(self):
+        with self._mu:
+            h = self._next
+            self._next += 1
+            self._vars[h] = self._Var()
+            return h
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        op = {'fn': fn, 'wait': len(const_vars) + len(mutable_vars) + 1,
+              'const': list(const_vars), 'mut': list(mutable_vars)}
+        ready = []
+        with self._mu:
+            self._pending += 1
+            for h in const_vars:
+                v = self._vars[h]
+                v.queue.append((op, False))
+                self._dispatch(v, ready)
+            for h in mutable_vars:
+                v = self._vars[h]
+                v.queue.append((op, True))
+                self._dispatch(v, ready)
+            op['wait'] -= 1
+            if op['wait'] == 0:
+                ready.append(op)
+        for r in ready:
+            self._run(r)
+
+    def _dispatch(self, v, ready):
+        while v.queue:
+            op, write = v.queue[0]
+            if write:
+                if v.readers == 0 and not v.writing:
+                    v.writing = True
+                    v.queue.pop(0)
+                    op['wait'] -= 1
+                    if op['wait'] == 0:
+                        ready.append(op)
+                break
+            if v.writing:
+                break
+            v.readers += 1
+            v.queue.pop(0)
+            op['wait'] -= 1
+            if op['wait'] == 0:
+                ready.append(op)
+
+    def _run(self, op):
+        def task():
+            try:
+                op['fn']()
+            finally:
+                self._complete(op)
+        if self._pool is not None:
+            self._pool.submit(task)
+        else:
+            task()
+
+    def _complete(self, op):
+        ready = []
+        with self._mu:
+            for h in op['const']:
+                v = self._vars.get(h)
+                if v is not None:
+                    v.readers -= 1
+                    self._dispatch(v, ready)
+            for h in op['mut']:
+                v = self._vars.get(h)
+                if v is not None:
+                    v.writing = False
+                    self._dispatch(v, ready)
+            self._pending -= 1
+            if self._pending == 0:
+                self._all_done.notify_all()
+        for r in ready:
+            self._run(r)
+
+    def wait_for_var(self, var):
+        ev = threading.Event()
+        self.push(ev.set, const_vars=(var,))
+        ev.wait()
+
+    def wait_all(self):
+        with self._mu:
+            while self._pending != 0:
+                self._all_done.wait()
+
+    def delete_variable(self, var):
+        with self._mu:
+            v = self._vars.get(var)
+            if v is not None and not v.queue and v.readers == 0 \
+                    and not v.writing:
+                del self._vars[var]
+
+
+class Engine:
+    """Engine facade (reference Engine::Get())."""
+
+    def __init__(self, num_workers=None):
+        if num_workers is None:
+            num_workers = int(os.environ.get(
+                'MXNET_CPU_WORKER_NTHREADS', 4))
+        if os.environ.get('MXNET_ENGINE_TYPE') == 'NaiveEngine':
+            self._impl = _PyEngine(0)
+        elif _core.available():
+            self._impl = _NativeEngine(num_workers)
+        else:
+            self._impl = _PyEngine(num_workers)
+
+    def new_variable(self):
+        return self._impl.new_variable()
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        """Run fn when all deps clear; reads const_vars, writes
+        mutable_vars (reference PushAsync, engine.h:168)."""
+        self._impl.push(fn, const_vars, mutable_vars)
+
+    def wait_for_var(self, var):
+        self._impl.wait_for_var(var)
+
+    def wait_all(self):
+        self._impl.wait_all()
+
+    def delete_variable(self, var):
+        self._impl.delete_variable(var)
+
+
+_engine = None
+_engine_mu = threading.Lock()
+
+
+def get():
+    global _engine
+    with _engine_mu:
+        if _engine is None:
+            _engine = Engine()
+        return _engine
+
+
+def new_variable():
+    return get().new_variable()
+
+
+def push(fn, const_vars=(), mutable_vars=()):
+    get().push(fn, const_vars, mutable_vars)
+
+
+def wait_for_var(var):
+    get().wait_for_var(var)
+
+
+def wait_all():
+    get().wait_all()

@@ -1,0 +1,195 @@
+// C ABI for the native runtime — consumed by mxnet_tpu/_core.py via
+// ctypes.  TPU-native counterpart of the reference's C API surface for
+// the engine and IO (reference src/c_api/c_api.cc NDArray/engine/
+// recordio sections; SURVEY.md §2.6) — the tensor/executor parts of the
+// reference C API live in JAX/XLA instead.
+#include <cstring>
+#include <string>
+
+#include "engine/engine.h"
+#include "io/image_record_iter.h"
+#include "io/recordio.h"
+
+extern "C" {
+
+// ---- error handling (reference c_api_common.h API_BEGIN/END) ----------
+static thread_local std::string last_error;
+const char* MXTGetLastError() { return last_error.c_str(); }
+
+#define API_BEGIN() try {
+#define API_END()                     \
+  }                                   \
+  catch (const std::exception& e) {   \
+    last_error = e.what();            \
+    return -1;                        \
+  }                                   \
+  return 0;
+
+// ---- engine ------------------------------------------------------------
+typedef void (*MXTOpCallback)(void* payload);
+
+void* MXTEngineCreate(int num_workers) {
+  return new mxtpu::engine::ThreadedEngine(num_workers);
+}
+
+void MXTEngineFree(void* h) {
+  delete static_cast<mxtpu::engine::ThreadedEngine*>(h);
+}
+
+int64_t MXTEngineNewVar(void* h) {
+  return static_cast<mxtpu::engine::ThreadedEngine*>(h)->NewVariable();
+}
+
+int MXTEnginePush(void* h, MXTOpCallback cb, void* payload,
+                  const int64_t* const_vars, int n_const,
+                  const int64_t* mutable_vars, int n_mut) {
+  API_BEGIN()
+  auto* eng = static_cast<mxtpu::engine::ThreadedEngine*>(h);
+  std::vector<int64_t> cv(const_vars, const_vars + n_const);
+  std::vector<int64_t> mv(mutable_vars, mutable_vars + n_mut);
+  eng->Push([cb, payload] { cb(payload); }, cv, mv);
+  API_END()
+}
+
+int MXTEngineWaitForVar(void* h, int64_t var) {
+  API_BEGIN()
+  static_cast<mxtpu::engine::ThreadedEngine*>(h)->WaitForVar(var);
+  API_END()
+}
+
+int MXTEngineWaitAll(void* h) {
+  API_BEGIN()
+  static_cast<mxtpu::engine::ThreadedEngine*>(h)->WaitForAll();
+  API_END()
+}
+
+int MXTEngineDeleteVar(void* h, int64_t var) {
+  API_BEGIN()
+  static_cast<mxtpu::engine::ThreadedEngine*>(h)->DeleteVariable(var);
+  API_END()
+}
+
+// ---- recordio ----------------------------------------------------------
+void* MXTRecordReaderCreate(const char* path) {
+  try {
+    return new mxtpu::io::RecordReader(path);
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return nullptr;
+  }
+}
+
+void MXTRecordReaderFree(void* h) {
+  delete static_cast<mxtpu::io::RecordReader*>(h);
+}
+
+// Returns 1 if a record was read, 0 at EOF, -1 on error.  The pointer
+// is valid until the next call on this reader.
+int MXTRecordReaderNext(void* h, const char** data, uint64_t* size) {
+  static thread_local std::string buf;
+  try {
+    auto* r = static_cast<mxtpu::io::RecordReader*>(h);
+    if (!r->Next(&buf)) return 0;
+    *data = buf.data();
+    *size = buf.size();
+    return 1;
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return -1;
+  }
+}
+
+int MXTRecordReaderSeek(void* h, uint64_t pos) {
+  API_BEGIN()
+  static_cast<mxtpu::io::RecordReader*>(h)->Seek(pos);
+  API_END()
+}
+
+void* MXTRecordWriterCreate(const char* path) {
+  try {
+    return new mxtpu::io::RecordWriter(path);
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return nullptr;
+  }
+}
+
+void MXTRecordWriterFree(void* h) {
+  delete static_cast<mxtpu::io::RecordWriter*>(h);
+}
+
+int64_t MXTRecordWriterWrite(void* h, const char* data, uint64_t size) {
+  try {
+    return static_cast<int64_t>(
+        static_cast<mxtpu::io::RecordWriter*>(h)->Write(data, size));
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return -1;
+  }
+}
+
+// ---- image record iterator ---------------------------------------------
+void* MXTImageRecordIterCreate(const char* rec_path, const char* idx_path,
+                               int batch_size, int channels, int height,
+                               int width, int label_width, int shuffle,
+                               int rand_crop, int rand_mirror, int resize,
+                               const float* mean, const float* stdv,
+                               int num_parts, int part_index,
+                               int num_threads, int prefetch,
+                               uint64_t seed) {
+  try {
+    mxtpu::io::ImageRecordParam p;
+    p.path_imgrec = rec_path;
+    p.path_imgidx = idx_path;
+    p.batch_size = batch_size;
+    p.channels = channels;
+    p.height = height;
+    p.width = width;
+    p.label_width = label_width;
+    p.shuffle = shuffle != 0;
+    p.rand_crop = rand_crop != 0;
+    p.rand_mirror = rand_mirror != 0;
+    p.resize = resize;
+    for (int i = 0; i < 3; ++i) {
+      p.mean[i] = mean ? mean[i] : 0.f;
+      p.std_[i] = stdv ? stdv[i] : 1.f;
+    }
+    p.num_parts = num_parts;
+    p.part_index = part_index;
+    p.num_threads = num_threads;
+    p.prefetch = prefetch;
+    p.seed = seed;
+    return new mxtpu::io::ImageRecordIter(p);
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return nullptr;
+  }
+}
+
+void MXTImageRecordIterFree(void* h) {
+  delete static_cast<mxtpu::io::ImageRecordIter*>(h);
+}
+
+// Returns 1 with pointers set, 0 at epoch end, -1 on error.
+int MXTImageRecordIterNext(void* h, const float** data,
+                           const float** label, int* pad) {
+  try {
+    auto* it = static_cast<mxtpu::io::ImageRecordIter*>(h);
+    if (!it->Next()) return 0;
+    *data = it->data();
+    *label = it->label();
+    *pad = it->pad();
+    return 1;
+  } catch (const std::exception& e) {
+    last_error = e.what();
+    return -1;
+  }
+}
+
+int MXTImageRecordIterReset(void* h) {
+  API_BEGIN()
+  static_cast<mxtpu::io::ImageRecordIter*>(h)->Reset();
+  API_END()
+}
+
+}  // extern "C"

@@ -1,0 +1,57 @@
+// Minimal logging/CHECK facility.
+// TPU-native rebuild of the dmlc-core logging surface the reference uses
+// everywhere (reference /root/reference usage: dmlc/logging.h CHECK/LOG,
+// SURVEY.md §2.9 dmlc-core row).
+#ifndef MXTPU_COMMON_LOGGING_H_
+#define MXTPU_COMMON_LOGGING_H_
+
+#include <cstdlib>
+#include <iostream>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+
+namespace mxtpu {
+
+struct Error : public std::runtime_error {
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, bool fatal)
+      : fatal_(fatal) {
+    stream_ << "[" << file << ":" << line << "] ";
+  }
+  std::ostringstream& stream() { return stream_; }
+  ~LogMessage() noexcept(false) {
+    if (fatal_) {
+      throw Error(stream_.str());
+    } else {
+      std::cerr << stream_.str() << std::endl;
+    }
+  }
+
+ private:
+  std::ostringstream stream_;
+  bool fatal_;
+};
+
+}  // namespace mxtpu
+
+#define MXTPU_LOG_INFO ::mxtpu::LogMessage(__FILE__, __LINE__, false).stream()
+#define MXTPU_LOG_FATAL ::mxtpu::LogMessage(__FILE__, __LINE__, true).stream()
+
+#define MXTPU_CHECK(x)                                   \
+  if (!(x))                                              \
+  ::mxtpu::LogMessage(__FILE__, __LINE__, true).stream() \
+      << "Check failed: " #x " "
+
+#define MXTPU_CHECK_EQ(a, b) MXTPU_CHECK((a) == (b))
+#define MXTPU_CHECK_NE(a, b) MXTPU_CHECK((a) != (b))
+#define MXTPU_CHECK_GT(a, b) MXTPU_CHECK((a) > (b))
+#define MXTPU_CHECK_GE(a, b) MXTPU_CHECK((a) >= (b))
+#define MXTPU_CHECK_LT(a, b) MXTPU_CHECK((a) < (b))
+#define MXTPU_CHECK_LE(a, b) MXTPU_CHECK((a) <= (b))
+
+#endif  // MXTPU_COMMON_LOGGING_H_

@@ -1,0 +1,113 @@
+// Multithreaded RecordIO image iterator.
+// TPU-native rebuild of the reference's default training data path
+// (reference src/io/iter_image_recordio_2.cc: threaded chunk read ->
+// JPEG decode -> augment -> batch assembly; SURVEY.md §2.5/§3.5).
+// One producer thread walks the (sharded, optionally shuffled) index,
+// a decode worker pool runs OpenCV decode + augmentation straight into
+// preallocated batch buffers, and a bounded ready-queue hands finished
+// batches to the consumer — decode overlaps with TPU compute exactly
+// like the reference overlaps decode with GPU kernels.
+#ifndef MXTPU_IO_IMAGE_RECORD_ITER_H_
+#define MXTPU_IO_IMAGE_RECORD_ITER_H_
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace mxtpu {
+namespace io {
+
+struct ImageRecordParam {
+  std::string path_imgrec;
+  std::string path_imgidx;
+  int batch_size = 1;
+  int channels = 3;
+  int height = 224;
+  int width = 224;
+  int label_width = 1;
+  bool shuffle = false;
+  bool rand_crop = false;
+  bool rand_mirror = false;
+  int resize = 0;  // resize shorter side first if > 0
+  float mean[3] = {0.f, 0.f, 0.f};
+  float std_[3] = {1.f, 1.f, 1.f};
+  int num_parts = 1;
+  int part_index = 0;
+  int num_threads = 4;
+  int prefetch = 4;  // ready-batch queue depth
+  uint64_t seed = 0;
+  bool round_batch = true;  // wrap the last partial batch
+};
+
+class ImageRecordIter {
+ public:
+  explicit ImageRecordIter(const ImageRecordParam& p);
+  ~ImageRecordIter();
+
+  // Advance to the next batch. Returns false at epoch end.
+  bool Next();
+  const float* data() const { return current_->data.data(); }
+  const float* label() const { return current_->label.data(); }
+  int pad() const { return current_->pad; }
+  void Reset();
+  size_t data_size() const;
+  size_t label_size() const;
+
+ private:
+  struct Batch {
+    std::vector<float> data;
+    std::vector<float> label;
+    int pad = 0;
+    std::atomic<int> remaining{0};
+  };
+  struct Task {
+    std::string raw;
+    Batch* batch;
+    int slot;
+    uint64_t rng_seed;
+  };
+
+  void ProducerLoop(uint64_t epoch_seed);
+  void ProducerBody(uint64_t epoch_seed);
+  void WorkerLoop();
+  void DecodeInto(const Task& t);
+  void StopThreads();
+  void StartEpoch();
+  void CheckFailed();
+
+  ImageRecordParam p_;
+  std::vector<uint64_t> offsets_;  // sharded record offsets
+
+  // decode task queue
+  std::deque<Task> tasks_;
+  std::mutex task_mu_;
+  std::condition_variable task_cv_;
+
+  // ready batches
+  std::deque<std::unique_ptr<Batch>> ready_;
+  std::mutex ready_mu_;
+  std::condition_variable ready_cv_, space_cv_;
+  int batches_emitted_ = 0;   // produced to ready_ so far (epoch)
+  int batches_consumed_ = 0;
+  int batches_per_epoch_ = 0;
+
+  std::unique_ptr<Batch> current_;
+  std::vector<std::thread> workers_;
+  std::thread producer_;
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> failed_{false};
+  std::string error_;  // guarded by ready_mu_
+  uint64_t epoch_ = 0;
+};
+
+}  // namespace io
+}  // namespace mxtpu
+
+#endif  // MXTPU_IO_IMAGE_RECORD_ITER_H_

@@ -1,0 +1,65 @@
+// RecordIO binary framing — reader/writer.
+// TPU-native rebuild of the reference's record format
+// (reference src/io/image_recordio.h + dmlc-core recordio spec usage,
+// SURVEY.md §2.5): each record is
+//   uint32 magic(0xced7230a) | uint32 (cflag<<29|len) | payload | pad4
+// Matches mxnet_tpu/recordio.py bit-for-bit.
+#ifndef MXTPU_IO_RECORDIO_H_
+#define MXTPU_IO_RECORDIO_H_
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+namespace io {
+
+constexpr uint32_t kRecordMagic = 0xced7230a;
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path);
+  ~RecordReader();
+  // Read the next logical record into *out. Returns false at EOF.
+  bool Next(std::string* out);
+  void Reset();
+  // Seek to a byte offset (for indexed access).
+  void Seek(uint64_t pos);
+
+ private:
+  bool FillChunk();
+  std::FILE* fp_;
+  std::vector<char> chunk_;   // buffered chunk
+  size_t chunk_pos_ = 0;
+  size_t chunk_len_ = 0;
+  size_t chunk_capacity_;
+};
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path);
+  ~RecordWriter();
+  // Returns the byte offset the record was written at.
+  uint64_t Write(const char* data, size_t size);
+
+ private:
+  std::FILE* fp_;
+};
+
+// Image record header (reference python/mxnet/recordio.py IRHeader,
+// struct IfQQ little-endian).
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+static_assert(sizeof(IRHeader) == 24, "IRHeader must pack to 24 bytes");
+
+}  // namespace io
+}  // namespace mxtpu
+
+#endif  // MXTPU_IO_RECORDIO_H_
