@@ -41,6 +41,7 @@ from .module import Module
 from . import parallel
 from .io import DataBatch, DataIter, NDArrayIter, DataDesc
 from . import engine
+from . import rnn
 from . import recordio
 from . import image
 from . import gluon

@@ -33,13 +33,22 @@ class _NativeEngine:
         # an id into _fns, so no CFUNCTYPE object is ever freed while a
         # C worker thread may still be inside it
         self._trampoline = self._cb_type(self._dispatch)
+        # Python exceptions cannot cross the ctypes callback boundary
+        # into C++, so the first failure is latched here and rethrown at
+        # the next wait (mirrors the C++ engine's own error latch)
+        self._first_error = None
 
     def _dispatch(self, payload):
         cid = int(payload) if payload else 0
         with self._mu:
             fn = self._fns.pop(cid, None)
         if fn is not None:
-            fn()
+            try:
+                fn()
+            except BaseException as e:
+                with self._mu:
+                    if self._first_error is None:
+                        self._first_error = e
 
     def new_variable(self):
         return self._lib.MXTEngineNewVar(self._handle)
@@ -58,9 +67,17 @@ class _NativeEngine:
     def wait_for_var(self, var):
         _core.check_call(self._lib.MXTEngineWaitForVar(
             self._handle, var))
+        self._rethrow()
 
     def wait_all(self):
         _core.check_call(self._lib.MXTEngineWaitAll(self._handle))
+        self._rethrow()
+
+    def _rethrow(self):
+        with self._mu:
+            err, self._first_error = self._first_error, None
+        if err is not None:
+            raise RuntimeError('engine op failed: %r' % (err,)) from err
 
     def delete_variable(self, var):
         _core.check_call(self._lib.MXTEngineDeleteVar(self._handle, var))
@@ -87,6 +104,9 @@ class _PyEngine:
         self._next = 1
         self._pending = 0
         self._all_done = threading.Condition(self._mu)
+        # first op failure since the last wait, surfaced at sync points
+        # (reference propagates errors through on_complete)
+        self._first_error = None
 
     class _Var:
         __slots__ = ('queue', 'readers', 'writing')
@@ -104,6 +124,12 @@ class _PyEngine:
             return h
 
     def push(self, fn, const_vars=(), mutable_vars=()):
+        # CheckDuplicate semantics (reference threaded_engine.h:376)
+        if len(set(const_vars)) != len(const_vars) or \
+                len(set(mutable_vars)) != len(mutable_vars) or \
+                set(const_vars) & set(mutable_vars):
+            raise ValueError(
+                'duplicate var handles in const/mutable lists')
         op = {'fn': fn, 'wait': len(const_vars) + len(mutable_vars) + 1,
               'const': list(const_vars), 'mut': list(mutable_vars)}
         ready = []
@@ -146,6 +172,10 @@ class _PyEngine:
         def task():
             try:
                 op['fn']()
+            except BaseException as e:           # latch first failure
+                with self._mu:
+                    if self._first_error is None:
+                        self._first_error = e
             finally:
                 self._complete(op)
         if self._pool is not None:
@@ -176,11 +206,19 @@ class _PyEngine:
         ev = threading.Event()
         self.push(ev.set, const_vars=(var,))
         ev.wait()
+        self._rethrow()
 
     def wait_all(self):
         with self._mu:
             while self._pending != 0:
                 self._all_done.wait()
+        self._rethrow()
+
+    def _rethrow(self):
+        with self._mu:
+            err, self._first_error = self._first_error, None
+        if err is not None:
+            raise RuntimeError('engine op failed: %r' % (err,)) from err
 
     def delete_variable(self, var):
         with self._mu:

@@ -139,9 +139,12 @@ class Executor:
                         raise MXNetError(
                             'forward: shape mismatch for %s: %s vs bound %s'
                             % (k, v.shape, dst.shape))
-                    dst._data = v._data.astype(dst.dtype)
+                    val = v._data.astype(dst.dtype)
                 else:
-                    dst._data = jnp.asarray(v, dtype=dst.dtype)
+                    val = jnp.asarray(v, dtype=dst.dtype)
+                # commit to the executor's device (inputs often arrive on
+                # cpu(0) from host-side iterators)
+                dst._data = jax.device_put(val, self._ctx.jax_device())
             else:
                 raise MXNetError('forward: unknown argument %s' % k)
 

@@ -122,6 +122,75 @@ class Constant(Initializer):
 
 
 @register
+class LSTMBias(Initializer):
+    """Init LSTM stacked biases to zero except the forget gate, whose
+    bias is set to a custom value to ease gradient flow at the start of
+    training (reference initializer.py LSTMBias; cuDNN gate order
+    i, f, c, o so the forget gate is the second quarter)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        num_hidden = int(arr.shape[0] / 4)
+        a = np.zeros(arr.shape, dtype=np.float32)
+        a[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = a
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the flat parameter vector of a fused RNN op by
+    unpacking it into per-layer weight/bias blocks, initializing each
+    with `init` (or the in-scope global initializer), and re-packing
+    (reference initializer.py FusedRNN)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if init is not None and not isinstance(init, str):
+            init = init.dumps()
+        super().__init__(init=init, num_hidden=num_hidden,
+                         num_layers=num_layers, mode=mode,
+                         bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, num_layers=self._num_layers, mode=self._mode,
+            bidirectional=self._bidirectional,
+            forget_bias=self._forget_bias, prefix='')
+        args = cell.unpack_weights({'parameters': arr})
+        inner = None
+        if self._init is not None:
+            klass, kwargs = json.loads(self._init)
+            inner = create(klass, **kwargs)
+        global_init = desc.global_init if isinstance(desc, InitDesc) \
+            else None
+        lstm_bias = LSTMBias(self._forget_bias) if self._mode == 'lstm' \
+            else None
+        for name, block in args.items():
+            sub_desc = InitDesc(name, global_init=global_init)
+            if lstm_bias is not None and name.endswith('i2h_bias'):
+                lstm_bias._init_weight(sub_desc, block)
+            elif inner is not None:
+                inner(sub_desc, block)
+            else:
+                assert global_init is not None, (
+                    'FusedRNN needs either an explicit init or a '
+                    'global initializer in scope')
+                global_init(sub_desc, block)
+        arr[:] = cell.pack_weights(args)['parameters']
+
+
+@register
 class Uniform(Initializer):
     """U(-scale, scale) (reference initializer.py Uniform, default 0.07)."""
 

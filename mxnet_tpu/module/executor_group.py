@@ -110,6 +110,12 @@ class DataParallelExecutorGroup:
         data = data.astype(dst.dtype)
         if self.mesh is not None:
             data = pmesh.shard_batch(self.mesh, data)
+        else:
+            # batches commonly arrive from host-side iterators on cpu(0);
+            # commit them to the executor's device (the reference's
+            # _load_general does the cross-device copy the same way,
+            # executor_group.py:31-73)
+            data = jax.device_put(data, self.contexts[0].jax_device())
         dst._data = data
 
     def load_data_batch(self, data_batch):

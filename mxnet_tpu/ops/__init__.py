@@ -10,5 +10,6 @@ from . import nn
 from . import random_ops
 from . import spatial
 from . import extra
+from . import rnn_op
 
 from .registry import get, exists, list_ops, register, OpDef, OpContext

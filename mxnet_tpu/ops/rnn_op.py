@@ -1,0 +1,202 @@
+"""Fused multi-layer RNN operator (`RNN`).
+
+TPU-native equivalent of the reference's cuDNN fused RNN op
+(/root/reference src/operator/rnn.cc, rnn-inl.h; SURVEY.md §2.3): one op
+runs a whole stacked (optionally bidirectional) RNN/LSTM/GRU over a
+sequence.  The reference calls cudnnRNNForward; here each layer is a
+`jax.lax.scan` over time whose body is two MXU matmuls — XLA fuses the
+gate math and pipelines layers, which is the TPU-shaped version of the
+same fusion cuDNN does by hand.
+
+Weight layout is cuDNN-flat (all layers' i2h/h2h weight matrices
+concatenated first, then all bias vectors), identical to the layout the
+reference's FusedRNNCell packs/unpacks
+(python/mxnet/rnn/rnn_cell.py, _cells_weight concat order), so
+checkpoints move between the fused op and explicit per-step cells.
+
+Gate orders match cuDNN: LSTM = (i, f, g, o); GRU = (r, z, n) with the
+reset gate applied to (h2h·h + h2h_bias), not to h directly.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, asbool, asint, asfloat
+from ..base import parse_attr_value
+
+_NUM_GATES = {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4, 'gru': 3}
+
+
+def _rnn_mode(attrs):
+    return str(parse_attr_value(attrs['mode']))
+
+
+def _rnn_dims(attrs):
+    h = asint(attrs['state_size'])
+    nl = asint(attrs['num_layers'])
+    ndir = 2 if asbool(attrs.get('bidirectional', False)) else 1
+    gates = _NUM_GATES[_rnn_mode(attrs)]
+    return h, nl, ndir, gates
+
+
+def rnn_param_size(attrs, input_size):
+    """Total number of scalars in the flat `parameters` vector."""
+    h, nl, ndir, gates = _rnn_dims(attrs)
+    size = 0
+    for layer in range(nl):
+        isz = input_size if layer == 0 else h * ndir
+        size += ndir * gates * h * (isz + h)      # i2h + h2h weights
+    size += nl * ndir * 2 * gates * h             # i2h + h2h biases
+    return size
+
+
+def _split_params(params, attrs, input_size):
+    """Flat cuDNN layout -> per (layer, dir) dict of w_i2h/w_h2h/b_i2h/b_h2h."""
+    h, nl, ndir, gates = _rnn_dims(attrs)
+    out = []
+    pos = 0
+    for layer in range(nl):
+        isz = input_size if layer == 0 else h * ndir
+        for d in range(ndir):
+            w_i2h = params[pos:pos + gates * h * isz].reshape(gates * h, isz)
+            pos += gates * h * isz
+            w_h2h = params[pos:pos + gates * h * h].reshape(gates * h, h)
+            pos += gates * h * h
+            out.append({'w_i2h': w_i2h, 'w_h2h': w_h2h})
+    for layer in range(nl):
+        for d in range(ndir):
+            cell = out[layer * ndir + d]
+            cell['b_i2h'] = params[pos:pos + gates * h]
+            pos += gates * h
+            cell['b_h2h'] = params[pos:pos + gates * h]
+            pos += gates * h
+    return out
+
+
+def _cell_step(mode, h_size):
+    """Returns step(carry, gates_x, w_h2h, b_h2h) -> (carry, output)."""
+    if mode in ('rnn_relu', 'rnn_tanh'):
+        act = jax.nn.relu if mode == 'rnn_relu' else jnp.tanh
+
+        def step(carry, gx, w_h2h, b_h2h):
+            (h,) = carry
+            nh = act(gx + h @ w_h2h.T + b_h2h)
+            return (nh,), nh
+        return step
+    if mode == 'lstm':
+        def step(carry, gx, w_h2h, b_h2h):
+            h, c = carry
+            g = gx + h @ w_h2h.T + b_h2h
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            nc = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(gg)
+            nh = jax.nn.sigmoid(o) * jnp.tanh(nc)
+            return (nh, nc), nh
+        return step
+    # gru
+    def step(carry, gx, w_h2h, b_h2h):
+        (h,) = carry
+        gh = h @ w_h2h.T + b_h2h
+        xr, xz, xn = jnp.split(gx, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        nh = (1.0 - z) * n + z * h
+        return (nh,), nh
+    return step
+
+
+def _run_layer(mode, x, cell, h0, c0, reverse=False):
+    """x (T,N,I) -> (out (T,N,H), h_T, c_T).  One direction of one layer.
+
+    The i2h projection for ALL timesteps is a single (T*N, I)x(I, GH)
+    matmul outside the scan — big MXU work; the scan body only does the
+    (N, H)x(H, GH) recurrent matmul.
+    """
+    gates_x = x @ cell['w_i2h'].T + cell['b_i2h']
+    step = _cell_step(mode, h0.shape[-1])
+    carry0 = (h0, c0) if mode == 'lstm' else (h0,)
+
+    def body(carry, gx):
+        return step(carry, gx, cell['w_h2h'], cell['b_h2h'])
+
+    carry, out = lax.scan(body, carry0, gates_x, reverse=reverse)
+    if mode == 'lstm':
+        return out, carry[0], carry[1]
+    return out, carry[0], None
+
+
+def _rnn_compute(attrs, inputs, auxs, op_ctx):
+    mode = _rnn_mode(attrs)
+    h_size, nl, ndir, gates = _rnn_dims(attrs)
+    p = asfloat(attrs.get('p', 0.0))
+    state_outputs = asbool(attrs.get('state_outputs', False))
+
+    data = inputs[0]                       # (T, N, I) — TNC layout
+    params = inputs[1]
+    state = inputs[2]                      # (nl*ndir, N, H)
+    state_cell = inputs[3] if mode == 'lstm' else None
+
+    cells = _split_params(params, attrs, data.shape[2])
+    rng = op_ctx.rng
+    x = data
+    h_finals, c_finals = [], []
+    for layer in range(nl):
+        if layer > 0 and p > 0 and op_ctx.is_train:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - p
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, jnp.zeros_like(x))
+        outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else None
+            out, hT, cT = _run_layer(mode, x, cells[idx], h0, c0,
+                                     reverse=(d == 1))
+            outs.append(out)
+            h_finals.append(hT)
+            if cT is not None:
+                c_finals.append(cT)
+        x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+
+    outputs = [x]
+    if state_outputs:
+        outputs.append(jnp.stack(h_finals, axis=0))
+        if mode == 'lstm':
+            outputs.append(jnp.stack(c_finals, axis=0))
+    return outputs, []
+
+
+def _rnn_input_names(attrs):
+    names = ['data', 'parameters', 'state']
+    if _rnn_mode(attrs) == 'lstm':
+        names.append('state_cell')
+    return names
+
+
+def _rnn_num_outputs(attrs):
+    if not asbool(attrs.get('state_outputs', False)):
+        return 1
+    return 3 if _rnn_mode(attrs) == 'lstm' else 2
+
+
+def _rnn_infer_shape(attrs, in_shapes):
+    h, nl, ndir, gates = _rnn_dims(attrs)
+    d = in_shapes[0]
+    if d is None:
+        return in_shapes
+    t, n, isz = d
+    if in_shapes[1] is None:
+        in_shapes[1] = (rnn_param_size(attrs, isz),)
+    sshape = (nl * ndir, n, h)
+    for i in range(2, len(in_shapes)):
+        if in_shapes[i] is None:
+            in_shapes[i] = sshape
+    return in_shapes
+
+
+register('RNN', input_names=_rnn_input_names, num_outputs=_rnn_num_outputs,
+         infer_shape=_rnn_infer_shape, needs_rng=True, mode_dependent=True,
+         hint='rnn', simple=False)(_rnn_compute)

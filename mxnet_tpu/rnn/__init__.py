@@ -1,0 +1,9 @@
+"""Symbolic recurrent-network toolkit (`mx.rnn`), rebuilding the
+reference's python/mxnet/rnn package (SURVEY.md §2.7) on the TPU-native
+symbol/op stack."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       ModifierCell, DropoutCell, ZoneoutCell, ResidualCell)
+from .io import BucketSentenceIter, encode_sentences
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)

@@ -70,9 +70,17 @@ int MXTEngineDeleteVar(void* h, int64_t var) {
 }
 
 // ---- recordio ----------------------------------------------------------
+// Reader handle owns its record buffer so returned pointers stay valid
+// until the next call on the SAME reader (not just the same thread).
+struct MXTReaderHandle {
+  explicit MXTReaderHandle(const char* path) : reader(path) {}
+  mxtpu::io::RecordReader reader;
+  std::string buf;
+};
+
 void* MXTRecordReaderCreate(const char* path) {
   try {
-    return new mxtpu::io::RecordReader(path);
+    return new MXTReaderHandle(path);
   } catch (const std::exception& e) {
     last_error = e.what();
     return nullptr;
@@ -80,18 +88,17 @@ void* MXTRecordReaderCreate(const char* path) {
 }
 
 void MXTRecordReaderFree(void* h) {
-  delete static_cast<mxtpu::io::RecordReader*>(h);
+  delete static_cast<MXTReaderHandle*>(h);
 }
 
 // Returns 1 if a record was read, 0 at EOF, -1 on error.  The pointer
 // is valid until the next call on this reader.
 int MXTRecordReaderNext(void* h, const char** data, uint64_t* size) {
-  static thread_local std::string buf;
   try {
-    auto* r = static_cast<mxtpu::io::RecordReader*>(h);
-    if (!r->Next(&buf)) return 0;
-    *data = buf.data();
-    *size = buf.size();
+    auto* r = static_cast<MXTReaderHandle*>(h);
+    if (!r->reader.Next(&r->buf)) return 0;
+    *data = r->buf.data();
+    *size = r->buf.size();
     return 1;
   } catch (const std::exception& e) {
     last_error = e.what();
@@ -101,7 +108,7 @@ int MXTRecordReaderNext(void* h, const char** data, uint64_t* size) {
 
 int MXTRecordReaderSeek(void* h, uint64_t pos) {
   API_BEGIN()
-  static_cast<mxtpu::io::RecordReader*>(h)->Seek(pos);
+  static_cast<MXTReaderHandle*>(h)->reader.Seek(pos);
   API_END()
 }
 

@@ -56,7 +56,9 @@ void ThreadedEngine::TryDispatchHead(Var* v, std::vector<Opr*>* ready) {
 void ThreadedEngine::Push(OpFn fn,
                           const std::vector<VarHandle>& const_vars,
                           const std::vector<VarHandle>& mutable_vars) {
-  Opr* opr = new Opr();
+  // unique_ptr until fully validated, so a CHECK throw doesn't leak
+  std::unique_ptr<Opr> guard(new Opr());
+  Opr* opr = guard.get();
   opr->fn = std::move(fn);
   {
     std::lock_guard<std::mutex> lk(vars_mu_);
@@ -71,12 +73,23 @@ void ThreadedEngine::Push(OpFn fn,
       opr->mutable_vars.push_back(it->second.get());
     }
   }
+  // full CheckDuplicate semantics (reference threaded_engine.h:376):
+  // no overlap across lists AND no duplicates within either list
+  for (size_t i = 0; i < opr->const_vars.size(); ++i)
+    for (size_t j = i + 1; j < opr->const_vars.size(); ++j)
+      MXTPU_CHECK(opr->const_vars[i] != opr->const_vars[j])
+          << "duplicate var in const_vars";
+  for (size_t i = 0; i < opr->mutable_vars.size(); ++i)
+    for (size_t j = i + 1; j < opr->mutable_vars.size(); ++j)
+      MXTPU_CHECK(opr->mutable_vars[i] != opr->mutable_vars[j])
+          << "duplicate var in mutable_vars";
   for (Var* cv : opr->const_vars) {
     for (Var* mv : opr->mutable_vars) {
       MXTPU_CHECK(cv != mv)
           << "a var may not be both const and mutable in one op";
     }
   }
+  guard.release();
   pending_.fetch_add(1);
   opr->wait.store(static_cast<int>(opr->const_vars.size() +
                                    opr->mutable_vars.size()) + 1);
@@ -118,6 +131,8 @@ void ThreadedEngine::WorkerLoop() {
       opr->fn();
     } catch (const std::exception& e) {
       std::cerr << "[mxtpu engine] op threw: " << e.what() << std::endl;
+      std::lock_guard<std::mutex> lk(error_mu_);
+      if (first_error_.empty()) first_error_ = e.what();
     }
     OnComplete(opr);
   }
@@ -175,11 +190,22 @@ void ThreadedEngine::WaitForVar(VarHandle var) {
       {var}, {});
   std::unique_lock<std::mutex> lk(mu);
   cv.wait(lk, [&] { return done; });
+  RethrowPendingError();
 }
 
 void ThreadedEngine::WaitForAll() {
   std::unique_lock<std::mutex> lk(finished_mu_);
   finished_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  RethrowPendingError();
+}
+
+void ThreadedEngine::RethrowPendingError() {
+  std::string err;
+  {
+    std::lock_guard<std::mutex> lk(error_mu_);
+    err.swap(first_error_);
+  }
+  if (!err.empty()) throw std::runtime_error("engine op failed: " + err);
 }
 
 void ThreadedEngine::DeleteVariable(VarHandle var) {

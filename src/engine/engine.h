@@ -42,6 +42,9 @@ class ThreadedEngine {
   // (reference CheckDuplicate, threaded_engine.h:376).
   void Push(OpFn fn, const std::vector<VarHandle>& const_vars,
             const std::vector<VarHandle>& mutable_vars);
+  // Both wait calls throw std::runtime_error if any op failed since the
+  // last wait (the reference propagates op errors through on_complete;
+  // here the first error is latched and surfaced at the next sync point).
   void WaitForVar(VarHandle var);
   void WaitForAll();
   // Delete a variable once all pending ops on it complete.
@@ -92,6 +95,11 @@ class ThreadedEngine {
   std::atomic<int64_t> pending_{0};
   std::mutex finished_mu_;
   std::condition_variable finished_cv_;
+
+  // first op failure since the last wait (latched, reported once)
+  std::mutex error_mu_;
+  std::string first_error_;
+  void RethrowPendingError();
 };
 
 }  // namespace engine

@@ -61,13 +61,25 @@ def test_engine_read_write_ordering():
 def test_engine_independent_parallelism():
     eng = _mk_engine()
     v1, v2 = eng.new_variable(), eng.new_variable()
-    t0 = time.time()
-    for v in (v1, v2):
+    # structural check: record wall-clock intervals of each chain's ops
+    # and assert the two chains overlapped (timing-threshold-free)
+    intervals = []
+    lock = threading.Lock()
+
+    def op(tag):
+        t0 = time.time()
+        time.sleep(0.02)
+        with lock:
+            intervals.append((tag, t0, time.time()))
+    for tag, v in (('a', v1), ('b', v2)):
         for _ in range(2):
-            eng.push(lambda: time.sleep(0.02), mutable_vars=(v,))
+            eng.push(lambda tag=tag: op(tag), mutable_vars=(v,))
     eng.wait_all()
-    # serialized would be 0.08s; two independent chains ~0.04s
-    assert time.time() - t0 < 0.07
+    a = [(s, e) for t, s, e in intervals if t == 'a']
+    b = [(s, e) for t, s, e in intervals if t == 'b']
+    overlap = any(s1 < e2 and s2 < e1
+                  for s1, e1 in a for s2, e2 in b)
+    assert overlap, (a, b)
 
 
 @native
@@ -198,3 +210,32 @@ def test_native_iter_sharding(tmp_path):
     assert len(alll) == 12
     assert sorted(alll.tolist()) == sorted(
         [float(i % 4) for i in range(12)])
+
+
+def test_engine_error_propagates_at_wait():
+    """Op failures surface at the next sync point instead of vanishing
+    (both native and Python engines latch the first error)."""
+    for eng in (eng_mod.Engine(num_workers=4),
+                eng_mod._PyEngine(num_workers=2)):
+        var = eng.new_variable() if hasattr(eng, 'new_variable') else None
+        eng.push(lambda: (_ for _ in ()).throw(ValueError('boom')),
+                 mutable_vars=(var,))
+        with pytest.raises(Exception) as exc:
+            eng.wait_all()
+        assert 'engine op failed' in str(exc.value)
+        # error is reported once; engine remains usable
+        eng.push(lambda: None, mutable_vars=(var,))
+        eng.wait_all()
+
+
+def test_engine_rejects_duplicate_vars():
+    for eng in (eng_mod.Engine(num_workers=2),
+                eng_mod._PyEngine(num_workers=2)):
+        v = eng.new_variable()
+        with pytest.raises(Exception):
+            eng.push(lambda: None, mutable_vars=(v, v))
+        with pytest.raises(Exception):
+            eng.push(lambda: None, const_vars=(v,), mutable_vars=(v,))
+        # engine still functional afterwards
+        eng.push(lambda: None, mutable_vars=(v,))
+        eng.wait_all()

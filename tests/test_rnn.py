@@ -1,0 +1,239 @@
+"""mx.rnn symbolic cell tests (model: reference
+tests/python/unittest/test_rnn.py) plus fused-RNN-op numerics."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(100, prefix='rnn_')
+    inputs = [sym.Variable('rnn_t%d_data' % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        'rnn_h2h_bias', 'rnn_h2h_weight', 'rnn_i2h_bias', 'rnn_i2h_weight']
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100)] * 3
+
+
+def test_lstm_cell_unroll_shapes():
+    cell = mx.rnn.LSTMCell(100, prefix='rnn_', forget_bias=1.0)
+    inputs = [sym.Variable('rnn_t%d_data' % i) for i in range(3)]
+    outputs, _ = cell.unroll(3, inputs)
+    outputs = sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        'rnn_h2h_bias', 'rnn_h2h_weight', 'rnn_i2h_bias', 'rnn_i2h_weight']
+    _, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                     rnn_t1_data=(10, 50),
+                                     rnn_t2_data=(10, 50))
+    assert outs == [(10, 100)] * 3
+
+
+def test_gru_and_residual_and_zoneout():
+    cell = mx.rnn.ResidualCell(mx.rnn.GRUCell(50, prefix='gru_'))
+    inputs = [sym.Variable('t%d_data' % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    outputs = sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(t0_data=(10, 50), t1_data=(10, 50))
+    assert outs == [(10, 50)] * 2
+
+    cell = mx.rnn.ZoneoutCell(mx.rnn.RNNCell(100, prefix='rnn_'),
+                              zoneout_outputs=0.5, zoneout_states=0.5)
+    inputs = [sym.Variable('z%d_data' % i) for i in range(2)]
+    outputs, _ = cell.unroll(2, inputs)
+    outputs = sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(z0_data=(10, 50), z1_data=(10, 50))
+    assert outs == [(10, 100)] * 2
+
+
+def test_stack_bidirectional_unroll():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.BidirectionalCell(
+        mx.rnn.LSTMCell(16, prefix='l0_'),
+        mx.rnn.LSTMCell(16, prefix='r0_'),
+        output_prefix='bi_'))
+    stack.add(mx.rnn.DropoutCell(0.5, prefix='drop_'))
+    stack.add(mx.rnn.GRUCell(20, prefix='g1_'))
+    data = sym.Variable('data')
+    outputs, states = stack.unroll(4, data, layout='NTC',
+                                   merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(data=(8, 4, 12))
+    assert outs == [(8, 4, 20)]
+
+
+def _np_lstm_ref(x, cells, h0, c0):
+    """Single-layer LSTM with cuDNN gate order, numpy reference."""
+    T, N, _ = x.shape
+    H = h0.shape[-1]
+    w_i2h, w_h2h, b_i2h, b_h2h = cells
+    h, c = h0, c0
+    outs = []
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for t in range(T):
+        g = x[t] @ w_i2h.T + b_i2h + h @ w_h2h.T + b_h2h
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs), h, c
+
+
+def test_fused_rnn_op_matches_numpy_lstm():
+    T, N, I, H = 4, 3, 5, 6
+    rs = np.random.RandomState(7)
+    w_i2h = rs.randn(4 * H, I).astype(np.float32) * 0.2
+    w_h2h = rs.randn(4 * H, H).astype(np.float32) * 0.2
+    b_i2h = rs.randn(4 * H).astype(np.float32) * 0.1
+    b_h2h = rs.randn(4 * H).astype(np.float32) * 0.1
+    params = np.concatenate([w_i2h.ravel(), w_h2h.ravel(), b_i2h, b_h2h])
+    x = rs.randn(T, N, I).astype(np.float32)
+    h0 = np.zeros((1, N, H), np.float32)
+
+    out = nd.RNN(data=nd.array(x), parameters=nd.array(params),
+                 state=nd.array(h0), state_cell=nd.array(h0),
+                 mode='lstm', state_size=H, num_layers=1,
+                 state_outputs=True)
+    ref_out, ref_h, ref_c = _np_lstm_ref(
+        x, (w_i2h, w_h2h, b_i2h, b_h2h), h0[0], h0[0])
+    np.testing.assert_allclose(out[0].asnumpy(), ref_out, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(out[1].asnumpy()[0], ref_h, rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(out[2].asnumpy()[0], ref_c, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_fused_vs_unfused_consistency():
+    """FusedRNNCell.unroll == its unfuse()'d stack with weights moved
+    through unpack_weights (reference test_rnn.py test_unfuse +
+    test_convert semantics)."""
+    T, N, I, H, L = 3, 2, 4, 5, 2
+    fused = mx.rnn.FusedRNNCell(H, num_layers=L, mode='lstm',
+                                prefix='lstm_', get_next_state=True)
+    data = sym.Variable('data')
+    f_out, f_states = fused.unroll(T, data, layout='NTC',
+                                   merge_outputs=True)
+    f_grp = sym.Group([f_out] + f_states)
+
+    ex = f_grp.simple_bind(mx.cpu(), data=(N, T, I), grad_req='null')
+    rs = np.random.RandomState(3)
+    x = rs.randn(N, T, I).astype(np.float32)
+    pshape = ex.arg_dict['lstm_parameters'].shape
+    pvals = (rs.rand(*pshape).astype(np.float32) - 0.5) * 0.4
+    ex.arg_dict['data'][:] = x
+    ex.arg_dict['lstm_parameters'][:] = pvals
+    f_vals = [o.asnumpy() for o in ex.forward(is_train=False)]
+
+    unfused = fused.unfuse()
+    u_out, u_states = unfused.unroll(T, sym.Variable('data'),
+                                     layout='NTC', merge_outputs=True)
+    u_grp = sym.Group([u_out] + u_states)
+    args = fused.unpack_weights({'lstm_parameters': nd.array(pvals)})
+    ex2 = u_grp.simple_bind(mx.cpu(), data=(N, T, I), grad_req='null')
+    ex2.arg_dict['data'][:] = x
+    for k, v in args.items():
+        ex2.arg_dict[k][:] = v.asnumpy()
+    u_vals = [o.asnumpy() for o in ex2.forward(is_train=False)]
+
+    # fused output vs unfused output
+    np.testing.assert_allclose(f_vals[0], u_vals[0], rtol=1e-5, atol=1e-5)
+    # final states: fused stacks (L, N, H); unfused returns per-layer
+    fused_h = f_vals[1]
+    fused_c = f_vals[2]
+    # unfused states ordering: [h_l0, c_l0, h_l1, c_l1]
+    np.testing.assert_allclose(fused_h[0], u_vals[1], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(fused_c[0], u_vals[2], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(fused_h[1], u_vals[3], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(fused_c[1], u_vals[4], rtol=1e-5, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    fused = mx.rnn.FusedRNNCell(6, num_layers=2, mode='gru',
+                                bidirectional=True, prefix='gru_')
+    from mxnet_tpu.ops.rnn_op import rnn_param_size
+    psize = rnn_param_size({'mode': 'gru', 'state_size': 6,
+                            'num_layers': 2, 'bidirectional': True}, 4)
+    rs = np.random.RandomState(0)
+    pvals = rs.rand(psize).astype(np.float32)
+    unpacked = fused.unpack_weights({'gru_parameters': nd.array(pvals)})
+    assert 'gru_parameters' not in unpacked
+    packed = fused.pack_weights(unpacked)
+    np.testing.assert_allclose(packed['gru_parameters'].asnumpy(), pvals)
+
+
+def test_bucket_sentence_iter():
+    rs = np.random.RandomState(0)
+    sentences = [[int(w) + 1 for w in
+                  rs.randint(0, 20, size=rs.randint(2, 12))]
+                 for _ in range(200)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[4, 8, 12], invalid_label=0)
+    nbatch = 0
+    for batch in it:
+        assert batch.data[0].shape == (8, batch.bucket_key)
+        assert batch.label[0].shape == (8, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        np.testing.assert_allclose(l[:, :-1], d[:, 1:])
+        nbatch += 1
+    assert nbatch > 0
+
+
+def test_encode_sentences():
+    sents = [['a', 'b', 'c'], ['b', 'c', 'd']]
+    coded, vocab = mx.rnn.encode_sentences(sents, invalid_label=0,
+                                           start_label=1)
+    assert len(vocab) == 5  # 4 words + invalid key
+    assert coded[0][1] == coded[1][0]  # 'b' consistent
+
+
+def test_lstm_bucketing_training():
+    """End-to-end: BucketingModule + LSTMCell.unroll on a toy
+    next-token task (reference example/rnn/lstm_bucketing.py shape,
+    tests/python/train/test_bucketing.py scale-down)."""
+    vocab = 16
+    hidden = 16
+    embed = 8
+    rs = np.random.RandomState(0)
+    # toy language: token t+1 = (t + 1) % vocab, start random
+    sentences = []
+    for _ in range(120):
+        ln = rs.choice([4, 8])
+        s0 = rs.randint(1, vocab)
+        sentences.append([(s0 + i) % vocab for i in range(ln)])
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=8,
+                                   buckets=[4, 8], invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = sym.Variable('data')
+        label = sym.Variable('softmax_label')
+        emb = sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                            name='embed')
+        cell = mx.rnn.LSTMCell(hidden, prefix='lstm_')
+        outputs, _ = cell.unroll(seq_len, emb, layout='NTC',
+                                 merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab, name='pred')
+        lab = sym.Reshape(label, shape=(-1,))
+        pred = sym.SoftmaxOutput(pred, label=lab, name='softmax')
+        return pred, ('data',), ('softmax_label',)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer='adam',
+                       optimizer_params={'learning_rate': 0.02})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    for epoch in range(15):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+    # toy task is deterministic; a fitted LSTM should reach low perplexity
+    assert metric.get()[1] < 2.5, metric.get()
