@@ -42,6 +42,7 @@ from . import parallel
 from .io import DataBatch, DataIter, NDArrayIter, DataDesc
 from . import engine
 from . import rnn
+from . import contrib
 from . import recordio
 from . import image
 from . import gluon

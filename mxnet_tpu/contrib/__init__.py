@@ -1,0 +1,11 @@
+"""Contrib namespace (`mx.contrib.ndarray` / `mx.contrib.symbol` /
+`mx.contrib.autograd`), mirroring the reference's python/mxnet/contrib
+package (SURVEY.md §2.7).  The contrib operators themselves are
+registered in ops/contrib_ops.py and reachable both here and on the
+main nd/sym modules (the reference exposes them with a `_contrib_`
+name prefix through the same codegen)."""
+from . import ndarray
+from . import ndarray as nd
+from . import symbol
+from . import symbol as sym
+from . import autograd

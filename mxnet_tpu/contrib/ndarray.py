@@ -1,0 +1,15 @@
+"""Imperative contrib operators (reference python/mxnet/contrib/ndarray
+codegen of `_contrib_*` ops)."""
+from .. import ndarray as _nd
+
+_CONTRIB_OPS = [
+    'MultiBoxPrior', 'MultiBoxTarget', 'MultiBoxDetection', 'Proposal',
+    'MultiProposal', 'PSROIPooling', 'DeformableConvolution',
+    'DeformablePSROIPooling', 'ctc_loss', 'CTCLoss', 'fft', 'ifft',
+    'count_sketch', 'quantize', 'dequantize',
+]
+
+for _name in _CONTRIB_OPS:
+    globals()[_name] = getattr(_nd, _name)
+
+del _nd, _name

@@ -1,0 +1,15 @@
+"""Symbolic contrib operators (reference python/mxnet/contrib/symbol
+codegen of `_contrib_*` ops)."""
+from .. import symbol as _sym
+
+_CONTRIB_OPS = [
+    'MultiBoxPrior', 'MultiBoxTarget', 'MultiBoxDetection', 'Proposal',
+    'MultiProposal', 'PSROIPooling', 'DeformableConvolution',
+    'DeformablePSROIPooling', 'ctc_loss', 'CTCLoss', 'fft', 'ifft',
+    'count_sketch', 'quantize', 'dequantize',
+]
+
+for _name in _CONTRIB_OPS:
+    globals()[_name] = getattr(_sym, _name)
+
+del _sym, _name

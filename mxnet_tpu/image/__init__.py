@@ -9,3 +9,6 @@ from .image import (imdecode, imread, imresize, scale_down, resize_short,
                     SaturationJitterAug, ColorJitterAug, LightingAug,
                     ColorNormalizeAug, HorizontalFlipAug, CastAug,
                     CreateAugmenter, ImageIter)
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter, ImageDetIter)

@@ -5,7 +5,7 @@ behind every BASELINE.md number (resnet/alexnet/vgg/inception-bn/lenet).
 Same architectures, composed from this framework's symbol API; on TPU
 the whole network compiles to one XLA module per executor.
 """
-from . import lenet, mlp, resnet, alexnet, vgg, inception_bn
+from . import lenet, mlp, resnet, alexnet, vgg, inception_bn, ssd
 
 _FACTORY = {
     'lenet': lenet.get_symbol,

@@ -11,5 +11,6 @@ from . import random_ops
 from . import spatial
 from . import extra
 from . import rnn_op
+from . import contrib_ops
 
 from .registry import get, exists, list_ops, register, OpDef, OpContext

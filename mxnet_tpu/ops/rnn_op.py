@@ -40,37 +40,49 @@ def _rnn_dims(attrs):
     return h, nl, ndir, gates
 
 
+def enumerate_param_blocks(h, nl, ndir, gates, input_size):
+    """Walk the cuDNN-flat parameter layout: ALL weight matrices first
+    (per layer, per direction: i2h then h2h), then all bias vectors in
+    the same order.  Yields (layer, direction, group, kind, start,
+    shape).  This is the ONE encoding of the layout — the fused op,
+    FusedRNNCell pack/unpack and the FusedRNN initializer all consume
+    it, so they cannot drift apart."""
+    pos = 0
+    for layer in range(nl):
+        isz = input_size if layer == 0 else h * ndir
+        for d in range(ndir):
+            for group, ni in (('i2h', isz), ('h2h', h)):
+                shape = (gates * h, ni)
+                yield layer, d, group, 'weight', pos, shape
+                pos += shape[0] * shape[1]
+    for layer in range(nl):
+        for d in range(ndir):
+            for group in ('i2h', 'h2h'):
+                yield layer, d, group, 'bias', pos, (gates * h,)
+                pos += gates * h
+
+
 def rnn_param_size(attrs, input_size):
     """Total number of scalars in the flat `parameters` vector."""
     h, nl, ndir, gates = _rnn_dims(attrs)
     size = 0
-    for layer in range(nl):
-        isz = input_size if layer == 0 else h * ndir
-        size += ndir * gates * h * (isz + h)      # i2h + h2h weights
-    size += nl * ndir * 2 * gates * h             # i2h + h2h biases
+    for *_unused, start, shape in enumerate_param_blocks(
+            h, nl, ndir, gates, input_size):
+        size = start + int(np.prod(shape))
     return size
 
 
 def _split_params(params, attrs, input_size):
     """Flat cuDNN layout -> per (layer, dir) dict of w_i2h/w_h2h/b_i2h/b_h2h."""
     h, nl, ndir, gates = _rnn_dims(attrs)
-    out = []
-    pos = 0
-    for layer in range(nl):
-        isz = input_size if layer == 0 else h * ndir
-        for d in range(ndir):
-            w_i2h = params[pos:pos + gates * h * isz].reshape(gates * h, isz)
-            pos += gates * h * isz
-            w_h2h = params[pos:pos + gates * h * h].reshape(gates * h, h)
-            pos += gates * h * h
-            out.append({'w_i2h': w_i2h, 'w_h2h': w_h2h})
-    for layer in range(nl):
-        for d in range(ndir):
-            cell = out[layer * ndir + d]
-            cell['b_i2h'] = params[pos:pos + gates * h]
-            pos += gates * h
-            cell['b_h2h'] = params[pos:pos + gates * h]
-            pos += gates * h
+    out = [{} for _ in range(nl * ndir)]
+    key = {('i2h', 'weight'): 'w_i2h', ('h2h', 'weight'): 'w_h2h',
+           ('i2h', 'bias'): 'b_i2h', ('h2h', 'bias'): 'b_h2h'}
+    for layer, d, group, kind, start, shape in enumerate_param_blocks(
+            h, nl, ndir, gates, input_size):
+        n = int(np.prod(shape))
+        out[layer * ndir + d][key[(group, kind)]] = \
+            params[start:start + n].reshape(shape)
     return out
 
 

@@ -202,6 +202,12 @@ class Symbol:
             for node in topo:
                 if node.op is None:
                     s = var_shapes.get(node.name)
+                    if s is None and '__shape__' in node.user_attrs:
+                        # honor Variable(shape=...) hints (reference
+                        # symbol.py var(shape=...))
+                        s = tuple(parse_attr_value(
+                            node.user_attrs['__shape__']))
+                        var_shapes[node.name] = s
                     if s is not None and entry_shape.get((id(node), 0)) != s:
                         entry_shape[(id(node), 0)] = tuple(s)
                         changed = True

@@ -18,7 +18,12 @@ ThreadedEngine::ThreadedEngine(int num_workers) {
 }
 
 ThreadedEngine::~ThreadedEngine() {
-  WaitForAll();
+  // drain WITHOUT RethrowPendingError: destructors are noexcept and a
+  // latched op error must not std::terminate the process
+  {
+    std::unique_lock<std::mutex> lk(finished_mu_);
+    finished_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
   {
     std::lock_guard<std::mutex> lk(queue_mu_);
     shutdown_ = true;

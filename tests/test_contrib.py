@@ -1,0 +1,234 @@
+"""Contrib operator tests (model: reference
+tests/python/unittest/test_operator.py sections for multibox/ctc/fft +
+contrib op behavior documented in SURVEY.md §2.3)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_prior_layout():
+    data = nd.zeros((1, 3, 4, 6))
+    boxes = mx.contrib.nd.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                        ratios=(1, 2, 0.5))
+    # anchors per loc = num_sizes - 1 + num_ratios = 4
+    assert boxes.shape == (1, 4 * 6 * 4, 4)
+    b = boxes.asnumpy().reshape(4, 6, 4, 4)
+    # first anchor at (0,0): center ((0.5)/6, 0.5/4), size 0.5
+    cx, cy = 0.5 / 6, 0.5 / 4
+    np.testing.assert_allclose(b[0, 0, 0],
+                               [cx - 0.25, cy - 0.25, cx + 0.25, cy + 0.25],
+                               rtol=1e-5)
+    # ratio-2 anchor: w = 0.5*sqrt(2)/2, h = 0.5/sqrt(2)/2
+    w = 0.5 * np.sqrt(2) / 2
+    h = 0.5 / np.sqrt(2) / 2
+    np.testing.assert_allclose(b[0, 0, 2],
+                               [cx - w, cy - h, cx + w, cy + h], rtol=1e-5)
+
+
+def test_multibox_target_basic():
+    # one anchor exactly on the gt, one far away
+    anchors = nd.array(np.array(
+        [[[0.1, 0.1, 0.5, 0.5], [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    labels = nd.array(np.array(
+        [[[0, 0.1, 0.1, 0.5, 0.5], [-1, -1, -1, -1, -1]]], np.float32))
+    cls_pred = nd.zeros((1, 3, 2))
+    loc_t, loc_m, cls_t = mx.contrib.nd.MultiBoxTarget(
+        anchors, labels, cls_pred, overlap_threshold=0.5)
+    ct = cls_t.asnumpy()
+    assert ct.shape == (1, 2)
+    assert ct[0, 0] == 1.0          # matched -> class 0 + 1
+    assert ct[0, 1] == 0.0          # unmatched -> background
+    lm = loc_m.asnumpy().reshape(1, 2, 4)
+    assert lm[0, 0].sum() == 4.0
+    assert lm[0, 1].sum() == 0.0
+    # perfect match -> zero regression target
+    lt = loc_t.asnumpy().reshape(1, 2, 4)
+    np.testing.assert_allclose(lt[0, 0], np.zeros(4), atol=1e-5)
+
+
+def test_multibox_target_no_gt():
+    anchors = nd.array(np.random.rand(1, 5, 4).astype(np.float32))
+    labels = nd.array(np.full((1, 2, 5), -1, np.float32))
+    cls_pred = nd.zeros((1, 4, 5))
+    loc_t, loc_m, cls_t = mx.contrib.nd.MultiBoxTarget(
+        anchors, labels, cls_pred)
+    assert np.all(cls_t.asnumpy() == 0)
+    assert np.all(loc_m.asnumpy() == 0)
+
+
+def test_multibox_detection_roundtrip():
+    """Encode a gt box as a target then decode via detection; NMS keeps
+    the best anchor and recovers the gt box."""
+    anchors = np.array([[0.1, 0.1, 0.5, 0.5],
+                        [0.12, 0.12, 0.52, 0.52],
+                        [0.7, 0.7, 0.9, 0.9]], np.float32)
+    # class scores: anchor 0/1 -> class 1, anchor 2 below threshold
+    cls_prob = np.array([[0.05, 0.1, 0.9],
+                         [0.9, 0.8, 0.05],
+                         [0.05, 0.1, 0.05]], np.float32)[None]
+    loc_pred = np.zeros((1, 12), np.float32)
+    out = mx.contrib.nd.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchors[None]),
+        nms_threshold=0.5, threshold=0.4)
+    o = out.asnumpy()[0]
+    kept = o[o[:, 0] >= 0]
+    # NMS suppresses overlapping anchor 1; only anchor 0 survives
+    assert kept.shape[0] == 1
+    assert kept[0, 0] == 0.0         # class id 0 (= class 1 - background)
+    np.testing.assert_allclose(kept[0, 1], 0.9, rtol=1e-5)
+    np.testing.assert_allclose(kept[0, 2:], anchors[0], atol=1e-5)
+
+
+def test_proposal_shapes():
+    rs = np.random.RandomState(0)
+    A = 12  # 3 ratios x 4 scales (defaults)
+    h, w = 4, 5
+    cls = nd.array(rs.rand(1, 2 * A, h, w).astype(np.float32))
+    bbox = nd.array((rs.rand(1, 4 * A, h, w).astype(np.float32) - 0.5) * 0.1)
+    im_info = nd.array(np.array([[64, 80, 1.0]], np.float32))
+    rois = mx.contrib.nd.Proposal(cls, bbox, im_info,
+                                  rpn_pre_nms_top_n=50,
+                                  rpn_post_nms_top_n=16,
+                                  feature_stride=16, threshold=0.7,
+                                  rpn_min_size=4)
+    assert rois.shape == (16, 5)
+    r = rois.asnumpy()
+    # rois are clipped to the image
+    assert r[:, 1].min() >= 0 and r[:, 3].max() <= 80 - 1
+    assert r[:, 2].min() >= 0 and r[:, 4].max() <= 64 - 1
+
+
+def test_psroi_pooling():
+    """Constant-valued channel blocks -> each output bin picks its
+    group's constant."""
+    dim, g = 2, 2
+    data = np.zeros((1, dim * g * g, 8, 8), np.float32)
+    for c in range(dim * g * g):
+        data[0, c] = c
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = mx.contrib.nd.PSROIPooling(nd.array(data), nd.array(rois),
+                                     spatial_scale=1.0, output_dim=dim,
+                                     pooled_size=2, group_size=g)
+    o = out.asnumpy()
+    assert o.shape == (1, dim, 2, 2)
+    for d in range(dim):
+        for ph in range(2):
+            for pw in range(2):
+                assert o[0, d, ph, pw] == (d * g + ph) * g + pw
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 8, 8).astype(np.float32)
+    wgt = rs.rand(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out_d = mx.contrib.nd.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(wgt), num_filter=4,
+        kernel=(3, 3), no_bias=True)
+    out_c = nd.Convolution(nd.array(x), nd.array(wgt), num_filter=4,
+                           kernel=(3, 3), no_bias=True)
+    np.testing.assert_allclose(out_d.asnumpy(), out_c.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_vs_manual():
+    """T=1 single label: loss = -log softmax(label)."""
+    logits = np.array([[[1.0, 2.0, 0.5]]], np.float32)  # (T=1, N=1, C=3)
+    label = np.array([[1, 0]], np.float32)
+    out = mx.contrib.nd.ctc_loss(nd.array(logits), nd.array(label))
+    p = np.exp(logits[0, 0]) / np.exp(logits[0, 0]).sum()
+    np.testing.assert_allclose(out.asnumpy()[0], -np.log(p[1]), rtol=1e-5)
+
+
+def test_ctc_loss_two_steps():
+    """T=2, label 'a': paths = {blank,a}, {a,blank}, {a,a}."""
+    rs = np.random.RandomState(3)
+    logits = rs.rand(2, 1, 3).astype(np.float32)
+    label = np.array([[2, 0]], np.float32)
+    out = mx.contrib.nd.ctc_loss(nd.array(logits), nd.array(label))
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    p = p[:, 0, :]
+    lik = p[0, 0] * p[1, 2] + p[0, 2] * p[1, 0] + p[0, 2] * p[1, 2]
+    np.testing.assert_allclose(out.asnumpy()[0], -np.log(lik), rtol=1e-5)
+
+
+def test_ctc_loss_grad_flows():
+    import jax
+    from mxnet_tpu import autograd
+    logits = nd.array(np.random.RandomState(0)
+                      .rand(4, 2, 5).astype(np.float32))
+    label = nd.array(np.array([[1, 2], [3, 0]], np.float32))
+    logits.attach_grad()
+    with autograd.record():
+        loss = mx.contrib.nd.ctc_loss(logits, label)
+        s = nd.sum(loss)
+    s.backward()
+    g = logits.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fft_ifft_roundtrip():
+    rs = np.random.RandomState(0)
+    x = rs.rand(3, 8).astype(np.float32)
+    y = mx.contrib.nd.fft(nd.array(x))
+    assert y.shape == (3, 16)
+    # packed layout: interleaved re/im matches numpy fft
+    ref = np.fft.fft(x, axis=-1)
+    packed = np.stack([ref.real, ref.imag], -1).reshape(3, 16)
+    np.testing.assert_allclose(y.asnumpy(), packed, rtol=1e-4, atol=1e-4)
+    # reference ifft is unnormalized: ifft(fft(x)) = x * d
+    z = mx.contrib.nd.ifft(y)
+    np.testing.assert_allclose(z.asnumpy(), x * 8, rtol=1e-4, atol=1e-4)
+
+
+def test_count_sketch():
+    x = np.array([[1.0, 2.0, 3.0]], np.float32)
+    h = np.array([[0, 1, 0]], np.float32)
+    s = np.array([[1, -1, 1]], np.float32)
+    out = mx.contrib.nd.count_sketch(nd.array(x), nd.array(h), nd.array(s),
+                                     out_dim=2)
+    np.testing.assert_allclose(out.asnumpy(), [[4.0, -2.0]], rtol=1e-6)
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.linspace(-1, 1, 16).astype(np.float32).reshape(4, 4)
+    q, mn, mx_ = mx.contrib.nd.quantize(
+        nd.array(x), nd.array([-1.0]), nd.array([1.0]))
+    assert q.asnumpy().dtype == np.uint8
+    back = mx.contrib.nd.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=2.0 / 255 + 1e-6)
+
+
+def test_contrib_symbol_compose():
+    """SSD head fragment composes symbolically and binds."""
+    from mxnet_tpu import sym
+    data = sym.Variable('data')
+    anchors = sym.MultiBoxPrior(data, sizes=(0.4,), ratios=(1, 2))
+    cls_prob = sym.Variable('cls_prob')
+    loc_pred = sym.Variable('loc_pred')
+    det = sym.MultiBoxDetection(cls_prob, loc_pred, anchors)
+    A = 3 * 3 * 2
+    ex = det.simple_bind(mx.cpu(), data=(1, 8, 3, 3),
+                         cls_prob=(1, 2, A), loc_pred=(1, A * 4),
+                         grad_req='null')
+    out = ex.forward(is_train=False)[0]
+    assert out.shape == (1, A, 6)
+
+
+def test_proposal_batch_index_stamped():
+    """ROIs carry their image index in column 0 (reference MultiProposal);
+    batch>1 must not all point at image 0."""
+    rs = np.random.RandomState(0)
+    A, h, w = 12, 4, 4
+    cls = nd.array(rs.rand(3, 2 * A, h, w).astype(np.float32))
+    bbox = nd.array(np.zeros((3, 4 * A, h, w), np.float32))
+    im_info = nd.array(np.tile([64, 64, 1.0], (3, 1)).astype(np.float32))
+    rois = mx.contrib.nd.MultiProposal(cls, bbox, im_info,
+                                       rpn_pre_nms_top_n=20,
+                                       rpn_post_nms_top_n=8,
+                                       rpn_min_size=2)
+    r = rois.asnumpy().reshape(3, 8, 5)
+    for b in range(3):
+        assert (r[b, :, 0] == b).all()

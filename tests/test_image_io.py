@@ -155,3 +155,85 @@ def test_mnist_iter(tmp_path):
     it2 = mx.io.MNISTIter(image=ip, label=lp, batch_size=5, flat=False,
                           shuffle=False)
     assert it2.next().data[0].shape == (5, 1, 28, 28)
+
+
+# ---------------------------------------------------------------------------
+# Detection pipeline (mx.image.ImageDetIter; reference
+# python/mxnet/image/detection.py)
+# ---------------------------------------------------------------------------
+
+def _write_det_rec(tmp_path, n=8, size=64):
+    import cv2
+    from mxnet_tpu import recordio
+    prefix = str(tmp_path / 'det')
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (size, size, 3)).astype(np.uint8)
+        ret, buf = cv2.imencode('.png', img)
+        # label: header_w=2, obj_w=5, then objects
+        nobj = 1 + i % 3
+        label = [2, 5]
+        for j in range(nobj):
+            label += [float(j % 4), 0.1, 0.1, 0.6, 0.6]
+        header = recordio.IRHeader(0, np.array(label, np.float32), i, 0)
+        rec.write_idx(i, recordio.pack(header, buf.tobytes()))
+    rec.close()
+    return prefix
+
+
+def test_image_det_iter(tmp_path):
+    prefix = _write_det_rec(tmp_path, n=8)
+    it = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                               path_imgrec=prefix + '.rec', shuffle=False)
+    assert it.max_objects == 3
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4, 3, 5)
+    lab = batch.label[0].asnumpy()
+    # sample 0 has 1 object, padded rows are -1
+    assert lab[0, 0, 0] == 0.0
+    assert (lab[0, 1:] == -1).all()
+
+
+def test_det_hflip_updates_boxes():
+    from mxnet_tpu.image.detection import DetHorizontalFlipAug
+    import random as pyrandom
+    pyrandom.seed(0)
+    img = np.zeros((10, 10, 3), np.uint8)
+    label = np.array([[1, 0.1, 0.2, 0.4, 0.6],
+                      [-1, -1, -1, -1, -1]], np.float32)
+    aug = DetHorizontalFlipAug(p=1.0)
+    _, out = aug(img, label)
+    np.testing.assert_allclose(out[0], [1, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    assert (out[1] == -1).all()
+
+
+def test_det_random_crop_keeps_box(tmp_path):
+    from mxnet_tpu.image.detection import DetRandomCropAug
+    import random as pyrandom
+    pyrandom.seed(3)
+    img = np.random.RandomState(0).randint(
+        0, 255, (40, 40, 3)).astype(np.uint8)
+    label = np.array([[0, 0.3, 0.3, 0.7, 0.7]], np.float32)
+    aug = DetRandomCropAug(min_object_covered=0.5, max_attempts=50)
+    out_img, out_label = aug(img, label)
+    valid = out_label[out_label[:, 0] >= 0]
+    assert len(valid) >= 1
+    assert (valid[:, 1:] >= -1e-6).all() and (valid[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_det_iter_feeds_multibox_target(tmp_path):
+    """End-to-end: ImageDetIter batch drives MultiBoxTarget."""
+    prefix = _write_det_rec(tmp_path, n=4)
+    it = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                               path_imgrec=prefix + '.rec')
+    batch = it.next()
+    anchors = mx.contrib.nd.MultiBoxPrior(batch.data[0], sizes=(0.5,),
+                                          ratios=(1, 2))
+    A = anchors.shape[1]
+    cls_pred = mx.nd.zeros((2, 5, A))
+    loc_t, loc_m, cls_t = mx.contrib.nd.MultiBoxTarget(
+        anchors, batch.label[0], cls_pred)
+    assert cls_t.shape == (2, A)
+    assert (cls_t.asnumpy() >= 0).all()  # matched or background
