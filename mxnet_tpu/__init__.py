@@ -43,6 +43,13 @@ from .io import DataBatch, DataIter, NDArrayIter, DataDesc
 from . import engine
 from . import rnn
 from . import contrib
+from . import profiler
+from . import monitor
+from . import monitor as mon
+from . import visualization
+from . import visualization as viz
+from . import operator
+from . import rtc
 from . import recordio
 from . import image
 from . import gluon

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from . import ndarray as nd
 from . import random as _random
+from . import profiler
 from .base import MXNetError
 from .ops.registry import OpContext
 
@@ -55,8 +56,9 @@ class Executor:
         aux_pos = {n: i for i, n in enumerate(self._aux_names)}
         out_entries = [(node_index[id(n)], i) for n, i in sym._outputs]
 
-        def run_graph(arg_vals, aux_vals, rng, is_train):
-            """Evaluate the DAG; returns (outputs, new_aux_tuple)."""
+        def run_graph(arg_vals, aux_vals, rng, is_train, collect_all=False):
+            """Evaluate the DAG; returns (outputs, new_aux_tuple), plus
+            every node's outputs when collect_all (monitor mode)."""
             results = [None] * len(topo)   # per node: list of outputs
             new_aux = list(aux_vals)
             for ni, node in enumerate(topo):
@@ -84,6 +86,13 @@ class Executor:
                         if src.op is None and src.name in aux_pos:
                             new_aux[aux_pos[src.name]] = newv
             outputs = tuple(results[ni][oi] for ni, oi in out_entries)
+            if collect_all:
+                mon = []
+                for node, outs_ in zip(topo, results):
+                    if node.op is None:
+                        continue
+                    mon.extend(outs_)
+                return outputs, tuple(new_aux), tuple(mon)
             return outputs, tuple(new_aux)
 
         self._n_outputs = len(out_entries)
@@ -95,6 +104,26 @@ class Executor:
         @jax.jit
         def fwd_eval(arg_vals, aux_vals, rng):
             return run_graph(arg_vals, aux_vals, rng, False)
+
+        # monitor mode: also emit every node's outputs (the reference's
+        # executor monitor callback, graph_executor.cc:1214 — there it
+        # disables bulk segments; here it is a separate jit)
+        monitor_names = []
+        for node in topo:
+            if node.op is None:
+                continue
+            n_out = node.op.num_outputs(node.attrs)
+            if n_out == 1:
+                monitor_names.append(node.name + '_output')
+            else:
+                monitor_names.extend('%s_output%d' % (node.name, i)
+                                     for i in range(n_out))
+        self._monitor_names = monitor_names
+
+        def fwd_monitor(arg_vals, aux_vals, rng, is_train):
+            return run_graph(arg_vals, aux_vals, rng, is_train,
+                             collect_all=True)
+        self._fwd_monitor = jax.jit(fwd_monitor, static_argnums=(3,))
 
         diff_idx = [arg_pos[n] for n in self._diff_names]
 
@@ -153,25 +182,55 @@ class Executor:
             self._set_args(kwargs)
         arg_vals, aux_vals = self._gather()
         self._key, sub = jax.random.split(self._key)
-        if is_train:
+        monitor_active = self._monitor_callback is not None and \
+            getattr(self._monitor_callback, 'active', True)
+        if monitor_active:
+            # collect-all jit: every node output is materialized — only
+            # when the monitor is actually collecting this batch
+            with profiler.scope(self._name('forward_monitor')):
+                outs, new_aux, mon = self._fwd_monitor(
+                    arg_vals, aux_vals, sub, bool(is_train))
+                self._maybe_block(outs)
+            if is_train:
+                self._stash = (arg_vals, aux_vals, sub)
+            for name, v in zip(self._monitor_names, mon):
+                self._monitor_callback(name, nd.NDArray(v, self._ctx))
+        elif is_train:
             self._stash = (arg_vals, aux_vals, sub)
-            outs, new_aux = self._fwd_train(arg_vals, aux_vals, sub)
+            with profiler.scope(self._name('forward_train')):
+                outs, new_aux = self._fwd_train(arg_vals, aux_vals, sub)
+                self._maybe_block(outs)
+        else:
+            with profiler.scope(self._name('forward')):
+                outs, new_aux = self._fwd_eval(arg_vals, aux_vals, sub)
+                self._maybe_block(outs)
+            new_aux = None
+        if is_train and new_aux is not None:
             for n, v in zip(self._aux_names, new_aux):
                 self.aux_dict[n]._data = v
-        else:
-            outs, _ = self._fwd_eval(arg_vals, aux_vals, sub)
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
-        if self._monitor_callback is not None:
-            for name, out in zip(self._symbol.list_outputs(), self.outputs):
-                self._monitor_callback(name, out)
         return self.outputs
+
+    def _name(self, suffix):
+        return '%s_%s' % (self._symbol.name or 'executor', suffix)
+
+    @staticmethod
+    def _maybe_block(outs):
+        """When profiling, wait for device completion INSIDE the scope —
+        jit dispatch is async, so without this the recorded span would
+        measure only enqueue time, not execution."""
+        if profiler.is_running():
+            jax.block_until_ready(outs)
 
     def backward(self, out_grads=None):
         if self._stash is None:
             raise MXNetError('backward called before forward(is_train=True)')
         arg_vals, aux_vals, sub = self._stash
         heads = self._default_head_grads(out_grads)
-        outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, sub, heads)
+        with profiler.scope(self._name('backward')):
+            outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, sub,
+                                                 heads)
+            self._maybe_block(grads)
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         for n, v in zip(self._aux_names, new_aux):
             self.aux_dict[n]._data = v
@@ -187,7 +246,10 @@ class Executor:
         self._key, sub = jax.random.split(self._key)
         self._stash = (arg_vals, aux_vals, sub)
         heads = self._default_head_grads(out_grads)
-        outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, sub, heads)
+        with profiler.scope(self._name('forward_backward')):
+            outs, new_aux, grads = self._fwd_bwd(arg_vals, aux_vals, sub,
+                                                 heads)
+            self._maybe_block(grads)
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         for n, v in zip(self._aux_names, new_aux):
             self.aux_dict[n]._data = v

@@ -62,6 +62,11 @@ class KVStore:
         """Push gradients.  Multi-device values are summed (the in-XLA
         all-reduce has usually already produced identical replicas, in
         which case the single representative is used)."""
+        from . import profiler
+        with profiler.scope('kvstore_push', 'kvstore'):
+            self._push_impl(key, value, priority)
+
+    def _push_impl(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
@@ -77,6 +82,11 @@ class KVStore:
                 self._pending[k] = merged
 
     def pull(self, key, out=None, priority=0):
+        from . import profiler
+        with profiler.scope('kvstore_pull', 'kvstore'):
+            self._pull_impl(key, out, priority)
+
+    def _pull_impl(self, key, out=None, priority=0):
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:

@@ -1,0 +1,95 @@
+"""Per-layer numeric tracing (`mx.mon.Monitor`).
+
+Rebuild of the reference's python/mxnet/monitor.py (SURVEY.md §5.1):
+installs a callback on executors that receives EVERY node output each
+monitored forward (executor.py's monitor jit — the analog of the
+reference's ExecuteMonCallback, graph_executor.cc:1214, which likewise
+pays a perf cost by disabling op fusion/bulking).
+"""
+import re
+import logging
+
+from . import ndarray as nd
+
+
+class Monitor(object):
+    """Collects per-layer output statistics every `interval` batches
+    (reference monitor.py Monitor)."""
+
+    def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
+        if stat_func is None:
+            def stat_func(x):
+                """mean absolute value (reference default |x|/size)"""
+                return nd.norm(x) / (x.size ** 0.5)
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_pattern.match(name):
+                return
+            self.queue.append((self.step, name,
+                               self.stat_func(array)))
+        # the executor consults .active to decide whether to run the
+        # (expensive) collect-all-outputs jit for this batch
+        stat_helper.active = False
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Attach to an executor (reference Monitor.install)."""
+        exe.set_monitor_callback(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for this batch if it's due."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+            self.stat_helper.active = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collection; also record current args/auxs; returns
+        [(step, name, stat_string)]."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+            for name, array in exe.aux_dict.items():
+                if self.re_pattern.match(name):
+                    self.queue.append((self.step, name,
+                                       self.stat_func(array)))
+        self.activated = False
+        self.stat_helper.active = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, nd.NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ''
+            for v in v_list:
+                assert isinstance(v, nd.NDArray)
+                if v.shape == (1,) or v.shape == ():
+                    s += str(v.asnumpy().reshape(-1)[0]) + '\t'
+                else:
+                    s += str(v.asnumpy()) + '\t'
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log the stats (reference Monitor.toc_print)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info('Batch: %7d %30s %s', n, k, v)
+        return res

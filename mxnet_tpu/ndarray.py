@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from . import random as _random
+from . import profiler as _profiler
 from . import autograd as _autograd
 from .base import MXNetError, parse_attr_value
 from .context import Context, current_context, cpu
@@ -401,7 +402,13 @@ def invoke(op_name, inputs, attrs, out=None):
     auxs = inputs[len(inputs) - n_aux:] if n_aux else []
     in_data = [x._data for x in args]
     aux_data = [x._data for x in auxs]
-    outs, new_auxs = op.apply(attrs, in_data, aux_data, op_ctx)
+    if _profiler.is_running() and _profiler.mode() == 'all':
+        # imperative-op spans under mode='all' (reference kAllOperator)
+        with _profiler.scope(op_name, 'imperative'):
+            outs, new_auxs = op.apply(attrs, in_data, aux_data, op_ctx)
+            jax.block_until_ready(outs)
+    else:
+        outs, new_auxs = op.apply(attrs, in_data, aux_data, op_ctx)
     ctx = args[0]._ctx if args else _attr_ctx(attrs)
     results = [NDArray(o, ctx) for o in outs]
     if op.mutable_aux and is_train:
@@ -696,3 +703,14 @@ def _init_module():
 
 
 _init_module()
+
+
+def __getattr__(name):
+    """Late-registered ops (e.g. `Custom`, registered when
+    mxnet_tpu.operator is imported) resolve on first access."""
+    if _reg.exists(name):
+        fn = _make_op_func(name)
+        setattr(sys.modules[__name__], name, fn)
+        return fn
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

@@ -1,0 +1,226 @@
+"""Python custom operators (`mx.operator.CustomOp` / `CustomOpProp`).
+
+Rebuild of the reference's python/mxnet/operator.py custom-op bridge
+(:413 register; C side src/operator/custom/custom.cc — SURVEY.md §2.3):
+users implement forward/backward in numpy-land Python; the framework
+runs them inside compiled graphs.  Where the reference routes callbacks
+through a dedicated engine thread (ExecType::kAsync), here the custom op
+becomes a `jax.pure_callback` host call inside the XLA module — XLA
+stalls just that program point, and a `jax.custom_vjp` routes gradients
+through the user's backward().  Differences from the reference, by
+design: the operator instance is created per call (so it should be
+stateless), and auxiliary states are not yet supported.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import parse_attr_value
+from .ops.registry import register as _register_op, asbool
+
+
+class CustomOp(object):
+    """Base class for user ops (reference operator.py CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs: write results via self.assign(out_data[i],
+        req[i], value)."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into in_grad."""
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor the write/add/null request (reference CustomOp.assign)."""
+        if req in ('null', 0):
+            return
+        if req in ('add', 'add_to', 3):
+            dst[:] = dst + np.asarray(src, dst.dtype).reshape(dst.shape)
+        else:
+            dst[:] = np.asarray(src, dst.dtype).reshape(dst.shape)
+
+
+class CustomOpProp(object):
+    """Operator properties: arity, shapes, types, op factory
+    (reference operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ['data']
+
+    def list_outputs(self):
+        return ['output']
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: all same as first input."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_PROP_REGISTRY = {}
+
+
+def register(reg_name):
+    """Register a CustomOpProp subclass under `op_type`
+    (reference operator.py register :413)."""
+    def do_register(prop_cls):
+        _PROP_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_prop_cls(op_type):
+    if op_type not in _PROP_REGISTRY:
+        raise KeyError('Custom op type %s is not registered '
+                       '(mx.operator.register)' % op_type)
+    return _PROP_REGISTRY[op_type]
+
+
+def _make_prop(attrs):
+    op_type = str(parse_attr_value(attrs['op_type']))
+    kwargs = {k: str(parse_attr_value(v)) for k, v in attrs.items()
+              if k not in ('op_type',)}
+    return get_prop_cls(op_type)(**kwargs)
+
+
+def _custom_input_names(attrs):
+    return list(_make_prop(attrs).list_arguments())
+
+
+def _custom_num_outputs(attrs):
+    return len(_make_prop(attrs).list_outputs())
+
+
+def _custom_infer_shape(attrs, in_shapes):
+    if any(s is None for s in in_shapes):
+        return in_shapes
+    prop = _make_prop(attrs)
+    new_in, _, _ = prop.infer_shape([list(s) for s in in_shapes])
+    return [tuple(s) for s in new_in]
+
+
+def _attrs_key(attrs):
+    return tuple(sorted((k, str(parse_attr_value(v)))
+                        for k, v in attrs.items()))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _custom_fn(params, *inputs):
+    return _custom_fwd_impl(params, inputs)
+
+
+def _shapes_dtypes(params, inputs):
+    attrs = dict(params[0])
+    prop = _make_prop(attrs)
+    in_shapes = [list(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    return prop, [tuple(s) for s in out_shapes], out_types
+
+
+def _custom_fwd_impl(params, inputs):
+    attrs_t, is_train = params
+    prop, out_shapes, out_types = _shapes_dtypes(params, inputs)
+    n_out = len(out_shapes)
+
+    def cb(*arrays):
+        op = prop.create_operator(None, [a.shape for a in arrays],
+                                  [a.dtype for a in arrays])
+        in_data = [np.asarray(a) for a in arrays]
+        out_data = [np.zeros(s, t) for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train, ['write'] * n_out, in_data, out_data, [])
+        return tuple(out_data)
+
+    if not any(isinstance(x, jax.core.Tracer) for x in inputs):
+        # eager (imperative) path: explicit host round-trip — works on
+        # every backend, including PJRT plugins without host-callback
+        # support (the reference likewise runs custom ops on CPU with
+        # device memcpys, src/operator/custom/custom.cc)
+        outs = cb(*[np.asarray(x) for x in inputs])
+        dev = next(iter(inputs[0].devices())) if hasattr(
+            inputs[0], 'devices') else None
+        return tuple(jax.device_put(jnp.asarray(o), dev) for o in outs)
+
+    result_shapes = tuple(jax.ShapeDtypeStruct(s, t)
+                          for s, t in zip(out_shapes, out_types))
+    return jax.pure_callback(cb, result_shapes, *inputs,
+                             vmap_method='sequential')
+
+
+def _custom_fwd_rule(params, *inputs):
+    out = _custom_fwd_impl(params, inputs)
+    return out, (inputs, out)
+
+
+def _custom_bwd_rule(params, res, gs):
+    inputs, outputs = res
+    prop, out_shapes, out_types = _shapes_dtypes(params, inputs)
+    is_train = params[1]
+    in_shapes = [x.shape for x in inputs]
+    in_types = [x.dtype for x in inputs]
+
+    def cb(*arrays):
+        n_in = len(in_shapes)
+        n_out = len(out_shapes)
+        ins = [np.asarray(a) for a in arrays[:n_in]]
+        outs = [np.asarray(a) for a in arrays[n_in:n_in + n_out]]
+        grads = [np.asarray(a) for a in arrays[n_in + n_out:]]
+        op = prop.create_operator(None, in_shapes, in_types)
+        in_grad = [np.zeros(s, t) for s, t in zip(in_shapes, in_types)]
+        op.backward(['write'] * n_in, grads, ins, outs, in_grad, [])
+        return tuple(in_grad)
+
+    gs = gs if isinstance(gs, (tuple, list)) else (gs,)
+    all_args = tuple(inputs) + tuple(outputs) + tuple(gs)
+    if not any(isinstance(x, jax.core.Tracer) for x in all_args):
+        dev = next(iter(inputs[0].devices())) if hasattr(
+            inputs[0], 'devices') else None
+        outs = cb(*[np.asarray(x) for x in all_args])
+        return tuple(jax.device_put(jnp.asarray(o), dev) for o in outs)
+    result_shapes = tuple(jax.ShapeDtypeStruct(tuple(s), t)
+                          for s, t in zip(in_shapes, in_types))
+    in_grads = jax.pure_callback(cb, result_shapes, *all_args,
+                                 vmap_method='sequential')
+    return tuple(in_grads)
+
+
+_custom_fn.defvjp(_custom_fwd_rule, _custom_bwd_rule)
+
+
+def _custom_compute(attrs, inputs, auxs, op_ctx):
+    params = (_attrs_key(attrs), bool(op_ctx.is_train))
+    out = _custom_fn(params, *inputs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return list(out), []
+
+
+_register_op('Custom', input_names=_custom_input_names,
+             num_outputs=_custom_num_outputs,
+             infer_shape=_custom_infer_shape, mode_dependent=True,
+             hint='custom', simple=False)(_custom_compute)

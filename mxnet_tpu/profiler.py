@@ -1,0 +1,118 @@
+"""Profiler: per-operation execution records -> Chrome trace JSON.
+
+TPU-native rebuild of the reference profiler
+(/root/reference src/engine/profiler.{h,cc}: OprExecStat records with
+start/end microseconds dumped as chrome://tracing "traceEvents";
+python/mxnet/profiler.py:27-55 API — SURVEY.md §5.1).  The reference
+tags each engine OprBlock; here device work happens inside whole XLA
+executions, so the recorded spans are the framework's dispatch units:
+executor forward/backward/fused-step (device-synchronized inside the
+span so durations reflect execution, not async enqueue), kvstore
+push/pull, per-op imperative spans under mode='all', and any user
+`profiler.scope`.  For intra-XLA
+kernel timing, `profiler_set_config(profile_xla=True)` additionally
+starts a JAX device trace (PJRT/XPlane) alongside.
+
+Env autostart mirrors the reference: MXNET_PROFILER_AUTOSTART=1.
+"""
+import json
+import os
+import threading
+import time
+
+_STATE = {
+    'mode': 'symbolic',        # 'symbolic' | 'all'
+    'filename': 'profile.json',
+    'running': False,
+    'records': [],             # (name, category, ts_us, dur_us, tid)
+    'lock': threading.Lock(),
+    'jax_trace': False,
+    'jax_trace_dir': None,
+}
+
+
+def profiler_set_config(mode='symbolic', filename='profile.json',
+                        profile_xla=False, xla_trace_dir=None):
+    """Configure the profiler (reference profiler_set_config,
+    c_api.cc MXSetProfilerConfig:98).  mode: 'symbolic' records
+    executor/engine-level spans; 'all' also records imperative ops."""
+    assert mode in ('symbolic', 'all', 'all_ops')
+    _STATE['mode'] = 'all' if mode in ('all', 'all_ops') else 'symbolic'
+    _STATE['filename'] = filename
+    _STATE['jax_trace'] = bool(profile_xla)
+    _STATE['jax_trace_dir'] = xla_trace_dir or \
+        os.path.splitext(filename)[0] + '_xla'
+
+
+def profiler_set_state(state='stop'):
+    """'run' starts recording, 'stop' halts it (reference
+    MXSetProfilerState, c_api.cc:122)."""
+    assert state in ('run', 'stop')
+    running = state == 'run'
+    if running and not _STATE['running'] and _STATE['jax_trace']:
+        import jax
+        jax.profiler.start_trace(_STATE['jax_trace_dir'])
+    if not running and _STATE['running'] and _STATE['jax_trace']:
+        import jax
+        jax.profiler.stop_trace()
+    _STATE['running'] = running
+
+
+def dump_profile():
+    """Write accumulated records as a Chrome trace-event file
+    (reference Profiler::DumpProfile, profiler.cc:139-192)."""
+    events = []
+    with _STATE['lock']:
+        records = list(_STATE['records'])
+    for name, cat, ts, dur, tid in records:
+        events.append({'name': name, 'cat': cat, 'ph': 'X',
+                       'ts': ts, 'dur': dur, 'pid': 0, 'tid': tid})
+    with open(_STATE['filename'], 'w') as f:
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    return _STATE['filename']
+
+
+def is_running():
+    return _STATE['running']
+
+
+def mode():
+    return _STATE['mode']
+
+
+def record(name, category, ts_us, dur_us):
+    """Append one span (internal hook used by executor/kvstore/io)."""
+    if not _STATE['running']:
+        return
+    with _STATE['lock']:
+        _STATE['records'].append(
+            (name, category, ts_us, dur_us, threading.get_ident() % 1000))
+
+
+def clear():
+    with _STATE['lock']:
+        _STATE['records'].clear()
+
+
+class scope(object):
+    """Context manager recording one span:
+    `with profiler.scope('forward'): ...`"""
+
+    def __init__(self, name, category='operator'):
+        self.name = name
+        self.category = category
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _STATE['running']:
+            t1 = time.perf_counter()
+            record(self.name, self.category,
+                   int(self._t0 * 1e6), int((t1 - self._t0) * 1e6))
+        return False
+
+
+if os.environ.get('MXNET_PROFILER_AUTOSTART', '0') == '1':
+    profiler_set_state('run')

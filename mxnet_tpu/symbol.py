@@ -537,3 +537,13 @@ def _init_module():
 
 
 _init_module()
+
+
+def __getattr__(name):
+    """Late-registered ops (e.g. `Custom`) resolve on first access."""
+    if _reg.exists(name):
+        fn = _make_sym_func(name)
+        setattr(sys.modules[__name__], name, fn)
+        return fn
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

@@ -1,0 +1,250 @@
+"""Profiler / Monitor / visualization / CustomOp / rtc tests
+(models: reference tests/python/unittest/{test_profiler,test_operator
+(CustomOp section),test_rtc}.py and monitor usage in docs)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, name='fc1', num_hidden=8)
+    act = sym.Activation(fc1, name='relu1', act_type='relu')
+    fc2 = sym.FullyConnected(act, name='fc2', num_hidden=2)
+    return sym.SoftmaxOutput(fc2, name='softmax')
+
+
+def test_profiler_chrome_trace(tmp_path):
+    fname = str(tmp_path / 'profile.json')
+    mx.profiler.profiler_set_config(mode='symbolic', filename=fname)
+    mx.profiler.profiler_set_state('run')
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(4, 10))
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward_backward()
+    mx.profiler.profiler_set_state('stop')
+    out = mx.profiler.dump_profile()
+    assert out == fname
+    with open(fname) as f:
+        trace = json.load(f)
+    names = [e['name'] for e in trace['traceEvents']]
+    assert any('forward' in n for n in names)
+    assert any('backward' in n for n in names)
+    for e in trace['traceEvents']:
+        assert e['ph'] == 'X' and e['dur'] >= 0
+    mx.profiler.clear()
+
+
+def test_monitor_collects_layer_stats():
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(4, 10))
+    for v in ex.arg_dict.values():
+        v[:] = np.random.RandomState(0).rand(*v.shape).astype(np.float32)
+    mon = mx.mon.Monitor(interval=1, pattern='.*')
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=False)
+    res = mon.toc()
+    names = [k for _, k, _ in res]
+    # intermediate layers observed, not just graph outputs
+    assert any(k.startswith('fc1') for k in names), names
+    assert any(k.startswith('relu1') for k in names), names
+    assert any(k.startswith('softmax') for k in names), names
+    # params included at toc
+    assert 'fc1_weight' in names
+
+
+def test_monitor_interval():
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(2, 10))
+    mon = mx.mon.Monitor(interval=2, pattern='fc1.*')
+    mon.install(ex)
+    collected = []
+    for i in range(4):
+        mon.tic()
+        ex.forward(is_train=False)
+        collected.append(len(mon.toc()))
+    # fires on steps 0 and 2 only
+    assert (np.array(collected) > 0).sum() == 2
+
+
+def test_print_summary(capsys):
+    net = _mlp()
+    total = mx.viz.print_summary(net, shape={'data': (4, 10)})
+    out = capsys.readouterr().out
+    assert 'fc1' in out and 'softmax' in out
+    # 10*8+8 + 8*2+2 params
+    assert total == 10 * 8 + 8 + 8 * 2 + 2
+
+
+# ---------------------------------------------------------------------------
+# CustomOp
+# ---------------------------------------------------------------------------
+
+class _SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register('test_sigmoid')
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(_SigmoidProp, self).__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _SigmoidOp()
+
+
+def test_custom_op_imperative():
+    x = nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    y = nd.Custom(x, op_type='test_sigmoid')
+    ref = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-6)
+
+
+def test_custom_op_autograd():
+    from mxnet_tpu import autograd
+    x = nd.array(np.array([0.5, -0.5], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type='test_sigmoid')
+        s = nd.sum(y)
+    s.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), sig * (1 - sig),
+                               rtol=1e-5)
+
+
+def test_custom_op_symbolic_training():
+    """Custom op inside a compiled symbol graph, gradient checked against
+    the built-in sigmoid."""
+    data = sym.Variable('data')
+    net_c = sym.Custom(data, op_type='test_sigmoid', name='csig')
+    net_c = sym.make_loss(nd_sum_sym(net_c))
+    net_b = sym.Activation(sym.Variable('data'), act_type='sigmoid')
+    net_b = sym.make_loss(nd_sum_sym(net_b))
+
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    ex_c = net_c.simple_bind(mx.cpu(), data=(3, 4))
+    ex_b = net_b.simple_bind(mx.cpu(), data=(3, 4))
+    for ex in (ex_c, ex_b):
+        ex.arg_dict['data'][:] = x
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ex_c.grad_dict['data'].asnumpy(),
+                               ex_b.grad_dict['data'].asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def nd_sum_sym(s):
+    return sym.sum(s)
+
+
+class _ConcatProp(mx.operator.CustomOpProp):
+    """Two-input one-output custom op to exercise arity plumbing."""
+
+    def list_arguments(self):
+        return ['a', 'b']
+
+    def infer_shape(self, in_shape):
+        out = list(in_shape[0])
+        out[-1] = in_shape[0][-1] + in_shape[1][-1]
+        return in_shape, [out], []
+
+
+@mx.operator.register('test_concat')
+class _ConcatPropReg(_ConcatProp):
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        class _Op(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0],
+                            np.concatenate([in_data[0], in_data[1]], -1))
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                k = in_data[0].shape[-1]
+                self.assign(in_grad[0], req[0], out_grad[0][..., :k])
+                self.assign(in_grad[1], req[1], out_grad[0][..., k:])
+        return _Op()
+
+
+def test_custom_op_multi_input():
+    a = nd.array(np.ones((2, 3), np.float32))
+    b = nd.array(np.full((2, 5), 2.0, np.float32))
+    out = nd.Custom(a, b, op_type='test_concat')
+    assert out.shape == (2, 8)
+    ref = np.concatenate([a.asnumpy(), b.asnumpy()], -1)
+    np.testing.assert_allclose(out.asnumpy(), ref)
+
+
+# ---------------------------------------------------------------------------
+# rtc (Pallas runtime kernels)
+# ---------------------------------------------------------------------------
+
+def test_rtc_kernel():
+    def body(x_ref, y_ref, out_ref):
+        out_ref[...] = x_ref[...] * y_ref[...] + 1.0
+
+    k = mx.rtc.Rtc('saxpy1', ['x', 'y'], ['out'], body)
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(8, 128).astype(np.float32))
+    y = nd.array(rs.rand(8, 128).astype(np.float32))
+    out = k.push([x, y], out_shapes=[(8, 128)])
+    np.testing.assert_allclose(out.asnumpy(),
+                               x.asnumpy() * y.asnumpy() + 1.0,
+                               rtol=1e-6)
+    # into existing output buffer (reference push(ins, outs, ...) form)
+    dst = nd.zeros((8, 128))
+    k.push([x, y], outs=[dst])
+    np.testing.assert_allclose(dst.asnumpy(),
+                               x.asnumpy() * y.asnumpy() + 1.0, rtol=1e-6)
+
+
+def test_profiler_mode_all_records_imperative_ops(tmp_path):
+    fname = str(tmp_path / 'prof_all.json')
+    mx.profiler.clear()
+    mx.profiler.profiler_set_config(mode='all', filename=fname)
+    mx.profiler.profiler_set_state('run')
+    a = nd.array(np.ones((4, 4), np.float32))
+    _ = nd.dot(a, a).asnumpy()
+    mx.profiler.profiler_set_state('stop')
+    mx.profiler.dump_profile()
+    trace = json.load(open(fname))
+    assert any(e['name'] == 'dot' for e in trace['traceEvents'])
+    mx.profiler.clear()
+
+
+def test_monitor_inactive_steps_use_fast_path():
+    net = _mlp()
+    ex = net.simple_bind(mx.cpu(), data=(2, 10))
+    mon = mx.mon.Monitor(interval=3, pattern='.*')
+    mon.install(ex)
+    calls = []
+    orig = ex._fwd_monitor
+    ex._fwd_monitor = lambda *a, **k: (calls.append(1), orig(*a, **k))[1]
+    for _ in range(6):
+        mon.tic()
+        ex.forward(is_train=False)
+        mon.toc()
+    # collect-all jit ran only on the 2 active batches (steps 0 and 3)
+    assert len(calls) == 2
+
+
+def test_rtc_grid_as_list():
+    def body(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+    k = mx.rtc.Rtc('dbl', ['x'], ['o'], body)
+    x = nd.array(np.ones((8, 128), np.float32))
+    out = k.push([x], out_shapes=[(8, 128)])
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
