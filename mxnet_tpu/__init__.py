@@ -50,6 +50,12 @@ from . import visualization
 from . import visualization as viz
 from . import operator
 from . import rtc
+from . import registry
+from . import log
+from . import kvstore_server
+from . import executor_manager
+from . import torch_bridge
+from . import torch_bridge as th
 from . import recordio
 from . import image
 from . import gluon

@@ -46,9 +46,11 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._is_dist = 'dist' in kv_type
-        if 'async' in kv_type:
-            warnings.warn('dist_async has no TPU/ICI analog; running with '
-                          'synchronous all-reduce semantics (SURVEY.md §5.8)')
+        if 'async' in kv_type and type(self) is KVStore:
+            warnings.warn('dist_async without parameter servers has no '
+                          'TPU/ICI analog; running with synchronous '
+                          'all-reduce semantics (SURVEY.md §5.8). Use '
+                          'tools/launch.py -s N for true async.')
 
     # -- core API ----------------------------------------------------------
     def init(self, key, value):
@@ -187,10 +189,118 @@ class KVStore:
         pass  # kept for launcher compatibility (reference RunServer)
 
 
+class KVStoreDistPS(KVStore):
+    """`dist_*` store over host-side parameter-server processes
+    (reference KVStoreDist, kvstore_dist.h:50) — used when the
+    DMLC_PS_ROOT_URI env contract from tools/launch.py is present.
+    Gradients are pushed to TCP servers that run the optimizer
+    server-side with the reference's sync accumulation semantics
+    (kvstore_server.py); without servers, `dist_*` falls back to the
+    in-XLA collective design (KVStore)."""
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        import os
+        from . import kvstore_server as ps
+        host = os.environ['DMLC_PS_ROOT_URI']
+        port = int(os.environ['DMLC_PS_ROOT_PORT'])
+        self._num_servers = int(os.environ.get('DMLC_NUM_SERVER', '1'))
+        self._num_workers_env = int(os.environ.get('DMLC_NUM_WORKER', '1'))
+        self._rank = int(os.environ.get('DMLC_WORKER_ID', '0'))
+        self._client = ps.DistServerClient(host, port, self._num_servers)
+        self._update_on_kvstore = True
+        if 'async' in kv_type and self._rank == 0:
+            # reference: rank 0 sends the sync/async mode command to the
+            # servers (kvstore.cc:48-52 kSyncMode)
+            self._client.set_sync_mode(False)
+        self.barrier()
+
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            # only rank 0 initializes (reference kvstore_dist.h:96)
+            if self.rank == 0:
+                self._client.init(k, vlist[0].asnumpy())
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            merged = vlist[0]
+            for v in vlist[1:]:
+                merged = merged + v
+            self._client.push(k, merged.asnumpy())
+
+    def pull(self, key, out=None, priority=0):
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            val = self._client.pull(k)
+            for o in olist:
+                o[:] = nd.array(val, dtype=o.dtype)
+
+    def set_optimizer(self, optimizer):
+        """Pickle the optimizer to the server processes — rank 0 only,
+        like the reference (kvstore.py:239 sends from one worker; every
+        re-send would rebuild the server updater and drop its state)."""
+        if self.rank == 0:
+            sym_ref = getattr(optimizer, 'sym', None)
+            optimizer.sym = None
+            try:
+                blob = pickle.dumps(optimizer)
+            finally:
+                optimizer.sym = sym_ref
+            self._client.set_optimizer(blob)
+        self.barrier()
+        self._update_on_kvstore = True
+
+    def set_updater(self, updater):
+        # the updater runs server-side in PS mode; a worker-side updater
+        # would silently never run, and setting _updater would un-gate
+        # the base class's local optimizer-state checkpointing
+        raise MXNetError(
+            'dist kvstore runs the updater on the servers; use '
+            'set_optimizer instead (reference update_on_kvstore path)')
+
+    _set_updater = set_updater
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers_env
+
+    def barrier(self):
+        self._client.barrier()
+
+    def send_command_to_servers(self, head, body):
+        if head == 'stop':
+            self._client.stop_servers()
+
+    _send_command_to_servers = send_command_to_servers
+
+    def stop_servers(self):
+        """Rank-0 teardown (reference ~KVStoreDist sends kStopServer)."""
+        if self.rank == 0:
+            self._client.stop_servers()
+
+    def close(self):
+        self._client.close()
+
+
 def create(name='local'):
     """Create a KVStore (reference kvstore.py:411 / kvstore.cc:40).
     Types: local, device, local_allreduce_*, dist_sync, dist_device_sync,
-    dist_async."""
+    dist_async.  `dist_*` with the DMLC_PS_ROOT_URI env set (the
+    tools/launch.py contract) uses parameter-server processes; otherwise
+    dist maps onto jax.distributed in-XLA collectives."""
+    import os
     if not isinstance(name, str):
         raise TypeError('name must be a string')
+    if 'dist' in name and os.environ.get('DMLC_PS_ROOT_URI') and \
+            int(os.environ.get('DMLC_NUM_SERVER', '0')) > 0:
+        # launch.py -s 0 (SPMD mode) exports the URI for jax.distributed
+        # bootstrap reuse — only actual servers select the PS path
+        return KVStoreDistPS(name)
     return KVStore(name)

@@ -1,0 +1,323 @@
+"""Distributed KVStore: parameter-server processes + worker client.
+
+TPU-native rebuild of the reference's ps-lite distribution layer
+(src/kvstore/kvstore_dist.h, kvstore_dist_server.h; SURVEY.md §2.4,
+§3.4).  Two data paths exist for `dist_*` stores:
+
+  * In-XLA collectives (kvstore.py): when all hosts run one SPMD program
+    under jax.distributed, gradient aggregation is an all-reduce riding
+    ICI/DCN and this module is not involved.  That is the fast path.
+  * Host-side parameter server (this module): TCP servers hold weights
+    and run the optimizer server-side, workers push gradients and pull
+    weights — the reference's exact sync semantics (server accumulates a
+    key's gradients until every worker contributed, applies the updater
+    once, then answers pulls; kvstore_dist_server.h:154 DataHandle).
+    Useful when worker processes run independent (non-SPMD) programs or
+    optimizer state must live host-side, and for `dist_async`.
+
+Transport is length-prefixed pickles over sockets (ZeroMQ's role in
+ps-lite).  Key sharding across multiple servers follows the reference:
+server id = (key_hash * 9973) % num_servers (kvstore_dist.h:292).
+Ports are DMLC_PS_ROOT_PORT + server_id on DMLC_PS_ROOT_URI.
+
+Roles come from the reference's env contract (§3.4): DMLC_ROLE,
+DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER, DMLC_NUM_SERVER —
+set by tools/launch.py.  `python -m mxnet_tpu.kvstore_server` runs a
+server process until it receives STOP (reference kStopServer).
+"""
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack('<Q', len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError('socket closed')
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock):
+    (n,) = struct.unpack('<Q', _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _key_to_server(key, num_servers):
+    """Reference key sharding: (key * 9973) % n (kvstore_dist.h:292);
+    string keys hash first."""
+    k = key if isinstance(key, int) else \
+        int.from_bytes(str(key).encode(), 'little') % (1 << 31)
+    return (k * 9973) % num_servers
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class KVStoreServer(object):
+    """One parameter-server process (reference KVStoreDistServer)."""
+
+    def __init__(self, port, num_workers, sync_mode=True):
+        self.num_workers = num_workers
+        self.sync_mode = sync_mode
+        self.store = {}               # key -> np.ndarray (weights)
+        self.merge_buf = {}           # key -> (sum, count) during a round
+        self.version = {}             # key -> number of applied updates
+        self.updater = None
+        self.cv = threading.Condition()
+        self.stopped = False
+        self.barrier_count = 0
+        self.barrier_gen = 0
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(('', port))
+        self.listener.listen(num_workers + 8)
+        self.port = self.listener.getsockname()[1]
+        self._threads = []
+
+    # -- message handlers ---------------------------------------------------
+    def _handle_init(self, key, value):
+        with self.cv:
+            if key not in self.store:
+                self.store[key] = np.array(value, copy=True)
+        return ('ok',)
+
+    def _handle_push(self, key, value):
+        with self.cv:
+            if key not in self.store:
+                # late init push (reference inits on first push too)
+                self.store[key] = np.zeros_like(value)
+            if not self.sync_mode:
+                self._apply(key, np.asarray(value))
+                self.cv.notify_all()
+                return ('ok',)
+            s, c = self.merge_buf.get(key, (None, 0))
+            s = np.array(value, copy=True) if s is None else s + value
+            c += 1
+            if c >= self.num_workers:
+                self._apply(key, s)
+                self.merge_buf.pop(key, None)
+                self.cv.notify_all()
+            else:
+                self.merge_buf[key] = (s, c)
+                # sync push blocks the round for this key; worker's ack
+                # is immediate (its next pull will wait for completion)
+        return ('ok',)
+
+    def _apply(self, key, merged):
+        if self.updater is not None:
+            self.updater(key, merged)     # reads + writes self.store[key]
+        else:
+            self.store[key] = merged
+        self.version[key] = self.version.get(key, 0) + 1
+
+    def _handle_pull(self, key, min_version=0):
+        """Sync semantics, deadlock-free: the pull carries the calling
+        worker's own push count for this key and waits until that many
+        rounds have been APPLIED (every round completes from the other
+        workers' pushes, never from this worker's pull) — the versioned
+        equivalent of the reference answering queued pulls after the
+        update (kvstore_dist_server.h:182-218)."""
+        with self.cv:
+            while self.sync_mode and \
+                    self.version.get(key, 0) < min_version:
+                self.cv.wait()
+            if key not in self.store:
+                return ('err', 'key %r not initialized' % (key,))
+            return ('ok', self.store[key])
+
+    def _handle_barrier(self):
+        with self.cv:
+            gen = self.barrier_gen
+            self.barrier_count += 1
+            if self.barrier_count >= self.num_workers:
+                self.barrier_count = 0
+                self.barrier_gen += 1
+                self.cv.notify_all()
+            else:
+                while self.barrier_gen == gen:
+                    self.cv.wait()
+        return ('ok',)
+
+    def _handle_set_optimizer(self, blob):
+        from . import optimizer as opt
+        optimizer = pickle.loads(blob)
+        updater = opt.get_updater(optimizer)
+
+        def np_updater(key, grad):
+            from . import ndarray as nd
+            w = nd.array(self.store[key])
+            updater(key, nd.array(grad), w)
+            self.store[key] = w.asnumpy()
+        self.updater = np_updater
+        return ('ok',)
+
+    # -- loop ---------------------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == 'init':
+                    reply = self._handle_init(msg[1], msg[2])
+                elif op == 'push':
+                    reply = self._handle_push(msg[1], msg[2])
+                elif op == 'pull':
+                    reply = self._handle_pull(
+                        msg[1], msg[2] if len(msg) > 2 else 0)
+                elif op == 'barrier':
+                    reply = self._handle_barrier()
+                elif op == 'set_optimizer':
+                    reply = self._handle_set_optimizer(msg[1])
+                elif op == 'set_sync':
+                    with self.cv:
+                        self.sync_mode = bool(msg[1])
+                    reply = ('ok',)
+                elif op == 'get_states':
+                    reply = ('ok', pickle.dumps(self.store))
+                elif op == 'stop':
+                    with self.cv:
+                        self.stopped = True
+                        self.cv.notify_all()
+                    _send_msg(conn, ('ok',))
+                    break
+                else:
+                    reply = ('err', 'unknown op %r' % (op,))
+                _send_msg(conn, reply)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def run(self):
+        """Serve until STOP (reference KVStoreDistServer::Run :135)."""
+        self.listener.settimeout(0.2)
+        while True:
+            with self.cv:
+                if self.stopped:
+                    break
+            try:
+                conn, _ = self.listener.accept()
+            except socket.timeout:
+                continue
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.listener.close()
+
+
+# ---------------------------------------------------------------------------
+# worker-side client
+# ---------------------------------------------------------------------------
+
+class DistServerClient(object):
+    """Worker connections to all servers (reference ps::KVWorker)."""
+
+    def __init__(self, host, base_port, num_servers):
+        self.num_servers = num_servers
+        self.push_counts = {}         # key -> this worker's push count
+        self.socks = []
+        self.locks = []
+        for i in range(num_servers):
+            s = self._connect_retry(host, base_port + i)
+            # blocking mode: sync pulls/barriers legitimately wait for
+            # peers that may still be starting up (jax import is slow)
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.socks.append(s)
+            self.locks.append(threading.Lock())
+
+    @staticmethod
+    def _connect_retry(host, port, total_timeout=120.0):
+        """Workers may start before their servers finish booting."""
+        import time
+        deadline = time.time() + total_timeout
+        while True:
+            try:
+                return socket.create_connection((host, port), timeout=5)
+            except OSError:
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+
+    def _rpc(self, sid, *msg):
+        with self.locks[sid]:
+            _send_msg(self.socks[sid], msg)
+            reply = _recv_msg(self.socks[sid])
+        if reply[0] != 'ok':
+            from .base import MXNetError
+            raise MXNetError('kvstore server error: %s' % (reply[1],))
+        return reply[1] if len(reply) > 1 else None
+
+    def _sid(self, key):
+        return _key_to_server(key, self.num_servers)
+
+    def init(self, key, value):
+        self._rpc(self._sid(key), 'init', key, np.asarray(value))
+
+    def push(self, key, value):
+        self.push_counts[key] = self.push_counts.get(key, 0) + 1
+        self._rpc(self._sid(key), 'push', key, np.asarray(value))
+
+    def pull(self, key):
+        return self._rpc(self._sid(key), 'pull', key,
+                         self.push_counts.get(key, 0))
+
+    def barrier(self):
+        for sid in range(self.num_servers):
+            self._rpc(sid, 'barrier')
+
+    def set_optimizer(self, optimizer_blob):
+        for sid in range(self.num_servers):
+            self._rpc(sid, 'set_optimizer', optimizer_blob)
+
+    def set_sync_mode(self, sync):
+        for sid in range(self.num_servers):
+            self._rpc(sid, 'set_sync', sync)
+
+    def stop_servers(self):
+        for sid in range(self.num_servers):
+            self._rpc(sid, 'stop')
+
+    def close(self):
+        for s in self.socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def main():
+    """Server-process entry: `python -m mxnet_tpu.kvstore_server`
+    (the reference's `import mxnet` auto-runs kvstore_server when
+    DMLC_ROLE=server)."""
+    role = os.environ.get('DMLC_ROLE', 'server')
+    assert role in ('server', 'scheduler'), role
+    num_workers = int(os.environ['DMLC_NUM_WORKER'])
+    base_port = int(os.environ['DMLC_PS_ROOT_PORT'])
+    server_id = int(os.environ.get('DMLC_SERVER_ID', '0'))
+    sync = os.environ.get('MXNET_KVSTORE_SYNC', '1') == '1'
+    server = KVStoreServer(base_port + server_id, num_workers,
+                           sync_mode=sync)
+    server.run()
+
+
+if __name__ == '__main__':
+    main()

@@ -235,11 +235,18 @@ class NDArray:
         elif isinstance(value, (np.ndarray, list, tuple, float, int)):
             value = jnp.asarray(value, dtype=self.dtype)
         if isinstance(key, _py_slice) and key == _py_slice(None):
-            self._data = jnp.broadcast_to(value, self.shape).astype(self.dtype)
+            new = jnp.broadcast_to(value, self.shape).astype(self.dtype)
         else:
             if isinstance(key, NDArray):
                 key = key._data
-            self._data = self._data.at[key].set(value)
+            new = self._data.at[key].set(value)
+        # assignment must not silently migrate this array off its
+        # device(s) — restore the full sharding, not one device
+        # (reference CopyFromTo is the cross-device writer, ndarray.h:471)
+        if not isinstance(new, jax.core.Tracer) and \
+                new.devices() != self._data.devices():
+            new = jax.device_put(new, self._data.sharding)
+        self._data = new
 
     # -- arithmetic --------------------------------------------------------
     def _binary(self, other, elem_op, scalar_op, reverse=False):

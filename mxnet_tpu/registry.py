@@ -1,0 +1,11 @@
+"""Generic class registry (reference python/mxnet/registry.py):
+register/alias/create factories keyed by a nickname, used by optimizers,
+initializers, evaluation metrics and data iterators."""
+from .base import get_register_func, get_alias_func, get_create_func
+
+register = get_register_func
+alias = get_alias_func
+create = get_create_func
+
+__all__ = ['register', 'alias', 'create', 'get_register_func',
+           'get_alias_func', 'get_create_func']

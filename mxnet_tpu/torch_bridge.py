@@ -1,0 +1,77 @@
+"""PyTorch interop (`mx.th`).
+
+Modernized rebuild of the reference's Torch7 bridge
+(python/mxnet/torch.py, 181 LoC + src/operator/custom torch plugin;
+SURVEY.md §2.7): the reference wrapped TH/lua tensor functions as ops.
+Torch7 is dead; the living equivalent is PyTorch (CPU build available in
+this environment), so `mx.th.function(fn)` wraps any torch callable as
+an NDArray->NDArray host function, and `as_torch`/`from_torch` convert
+zero-copy where dtypes allow.  Like the reference's bridge, the wrapped
+function runs on the host — use it for data/metric plumbing, not the
+hot path.
+"""
+import numpy as np
+
+from . import ndarray as nd
+from .base import MXNetError
+
+_torch = None
+_torch_checked = False
+
+
+def _require():
+    """Import torch lazily — the bridge must cost nothing at
+    `import mxnet_tpu` time (torch is seconds + hundreds of MB)."""
+    global _torch, _torch_checked
+    if not _torch_checked:
+        _torch_checked = True
+        try:
+            import torch
+            _torch = torch
+        except ImportError:  # pragma: no cover
+            _torch = None
+    if _torch is None:
+        raise MXNetError('PyTorch is not available in this environment')
+    return _torch
+
+
+def as_torch(arr):
+    """NDArray -> torch.Tensor (host copy)."""
+    torch = _require()
+    return torch.from_numpy(np.asarray(arr.asnumpy()))
+
+
+def from_torch(tensor, ctx=None):
+    """torch.Tensor -> NDArray."""
+    _require()
+    return nd.array(tensor.detach().cpu().numpy(), ctx=ctx)
+
+
+def function(fn):
+    """Wrap a torch callable as an NDArray function
+    (the reference's mxnet.th.* codegen role)."""
+    _require()
+
+    def wrapped(*args, **kwargs):
+        torch = _require()
+        targs = [as_torch(a) if isinstance(a, nd.NDArray) else a
+                 for a in args]
+        tkw = {k: as_torch(v) if isinstance(v, nd.NDArray) else v
+               for k, v in kwargs.items()}
+        out = fn(*targs, **tkw)
+        if isinstance(out, (list, tuple)):
+            return [from_torch(o) if torch.is_tensor(o) else o
+                    for o in out]
+        return from_torch(out) if torch.is_tensor(out) else out
+    wrapped.__name__ = getattr(fn, '__name__', 'torch_fn')
+    return wrapped
+
+
+def __getattr__(name):
+    """mx.th.<name> resolves torch.<name> lazily (the reference
+    generated these wrappers from the TH registry)."""
+    torch = _require()
+    fn = getattr(torch, name, None)
+    if fn is None or not callable(fn):
+        raise AttributeError('torch has no callable %r' % name)
+    return function(fn)

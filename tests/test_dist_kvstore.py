@@ -1,0 +1,219 @@
+"""Distributed KVStore tests (model: reference
+tests/nightly/dist_sync_kvstore.py exact-arithmetic assertions, run as
+threads in-process and as real processes via tools/launch.py — the
+reference's launcher=local strategy, SURVEY.md §4)."""
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore_server as ps
+
+
+def _start_server(num_workers, sync=True):
+    srv = ps.KVStoreServer(0, num_workers, sync_mode=sync)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    return srv, t
+
+
+def test_dist_sync_arithmetic():
+    """value after R rounds of W workers pushing rank-dependent grads
+    matches the exact sum (reference dist_sync_kvstore.py:50-58)."""
+    import pickle
+    W, R = 3, 4
+    srv, t = _start_server(W)
+    clients = [ps.DistServerClient('127.0.0.1', srv.port, 1)
+               for _ in range(W)]
+    shape = (4, 5)
+    clients[0].init('w', np.zeros(shape, np.float32))
+    # reference nightly sets the accumulate-grad 'test' optimizer
+    # server-side; without an updater the server ASSIGNS the merged
+    # gradient (reference CopyFromTo(merged, &stored))
+    clients[0].set_optimizer(pickle.dumps(
+        mx.optimizer.create('test', rescale_grad=1.0)))
+
+    errs = []
+
+    def worker(rank):
+        try:
+            c = clients[rank]
+            for r in range(R):
+                c.push('w', np.full(shape, float(rank + 1), np.float32))
+                val = c.pull('w')
+                # after round r+1: sum of (1+2+...+W) per round
+                expect = (r + 1) * sum(range(1, W + 1))
+                np.testing.assert_allclose(val, expect)
+                c.barrier()
+        except Exception as e:  # surface thread failures
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(W)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errs, errs
+    clients[0].stop_servers()
+    t.join(timeout=10)
+
+
+def test_dist_sync_server_side_optimizer():
+    """Optimizer runs on the server (reference set_optimizer pickles it
+    to servers; weight = -lr * sum(grads) after one round)."""
+    import pickle
+    W = 2
+    srv, t = _start_server(W)
+    clients = [ps.DistServerClient('127.0.0.1', srv.port, 1)
+               for _ in range(W)]
+    clients[0].init(3, np.zeros((3,), np.float32))
+    opt = mx.optimizer.create('sgd', learning_rate=0.1, rescale_grad=1.0,
+                              wd=0.0)
+    clients[0].set_optimizer(pickle.dumps(opt))
+
+    def worker(rank):
+        clients[rank].push(3, np.ones((3,), np.float32))
+        v = clients[rank].pull(3)
+        np.testing.assert_allclose(v, -0.1 * W * np.ones(3), rtol=1e-6)
+
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(W)]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join(timeout=60)
+    clients[0].stop_servers()
+
+
+def test_dist_async_updates_immediately():
+    srv, t = _start_server(2, sync=False)
+    c = ps.DistServerClient('127.0.0.1', srv.port, 1)
+    c.init('k', np.zeros((2,), np.float32))
+    c.push('k', np.ones((2,), np.float32))
+    # async: no waiting for the second worker
+    np.testing.assert_allclose(c.pull('k'), 1.0)
+    c.stop_servers()
+
+
+def test_key_sharding_layout():
+    assert ps._key_to_server(0, 3) == 0
+    sids = {ps._key_to_server(k, 3) for k in range(20)}
+    assert sids == {0, 1, 2}
+    # string keys shard deterministically
+    assert ps._key_to_server('fc_weight', 4) == \
+        ps._key_to_server('fc_weight', 4)
+
+
+def test_kvstore_dist_ps_facade():
+    """mx.kv.create('dist_sync') with the DMLC env -> PS-backed store
+    with reference push/pull/rank semantics."""
+    srv, t = _start_server(1)
+    old = dict(os.environ)
+    os.environ.update({'DMLC_PS_ROOT_URI': '127.0.0.1',
+                       'DMLC_PS_ROOT_PORT': str(srv.port),
+                       'DMLC_NUM_WORKER': '1', 'DMLC_NUM_SERVER': '1',
+                       'DMLC_WORKER_ID': '0'})
+    try:
+        kv = mx.kvstore.create('dist_sync')
+        assert kv.rank == 0 and kv.num_workers == 1
+        kv.init('p', mx.nd.array(np.arange(4, dtype=np.float32)))
+        kv.push('p', mx.nd.array(np.ones(4, np.float32)))
+        out = mx.nd.zeros((4,))
+        kv.pull('p', out=out)
+        np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+        kv.stop_servers()
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+
+
+_WORKER_SCRIPT = r'''
+import os
+import numpy as np
+import mxnet_tpu as mx
+
+kv = mx.kvstore.create('dist_sync')
+rank, W = kv.rank, kv.num_workers
+kv.init('x', mx.nd.zeros((2, 2)))
+# every worker calls set_optimizer (Module.init_optimizer does); only
+# rank 0 actually sends it to the servers
+kv.set_optimizer(mx.optimizer.create('test', rescale_grad=1.0))
+for r in range(3):
+    kv.push('x', mx.nd.array(np.full((2, 2), float(rank + 1), np.float32)))
+    out = mx.nd.zeros((2, 2))
+    kv.pull('x', out=out)
+    expect = (r + 1) * sum(range(1, W + 1))
+    np.testing.assert_allclose(out.asnumpy(), expect)
+    kv.barrier()
+kv.barrier()
+if rank == 0:
+    kv.stop_servers()
+print('WORKER_OK rank=%d' % rank)
+'''
+
+
+def test_launch_local_multiprocess(tmp_path):
+    """Real multi-process dist_sync through tools/launch.py (the
+    reference's `launch.py -n 2 --launcher local` nightly pattern)."""
+    script = tmp_path / 'worker.py'
+    script.write_text(_WORKER_SCRIPT)
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), '..'))
+    env = dict(os.environ)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
+    env.pop('DMLC_PS_ROOT_URI', None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), '..',
+                                      'tools', 'launch.py'),
+         '-n', '2', '-s', '1', '--launcher', 'local',
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), '..'))
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert 'WORKER_OK rank=0' in res.stdout
+    assert 'WORKER_OK rank=1' in res.stdout
+
+
+def test_torch_bridge():
+    import torch
+    a = mx.nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t = mx.th.as_torch(a)
+    assert torch.is_tensor(t)
+    back = mx.th.from_torch(t * 2)
+    np.testing.assert_allclose(back.asnumpy(), a.asnumpy() * 2)
+    mm = mx.th.function(torch.mm)
+    out = mm(a, mx.nd.array(np.ones((3, 2), np.float32)))
+    np.testing.assert_allclose(out.asnumpy(),
+                               a.asnumpy() @ np.ones((3, 2), np.float32))
+    # lazy attribute wrapping
+    out2 = mx.th.relu(mx.nd.array(np.array([-1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(out2.asnumpy(), [0.0, 2.0])
+
+
+def test_executor_manager_facade():
+    from mxnet_tpu import sym
+    data = sym.Variable('data')
+    net = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=4,
+                                               name='fc'), name='softmax')
+    it = mx.io.NDArrayIter(np.random.rand(8, 6).astype(np.float32),
+                           np.zeros(8, np.float32), batch_size=8,
+                           label_name='softmax_label')
+    mgr = mx.executor_manager.DataParallelExecutorManager(
+        net, mx.cpu(), it)
+    assert 'fc_weight' in mgr.param_names
+    batch = next(iter(it))
+    mgr.load_data_batch(batch)
+    mgr.forward(is_train=True)
+    mgr.backward()
+    assert mgr.grad_arrays[0] is not None
+
+
+def test_split_input_slice():
+    from mxnet_tpu.executor_manager import _split_input_slice
+    s = _split_input_slice(10, [1, 1])
+    assert s == [slice(0, 5), slice(5, 10)]
+    s = _split_input_slice(9, [2, 1])
+    assert s[0] == slice(0, 6) and s[1] == slice(6, 9)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Gradient-aggregation bandwidth benchmark.
+
+Rebuild of the reference's tools/bandwidth/measure.py (the KVStore
+allreduce-bandwidth BASELINE metric: 11.1 GB/s/GPU at 2 GPUs —
+SURVEY.md §6).  Measures the two aggregation paths of this framework:
+
+  * mesh: in-XLA all-reduce (psum) over the device mesh — the path
+    training actually uses on TPU (ICI).
+  * ps:   host-side parameter-server push+pull round trip
+    (kvstore_server.py), for the DCN/host path.
+
+Example:
+  python tools/bandwidth.py --test mesh --size-mb 64 --iters 10
+"""
+import argparse
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.abspath(
+    os.path.join(os.path.dirname(__file__), '..')))
+
+
+def measure_mesh(size_mb, iters):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    elems = int(size_mb * 1e6 / 4)
+    mesh = Mesh(np.array(devs), ('d',))
+    x = jnp.ones((n, elems), jnp.float32)
+
+    @jax.jit
+    def allreduce(x):
+        def f(v):
+            return jax.lax.psum(v, 'd')
+        return shard_map(f, mesh=mesh, in_specs=P('d'),
+                         out_specs=P())(x)
+
+    allreduce(x).block_until_ready()      # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = allreduce(x)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    # bytes reduced per device per iteration (algorithm bandwidth)
+    gb = size_mb / 1e3
+    print('devices=%d payload=%.1fMB time=%.2fms algbw=%.2f GB/s/dev'
+          % (n, size_mb, dt * 1e3, gb / dt))
+    return gb / dt
+
+
+def measure_ps(size_mb, iters, num_workers):
+    from mxnet_tpu import kvstore_server as ps
+    srv = ps.KVStoreServer(0, num_workers, sync_mode=True)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    elems = int(size_mb * 1e6 / 4)
+    grad = np.ones((elems,), np.float32)
+    clients = [ps.DistServerClient('127.0.0.1', srv.port, 1)
+               for _ in range(num_workers)]
+    clients[0].init('g', np.zeros_like(grad))
+
+    times = []
+
+    def worker(c):
+        for _ in range(iters):
+            c.push('g', grad)
+            c.pull('g')
+
+    t0 = time.perf_counter()
+    ths = [threading.Thread(target=worker, args=(c,)) for c in clients]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    dt = (time.perf_counter() - t0) / iters
+    clients[0].stop_servers()
+    gb = 2 * size_mb / 1e3      # push + pull
+    print('workers=%d payload=%.1fMB time=%.2fms bw=%.2f GB/s/worker'
+          % (num_workers, size_mb, dt * 1e3, gb / dt))
+    return gb / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--test', choices=['mesh', 'ps'], default='mesh')
+    p.add_argument('--size-mb', type=float, default=64.0)
+    p.add_argument('--iters', type=int, default=10)
+    p.add_argument('-n', '--num-workers', type=int, default=2)
+    args = p.parse_args()
+    if args.test == 'mesh':
+        measure_mesh(args.size_mb, args.iters)
+    else:
+        measure_ps(args.size_mb, args.iters, args.num_workers)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Rebuild of the reference's tools/launch.py + dmlc_tracker (SURVEY.md
+§2.4, §3.4): starts N workers and S parameter servers with the DMLC_*
+env contract and runs the user command in each worker.  Launchers:
+
+  * local — all processes on this machine (the reference's answer to
+    testing multi-node without a cluster, tests/nightly/; SURVEY.md §4)
+  * ssh   — one process group per host from a hostfile
+
+For SPMD TPU jobs (no parameter servers, -s 0) the workers are expected
+to call jax.distributed.initialize themselves; this launcher still
+provides rank/size env (DMLC_WORKER_ID / DMLC_NUM_WORKER) plus
+coordinator address (DMLC_PS_ROOT_URI/PORT) they can reuse.
+
+Usage (mirrors the reference CLI):
+  python tools/launch.py -n 2 -s 1 --launcher local \
+      python train_script.py --kv-store dist_sync
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args, command):
+    host = '127.0.0.1'
+    port = args.port or _free_port()
+    base_env = dict(os.environ)
+    base_env.update({
+        'DMLC_PS_ROOT_URI': host,
+        'DMLC_PS_ROOT_PORT': str(port),
+        'DMLC_NUM_WORKER': str(args.num_workers),
+        'DMLC_NUM_SERVER': str(args.num_servers),
+    })
+    procs = []
+    try:
+        for sid in range(args.num_servers):
+            env = dict(base_env)
+            env.update({'DMLC_ROLE': 'server', 'DMLC_SERVER_ID': str(sid)})
+            procs.append(subprocess.Popen(
+                [sys.executable, '-m', 'mxnet_tpu.kvstore_server'],
+                env=env))
+        for wid in range(args.num_workers):
+            env = dict(base_env)
+            env.update({'DMLC_ROLE': 'worker', 'DMLC_WORKER_ID': str(wid)})
+            procs.append(subprocess.Popen(command, env=env))
+        # wait for workers (last num_workers processes)
+        rc = 0
+        for p in procs[args.num_servers:]:
+            rc = p.wait() or rc
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def launch_ssh(args, command):
+    """One worker per host in --hostfile; servers on the first
+    args.num_servers hosts (reference ssh launcher)."""
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    if len(hosts) < args.num_workers:
+        raise SystemExit('hostfile has %d hosts < %d workers'
+                         % (len(hosts), args.num_workers))
+    root = hosts[0]
+    port = args.port or 9091
+    base = ('DMLC_PS_ROOT_URI=%s DMLC_PS_ROOT_PORT=%d DMLC_NUM_WORKER=%d '
+            'DMLC_NUM_SERVER=%d' % (root, port, args.num_workers,
+                                    args.num_servers))
+    procs = []
+    for sid in range(args.num_servers):
+        cmd = '%s DMLC_ROLE=server DMLC_SERVER_ID=%d %s -m ' \
+            'mxnet_tpu.kvstore_server' % (base, sid, sys.executable)
+        procs.append(subprocess.Popen(['ssh', hosts[sid % len(hosts)], cmd]))
+    for wid in range(args.num_workers):
+        cmd = '%s DMLC_ROLE=worker DMLC_WORKER_ID=%d %s' % (
+            base, wid, ' '.join(command))
+        procs.append(subprocess.Popen(['ssh', hosts[wid], cmd]))
+    rc = 0
+    for p in procs[args.num_servers:]:
+        rc = p.wait() or rc
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='Launch a distributed job (reference tools/launch.py)')
+    parser.add_argument('-n', '--num-workers', type=int, required=True)
+    parser.add_argument('-s', '--num-servers', type=int, default=0)
+    parser.add_argument('--launcher', default='local',
+                        choices=['local', 'ssh'])
+    parser.add_argument('-H', '--hostfile', default=None)
+    parser.add_argument('--port', type=int, default=None)
+    parser.add_argument('command', nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if args.command and args.command[0] == '--':
+        args.command = args.command[1:]
+    if not args.command:
+        raise SystemExit('no command given')
+    if args.launcher == 'local':
+        sys.exit(launch_local(args, args.command))
+    sys.exit(launch_ssh(args, args.command))
+
+
+if __name__ == '__main__':
+    main()
