@@ -99,33 +99,43 @@ class KVStoreServer(object):
         return ('ok',)
 
     def _handle_push(self, key, value):
+        merged = None
         with self.cv:
             if key not in self.store:
                 # late init push (reference inits on first push too)
                 self.store[key] = np.zeros_like(value)
             if not self.sync_mode:
-                self._apply(key, np.asarray(value))
-                self.cv.notify_all()
-                return ('ok',)
-            s, c = self.merge_buf.get(key, (None, 0))
-            s = np.array(value, copy=True) if s is None else s + value
-            c += 1
-            if c >= self.num_workers:
-                self._apply(key, s)
-                self.merge_buf.pop(key, None)
-                self.cv.notify_all()
+                merged = np.asarray(value)
             else:
-                self.merge_buf[key] = (s, c)
-                # sync push blocks the round for this key; worker's ack
-                # is immediate (its next pull will wait for completion)
+                s, c = self.merge_buf.get(key, (None, 0))
+                s = np.array(value, copy=True) if s is None else s + value
+                c += 1
+                if c >= self.num_workers:
+                    self.merge_buf.pop(key, None)
+                    merged = s   # round complete: update outside the lock
+                else:
+                    self.merge_buf[key] = (s, c)
+                    # sync push acks immediately; the worker's next pull
+                    # waits for the round via the key version
+        if merged is not None:
+            # optimizer math runs OUTSIDE the global lock so pulls,
+            # barriers and other keys' pushes proceed concurrently; at
+            # most one thread updates a given key per round (the round
+            # completes exactly once), and pulls wait on the version
+            self._apply(key, merged)
+            with self.cv:
+                self.version[key] = self.version.get(key, 0) + 1
+                self.cv.notify_all()
         return ('ok',)
 
     def _apply(self, key, merged):
+        """Apply one round's merged gradient.  Called without the global
+        lock; per-key exclusivity is guaranteed by round completion (the
+        caller bumps the key version under the lock afterwards)."""
         if self.updater is not None:
             self.updater(key, merged)     # reads + writes self.store[key]
         else:
             self.store[key] = merged
-        self.version[key] = self.version.get(key, 0) + 1
 
     def _handle_pull(self, key, min_version=0):
         """Sync semantics, deadlock-free: the pull carries the calling

@@ -244,6 +244,7 @@ class NDArray:
         # device(s) — restore the full sharding, not one device
         # (reference CopyFromTo is the cross-device writer, ndarray.h:471)
         if not isinstance(new, jax.core.Tracer) and \
+                not isinstance(self._data, jax.core.Tracer) and \
                 new.devices() != self._data.devices():
             new = jax.device_put(new, self._data.sharding)
         self._data = new

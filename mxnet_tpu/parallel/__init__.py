@@ -12,3 +12,5 @@ from .mesh import (make_mesh, data_sharding, replicated, shard_batch,
                    replicate_params, current_mesh, set_current_mesh)
 from .ring_attention import ring_attention
 from . import collectives
+from . import pipeline
+from . import moe

@@ -1,0 +1,138 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over a
+mesh axis.
+
+No counterpart in the reference (MXNet 0.11's closest feature is
+engine-async `PartialForward` overlap, SURVEY.md §2.4 item 5) — this is
+the §7-step-9 new-design extension.  Each device along the 'pipe' axis
+holds ONE stage's parameters; microbatches stream through the stages
+with `lax.ppermute` hops over ICI inside a `lax.scan`, so the whole
+pipeline schedule — warmup bubble, steady state, drain — is a single
+XLA program.  Backward is plain autodiff: the transpose of ppermute is
+ppermute with the inverse permutation, so XLA derives the reverse
+schedule automatically.
+
+Schedule: plain GPipe fill-drain over T = M + S - 1 ticks (M
+microbatches, S stages).  Bubble fraction (S-1)/T shrinks as M grows —
+pick M a few multiples of S.
+"""
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pipeline_run(stage_fn, params, microbatches, num_stages,
+                 axis_name='pipe'):
+    """Run inside shard_map: stream microbatches through the stages.
+
+    stage_fn(params, x) -> y: one stage's computation; every stage must
+    map activations of the same shape/dtype.
+    params: THIS stage's parameter pytree (leading 'pipe'-sharded dim of
+    size 1 removed by the caller or kept — stage_fn decides).
+    microbatches: (M, mb, ...) — only stage 0 reads them.
+    Returns (M, mb, ...): stage S-1's outputs (garbage on other stages).
+    """
+    idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    T = M + num_stages - 1
+    perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+    state = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+
+    def body(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (clamped; out-of-range ticks feed
+        # garbage that never reaches a valid output slot)
+        mb = lax.dynamic_index_in_dim(microbatches,
+                                      jnp.clip(t, 0, M - 1), 0,
+                                      keepdims=False)
+        inp = jnp.where(idx == 0, mb, state)
+        out = stage_fn(params, inp)
+        # last stage writes its result for microbatch (t - S + 1)
+        oidx = jnp.clip(t - (num_stages - 1), 0, M - 1)
+        valid = t >= (num_stages - 1)
+        outputs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(o, out, oidx, 0),
+            lambda o: o, outputs)
+        state = lax.ppermute(out, axis_name, perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(body, (state, outputs),
+                                   jnp.arange(T))
+    return outputs
+
+
+def make_pipeline_train_step(stage_fn, loss_fn, mesh, num_micro,
+                             axis_name='pipe', lr=0.1):
+    """Compile a full pipeline-parallel training step.
+
+    stage_fn(stage_params, x) -> y        (same activation shape in/out)
+    loss_fn(y, targets) -> scalar         (applied on the LAST stage)
+
+    Parameters are passed with a leading stage dim (S, ...) sharded over
+    the pipe axis; inputs (B, ...) are split into `num_micro`
+    microbatches and replicated to all stages (only stage 0 reads them).
+    Returns jitted step(params, x, targets) -> (loss, new_params).
+    """
+    S = mesh.shape[axis_name]
+
+    def step(params, x, targets):
+        # shard_map gives this stage params[1, ...] -> drop stage dim
+        sparams = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = lax.axis_index(axis_name)
+        mb = x.shape[0] // num_micro
+        micro = x.reshape((num_micro, mb) + x.shape[1:])
+        tmicro = targets.reshape((num_micro, mb) + targets.shape[1:])
+
+        def loss_of(sp):
+            outs = pipeline_run(stage_fn, sp, micro, S, axis_name)
+            # loss counts only on the last stage; other stages emit 0.
+            # Do NOT psum inside the differentiated function: per-device
+            # cotangent seeds of 1 already make this differentiate
+            # sum_i(local_i) (earlier stages' grads arrive through the
+            # ppermute transposes), and a psum here would scale every
+            # gradient by the stage count.
+            return jnp.where(
+                idx == S - 1,
+                loss_fn(outs.reshape((-1,) + outs.shape[2:]),
+                        tmicro.reshape((-1,) + tmicro.shape[2:])),
+                0.0)
+
+        loss_local, grads = jax.value_and_grad(loss_of)(sparams)
+        loss = lax.psum(loss_local, axis_name)   # reporting only
+        new_sparams = jax.tree_util.tree_map(
+            lambda w, g: w - lr * g, sparams, grads)
+        new_params = jax.tree_util.tree_map(
+            lambda p: p[None], new_sparams)
+        return loss, new_params
+
+    pspec = P(axis_name)
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(pspec, P(), P()),
+        out_specs=(P(), pspec),
+        check_vma=False)
+
+    def wrapper(params, x, targets):
+        return sharded(params, x, targets)
+
+    return jax.jit(wrapper, donate_argnums=(0,))
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_pytree, stage1_pytree, ...] -> single pytree with leading
+    stage dim, ready to device_put with P('pipe') sharding."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *per_stage_params)
+
+
+def place_pipeline_params(params, mesh, axis_name='pipe'):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(axis_name))), params)

@@ -70,7 +70,14 @@ def function(fn):
 def __getattr__(name):
     """mx.th.<name> resolves torch.<name> lazily (the reference
     generated these wrappers from the TH registry)."""
-    torch = _require()
+    if name.startswith('__'):
+        # dunder probes (pydoc, copy, import machinery) must get a
+        # plain AttributeError and must not trigger the torch import
+        raise AttributeError(name)
+    try:
+        torch = _require()
+    except MXNetError as e:     # hasattr() probes expect AttributeError
+        raise AttributeError(name) from e
     fn = getattr(torch, name, None)
     if fn is None or not callable(fn):
         raise AttributeError('torch has no callable %r' % name)

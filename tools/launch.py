@@ -26,17 +26,32 @@ import subprocess
 import sys
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(('', 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+def _free_port_range(n):
+    """Find a base port with n consecutive free ports (server sid binds
+    base+sid, kvstore_server.py)."""
+    for _ in range(64):
+        probe = socket.socket()
+        probe.bind(('', 0))
+        base = probe.getsockname()[1]
+        probe.close()
+        socks = []
+        try:
+            for i in range(max(n, 1)):
+                s = socket.socket()
+                s.bind(('', base + i))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError('could not find %d consecutive free ports' % n)
 
 
 def launch_local(args, command):
     host = '127.0.0.1'
-    port = args.port or _free_port()
+    port = args.port or _free_port_range(args.num_servers)
     base_env = dict(os.environ)
     base_env.update({
         'DMLC_PS_ROOT_URI': host,
@@ -80,24 +95,36 @@ def launch_ssh(args, command):
     if len(hosts) < args.num_workers:
         raise SystemExit('hostfile has %d hosts < %d workers'
                          % (len(hosts), args.num_workers))
+    import shlex
     root = hosts[0]
     port = args.port or 9091
     base = ('DMLC_PS_ROOT_URI=%s DMLC_PS_ROOT_PORT=%d DMLC_NUM_WORKER=%d '
             'DMLC_NUM_SERVER=%d' % (root, port, args.num_workers,
                                     args.num_servers))
     procs = []
-    for sid in range(args.num_servers):
-        cmd = '%s DMLC_ROLE=server DMLC_SERVER_ID=%d %s -m ' \
-            'mxnet_tpu.kvstore_server' % (base, sid, sys.executable)
-        procs.append(subprocess.Popen(['ssh', hosts[sid % len(hosts)], cmd]))
-    for wid in range(args.num_workers):
-        cmd = '%s DMLC_ROLE=worker DMLC_WORKER_ID=%d %s' % (
-            base, wid, ' '.join(command))
-        procs.append(subprocess.Popen(['ssh', hosts[wid], cmd]))
-    rc = 0
-    for p in procs[args.num_servers:]:
-        rc = p.wait() or rc
-    return rc
+    try:
+        for sid in range(args.num_servers):
+            cmd = '%s DMLC_ROLE=server DMLC_SERVER_ID=%d python -m ' \
+                'mxnet_tpu.kvstore_server' % (base, sid)
+            procs.append(subprocess.Popen(
+                ['ssh', hosts[sid % len(hosts)], cmd]))
+        for wid in range(args.num_workers):
+            cmd = '%s DMLC_ROLE=worker DMLC_WORKER_ID=%d %s' % (
+                base, wid, ' '.join(shlex.quote(c) for c in command))
+            procs.append(subprocess.Popen(['ssh', hosts[wid], cmd]))
+        rc = 0
+        for p in procs[args.num_servers:]:
+            rc = p.wait() or rc
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def main():
