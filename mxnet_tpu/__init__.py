@@ -56,6 +56,8 @@ from . import kvstore_server
 from . import executor_manager
 from . import torch_bridge
 from . import torch_bridge as th
+from . import predictor
+from .model import FeedForward
 from . import recordio
 from . import image
 from . import gluon

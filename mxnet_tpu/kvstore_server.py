@@ -105,7 +105,12 @@ class KVStoreServer(object):
                 # late init push (reference inits on first push too)
                 self.store[key] = np.zeros_like(value)
             if not self.sync_mode:
-                merged = np.asarray(value)
+                # async pushes may arrive concurrently for one key, so
+                # the read-modify-write update must stay under the lock
+                self._apply(key, np.asarray(value))
+                self.version[key] = self.version.get(key, 0) + 1
+                self.cv.notify_all()
+                return ('ok',)
             else:
                 s, c = self.merge_buf.get(key, (None, 0))
                 s = np.array(value, copy=True) if s is None else s + value
@@ -118,10 +123,10 @@ class KVStoreServer(object):
                     # sync push acks immediately; the worker's next pull
                     # waits for the round via the key version
         if merged is not None:
-            # optimizer math runs OUTSIDE the global lock so pulls,
-            # barriers and other keys' pushes proceed concurrently; at
-            # most one thread updates a given key per round (the round
-            # completes exactly once), and pulls wait on the version
+            # sync mode: optimizer math runs OUTSIDE the global lock so
+            # pulls, barriers and other keys' pushes proceed
+            # concurrently; exactly one thread completes a given key's
+            # round, and pulls wait on the version
             self._apply(key, merged)
             with self.cv:
                 self.version[key] = self.version.get(key, 0) + 1

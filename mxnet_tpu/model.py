@@ -113,3 +113,150 @@ def load_checkpoint(prefix, epoch):
         if tp == 'aux':
             aux_params[name] = v
     return (symbol, arg_params, aux_params)
+
+
+class FeedForward(object):
+    """Legacy v0.8-style model API (reference model.py FeedForward,
+    ~:400-960) — kept for script compatibility; internally a thin layer
+    over mx.mod.Module, which is the primary training API."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer='sgd', initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from . import initializer as init_mod
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.epoch_size = epoch_size
+        self.optimizer = optimizer
+        self.initializer = initializer if initializer is not None \
+            else init_mod.Uniform(0.01)
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.allow_extra_params = allow_extra_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = dict(kwargs)
+        self._module = None
+
+    def _label_name(self):
+        outs = self.symbol.list_arguments()
+        labels = [n for n in outs if n.endswith('label')]
+        return labels[0] if labels else 'softmax_label'
+
+    def _as_iter(self, X, y=None, batch_size=None, shuffle=False):
+        from . import io as mxio
+        if isinstance(X, mxio.DataIter):
+            return X
+        import numpy as _np
+        batch_size = batch_size or self.numpy_batch_size
+        return mxio.NDArrayIter(_np.asarray(X),
+                                _np.asarray(y) if y is not None else None,
+                                batch_size=batch_size, shuffle=shuffle,
+                                label_name=self._label_name())
+
+    def _make_module(self, data_iter):
+        from . import module as mod
+        label_names = [d.name if hasattr(d, 'name') else d[0]
+                       for d in (data_iter.provide_label or [])] or None
+        self._module = mod.Module(self.symbol, label_names=label_names,
+                                  context=self.ctx)
+        return self._module
+
+    def fit(self, X, y=None, eval_data=None, eval_metric='acc',
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore='local', logger=None, work_load_list=None,
+            monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        """Train (reference FeedForward.fit)."""
+        data = self._as_iter(X, y, shuffle=True)
+        if eval_data is not None and isinstance(eval_data, tuple):
+            eval_data = self._as_iter(*eval_data)
+        module = self._make_module(data)
+        module.fit(data, eval_data=eval_data, eval_metric=eval_metric,
+                   epoch_end_callback=epoch_end_callback,
+                   batch_end_callback=batch_end_callback, kvstore=kvstore,
+                   optimizer=self.optimizer,
+                   optimizer_params=self.kwargs,
+                   initializer=self.initializer,
+                   arg_params=self.arg_params, aux_params=self.aux_params,
+                   allow_missing=True, begin_epoch=self.begin_epoch,
+                   num_epoch=self.num_epoch, monitor=monitor,
+                   eval_end_callback=eval_end_callback,
+                   eval_batch_end_callback=eval_batch_end_callback)
+        self.arg_params, self.aux_params = module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        """Forward over a dataset, concatenated (reference
+        FeedForward.predict)."""
+        if return_data:
+            raise NotImplementedError(
+                'return_data=True is not supported; iterate the data '
+                'iterator alongside predict() instead')
+        data = self._as_iter(X)
+        if reset:
+            data.reset()
+        if self._module is None or not self._module.binded:
+            module = self._make_module(data)
+            module.bind(data_shapes=data.provide_data,
+                        label_shapes=data.provide_label,
+                        for_training=False)
+            # unlabeled predict iters leave the label variable unbound;
+            # it stays zero-filled (ignored by loss ops at inference)
+            module.set_params(self.arg_params, self.aux_params or {},
+                              allow_missing=True)
+        outs = self._module.predict(data, num_batch=num_batch)
+        outs = outs if isinstance(outs, list) else [outs]
+        arrs = [o.asnumpy() for o in outs]
+        return arrs[0] if len(arrs) == 1 else arrs
+
+    def score(self, X, eval_metric='acc', num_batch=None, **kwargs):
+        data = self._as_iter(X)
+        if self._module is None or not self._module.binded:
+            module = self._make_module(data)
+            module.bind(data_shapes=data.provide_data,
+                        label_shapes=data.provide_label,
+                        for_training=False)
+            module.set_params(self.arg_params, self.aux_params or {},
+                              allow_missing=True)
+        res = self._module.score(data, eval_metric, num_batch=num_batch)
+        return res[0][1]
+
+    def save(self, prefix, epoch=None):
+        """Checkpoint (reference FeedForward.save)."""
+        if epoch is None:
+            epoch = self.num_epoch or 0
+        save_checkpoint(prefix, epoch, self.symbol,
+                        self.arg_params or {}, self.aux_params or {})
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        """Load a checkpointed model (reference FeedForward.load)."""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    @staticmethod
+    def create(symbol, X, y=None, ctx=None, num_epoch=None,
+               epoch_size=None, optimizer='sgd', initializer=None,
+               eval_data=None, eval_metric='acc', epoch_end_callback=None,
+               batch_end_callback=None, kvstore='local', logger=None,
+               work_load_list=None, eval_end_callback=None,
+               eval_batch_end_callback=None, **kwargs):
+        """Build + train in one call (reference FeedForward.create /
+        mx.model.FeedForward.create used by R/Scala frontends too)."""
+        from . import initializer as init_mod
+        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
+                            epoch_size=epoch_size, optimizer=optimizer,
+                            initializer=initializer or
+                            init_mod.Uniform(0.01), **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger, work_load_list=work_load_list,
+                  eval_end_callback=eval_end_callback,
+                  eval_batch_end_callback=eval_batch_end_callback)
+        return model

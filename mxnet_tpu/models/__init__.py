@@ -5,7 +5,8 @@ behind every BASELINE.md number (resnet/alexnet/vgg/inception-bn/lenet).
 Same architectures, composed from this framework's symbol API; on TPU
 the whole network compiles to one XLA module per executor.
 """
-from . import lenet, mlp, resnet, alexnet, vgg, inception_bn, ssd
+from . import (lenet, mlp, resnet, alexnet, vgg, inception_bn, ssd,
+               inception_v3, resnext)
 
 _FACTORY = {
     'lenet': lenet.get_symbol,
@@ -15,6 +16,10 @@ _FACTORY = {
     'vgg': vgg.get_symbol,
     'inception-bn': inception_bn.get_symbol,
     'inception_bn': inception_bn.get_symbol,
+    'inception-v3': inception_v3.get_symbol,
+    'inception_v3': inception_v3.get_symbol,
+    'resnext': resnext.get_symbol,
+    'ssd': ssd.get_symbol_train,
 }
 
 

@@ -10,14 +10,11 @@ all_to_all — the canonical TPU MoE data path.  Capacity is static
 expert; overflow tokens are dropped (standard switch behavior) and pass
 through via the residual connection in the caller.
 """
-import functools
-
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 
 def switch_route(x, router_w, num_experts, capacity):

@@ -15,14 +15,11 @@ Schedule: plain GPipe fill-drain over T = M + S - 1 ticks (M
 microbatches, S stages).  Bubble fraction (S-1)/T shrinks as M grows —
 pick M a few multiples of S.
 """
-import functools
-
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def pipeline_run(stage_fn, params, microbatches, num_stages,
@@ -119,10 +116,7 @@ def make_pipeline_train_step(stage_fn, loss_fn, mesh, num_micro,
         out_specs=(P(), pspec),
         check_vma=False)
 
-    def wrapper(params, x, targets):
-        return sharded(params, x, targets)
-
-    return jax.jit(wrapper, donate_argnums=(0,))
+    return jax.jit(sharded, donate_argnums=(0,))
 
 
 def stack_stage_params(per_stage_params):

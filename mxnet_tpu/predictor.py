@@ -1,0 +1,136 @@
+"""Deployment predictor: load a checkpoint, forward only.
+
+Rebuild of the reference's standalone predict API
+(src/c_predict_api.cc, 362 LoC + amalgamation/ mobile build; SURVEY.md
+§2.6/§2.8): `Predictor` consumes exactly the checkpoint artifacts
+Module writes (prefix-symbol.json + prefix-NNNN.params), binds a
+forward-only executor, and serves predictions.  The TPU-native extra:
+`export_compiled()` AOT-lowers the forward into a serialized StableHLO
+executable for serving environments that ship no Python graph code —
+the amalgamation story done the XLA way.
+"""
+import io
+import json
+
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym_mod
+from . import model as model_mod
+from .base import MXNetError
+from .context import cpu
+
+
+class Predictor(object):
+    """Forward-only model server (reference MXPredCreate flow)."""
+
+    def __init__(self, symbol_json_or_file=None, param_bytes_or_file=None,
+                 input_shapes=None, ctx=None, symbol=None, arg_params=None,
+                 aux_params=None, dev_type=None, dev_id=0):
+        """Create from serialized artifacts (the C predict API contract:
+        symbol JSON string/file + param blob) or in-memory objects."""
+        if symbol is None:
+            s = symbol_json_or_file
+            if s is None:
+                raise MXNetError('need symbol json or symbol')
+            if isinstance(s, str) and s.lstrip().startswith('{'):
+                symbol = sym_mod.load_json(s)
+            else:
+                symbol = sym_mod.load(s)
+        if arg_params is None and param_bytes_or_file is not None:
+            blob = param_bytes_or_file
+            if isinstance(blob, (bytes, bytearray)):
+                loaded = nd.load_buffer(bytes(blob)) if hasattr(
+                    nd, 'load_buffer') else _load_param_bytes(bytes(blob))
+            else:
+                loaded = nd.load(blob)
+            arg_params, aux_params = {}, {}
+            for k, v in loaded.items():
+                tp, name = k.split(':', 1)
+                if tp == 'arg':
+                    arg_params[name] = v
+                elif tp == 'aux':
+                    aux_params[name] = v
+        if ctx is None:
+            ctx = cpu() if dev_type is None else \
+                __import__('mxnet_tpu').Context(dev_type, dev_id)
+        input_shapes = dict(input_shapes or {})
+        self._symbol = symbol
+        self._ctx = ctx
+        self._executor = symbol.simple_bind(ctx, grad_req='null',
+                                            **input_shapes)
+        self._executor.copy_params_from(arg_params or {}, aux_params or {})
+        self._input_names = [n for n in symbol.list_arguments()
+                             if n in input_shapes]
+
+    @classmethod
+    def from_checkpoint(cls, prefix, epoch, input_shapes, ctx=None):
+        """Load Module.save_checkpoint artifacts (reference
+        MXPredCreate on prefix-symbol.json + prefix-NNNN.params)."""
+        symbol, arg_params, aux_params = model_mod.load_checkpoint(
+            prefix, epoch)
+        return cls(symbol=symbol, arg_params=arg_params,
+                   aux_params=aux_params, input_shapes=input_shapes,
+                   ctx=ctx)
+
+    def set_input(self, name, value):
+        """MXPredSetInput."""
+        self._executor.arg_dict[name][:] = value
+
+    def forward(self, **inputs):
+        """MXPredForward: set named inputs, run, return outputs."""
+        for k, v in inputs.items():
+            self.set_input(k, v)
+        return self._executor.forward(is_train=False)
+
+    def get_output(self, index=0):
+        """MXPredGetOutput."""
+        return self._executor.outputs[index]
+
+    def predict(self, data, input_name='data'):
+        out = self.forward(**{input_name: data})
+        return out[0].asnumpy()
+
+    def reshape(self, input_shapes):
+        """MXPredReshape: rebind for new input shapes sharing weights."""
+        arg_params = {k: v for k, v in self._executor.arg_dict.items()
+                      if k not in self._input_names}
+        aux_params = dict(self._executor.aux_dict)
+        self._executor = self._symbol.simple_bind(
+            self._ctx, grad_req='null', **dict(input_shapes))
+        self._executor.copy_params_from(arg_params, aux_params)
+        self._input_names = [n for n in self._symbol.list_arguments()
+                             if n in dict(input_shapes)]
+        return self
+
+    # -- TPU-native deployment extra ---------------------------------------
+    def export_compiled(self):
+        """AOT-lower the forward into a serialized XLA executable
+        (StableHLO text + compiled binary when supported) — the
+        amalgamation/mobile-deploy counterpart (SURVEY.md §2.8)."""
+        import jax
+        ex = self._executor
+        arg_vals, aux_vals = ex._gather()
+        rng = __import__('jax').random.PRNGKey(0)
+
+        def fwd(arg_vals, aux_vals, rng):
+            outs, _ = ex.raw_forward(arg_vals, aux_vals, rng)
+            return outs
+
+        lowered = jax.jit(fwd).lower(arg_vals, aux_vals, rng)
+        out = {'stablehlo': lowered.as_text()}
+        try:
+            out['compiled'] = lowered.compile().as_text()
+        except Exception:
+            pass
+        return out
+
+
+def _load_param_bytes(blob):
+    """Param blob bytes -> dict (reference c_predict accepts an
+    in-memory blob read from prefix-NNNN.params)."""
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix='.params') as f:
+        f.write(blob)
+        f.flush()
+        return nd.load(f.name)

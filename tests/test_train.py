@@ -1,0 +1,159 @@
+"""Train-tier integration tests (model: reference tests/python/train/
+test_mlp.py + test_conv.py — end-to-end fit() convergence to accuracy
+thresholds — with synthetic data instead of MNIST downloads)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def _blobs(n=512, num_classes=4, dim=16, seed=0):
+    """Linearly separable-ish gaussian blobs (class centers fixed
+    across seeds so train/val share the task)."""
+    centers = np.random.RandomState(42).randn(num_classes, dim) * 3.0
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, num_classes, n)
+    X = centers[y] + rs.randn(n, dim)
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def _digits(n=512, seed=0):
+    """Synthetic 'digit' images: class = quadrant of a bright square."""
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 1, 16, 16).astype(np.float32) * 0.2
+    y = rs.randint(0, 4, n)
+    for i in range(n):
+        r, c = divmod(int(y[i]), 2)
+        X[i, 0, r * 8:r * 8 + 8, c * 8:c * 8 + 8] += 0.8
+    return X, y.astype(np.float32)
+
+
+def _mlp_sym(num_classes=4):
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, name='fc1', num_hidden=32)
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, name='fc2', num_hidden=num_classes)
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def _lenet_sym(num_classes=4):
+    data = sym.Variable('data')
+    net = sym.Convolution(data, kernel=(5, 5), num_filter=8, name='conv1')
+    net = sym.Activation(net, act_type='tanh')
+    net = sym.Pooling(net, pool_type='max', kernel=(2, 2), stride=(2, 2))
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=16, name='conv2')
+    net = sym.Activation(net, act_type='tanh')
+    net = sym.Pooling(net, pool_type='max', kernel=(2, 2), stride=(2, 2))
+    net = sym.Flatten(net)
+    net = sym.FullyConnected(net, num_hidden=num_classes, name='fc')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def test_mlp_fit_convergence(tmp_path):
+    """Module.fit to >95% train acc with checkpoint + Speedometer
+    callbacks (reference test_mlp.py)."""
+    X, y = _blobs()
+    Xv, yv = _blobs(128, seed=1)
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                              label_name='softmax_label')
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=32,
+                            label_name='softmax_label')
+    mod = mx.mod.Module(_mlp_sym())
+    prefix = str(tmp_path / 'mlp')
+    mod.fit(train, eval_data=val, num_epoch=8,
+            optimizer_params={'learning_rate': 0.1},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(32, 50),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    score = mod.score(val, 'acc')
+    assert score[0][1] > 0.95, score
+
+    # checkpoint artifacts exist and resume restores accuracy
+    assert os.path.exists(prefix + '-symbol.json')
+    assert os.path.exists(prefix + '-0008.params')
+    symbol, arg_params, aux_params = mx.model.load_checkpoint(prefix, 8)
+    mod2 = mx.mod.Module(symbol)
+    mod2.bind(data_shapes=val.provide_data,
+              label_shapes=val.provide_label, for_training=False)
+    mod2.set_params(arg_params, aux_params)
+    score2 = mod2.score(val, 'acc')
+    assert abs(score2[0][1] - score[0][1]) < 1e-6
+
+
+def test_conv_fit_convergence():
+    """LeNet-style convnet on synthetic quadrant digits
+    (reference test_conv.py, MNIST swapped for synthetic)."""
+    X, y = _digits()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                              label_name='softmax_label')
+    mod = mx.mod.Module(_lenet_sym())
+    mod.fit(train, num_epoch=6,
+            optimizer_params={'learning_rate': 0.1, 'momentum': 0.9},
+            initializer=mx.init.Xavier())
+    score = mod.score(train, 'acc')
+    assert score[0][1] > 0.95, score
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """The v0.8 FeedForward facade: create/fit/predict/score/save/load
+    (reference model.py FeedForward; R/Perl frontends use this shape)."""
+    X, y = _blobs(256)
+    model = mx.model.FeedForward.create(
+        _mlp_sym(), X, y, num_epoch=6, learning_rate=0.1,
+        initializer=mx.init.Xavier(), numpy_batch_size=32)
+    preds = model.predict(X)
+    assert preds.shape == (256, 4)
+    acc = (preds.argmax(1) == y).mean()
+    assert acc > 0.9, acc
+    assert model.score(mx.io.NDArrayIter(
+        X, y, batch_size=32, label_name='softmax_label')) > 0.9
+
+    prefix = str(tmp_path / 'ff')
+    model.save(prefix, 6)
+    loaded = mx.model.FeedForward.load(prefix, 6)
+    preds2 = loaded.predict(X)
+    np.testing.assert_allclose(preds2, preds, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_deploy(tmp_path):
+    """Deployment predictor over checkpoint artifacts
+    (reference c_predict_api flow)."""
+    X, y = _blobs(128)
+    train = mx.io.NDArrayIter(X, y, batch_size=32,
+                              label_name='softmax_label')
+    mod = mx.mod.Module(_mlp_sym())
+    mod.fit(train, num_epoch=4, optimizer_params={'learning_rate': 0.1},
+            initializer=mx.init.Xavier())
+    prefix = str(tmp_path / 'deploy')
+    mod.save_checkpoint(prefix, 4)
+
+    pred = mx.predictor.Predictor.from_checkpoint(
+        prefix, 4, input_shapes={'data': (32, 16)})
+    out = pred.predict(X[:32])
+    assert out.shape == (32, 4)
+    # matches the module's own outputs (same bound batch size)
+    mod_out = mod.predict(mx.io.NDArrayIter(
+        X[:32], y[:32], batch_size=32, label_name='softmax_label'))
+    np.testing.assert_allclose(out, mod_out.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+    # reshape rebinds with shared weights
+    pred.reshape({'data': (4, 16)})
+    out2 = pred.predict(X[:4])
+    np.testing.assert_allclose(out2, out[:4], rtol=1e-5, atol=1e-6)
+    # AOT export produces a StableHLO module
+    exported = pred.export_compiled()
+    assert 'stablehlo' in exported and 'func' in exported['stablehlo']
+
+
+def test_model_factory_new_symbols():
+    from mxnet_tpu import models
+    inc = models.get_symbol('inception-v3', num_classes=10)
+    _, outs, _ = inc.infer_shape(data=(1, 3, 299, 299))
+    assert outs == [(1, 10)]
+    rx = models.get_symbol('resnext', num_classes=10, num_layers=50,
+                           num_group=32)
+    _, outs, _ = rx.infer_shape(data=(1, 3, 224, 224))
+    assert outs == [(1, 10)]

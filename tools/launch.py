@@ -104,7 +104,7 @@ def launch_ssh(args, command):
     procs = []
     try:
         for sid in range(args.num_servers):
-            cmd = '%s DMLC_ROLE=server DMLC_SERVER_ID=%d python -m ' \
+            cmd = '%s DMLC_ROLE=server DMLC_SERVER_ID=%d python3 -m ' \
                 'mxnet_tpu.kvstore_server' % (base, sid)
             procs.append(subprocess.Popen(
                 ['ssh', hosts[sid % len(hosts)], cmd]))
