@@ -7,6 +7,8 @@ dispatches regardless of graph size.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: BENCH_BATCH (default tries 256,128,64), BENCH_STEPS,
+BENCH_DTYPE (default bfloat16 mixed precision — fp32 master weights via
+multi_precision SGD; set float32 for full precision),
 BENCH_MODEL (default resnet-50 / num_layers).
 """
 import json
@@ -17,14 +19,15 @@ import time
 import numpy as np
 
 
-def run(batch, steps, warmup, num_layers=50):
+def run(batch, steps, warmup, num_layers=50, dtype='float32'):
     import jax
     import mxnet_tpu as mx
     from mxnet_tpu.models import resnet
 
     ctx = mx.tpu() if any(d.platform != 'cpu' for d in jax.devices()) \
         else mx.cpu()
-    sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers)
+    sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers,
+                            dtype=dtype)
     mod = mx.mod.Module(sym, context=ctx)
     mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 3, 224, 224))],
              label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
@@ -33,7 +36,9 @@ def run(batch, steps, warmup, num_layers=50):
                                                magnitude=2))
     mod.init_optimizer(optimizer='sgd',
                        optimizer_params={'learning_rate': 0.1,
-                                         'momentum': 0.9, 'wd': 1e-4})
+                                         'momentum': 0.9, 'wd': 1e-4,
+                                         'multi_precision':
+                                             dtype != 'float32'})
     rng = np.random.RandomState(0)
     data = mx.nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32),
                        ctx=ctx)
@@ -67,11 +72,12 @@ def main():
         else [256, 128, 64]
     steps = int(os.environ.get('BENCH_STEPS', 20))
     warmup = int(os.environ.get('BENCH_WARMUP', 3))
+    dtype = os.environ.get('BENCH_DTYPE', 'bfloat16')
     best = None
     err = None
     for b in batches:
         try:
-            ips = run(b, steps, warmup)
+            ips = run(b, steps, warmup, dtype=dtype)
             if best is None or ips > best:
                 best = ips
             break  # largest fitting batch wins

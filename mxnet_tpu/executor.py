@@ -369,11 +369,16 @@ class Executor:
         aux_names = symbol.list_auxiliary_states()
         arg_shapes, _, aux_shapes = symbol.infer_shape(**shape_kwargs)
         type_dict = type_dict or {}
+        # dtype inference: params downstream of a Cast allocate in the
+        # compute dtype (mixed-precision graphs, reference --dtype fp16)
+        arg_types, _, aux_types = symbol.infer_type(**type_dict)
+        inferred = dict(zip(arg_names, arg_types))
+        inferred.update(zip(aux_names, aux_types))
         req = Executor._normalize_grad_req(grad_req, arg_names)
         arg_dict = OrderedDict()
         grad_dict = {}
         for name, shape in zip(arg_names, arg_shapes):
-            dtype = type_dict.get(name, np.float32)
+            dtype = type_dict.get(name, inferred.get(name, np.float32))
             if shared_exec is not None and name in shared_exec.arg_dict and \
                     shared_exec.arg_dict[name].shape == tuple(shape):
                 arg_dict[name] = shared_exec.arg_dict[name]
@@ -392,7 +397,8 @@ class Executor:
                     shared_exec.aux_dict[name].shape == tuple(shape):
                 aux_dict[name] = shared_exec.aux_dict[name]
             else:
-                aux_dict[name] = nd.zeros(shape, ctx, dtype=np.float32)
+                aux_dict[name] = nd.zeros(
+                    shape, ctx, dtype=inferred.get(name, np.float32))
         return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
 
     @staticmethod

@@ -62,10 +62,15 @@ def residual_unit(data, num_filter, stride, dim_match, name,
 
 
 def resnet(units, num_stages, filter_list, num_classes, image_shape,
-           bottle_neck=True):
+           bottle_neck=True, dtype='float32'):
     num_unit = len(units)
     assert num_unit == num_stages
     data = sym.Variable('data')
+    if dtype != 'float32':
+        # mixed precision (reference --dtype float16 flow,
+        # common/fit.py): cast after data, cast back before the loss;
+        # params downstream allocate in the compute dtype via infer_type
+        data = sym.Cast(data, dtype=dtype, name='cast_data')
     data = sym.BatchNorm(data, fix_gamma=True, eps=BN_EPS, momentum=BN_MOM,
                          name='bn_data')
     (nchannel, height, width) = image_shape
@@ -99,11 +104,13 @@ def resnet(units, num_stages, filter_list, num_classes, image_shape,
                         pool_type='avg', name='pool1')
     flat = sym.Flatten(pool1)
     fc1 = sym.FullyConnected(flat, num_hidden=num_classes, name='fc1')
+    if dtype != 'float32':
+        fc1 = sym.Cast(fc1, dtype='float32', name='cast_out')
     return sym.SoftmaxOutput(fc1, name='softmax')
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape='3,224,224',
-               **kwargs):
+               dtype='float32', **kwargs):
     """Stage layout per depth (reference resnet.py get_symbol)."""
     if isinstance(image_shape, str):
         image_shape = tuple(int(x) for x in image_shape.split(','))
@@ -147,6 +154,6 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape='3,224,224',
         else:
             raise ValueError('no experiments done on num_layers %d'
                              % num_layers)
-    return resnet(units=units, num_stages=num_stages,
+    return resnet(dtype=dtype, units=units, num_stages=num_stages,
                   filter_list=filter_list, num_classes=num_classes,
                   image_shape=image_shape, bottle_neck=bottle_neck)

@@ -396,9 +396,24 @@ def _bn_infer_shape(attrs, in_shapes):
     return in_shapes
 
 
+def _bn_infer_dtype(attrs, in_dtypes):
+    """Mixed precision: scale/bias and the moving statistics stay
+    float32 regardless of the compute dtype (the reference's cuDNN BN
+    keeps fp32 params/stats for fp16 inputs); output follows data."""
+    d = np.dtype(in_dtypes[0]) if in_dtypes[0] is not None \
+        else np.dtype(np.float32)
+    f32 = np.dtype(np.float32)
+    n_out = 3 if asbool(attrs.get('output_mean_var', False)) else 1
+    return [d, f32, f32, f32, f32], [d] + [f32] * (n_out - 1)
+
+
 def _bn_compute(attrs, inputs, auxs, op_ctx):
     data, gamma, beta = inputs
     moving_mean, moving_var = auxs
+    in_dtype = data.dtype
+    if data.dtype != jnp.float32:
+        # normalize in fp32 (stats precision), emit in the compute dtype
+        data = data.astype(jnp.float32)
     eps = asfloat(attrs.get('eps', 1e-3))
     momentum = asfloat(attrs.get('momentum', 0.9))
     fix_gamma = asbool(attrs.get('fix_gamma', True))
@@ -419,10 +434,12 @@ def _bn_compute(attrs, inputs, auxs, op_ctx):
         new_var = moving_var * momentum + svar * (1 - momentum)
         out = (data - mean.reshape(bshape)) * lax.rsqrt(
             var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+        out = out.astype(in_dtype)
         outs = [out, mean, var] if output_mean_var else [out]
         return outs, [new_mean, new_var]
     out = (data - moving_mean.reshape(bshape)) * lax.rsqrt(
         moving_var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+    out = out.astype(in_dtype)
     outs = [out, moving_mean, moving_var] if output_mean_var else [out]
     return outs, [moving_mean, moving_var]
 
@@ -430,7 +447,8 @@ def _bn_compute(attrs, inputs, auxs, op_ctx):
 register('BatchNorm', input_names=('data', 'gamma', 'beta',
                                    'moving_mean', 'moving_var'),
          num_aux=2, mutable_aux=True, mode_dependent=True,
-         infer_shape=_bn_infer_shape, hint='batchnorm',
+         infer_shape=_bn_infer_shape, infer_dtype=_bn_infer_dtype,
+         hint='batchnorm',
          num_outputs=lambda attrs: 3 if asbool(attrs.get('output_mean_var', False)) else 1,
          output_names=lambda attrs: (['output', 'mean', 'var']
                                      if asbool(attrs.get('output_mean_var', False))

@@ -548,46 +548,67 @@ class FusedSGD:
     def __init__(self, optimizer, param_names):
         import jax
         import jax.numpy as jnp
-        assert type(optimizer) in (SGD, NAG) and not getattr(
-            optimizer, 'multi_precision', False)
+        assert type(optimizer) in (SGD, NAG)
         self.optimizer = optimizer
         self.param_names = list(param_names)
         self.states = {}
+        self.masters = {}     # fp32 master copies for low-precision params
         momentum = optimizer.momentum
         rescale = optimizer.rescale_grad
         clip = optimizer.clip_gradient
         nesterov = isinstance(optimizer, NAG)
+        multi_precision = bool(getattr(optimizer, 'multi_precision',
+                                       False))
 
-        def step(ws, gs, moms, lrs, wds):
-            new_ws, new_moms = [], []
-            for w, g, m, lr, wd in zip(ws, gs, moms, lrs, wds):
-                g = g * rescale
+        def step(ws, gs, moms, masters, lrs, wds):
+            new_ws, new_moms, new_masters = [], [], []
+            for w, g, m, mw, lr, wd in zip(ws, gs, moms, masters, lrs,
+                                           wds):
+                # with multi_precision, math runs on the fp32 master and
+                # the low-precision weight is a cast of it (reference
+                # mp_sgd_update, src/operator/optimizer_op-inl.h)
+                acc = mw if mw is not None else w
+                g = g.astype(acc.dtype) * rescale
                 if clip is not None:
                     g = jnp.clip(g, -clip, clip)
-                g = g + wd * w
+                g = g + wd * acc
                 if momentum == 0.0:
-                    w = w - lr * g
+                    acc = acc - lr * g
                     nm = m
                 elif nesterov:
                     nm = momentum * m + g
-                    w = w - lr * (g + momentum * nm)
+                    acc = acc - lr * (g + momentum * nm)
                 else:
                     nm = momentum * m - lr * g
-                    w = w + nm
-                new_ws.append(w)
+                    acc = acc + nm
+                if mw is not None:
+                    new_masters.append(acc)
+                    new_ws.append(acc.astype(w.dtype))
+                else:
+                    new_masters.append(None)
+                    new_ws.append(acc)
                 new_moms.append(nm)
-            return new_ws, new_moms
+            return new_ws, new_moms, new_masters
 
-        self._jit_step = jax.jit(step, donate_argnums=(0, 2))
+        self.multi_precision = multi_precision
+        self._jit_step = jax.jit(step, donate_argnums=(0, 2, 3))
 
     def __call__(self, weights, grads):
         """weights/grads: lists of NDArray aligned with param_names.
         Updates weights in place (rebinding device buffers)."""
         import jax.numpy as jnp
         opt = self.optimizer
-        if not self.states:
-            for name, w in zip(self.param_names, weights):
-                self.states[name] = jnp.zeros(w.shape, dtype=w.dtype)
+        for name, w in zip(self.param_names, weights):
+            mp = self.multi_precision and w.dtype in \
+                (np.dtype(np.float16), jnp.bfloat16)
+            if name not in self.states:
+                mdtype = np.float32 if mp else w.dtype
+                self.states[name] = jnp.zeros(w.shape, dtype=mdtype)
+            if name not in self.masters:
+                # backfill (fresh start or restored checkpoint without
+                # masters): re-derive from the current weight
+                self.masters[name] = w._data.astype(np.float32) if mp \
+                    else None
         lrs, wds = [], []
         for name in self.param_names:
             opt._update_count(name)
@@ -596,32 +617,50 @@ class FusedSGD:
         ws = [w._data for w in weights]
         gs = [g._data for g in grads]
         moms = [self.states[n] for n in self.param_names]
-        new_ws, new_moms = self._jit_step(ws, gs, moms, lrs, wds)
+        masters = [self.masters[n] for n in self.param_names]
+        new_ws, new_moms, new_masters = self._jit_step(
+            ws, gs, moms, masters, lrs, wds)
         for w, nw in zip(weights, new_ws):
             w._data = nw
-        for n, nm in zip(self.param_names, new_moms):
+        for n, nm, nmw in zip(self.param_names, new_moms, new_masters):
             self.states[n] = nm
+            self.masters[n] = nmw
 
     # checkpoint compatibility with Updater.get_states/set_states
     def get_states(self):
         states = {n: np.asarray(v) for n, v in self.states.items()}
+        masters = {n: (np.asarray(v) if v is not None else None)
+                   for n, v in self.masters.items()}
         return pickle.dumps((states,
-                             dict(self.optimizer._index_update_count)))
+                             dict(self.optimizer._index_update_count),
+                             masters))
 
     def set_states(self, states):
         payload = pickle.loads(states)
-        states, counts = payload if isinstance(payload, tuple) \
-            else (payload, None)
+        masters = None
+        if isinstance(payload, tuple) and len(payload) == 3:
+            states, counts, masters = payload
+        elif isinstance(payload, tuple):
+            states, counts = payload
+        else:
+            states, counts = payload, None
         import jax.numpy as jnp
         self.states = {n: jnp.asarray(v) for n, v in states.items()}
+        # fp32 masters ride along with the momentum states; older/other
+        # checkpoints without them re-derive masters from the weights on
+        # the first update (__call__ backfills missing keys)
+        self.masters = {} if masters is None else {
+            n: (jnp.asarray(v) if v is not None else None)
+            for n, v in masters.items()}
         if counts is not None:
             self.optimizer._index_update_count = dict(counts)
 
 
 def create_fused_updater(optimizer, param_names):
     """Return a fused whole-model updater when the optimizer supports it,
-    else None (caller falls back to the per-key Updater)."""
-    if type(optimizer) in (SGD, NAG) and not getattr(
-            optimizer, 'multi_precision', False):
+    else None (caller falls back to the per-key Updater).  FusedSGD
+    handles multi_precision natively (fp32 masters inside the jitted
+    step, reference mp_sgd_update)."""
+    if type(optimizer) in (SGD, NAG):
         return FusedSGD(optimizer, param_names)
     return None

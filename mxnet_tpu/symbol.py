@@ -242,6 +242,11 @@ class Symbol:
         return var_shapes, outs
 
     def infer_type(self, *args, **kwargs):
+        """Forward dtype inference over the DAG via each op's
+        infer_dtype (nnvm InferType pass) — this is what makes
+        mixed-precision graphs (Cast to bfloat16/float16 mid-graph)
+        allocate params in the compute dtype, the reference's
+        --dtype float16 flow."""
         arg_names = self.list_arguments()
         known = {}
         if args:
@@ -252,9 +257,45 @@ class Symbol:
             if v is not None:
                 known[k] = np.dtype(v)
         default = np.dtype(np.float32)
-        arg_types = [known.get(n, default) for n in self.list_arguments()]
-        aux_types = [known.get(n, default) for n in self.list_auxiliary_states()]
-        out_types = [default for _ in self._outputs]
+        topo = self._topo()
+        entry_type = {}
+        for _ in range(3):
+            changed = False
+            for node in topo:
+                if node.op is None:
+                    t = known.get(node.name)
+                    if t is not None and \
+                            entry_type.get((id(node), 0)) != t:
+                        entry_type[(id(node), 0)] = t
+                        changed = True
+                    continue
+                in_types = [entry_type.get((id(src), i))
+                            for src, i in node.inputs]
+                try:
+                    in_types, out_types = node.op.infer_dtype(
+                        node.attrs, in_types)
+                except Exception:
+                    continue
+                for (src, i), t in zip(node.inputs, in_types):
+                    if t is not None and \
+                            entry_type.get((id(src), i)) is None:
+                        entry_type[(id(src), i)] = np.dtype(t)
+                        if src.op is None:
+                            known.setdefault(src.name, np.dtype(t))
+                        changed = True
+                if out_types is not None:
+                    for i, t in enumerate(out_types):
+                        if t is not None and \
+                                entry_type.get((id(node), i)) != np.dtype(t):
+                            entry_type[(id(node), i)] = np.dtype(t)
+                            changed = True
+            if not changed:
+                break
+        arg_types = [known.get(n, default) for n in arg_names]
+        aux_types = [known.get(n, default)
+                     for n in self.list_auxiliary_states()]
+        out_types = [entry_type.get((id(n), i), default)
+                     for n, i in self._outputs]
         return arg_types, out_types, aux_types
 
     # -- serialization (nnvm JSON layout) ---------------------------------

@@ -157,3 +157,114 @@ def test_model_factory_new_symbols():
                            num_group=32)
     _, outs, _ = rx.infer_shape(data=(1, 3, 224, 224))
     assert outs == [(1, 10)]
+
+
+def test_mixed_precision_training():
+    """bfloat16 compute with fp32 master weights (reference
+    tests/python/train/test_dtype.py + fp16 multi_precision SGD,
+    NEWS.md:18): params downstream of the cast allocate in bf16, the
+    fused SGD keeps fp32 masters, and training converges."""
+    import jax.numpy as jnp
+    X, y = _blobs(256)
+    data = sym.Variable('data')
+    net = sym.Cast(data, dtype='bfloat16')
+    net = sym.FullyConnected(net, name='fc1', num_hidden=32)
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, name='fc2', num_hidden=4)
+    net = sym.Cast(net, dtype='float32')
+    net = sym.SoftmaxOutput(net, name='softmax')
+
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True,
+                              label_name='softmax_label')
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    # weights allocated in the compute dtype via dtype inference
+    w = mod._exec_group.executor.arg_dict['fc1_weight']
+    assert w.dtype == jnp.bfloat16, w.dtype
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'multi_precision': True})
+    for _ in range(6):
+        train.reset()
+        for batch in train:
+            mod.forward_backward(batch)
+            mod.update()
+    # fused updater holds fp32 masters for the bf16 params
+    fu = mod._fused_updater
+    assert fu is not None and fu.multi_precision
+    assert any(m is not None and m.dtype == np.float32
+               for m in fu.masters.values())
+    score = mod.score(train, 'acc')
+    assert score[0][1] > 0.9, score
+
+
+def test_fused_sgd_state_roundtrip(tmp_path):
+    """save_optimizer_states/load_optimizer_states through the fused
+    updater, including fp32 masters (regression: restore used to
+    KeyError on the first update)."""
+    import jax.numpy as jnp
+    X, y = _blobs(128)
+    data = sym.Variable('data')
+    net = sym.Cast(data, dtype='bfloat16')
+    net = sym.FullyConnected(net, name='fc1', num_hidden=8)
+    net = sym.Cast(net, dtype='float32')
+    net = sym.SoftmaxOutput(net, name='softmax')
+    train = mx.io.NDArrayIter(X, y, batch_size=32,
+                              label_name='softmax_label')
+
+    def make():
+        m = mx.mod.Module(net)
+        m.bind(data_shapes=train.provide_data,
+               label_shapes=train.provide_label)
+        m.init_params(initializer=mx.init.Xavier())
+        m.init_optimizer(optimizer='sgd',
+                         optimizer_params={'learning_rate': 0.1,
+                                           'momentum': 0.9,
+                                           'multi_precision': True})
+        return m
+
+    mod = make()
+    batch = next(iter(train))
+    for _ in range(3):
+        mod.forward_backward(batch)
+        mod.update()
+    fname = str(tmp_path / 'opt.states')
+    mod.save_optimizer_states(fname)
+
+    mod2 = make()
+    mod2.set_params(*mod.get_params())
+    mod2.load_optimizer_states(fname)
+    # the regression: first update after restore crashed
+    mod2.forward_backward(batch)
+    mod2.update()
+    fu = mod2._fused_updater
+    assert any(m is not None and m.dtype == np.float32
+               for m in fu.masters.values())
+
+
+def test_batchnorm_fp32_stats_in_bf16_graph():
+    """BN scale/bias/moving stats stay float32 in a bfloat16 graph
+    (reference cuDNN BN behavior for fp16)."""
+    import jax.numpy as jnp
+    data = sym.Variable('data')
+    net = sym.Cast(data, dtype='bfloat16')
+    net = sym.Convolution(net, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                          name='conv')
+    net = sym.BatchNorm(net, fix_gamma=False, name='bn')
+    net = sym.Cast(net, dtype='float32')
+    net = sym.make_loss(sym.sum(net))
+    ex = net.simple_bind(mx.cpu(), data=(2, 3, 8, 8))
+    assert ex.arg_dict['conv_weight'].dtype == jnp.bfloat16
+    assert ex.arg_dict['bn_gamma'].dtype == np.float32
+    assert ex.aux_dict['bn_moving_mean'].dtype == np.float32
+    ex.arg_dict['data'][:] = np.random.RandomState(0).rand(
+        2, 3, 8, 8).astype(np.float32)
+    ex.arg_dict['conv_weight'][:] = np.random.RandomState(1).rand(
+        4, 3, 3, 3).astype(np.float32) * 0.1
+    ex.forward(is_train=True)
+    ex.backward()
+    # stats updated in fp32
+    assert ex.aux_dict['bn_moving_mean'].dtype == np.float32
+    assert np.abs(ex.aux_dict['bn_moving_mean'].asnumpy()).sum() > 0
