@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training worker (reference
+example/image-classification with --kv-store dist_sync, launched by
+tools/launch.py — SURVEY.md §3.4):
+
+  python tools/launch.py -n 2 -s 1 --launcher local \
+      python examples/distributed/dist_mlp.py
+
+Each worker trains on its shard; gradients aggregate on the parameter
+servers which run the optimizer (update_on_kvstore).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import sym               # noqa: E402
+
+
+def main():
+    kv = mx.kvstore.create(os.environ.get('KV_STORE', 'dist_sync'))
+    rank, nworker = kv.rank, kv.num_workers
+
+    centers = np.random.RandomState(42).randn(4, 16) * 3.0
+    rs = np.random.RandomState(rank)        # each worker's shard
+    y = rs.randint(0, 4, 512)
+    X = (centers[y] + rs.randn(512, 16)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y.astype(np.float32), batch_size=64,
+                              shuffle=True, label_name='softmax_label')
+
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=64, name='fc1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=4, name='fc2')
+    net = sym.SoftmaxOutput(net, name='softmax')
+
+    mod = mx.mod.Module(net)
+    mod.fit(train, num_epoch=6, kvstore=kv,
+            optimizer='sgd', optimizer_params={'learning_rate': 0.1},
+            initializer=mx.init.Xavier())
+    acc = mod.score(train, 'acc')[0][1]
+    print('RANK %d/%d final acc %.4f' % (rank, nworker, acc))
+    kv.barrier()
+    if rank == 0 and hasattr(kv, 'stop_servers'):
+        kv.stop_servers()
+
+
+if __name__ == '__main__':
+    main()

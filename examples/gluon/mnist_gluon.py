@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Imperative Gluon training (reference example/gluon/mnist.py):
+autograd.record + Trainer on a Sequential net, synthetic digits.
+
+  python examples/gluon/mnist_gluon.py --epochs 5
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import gluon, autograd, nd   # noqa: E402
+
+
+def synthetic_digits(n=1024, seed=0):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, 10, n)
+    X = rs.rand(n, 1, 28, 28).astype(np.float32) * 0.2
+    for i in range(n):
+        r = int(y[i]) * 2 % 26
+        X[i, 0, r:r + 3, :] += 0.8
+    return X, y.astype(np.float32)
+
+
+def main():
+    p = argparse.ArgumentParser('gluon mnist')
+    p.add_argument('--epochs', type=int, default=5)
+    p.add_argument('--batch-size', type=int, default=64)
+    p.add_argument('--lr', type=float, default=0.1)
+    p.add_argument('--hybridize', action='store_true')
+    args = p.parse_args()
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(128, activation='relu'))
+    net.add(gluon.nn.Dense(64, activation='relu'))
+    net.add(gluon.nn.Dense(10))
+    if args.hybridize:
+        net.hybridize()
+    net.initialize(mx.init.Xavier())
+
+    X, y = synthetic_digits()
+    dataset = gluon.data.ArrayDataset(X.reshape(len(X), -1), y)
+    loader = gluon.data.DataLoader(dataset, batch_size=args.batch_size,
+                                   shuffle=True)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': args.lr})
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        metric.reset()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+        print('epoch %d acc %.4f' % (epoch, metric.get()[1]))
+    return metric.get()[1]
+
+
+if __name__ == '__main__':
+    main()

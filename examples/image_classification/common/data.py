@@ -1,0 +1,90 @@
+"""Data loaders for the image-classification examples
+(reference common/data.py get_rec_iter / get_mnist_iter): real .rec /
+MNIST files when paths are given, deterministic synthetic data
+otherwise (this sandbox has no dataset downloads)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group('Data')
+    data.add_argument('--data-train', type=str, default=None,
+                      help='path to training .rec')
+    data.add_argument('--data-val', type=str, default=None)
+    data.add_argument('--data-dir', type=str, default=None,
+                      help='dir with MNIST idx files')
+    data.add_argument('--image-shape', type=str, default='1,28,28')
+    data.add_argument('--num-classes', type=int, default=10)
+    data.add_argument('--num-examples', type=int, default=2048)
+    return data
+
+
+def _read_idx(path):
+    opener = gzip.open if path.endswith('.gz') else open
+    with opener(path, 'rb') as f:
+        zero, dtype, ndim = struct.unpack('>HBB', f.read(4))
+        shape = struct.unpack('>' + 'I' * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), np.uint8).reshape(shape)
+
+
+def _synthetic(args, seed):
+    """Class-dependent blob images — converges like a tiny MNIST."""
+    shape = tuple(int(x) for x in args.image_shape.split(','))
+    rs = np.random.RandomState(seed)
+    n = args.num_examples
+    y = rs.randint(0, args.num_classes, n)
+    X = rs.rand(n, *shape).astype(np.float32) * 0.2
+    c, h, w = shape
+    cell = max(1, h // args.num_classes)
+    for i in range(n):
+        r = int(y[i]) * cell % max(1, h - cell)
+        X[i, :, r:r + cell, :] += 0.8
+    return X, y.astype(np.float32)
+
+
+def get_mnist_iter(args, kv):
+    """MNIST idx files if --data-dir is given, else synthetic."""
+    if args.data_dir and os.path.exists(
+            os.path.join(args.data_dir, 'train-images-idx3-ubyte')):
+        tx = _read_idx(os.path.join(
+            args.data_dir, 'train-images-idx3-ubyte')) / 255.0
+        ty = _read_idx(os.path.join(
+            args.data_dir, 'train-labels-idx1-ubyte'))
+        vx = _read_idx(os.path.join(
+            args.data_dir, 't10k-images-idx3-ubyte')) / 255.0
+        vy = _read_idx(os.path.join(
+            args.data_dir, 't10k-labels-idx1-ubyte'))
+        tx = tx[:, None].astype(np.float32)
+        vx = vx[:, None].astype(np.float32)
+    else:
+        tx, ty = _synthetic(args, 0)
+        vx, vy = _synthetic(args, 1)
+    train = mx.io.NDArrayIter(tx, ty.astype(np.float32), args.batch_size,
+                              shuffle=True, label_name='softmax_label')
+    val = mx.io.NDArrayIter(vx, vy.astype(np.float32), args.batch_size,
+                            label_name='softmax_label')
+    return train, val
+
+
+def get_rec_iter(args, kv):
+    """ImageRecordIter over .rec shards with dist-aware parts
+    (reference common/data.py get_rec_iter)."""
+    if not args.data_train:
+        return get_mnist_iter(args, kv)
+    shape = tuple(int(x) for x in args.image_shape.split(','))
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True, num_parts=kv.num_workers, part_index=kv.rank)
+    val = None
+    if args.data_val:
+        val = mx.io.ImageRecordIter(
+            path_imgrec=args.data_val, data_shape=shape,
+            batch_size=args.batch_size, num_parts=kv.num_workers,
+            part_index=kv.rank)
+    return train, val

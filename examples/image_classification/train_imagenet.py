@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Train an ImageNet-class network from .rec shards (reference
+example/image-classification/train_imagenet.py — the BASELINE
+ResNet-50 workload).
+
+  python examples/image_classification/train_imagenet.py \
+      --network resnet --num-layers 50 --dtype bfloat16 \
+      --data-train train.rec --data-val val.rec \
+      --image-shape 3,224,224 --batch-size 256
+
+Distributed (parameter servers):
+  python tools/launch.py -n 4 -s 2 --launcher ssh -H hosts \
+      python examples/image_classification/train_imagenet.py \
+      --kv-store dist_sync ...
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+from common import fit, data            # noqa: E402
+from mxnet_tpu import models            # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='train imagenet',
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network='resnet', num_layers=50,
+                        image_shape='3,224,224', num_classes=1000,
+                        num_epochs=90, lr=0.1, lr_factor=0.1,
+                        lr_step_epochs='30,60,80', batch_size=256,
+                        dtype='bfloat16', top_k=5)
+    args = parser.parse_args()
+    kwargs = {'num_classes': args.num_classes,
+              'image_shape': args.image_shape}
+    if args.network in ('resnet', 'resnext'):
+        kwargs['num_layers'] = args.num_layers
+    if args.network == 'resnet':
+        kwargs['dtype'] = args.dtype
+    net = models.get_symbol(args.network, **kwargs)
+    fit.fit(args, net, data.get_rec_iter)
+
+
+if __name__ == '__main__':
+    main()

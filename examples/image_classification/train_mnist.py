@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Train MLP / LeNet on MNIST (reference
+example/image-classification/train_mnist.py — the SURVEY.md §7 first
+milestone script).  With no --data-dir it trains on synthetic digits so
+the example runs hermetically.
+
+  python examples/image_classification/train_mnist.py --network lenet
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+from common import fit, data            # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import models            # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='train on mnist',
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(network='mlp', num_epochs=10, lr=0.05,
+                        batch_size=64, image_shape='1,28,28',
+                        num_classes=10)
+    args = parser.parse_args()
+
+    if args.network == 'mlp':
+        net = models.get_symbol('mlp', num_classes=args.num_classes)
+    else:
+        net = models.get_symbol(args.network,
+                                num_classes=args.num_classes)
+    mod = fit.fit(args, net, data.get_mnist_iter)
+    return mod
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""LSTM language model with bucketing (reference
+example/rnn/lstm_bucketing.py — the PTB workload).  Reads a tokenized
+text file (one sentence per line) or generates a synthetic corpus.
+
+  python examples/rnn/lstm_bucketing.py --num-epochs 5
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import sym               # noqa: E402
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    return mx.rnn.encode_sentences(lines, vocab=vocab,
+                                   invalid_label=invalid_label,
+                                   start_label=start_label)
+
+
+def synthetic_corpus(vocab_size, n=2000, seed=0):
+    """Deterministic next-token structure a small LSTM can learn."""
+    rs = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ln = int(rs.choice([8, 16, 24, 32]))
+        s0 = int(rs.randint(1, vocab_size))
+        step = 1 + s0 % 3
+        out.append([1 + (s0 + i * step) % (vocab_size - 1)
+                    for i in range(ln)])
+    return out
+
+
+def main():
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format='%(asctime)-15s %(message)s')
+    p = argparse.ArgumentParser('LSTM bucketing language model')
+    p.add_argument('--train-data', type=str, default=None)
+    p.add_argument('--num-layers', type=int, default=2)
+    p.add_argument('--num-hidden', type=int, default=128)
+    p.add_argument('--num-embed', type=int, default=64)
+    p.add_argument('--vocab-size', type=int, default=64)
+    p.add_argument('--batch-size', type=int, default=32)
+    p.add_argument('--num-epochs', type=int, default=5)
+    p.add_argument('--lr', type=float, default=0.01)
+    p.add_argument('--fused', action='store_true', default=True,
+                   help='use FusedRNNCell (single scan-based RNN op)')
+    p.add_argument('--buckets', type=str, default='8,16,24,32')
+    args = p.parse_args()
+
+    buckets = [int(x) for x in args.buckets.split(',')]
+    if args.train_data:
+        sentences, vocab = tokenize_text(args.train_data,
+                                         invalid_label=0, start_label=1)
+        args.vocab_size = len(vocab) + 1
+    else:
+        sentences = synthetic_corpus(args.vocab_size)
+    data_train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                           buckets=buckets,
+                                           invalid_label=0)
+
+    if args.fused:
+        cell = mx.rnn.FusedRNNCell(args.num_hidden,
+                                   num_layers=args.num_layers,
+                                   mode='lstm', prefix='lstm_')
+    else:
+        cell = mx.rnn.SequentialRNNCell()
+        for i in range(args.num_layers):
+            cell.add(mx.rnn.LSTMCell(args.num_hidden,
+                                     prefix='lstm_l%d_' % i))
+
+    def sym_gen(seq_len):
+        data = sym.Variable('data')
+        label = sym.Variable('softmax_label')
+        embed = sym.Embedding(data, input_dim=args.vocab_size,
+                              output_dim=args.num_embed, name='embed')
+        outputs, _ = cell.unroll(seq_len, embed, layout='NTC',
+                                 merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=args.vocab_size,
+                                  name='pred')
+        lab = sym.Reshape(label, shape=(-1,))
+        return (sym.SoftmaxOutput(pred, label=lab, name='softmax'),
+                ('data',), ('softmax_label',))
+
+    mod = mx.mod.BucketingModule(
+        sym_gen, default_bucket_key=data_train.default_bucket_key)
+    mod.fit(data_train, eval_metric=mx.metric.Perplexity(None),
+            num_epoch=args.num_epochs, optimizer='adam',
+            optimizer_params={'learning_rate': args.lr},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 50))
+    return mod
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Train SSD (reference example/ssd/train.py — the VGG16-SSD BASELINE
+workload).  Reads a detection .rec (ImageDetIter format) or generates
+synthetic boxes.
+
+  python examples/ssd/train_ssd.py --num-epochs 2 --data-shape 300
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu.models import ssd        # noqa: E402
+
+
+class _SyntheticDetIter(mx.io.DataIter):
+    """One bright box per image; label row [cls, x1, y1, x2, y2]."""
+
+    def __init__(self, batch_size, data_shape, num_classes, nbatch=16,
+                 seed=0):
+        super().__init__(batch_size)
+        self.data_shape = data_shape
+        self.num_classes = num_classes
+        self.nbatch = nbatch
+        self.rs = np.random.RandomState(seed)
+        self.i = 0
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc('data',
+                               (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc('label', (self.batch_size, 2, 5))]
+
+    def reset(self):
+        self.i = 0
+
+    def next(self):
+        if self.i >= self.nbatch:
+            raise StopIteration
+        self.i += 1
+        c, h, w = self.data_shape
+        X = self.rs.rand(self.batch_size, c, h, w).astype(np.float32) * .2
+        lab = np.full((self.batch_size, 2, 5), -1, np.float32)
+        for b in range(self.batch_size):
+            cls = self.rs.randint(0, self.num_classes)
+            x1, y1 = self.rs.uniform(0.05, 0.45, 2)
+            bw = self.rs.uniform(0.2, 0.4)
+            x2, y2 = min(x1 + bw, 0.95), min(y1 + bw, 0.95)
+            X[b, :, int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] += .7
+            lab[b, 0] = [cls, x1, y1, x2, y2]
+        return mx.io.DataBatch(data=[mx.nd.array(X)],
+                               label=[mx.nd.array(lab)],
+                               provide_data=self.provide_data,
+                               provide_label=self.provide_label)
+
+
+def main():
+    import logging
+    logging.basicConfig(level=logging.INFO,
+                        format='%(asctime)-15s %(message)s')
+    p = argparse.ArgumentParser('train SSD')
+    p.add_argument('--train-rec', type=str, default=None)
+    p.add_argument('--num-classes', type=int, default=4)
+    p.add_argument('--data-shape', type=int, default=300)
+    p.add_argument('--batch-size', type=int, default=8)
+    p.add_argument('--num-epochs', type=int, default=2)
+    p.add_argument('--lr', type=float, default=0.002)
+    p.add_argument('--model-prefix', type=str, default=None)
+    args = p.parse_args()
+
+    shape = (3, args.data_shape, args.data_shape)
+    if args.train_rec:
+        train = mx.image.ImageDetIter(
+            batch_size=args.batch_size, data_shape=shape,
+            path_imgrec=args.train_rec, shuffle=True, rand_mirror=True)
+    else:
+        train = _SyntheticDetIter(args.batch_size, shape,
+                                  args.num_classes)
+
+    net = ssd.get_symbol_train(num_classes=args.num_classes)
+    mod = mx.mod.Module(net, data_names=('data',), label_names=('label',))
+    epoch_cbs = [mx.callback.do_checkpoint(args.model_prefix)] \
+        if args.model_prefix else []
+    mod.fit(train, num_epoch=args.num_epochs, optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9,
+                              'wd': 5e-4},
+            initializer=mx.init.Xavier(),
+            eval_metric=mx.metric.Loss(output_names=['loc_loss_output']),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 8),
+            epoch_end_callback=epoch_cbs)
+    return mod
+
+
+if __name__ == '__main__':
+    main()

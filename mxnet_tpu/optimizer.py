@@ -137,10 +137,15 @@ class SGD(Optimizer):
         self.momentum = momentum
         self.multi_precision = multi_precision
 
+    def _is_low_precision(self, weight):
+        import jax.numpy as jnp
+        return weight.dtype in (np.dtype(np.float16),
+                                np.dtype(jnp.bfloat16))
+
     def create_state(self, index, weight):
         momentum = None
         weight_master_copy = None
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and self._is_low_precision(weight):
             weight_master_copy = weight.astype(np.float32)
             if self.momentum != 0.0:
                 momentum = zeros(weight.shape, weight.context,
@@ -513,7 +518,14 @@ class Updater:
 
     def set_states(self, states):
         payload = pickle.loads(states)
-        states, counts = payload if isinstance(payload, tuple) else (payload, None)
+        if isinstance(payload, tuple) and len(payload) == 3:
+            # fused-updater checkpoints carry fp32 masters as a third
+            # member; the per-key path re-derives masters lazily
+            states, counts, _ = payload
+        elif isinstance(payload, tuple):
+            states, counts = payload
+        else:
+            states, counts = payload, None
         self.states = {
             k: ([nd.array(x) if x is not None else None for x in v]
                 if isinstance(v, (list, tuple)) else

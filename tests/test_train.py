@@ -268,3 +268,31 @@ def test_batchnorm_fp32_stats_in_bf16_graph():
     # stats updated in fp32
     assert ex.aux_dict['bn_moving_mean'].dtype == np.float32
     assert np.abs(ex.aux_dict['bn_moving_mean'].asnumpy()).sum() > 0
+
+
+def test_optimizer_states_portable_between_update_paths(tmp_path):
+    """Checkpoints written by the fused updater load through the per-key
+    Updater path (kvstore='local') and vice versa; and the per-key SGD
+    recognizes bfloat16 for multi_precision."""
+    import pickle
+    import jax.numpy as jnp
+    from mxnet_tpu import optimizer as opt_mod
+
+    # fused 3-tuple payload loads into a per-key Updater
+    o = mx.optimizer.create('sgd', momentum=0.9)
+    fu = opt_mod.FusedSGD(o, ['w0'])
+    w = [mx.nd.array(np.ones(3, np.float32))]
+    g = [mx.nd.array(np.ones(3, np.float32))]
+    fu(w, g)
+    blob = fu.get_states()
+    upd = opt_mod.get_updater(mx.optimizer.create('sgd', momentum=0.9))
+    upd.set_states(blob)     # regression: used to ValueError
+
+    # bf16 weights get fp32 masters on the per-key path too
+    o2 = mx.optimizer.create('sgd', momentum=0.9, multi_precision=True)
+    wbf = mx.nd.array(np.ones(3, np.float32)).astype('bfloat16') if \
+        hasattr(mx.nd.NDArray, 'astype') else None
+    state = o2.create_state(0, wbf)
+    assert isinstance(state, tuple)
+    mom, master = state
+    assert master.dtype == np.float32
