@@ -31,9 +31,17 @@ from .ops.registry import OpContext
 
 class Executor:
     def __init__(self, symbol, ctx, arg_dict, grad_dict, aux_dict,
-                 grad_req_dict):
+                 grad_req_dict, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        # ctx_group model parallelism (reference AttrScope ctx_group +
+        # PlaceDevice pass, graph_executor.cc:367): nodes whose
+        # 'ctx_group' attr maps to a device get their outputs pinned
+        # there; XLA inserts the cross-device copies the reference's
+        # _CrossDeviceCopy nodes did
+        self._group2ctx = dict(group2ctx or {})
+        self._group2dev = {k: v.jax_device()
+                           for k, v in self._group2ctx.items()}
         self.arg_dict = arg_dict        # OrderedDict name -> NDArray
         self.grad_dict = grad_dict      # name -> NDArray (or absent)
         self.aux_dict = aux_dict        # OrderedDict name -> NDArray
@@ -51,6 +59,13 @@ class Executor:
     def _build(self):
         sym = self._symbol
         topo = sym._topo()
+        # only drop to eager per-op dispatch when some node actually
+        # maps to a group device; a group2ctx dict that matches nothing
+        # must not forfeit the single fused XLA execution
+        self._grouped = bool(self._group2dev) and any(
+            n.op is not None and
+            n.user_attrs.get('ctx_group') in self._group2dev
+            for n in topo)
         node_index = {id(n): i for i, n in enumerate(topo)}
         arg_pos = {n: i for i, n in enumerate(self._arg_names)}
         aux_pos = {n: i for i, n in enumerate(self._aux_names)}
@@ -78,6 +93,19 @@ class Executor:
                 op_ctx = OpContext(
                     is_train=is_train,
                     rng=jax.random.fold_in(rng, ni) if op.needs_rng else None)
+                group = node.user_attrs.get('ctx_group')
+                if group is not None and group in self._group2dev:
+                    # grouped (model-parallel) execution: inputs
+                    # transfer to the group's device and the op
+                    # dispatches there — the reference's PlaceDevice +
+                    # _CrossDeviceCopy design (graph_executor.cc:367).
+                    # (Under jit these device_puts are ignored by
+                    # lowering; grouped executors run un-jitted.)
+                    dev = self._group2dev[group]
+                    args = [jax.device_put(a, dev) for a in args]
+                    auxs = [jax.device_put(a, dev) for a in auxs]
+                    if op_ctx.rng is not None:
+                        op_ctx.rng = jax.device_put(op_ctx.rng, dev)
                 outs, updated = op.apply(node.attrs, args, auxs, op_ctx)
                 results[ni] = outs
                 if op.mutable_aux and is_train and updated:
@@ -123,12 +151,14 @@ class Executor:
         def fwd_monitor(arg_vals, aux_vals, rng, is_train):
             return run_graph(arg_vals, aux_vals, rng, is_train,
                              collect_all=True)
-        self._fwd_monitor = jax.jit(fwd_monitor, static_argnums=(3,))
+        # grouped executors stay un-jitted everywhere, monitor included
+        # (jit would collapse ctx_group placement onto one device)
+        self._fwd_monitor = fwd_monitor if self._grouped else \
+            jax.jit(fwd_monitor, static_argnums=(3,))
 
         diff_idx = [arg_pos[n] for n in self._diff_names]
 
-        @jax.jit
-        def fwd_bwd(arg_vals, aux_vals, rng, head_grads):
+        def fwd_bwd_impl(arg_vals, aux_vals, rng, head_grads):
             arg_vals = list(arg_vals)
 
             def f(diff_vals):
@@ -143,9 +173,18 @@ class Executor:
             grads, = vjp_fn(tuple(head_grads))
             return outs, new_aux, grads
 
-        self._fwd_train = fwd_train
-        self._fwd_eval = fwd_eval
-        self._fwd_bwd = fwd_bwd
+        if self._grouped:
+            # ctx_group model parallelism: execute eagerly so each op
+            # dispatches on its group's device with real transfers at
+            # the boundaries (per-op dispatch is the reference's own
+            # granularity); jit would collapse everything to one device
+            self._fwd_train = lambda a, x, r: run_graph(a, x, r, True)
+            self._fwd_eval = lambda a, x, r: run_graph(a, x, r, False)
+            self._fwd_bwd = fwd_bwd_impl
+        else:
+            self._fwd_train = fwd_train
+            self._fwd_eval = fwd_eval
+            self._fwd_bwd = jax.jit(fwd_bwd_impl)
         self._stash = None
         # un-jitted graph functions (for AOT export / driver compile checks)
         self.raw_forward = lambda arg_vals, aux_vals, rng: \
@@ -346,7 +385,8 @@ class Executor:
             aux_dict[name] = cur if cur.shape == tuple(shape) else \
                 nd.zeros(shape, self._ctx, dtype=cur.dtype)
         return Executor(sym, self._ctx, arg_dict, grad_dict, aux_dict,
-                        dict(self._grad_req))
+                        dict(self._grad_req),
+                        group2ctx=self._group2ctx)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -361,7 +401,7 @@ class Executor:
 
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req='write', type_dict=None,
-                     shared_exec=None, shape_kwargs=None):
+                     shared_exec=None, shape_kwargs=None, group2ctx=None):
         """The reference simple_bind flow (graph_executor.cc:789):
         infer shapes/types, allocate arg/grad/aux arrays, compile."""
         shape_kwargs = shape_kwargs or {}
@@ -399,11 +439,12 @@ class Executor:
             else:
                 aux_dict[name] = nd.zeros(
                     shape, ctx, dtype=inferred.get(name, np.float32))
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req,
+                        group2ctx=group2ctx)
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad=None, grad_req='write',
-              aux_states=None, shared_exec=None):
+              aux_states=None, shared_exec=None, group2ctx=None):
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
@@ -428,4 +469,5 @@ class Executor:
             aux_dict = OrderedDict(zip(aux_names, aux_states))
         else:
             aux_dict = OrderedDict((n, aux_states[n]) for n in aux_names)
-        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req)
+        return Executor(symbol, ctx, arg_dict, grad_dict, aux_dict, req,
+                        group2ctx=group2ctx)

@@ -333,18 +333,21 @@ class Symbol:
 
     # -- binding -----------------------------------------------------------
     def simple_bind(self, ctx, grad_req='write', type_dict=None,
-                    shared_exec=None, shared_data_arrays=None, **kwargs):
+                    shared_exec=None, shared_data_arrays=None,
+                    group2ctx=None, **kwargs):
         from .executor import Executor
         return Executor._simple_bind(self, ctx, grad_req=grad_req,
                                      type_dict=type_dict,
                                      shared_exec=shared_exec,
+                                     group2ctx=group2ctx,
                                      shape_kwargs=kwargs)
 
     def bind(self, ctx, args, args_grad=None, grad_req='write',
-             aux_states=None, shared_exec=None):
+             aux_states=None, shared_exec=None, group2ctx=None):
         from .executor import Executor
         return Executor._bind(self, ctx, args, args_grad=args_grad,
                               grad_req=grad_req, aux_states=aux_states,
+                              group2ctx=group2ctx,
                               shared_exec=shared_exec)
 
     def eval(self, ctx=None, **kwargs):

@@ -250,3 +250,78 @@ def test_pipeline_gradients_match_sequential():
     for i in range(S):
         np.testing.assert_allclose(g_pipe[i], np.asarray(g_ref[i]),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_model_parallel_ctx_group():
+    """ctx_group model parallelism: layers placed on different devices
+    via AttrScope + group2ctx (reference test_model_parallel.py — there
+    cpu(0)/cpu(1); PlaceDevice's _CrossDeviceCopy becomes XLA device
+    placement)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym, nd
+
+    with mx.AttrScope(ctx_group='dev1'):
+        data = sym.Variable('data')
+        fc1 = sym.FullyConnected(data, num_hidden=8, name='fc1')
+        act1 = sym.Activation(fc1, act_type='relu')
+    with mx.AttrScope(ctx_group='dev2'):
+        fc2 = sym.FullyConnected(act1, num_hidden=4, name='fc2')
+        net = sym.SoftmaxOutput(fc2, name='softmax')
+
+    ex = net.simple_bind(mx.cpu(0), data=(4, 6),
+                         group2ctx={'dev1': mx.cpu(0),
+                                    'dev2': mx.cpu(1)})
+    rs = np.random.RandomState(0)
+    for k, v in ex.arg_dict.items():
+        v[:] = rs.rand(*v.shape).astype(np.float32)
+    out = ex.forward(is_train=True)[0]
+    # dev2-group ops executed on device 1 (the output is theirs)
+    assert any(d.id == 1 for d in out.handle.devices()), \
+        out.handle.devices()
+    ex.backward()
+    # gradients flow across the device boundary
+    g = ex.grad_dict['fc1_weight'].asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+    # numerics match the single-device run
+    ex2 = net.simple_bind(mx.cpu(0), data=(4, 6))
+    for k in ex.arg_dict:
+        ex2.arg_dict[k][:] = ex.arg_dict[k].asnumpy()
+    out2 = ex2.forward(is_train=False)[0]
+    ex.forward(is_train=False)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), out2.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_model_parallel_monitor_keeps_placement():
+    """Monitor mode must not collapse ctx_group placement (regression:
+    _fwd_monitor stayed jitted for grouped executors)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    with mx.AttrScope(ctx_group='a'):
+        data = sym.Variable('data')
+        fc1 = sym.FullyConnected(data, num_hidden=4, name='fc1')
+    with mx.AttrScope(ctx_group='b'):
+        net = sym.SoftmaxOutput(sym.FullyConnected(fc1, num_hidden=2,
+                                                   name='fc2'),
+                                name='softmax')
+    ex = net.simple_bind(mx.cpu(0), data=(2, 4),
+                         group2ctx={'a': mx.cpu(0), 'b': mx.cpu(1)})
+    seen = []
+    ex.set_monitor_callback(lambda name, arr: seen.append(name))
+    out = ex.forward(is_train=False)[0]
+    assert seen  # monitor fired
+    assert any(d.id == 1 for d in out.handle.devices())
+
+
+def test_group2ctx_without_groups_stays_jitted():
+    """Passing group2ctx that matches no node must keep the fused jit
+    path (regression: any non-empty dict forced eager dispatch)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    data = sym.Variable('data')
+    net = sym.SoftmaxOutput(sym.FullyConnected(data, num_hidden=2,
+                                               name='fc'), name='softmax')
+    ex = net.simple_bind(mx.cpu(0), data=(2, 4),
+                         group2ctx={'unused': mx.cpu(1)})
+    assert not ex._grouped
