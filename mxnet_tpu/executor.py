@@ -362,6 +362,31 @@ class Executor:
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
 
+    def debug_str(self):
+        """Plan dump: topo-ordered ops, output shapes, and memory
+        totals (reference Executor::Print / MXExecutorPrint,
+        graph_executor.cc:81-89)."""
+        lines = ['Symbol outputs: %s' % ', '.join(
+            self._symbol.list_outputs())]
+        total = 0
+        for name, arr in list(self.arg_dict.items()) + \
+                list(self.aux_dict.items()):
+            total += arr.size * np.dtype(arr.dtype).itemsize
+        for node in self._symbol._topo():
+            if node.op is None:
+                continue
+            group = node.user_attrs.get('ctx_group')
+            lines.append('  op %s (%s)%s' % (
+                node.name, node.op.name,
+                ' @%s' % group if group else ''))
+        lines.append('Total bytes in args/aux: %d (%.1f MB)'
+                     % (total, total / 1e6))
+        lines.append('Compiled: %s' % (
+            'eager per-op (ctx groups)' if getattr(self, '_grouped',
+                                                   False)
+            else 'single fused XLA module'))
+        return '\n'.join(lines)
+
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         """Return a new executor bound to new shapes (reference
         executor.py reshape; used by bucketing/DataParallel resize)."""

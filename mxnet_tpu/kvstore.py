@@ -207,7 +207,8 @@ class KVStoreDistPS(KVStore):
         self._num_servers = int(os.environ.get('DMLC_NUM_SERVER', '1'))
         self._num_workers_env = int(os.environ.get('DMLC_NUM_WORKER', '1'))
         self._rank = int(os.environ.get('DMLC_WORKER_ID', '0'))
-        self._client = ps.DistServerClient(host, port, self._num_servers)
+        self._client = ps.DistServerClient(host, port, self._num_servers,
+                                           rank=self._rank)
         self._update_on_kvstore = True
         if 'async' in kv_type and self._rank == 0:
             # reference: rank 0 sends the sync/async mode command to the
@@ -273,6 +274,19 @@ class KVStoreDistPS(KVStore):
 
     def barrier(self):
         self._client.barrier()
+
+    def send_heartbeat(self):
+        """Stamp liveness on the servers (ps-lite heartbeats role)."""
+        self._client.heartbeat(self._rank)
+
+    def get_num_dead_node(self, node_id=0, timeout_sec=60):
+        """Workers silent on the servers longer than timeout_sec
+        (reference KVStore::get_num_dead_node, kvstore.h:287)."""
+        return self._client.num_dead(timeout_sec)
+
+    @property
+    def num_dead_node(self):
+        return self.get_num_dead_node()
 
     def send_command_to_servers(self, head, body):
         if head == 'stop':

@@ -30,6 +30,7 @@ import pickle
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -84,6 +85,13 @@ class KVStoreServer(object):
         self.stopped = False
         self.barrier_count = 0
         self.barrier_gen = 0
+        # failure detection (reference ps-lite heartbeats ->
+        # KVStore::get_num_dead_node, kvstore.h:287): clients identify
+        # their rank once ('hello'); EVERY message on that connection
+        # then stamps liveness.  Never-seen workers age from server
+        # start, so a worker that dies during startup is detectable.
+        self.start_time = time.time()
+        self.last_seen = {}           # worker rank -> time.time()
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.listener.bind(('', port))
@@ -185,11 +193,37 @@ class KVStoreServer(object):
 
     # -- loop ---------------------------------------------------------------
     def _serve_conn(self, conn):
+        conn_rank = None
         try:
             while True:
                 msg = _recv_msg(conn)
                 op = msg[0]
-                if op == 'init':
+                if conn_rank is not None:
+                    # any traffic from an identified worker is liveness
+                    with self.cv:
+                        self.last_seen[conn_rank] = time.time()
+                if op == 'hello':
+                    conn_rank = int(msg[1])
+                    with self.cv:
+                        self.last_seen[conn_rank] = time.time()
+                    _send_msg(conn, ('ok',))
+                    continue
+                elif op == 'heartbeat':
+                    with self.cv:
+                        self.last_seen[int(msg[1])] = time.time()
+                    _send_msg(conn, ('ok',))
+                    continue
+                elif op == 'num_dead':
+                    timeout = float(msg[1])
+                    with self.cv:
+                        now = time.time()
+                        dead = sum(
+                            1 for r in range(self.num_workers)
+                            if now - self.last_seen.get(
+                                r, self.start_time) > timeout)
+                    _send_msg(conn, ('ok', dead))
+                    continue
+                elif op == 'init':
                     reply = self._handle_init(msg[1], msg[2])
                 elif op == 'push':
                     reply = self._handle_push(msg[1], msg[2])
@@ -245,7 +279,7 @@ class KVStoreServer(object):
 class DistServerClient(object):
     """Worker connections to all servers (reference ps::KVWorker)."""
 
-    def __init__(self, host, base_port, num_servers):
+    def __init__(self, host, base_port, num_servers, rank=None):
         self.num_servers = num_servers
         self.push_counts = {}         # key -> this worker's push count
         self.socks = []
@@ -258,6 +292,11 @@ class DistServerClient(object):
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self.socks.append(s)
             self.locks.append(threading.Lock())
+        if rank is not None:
+            # identify once; all subsequent RPCs on these connections
+            # double as heartbeats (no extra per-op round trips)
+            for sid in range(num_servers):
+                self._rpc(sid, 'hello', int(rank))
 
     @staticmethod
     def _connect_retry(host, port, total_timeout=120.0):
@@ -306,6 +345,14 @@ class DistServerClient(object):
     def set_sync_mode(self, sync):
         for sid in range(self.num_servers):
             self._rpc(sid, 'set_sync', sync)
+
+    def heartbeat(self, rank):
+        for sid in range(self.num_servers):
+            self._rpc(sid, 'heartbeat', rank)
+
+    def num_dead(self, timeout_sec):
+        return max(self._rpc(sid, 'num_dead', timeout_sec)
+                   for sid in range(self.num_servers))
 
     def stop_servers(self):
         for sid in range(self.num_servers):

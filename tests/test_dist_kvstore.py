@@ -217,3 +217,24 @@ def test_split_input_slice():
     assert s == [slice(0, 5), slice(5, 10)]
     s = _split_input_slice(9, [2, 1])
     assert s[0] == slice(0, 6) and s[1] == slice(6, 9)
+
+
+def test_num_dead_node_heartbeats():
+    """PS failure detection (reference ps-lite heartbeats ->
+    get_num_dead_node, kvstore.h:287): never-seen workers age from
+    server start; any RPC from an identified worker stamps liveness."""
+    import time
+    srv, t = _start_server(2)
+    c0 = ps.DistServerClient('127.0.0.1', srv.port, 1, rank=0)
+    time.sleep(0.15)
+    c0.heartbeat(0)                  # worker 0 fresh
+    # worker 1 NEVER connected: counts dead once the server has been up
+    # longer than the timeout (startup-crash detection)
+    assert c0.num_dead(timeout_sec=0.1) == 1
+    # ordinary RPCs double as heartbeats: pull traffic keeps 0 alive
+    c0.init('k', np.zeros(2, np.float32))
+    time.sleep(0.15)
+    c0.pull('k')
+    c1 = ps.DistServerClient('127.0.0.1', srv.port, 1, rank=1)
+    assert c0.num_dead(timeout_sec=0.12) == 0
+    c0.stop_servers()

@@ -158,3 +158,15 @@ def test_multi_output_executor():
     outs = ex.forward()
     assert len(outs) == 2
     np.testing.assert_allclose(outs[0].asnumpy(), [[0, 1], [4, 5]])
+
+
+def test_debug_str():
+    """Plan dump (reference MXExecutorPrint)."""
+    net = sym.SoftmaxOutput(sym.FullyConnected(sym.Variable('data'),
+                                               num_hidden=4, name='fc'),
+                            name='softmax')
+    ex = net.simple_bind(mx.cpu(), data=(2, 8))
+    s = ex.debug_str()
+    assert 'fc (FullyConnected)' in s
+    assert 'Total bytes' in s
+    assert 'fused XLA' in s
