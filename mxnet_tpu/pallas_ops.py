@@ -1,0 +1,273 @@
+"""In-tree Pallas TPU kernels for hot ops.
+
+The reference hand-writes CUDA for its hottest kernels; the TPU
+counterpart is Pallas (jax.readthedocs.io/en/latest/pallas).  This module
+ships the first production kernel: flash attention — a 3D
+(batch*head, q-block, k-block) grid streams K/V blocks through VMEM with
+the online-softmax recurrence in fp32 scratch, so neither the T^2 score
+matrix nor the full K/V sequence ever sits in VMEM/HBM at once, and
+causal q-tiles skip their fully-masked k-blocks.  Available directly as
+`pallas_ops.flash_attention` and opt-in via
+`parallel.ring_attention.full_attention(use_flash=True)`.
+
+Backward uses blocked recompute: gradients are assembled q-block by
+q-block (O(block_q * T) live memory, not O(T^2)) — standard
+flash-attention practice.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _online_softmax_step(q, kblk, vblk, m, l, acc, scale, causal,
+                         row0, col0):
+    """One K-block of the online-softmax recurrence — the ONE numerics
+    definition both schedules share."""
+    s = lax.dot_general(
+        q, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = row0 + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = col0 + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(rows >= cols, s, -jnp.inf)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    pv = lax.dot_general(
+        p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc * correction + pv
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                 scale, causal, block_q, block_k, num_kb):
+    """One (bh, qi, kb) grid step of the streaming schedule.  kb is the
+    minor grid dim: scratch (m, l, acc) carries the online softmax
+    across kb steps; the last live kb writes o_ref."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    # causal: this q tile's last live k block (diagonal inclusive)
+    last_kb = num_kb - 1
+    if causal:
+        last_kb = jnp.minimum(
+            (qi * block_q + block_q - 1) // block_k, num_kb - 1)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(jnp.logical_not(causal) | (kb <= last_kb))
+    def _compute():
+        m_new, l_new, acc_new = _online_softmax_step(
+            q_ref[0], k_ref[0], v_ref[0], m_ref[...], l_ref[...],
+            acc_ref[...], scale, causal, qi * block_q, kb * block_k)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+        acc_ref[...] = acc_new
+
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
+                          block_q, block_k, num_kb):
+    """Resident-K schedule: the whole K/V sequence for one head sits in
+    VMEM (fetched once per head); a fori_loop walks k-blocks with the
+    online-softmax recurrence, and causal q-tiles stop at the diagonal
+    (skipping both compute AND reads of the masked tail).  Fastest when
+    K/V fit in VMEM."""
+    q = q_ref[0]                          # (block_q, D)
+    qi = pl.program_id(1)
+    d = q.shape[-1]
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        return _online_softmax_step(q, kblk, vblk, m, l, acc, scale,
+                                    causal, qi * block_q, kb * block_k)
+
+    if causal:
+        upper = jnp.minimum(
+            (qi * block_q + block_q + block_k - 1) // block_k, num_kb)
+    else:
+        upper = num_kb
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+# resident-K schedule is used while K+V for one head fit comfortably in
+# VMEM (~16 MB/core); beyond that the 3D-grid streaming schedule keeps
+# VMEM bounded at O(block) regardless of T
+_VMEM_RESIDENT_BYTES = 10 * 1024 * 1024
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
+    b, h, t, d = q.shape
+    bh = b * h
+    qf = q.reshape(bh, t, d)
+    kf = k.reshape(bh, t, d)
+    vf = v.reshape(bh, t, d)
+    block_q = min(block_q, t)
+    while t % block_q:
+        block_q //= 2
+    block_k = block_q
+    num_kb = t // block_k
+    itemsize = jnp.dtype(q.dtype).itemsize
+    resident = 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES
+
+    if resident:
+        out = pl.pallas_call(
+            functools.partial(_attn_kernel_resident, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k, num_kb=num_kb),
+            grid=(bh, t // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j: (i, j, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            interpret=interpret,
+        )(qf, kf, vf)
+        return out.reshape(b, h, t, d)
+
+    grid = (bh, t // block_q, num_kb)
+    if causal:
+        # clamp masked k-blocks to the diagonal: repeated block indices
+        # skip the HBM->VMEM fetch (compute is gated by pl.when)
+        kv_index = lambda i, j, n: (i, jnp.minimum(n, j), 0)
+    else:
+        kv_index = lambda i, j, n: (i, n, 0)
+    out = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_kb=num_kb),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),     # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),     # normalizer
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accum
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)
+
+
+def _blocked_backward(q, k, v, g, causal, scale, block_q):
+    """Recompute-based gradients, q-block at a time: live memory is
+    O(block_q * T) instead of the dense O(T^2)."""
+    bh, t, d = q.shape
+    block_q = min(block_q, t)
+    while t % block_q:
+        block_q //= 2
+    nq = t // block_q
+    qb = q.reshape(bh, nq, block_q, d)
+    gb = g.reshape(bh, nq, block_q, d)
+
+    def one_block(carry, blk):
+        dk, dv = carry
+        qi, qblk, gblk = blk
+        s = jnp.einsum('bqd,bkd->bqk', qblk, k).astype(
+            jnp.float32) * scale                       # (bh, bq, T)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, t), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        pv = p.astype(v.dtype)
+        dp = jnp.einsum('bqd,bkd->bqk', gblk, v).astype(jnp.float32)
+        # softmax vjp: ds = p * (dp - sum(dp * p))
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        dq_blk = jnp.einsum('bqk,bkd->bqd', ds, k.astype(
+            jnp.float32)) * scale
+        dk = dk + jnp.einsum('bqk,bqd->bkd', ds, qblk.astype(
+            jnp.float32)) * scale
+        dv = dv + jnp.einsum('bqk,bqd->bkd', pv.astype(jnp.float32),
+                             gblk.astype(jnp.float32))
+        return (dk, dv), dq_blk.astype(q.dtype)
+
+    idx = jnp.arange(nq)
+    (dk, dv), dq_blocks = lax.scan(
+        one_block,
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+        (idx, qb.transpose(1, 0, 2, 3), gb.transpose(1, 0, 2, 3)))
+    dq = dq_blocks.transpose(1, 0, 2, 3).reshape(bh, t, d)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, interpret):
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, interpret):
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q,
+                           interpret), (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
+    q, k, v = res
+    b, h, t, d = q.shape
+    flat = lambda x: x.reshape(b * h, t, d)
+    dq, dk, dv = _blocked_backward(flat(q), flat(k), flat(v), flat(g),
+                                   causal, scale, block_q)
+    unflat = lambda x: x.reshape(b, h, t, d)
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    interpret=None):
+    """Streaming Pallas attention.
+
+    q, k, v: (batch, heads, seq, head_dim) with equal seq lengths
+    (square self-attention).  Returns the same shape.  On non-TPU
+    backends runs in Pallas interpret mode (slow but correct) unless
+    `interpret` is passed explicitly.
+    """
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(
+            'flash_attention requires square self-attention (equal '
+            'q/k/v shapes); got %s / %s / %s — use full_attention for '
+            'cross attention or KV-cache decode'
+            % (q.shape, k.shape, v.shape))
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if not _HAS_PALLAS:
+        from .parallel.ring_attention import full_attention
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    if interpret is None:
+        # Mosaic targets TPU only; interpret everywhere else (cpu, gpu)
+        interpret = jax.devices()[0].platform != 'tpu'
+    return _flash(q, k, v, bool(causal), float(scale), int(block_q),
+                  bool(interpret))

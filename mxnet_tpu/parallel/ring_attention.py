@@ -83,8 +83,14 @@ def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False):
     return fn(q, k, v)
 
 
-def full_attention(q, k, v, causal=False, scale=None):
-    """Single-device reference implementation (for tests)."""
+def full_attention(q, k, v, causal=False, scale=None, use_flash=False):
+    """Single-device attention.  use_flash=True routes (B, H, T, D)
+    inputs through the streaming Pallas kernel (pallas_ops.py) — same
+    numerics, no T^2 HBM scores, ~2x faster at long causal T."""
+    if use_flash and q.ndim == 4 and q.shape == k.shape == v.shape:
+        from .. import pallas_ops
+        return pallas_ops.flash_attention(q, k, v, causal=causal,
+                                          scale=scale)
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
