@@ -186,11 +186,170 @@ class Executor:
             self._fwd_eval = fwd_eval
             self._fwd_bwd = jax.jit(fwd_bwd_impl)
         self._stash = None
+        self._run_graph = run_graph
+        self._arg_pos = arg_pos
         # un-jitted graph functions (for AOT export / driver compile checks)
         self.raw_forward = lambda arg_vals, aux_vals, rng: \
             run_graph(arg_vals, aux_vals, rng, False)
         self.raw_forward_train = lambda arg_vals, aux_vals, rng: \
             run_graph(arg_vals, aux_vals, rng, True)
+
+    # ------------------------------------------------------------------
+    def make_fused_train_step(self, step_math):
+        """Compile forward + backward + optimizer update into ONE donated
+        XLA dispatch (the whole training step — no reference
+        counterpart; the reference pays per-op dispatch on all three
+        phases, graph_executor.cc:1236 + per-key optimizer pushes).
+
+        step_math(ws, gs, moms, masters, lrs, wds) ->
+            (new_ws, new_moms, new_masters)
+        is the optimizer's whole-model update math (FusedSGD.step).
+        Weights, aux states, momenta, and fp32 masters are donated, so
+        params update in place in HBM; the PRNG split happens inside the
+        step so the host issues exactly one dispatch per batch.
+
+        Returns None when this executor cannot fuse (ctx-group eager
+        mode).  Caller contract: every differentiable arg is a weight
+        updated by step_math (grad_req 'write'), in self._diff_names
+        order.
+
+        Implemented as the K=1 case of make_fused_multistep (no scan
+        wrapper, same step body).
+        """
+        return self.make_fused_multistep(step_math, (), repeat=1)
+
+    def make_fused_multistep(self, step_math, scan_names, repeat=None):
+        """K whole training steps (fwd+bwd+update) in ONE donated XLA
+        dispatch, looping on-device with lax.scan.
+
+        TPU-native analog of the reference's bulk-exec segments
+        (MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN, graph_executor.cc:1135):
+        where the reference amortizes engine-push overhead by fusing op
+        runs into segments, this amortizes the host->device dispatch
+        latency (dominant on tunneled/remote accelerators) over K full
+        steps, keeping the MXU busy back-to-back.
+
+        scan_names: args fed per-step (data/label).  In stacked mode
+        the caller passes them stacked on a leading K axis; with
+        `repeat=K` the currently bound batch is reused K times
+        (xs=None scan).  lr/wd are loop-invariant for the K steps.
+        """
+        if self._grouped:
+            return None
+        run_graph = self._run_graph
+        scan_set = set(scan_names)
+        diff_set = set(self._diff_names)
+        n_args = len(self._arg_names)
+        diff_idx = [i for i, n in enumerate(self._arg_names)
+                    if n in diff_set]
+        scan_idx = [i for i, n in enumerate(self._arg_names)
+                    if n in scan_set and n not in diff_set]
+        inv_idx = [i for i, n in enumerate(self._arg_names)
+                   if n not in diff_set and n not in scan_set]
+
+        def multistep(diff_vals, scan_vals, inv_vals, aux_vals, key,
+                      moms, masters, lrs, wds):
+            def run_one(diff_vals, aux_vals, moms, masters, key, sv):
+                key, sub = jax.random.split(key)
+
+                def f(dv):
+                    merged = [None] * n_args
+                    for i, v in zip(diff_idx, dv):
+                        merged[i] = v
+                    for i, v in zip(scan_idx, sv):
+                        merged[i] = v
+                    for i, v in zip(inv_idx, inv_vals):
+                        merged[i] = v
+                    outs, new_aux = run_graph(tuple(merged), aux_vals,
+                                              sub, True)
+                    return outs, new_aux
+
+                outs, vjp_fn, new_aux = jax.vjp(f, tuple(diff_vals),
+                                                has_aux=True)
+                heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+                grads, = vjp_fn(heads)
+                new_ws, new_moms, new_masters = step_math(
+                    list(diff_vals), list(grads), moms, masters, lrs,
+                    wds)
+                return (tuple(new_ws), new_aux, new_moms, new_masters,
+                        key, outs)
+
+            if repeat == 1:
+                # single step: no scan wrapper (keeps the whole body in
+                # one fusion scope and avoids a trip-count-1 while loop)
+                (new_ws, new_aux, new_moms, new_masters, key,
+                 outs) = run_one(tuple(diff_vals), aux_vals, moms,
+                                 masters, key, scan_vals)
+                return outs, new_aux, new_ws, new_moms, new_masters, key
+
+            out_shapes = jax.eval_shape(
+                lambda dv: run_one(dv, aux_vals, moms, masters, key,
+                                   jax.tree_util.tree_map(
+                                       lambda x: x[0], scan_vals)
+                                   if repeat is None else scan_vals)[5],
+                tuple(diff_vals))
+            outs0 = tuple(jnp.zeros(o.shape, o.dtype) for o in out_shapes)
+
+            def body(carry, xs):
+                diff_vals, aux_vals, moms, masters, key, _ = carry
+                sv = scan_vals if xs is None else xs
+                (new_ws, new_aux, new_moms, new_masters, key,
+                 outs) = run_one(diff_vals, aux_vals, moms, masters,
+                                 key, sv)
+                return (new_ws, new_aux, new_moms, new_masters, key,
+                        outs), None
+
+            init = (tuple(diff_vals), aux_vals, moms, masters, key,
+                    outs0)
+            if repeat is not None:
+                carry, _ = jax.lax.scan(body, init, None, length=repeat)
+            else:
+                carry, _ = jax.lax.scan(body, init, tuple(scan_vals))
+            new_ws, new_aux, new_moms, new_masters, key, outs = carry
+            return outs, new_aux, new_ws, new_moms, new_masters, key
+
+        return jax.jit(multistep, donate_argnums=(0, 3, 4, 5, 6))
+
+    def run_fused_multistep(self, step, diff_names, scan_names,
+                            scan_stacks, moms, masters, lrs, wds):
+        """Execute a step from make_fused_multistep over the bound
+        arrays.  scan_stacks: per-name stacked (K, ...) arrays, or None
+        in repeat mode (the bound batch is reused).  Returns (new_moms,
+        new_masters)."""
+        diff_set = set(diff_names)
+        scan_set = set(scan_names)
+        inv_names = [n for n in self._arg_names
+                     if n not in diff_set and n not in scan_set]
+        diff_vals = tuple(self.arg_dict[n]._data for n in diff_names)
+        if scan_stacks is not None:
+            scan_vals = tuple(scan_stacks[n] for n in self._arg_names
+                              if n in scan_set and n not in diff_set)
+        else:
+            scan_vals = tuple(self.arg_dict[n]._data
+                              for n in self._arg_names
+                              if n in scan_set and n not in diff_set)
+        inv_vals = tuple(self.arg_dict[n]._data for n in inv_names)
+        aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
+        with profiler.scope(self._name('fused_multistep')):
+            (outs, new_aux, new_ws, new_moms, new_masters,
+             self._key) = step(diff_vals, scan_vals, inv_vals, aux_vals,
+                               self._key, moms, masters, lrs, wds)
+            self._maybe_block(outs)
+        for n, w in zip(diff_names, new_ws):
+            self.arg_dict[n]._data = w
+        for n, v in zip(self._aux_names, new_aux):
+            self.aux_dict[n]._data = v
+        self._stash = None
+        self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
+        return new_moms, new_masters
+
+    def run_fused_train_step(self, step, diff_names, moms, masters,
+                             lrs, wds):
+        """Execute a step from make_fused_train_step over the bound
+        arrays and write everything back.  Returns (new_moms,
+        new_masters) for the optimizer to reclaim."""
+        return self.run_fused_multistep(step, diff_names, (), None,
+                                        moms, masters, lrs, wds)
 
     # ------------------------------------------------------------------
     def _gather(self):

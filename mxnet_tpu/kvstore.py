@@ -69,6 +69,7 @@ class KVStore:
             self._push_impl(key, value, priority)
 
     def _push_impl(self, key, value, priority=0):
+        import jax
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
@@ -78,7 +79,18 @@ class KVStore:
                 for v in vlist[1:]:
                     merged = merged + v
             if self._updater is not None:
-                self._updater(self._key_index(k), merged, self._store[k])
+                # gradients produced by a mesh-sharded step arrive
+                # replicated over the mesh; the stored weight may live
+                # on a single device — align the gradient with the
+                # weight's placement so the eager updater math runs on
+                # consistently-placed buffers
+                stored = self._store[k]
+                gsh = getattr(merged._data, 'sharding', None)
+                wsh = getattr(stored._data, 'sharding', None)
+                if gsh is not None and wsh is not None and gsh != wsh:
+                    merged = nd.NDArray(
+                        jax.device_put(merged._data, wsh), merged.context)
+                self._updater(self._key_index(k), merged, stored)
             else:
                 self._pending = getattr(self, '_pending', {})
                 self._pending[k] = merged
@@ -89,6 +101,7 @@ class KVStore:
             self._pull_impl(key, out, priority)
 
     def _pull_impl(self, key, out=None, priority=0):
+        import jax
         keys, outs = _ctype_key_value(key, out)
         for k, olist in zip(keys, outs):
             if k not in self._store:
@@ -98,7 +111,17 @@ class KVStore:
             if self._updater is None and k in pending:
                 src = pending[k]
             for o in olist:
-                o._data = src._data
+                # preserve the destination's mesh sharding: executor
+                # params are often replicated over a device mesh, and
+                # rebinding them to the store's (single-device) buffer
+                # would silently break the SPMD step's placement
+                val = src._data
+                dsh = getattr(o._data, 'sharding', None)
+                ssh = getattr(val, 'sharding', None)
+                if dsh is not None and dsh != ssh and \
+                        val.shape == o._data.shape:
+                    val = jax.device_put(val, dsh)
+                o._data = val
 
     # -- updater / optimizer ----------------------------------------------
     def _key_index(self, key):

@@ -59,6 +59,10 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # whole-step fusion (fwd+bwd+update in one donated XLA dispatch)
+        self._pending_fused = False
+        self._fused_step = None
+        self._fused_step_key = None
 
     # -- checkpoint (reference module.py:114-173) -------------------------
     @staticmethod
@@ -215,6 +219,7 @@ class Module(BaseModule):
         if force_rebind:
             self._exec_group = None
             self.binded = False
+            self._pending_fused = False
         if self.binded:
             self.logger.warning('Already binded, ignoring bind()')
             return
@@ -307,24 +312,175 @@ class Module(BaseModule):
         self.optimizer_initialized = True
 
     # -- per-batch ---------------------------------------------------------
+    def _fusable_step(self):
+        """True when the whole train step (fwd+bwd+update) can compile
+        into one donated XLA dispatch: a fused updater is active, the
+        executor is a single fused XLA module (no ctx groups / monitor),
+        no input grads are requested, and every differentiable arg is a
+        grad_req='write' parameter the updater owns."""
+        if self._fused_updater is None or not self.optimizer_initialized:
+            return False
+        if self.inputs_need_grad:
+            return False
+        ex = self._exec_group.executor
+        if ex._grouped or ex._monitor_callback is not None:
+            return False
+        fnames = [n for n, g in zip(self._param_names,
+                                    self._exec_group.grad_arrays)
+                  if g is not None]
+        if ex._diff_names != fnames:
+            return False
+        return all(ex._grad_req.get(n) == 'write' for n in fnames)
+
+    def _materialize_fused(self):
+        """A deferred step is pending but something other than update()
+        needs its results: fall back to the plain fwd+bwd execution
+        (grads land in grad_dict; update() then takes the two-dispatch
+        path — exactly the pre-fusion behavior)."""
+        if self._pending_fused:
+            self._pending_fused = False
+            self._exec_group.forward_backward()
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        self._materialize_fused()
         self._exec_group.forward(data_batch, is_train)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
+        self._materialize_fused()
         self._exec_group.backward(out_grads=out_grads)
 
     def forward_backward(self, data_batch):
-        """Fused fwd+bwd (one XLA execution)."""
+        """Fused fwd+bwd (one XLA execution).  When the whole step can
+        fuse (see _fusable_step), execution is deferred to update() so
+        forward+backward+optimizer run as ONE donated dispatch; any
+        other access (get_outputs, backward, ...) materializes the
+        plain fwd+bwd first."""
         assert self.binded and self.params_initialized
+        if self._fusable_step():
+            self._exec_group.load_data_batch(data_batch)
+            self._pending_fused = True
+            return
+        self._pending_fused = False
         self._exec_group.forward_backward(data_batch)
+
+    def _run_fused_step(self):
+        ex = self._exec_group.executor
+        fu = self._fused_updater
+        fnames = ex._diff_names
+        if fu.param_names != fnames:
+            fu.param_names = list(fnames)
+        weights = [ex.arg_dict[n] for n in fnames]
+        moms, masters, lrs, wds = fu.host_prep(weights)
+        # keyed on executor AND updater: init_optimizer(force_init=True)
+        # makes a new FusedSGD whose step_math bakes new hyperparams
+        if self._fused_step_key != (ex, fu):
+            self._fused_step = ex.make_fused_train_step(fu.step_math)
+            self._fused_step_key = (ex, fu)
+        new_moms, new_masters = ex.run_fused_train_step(
+            self._fused_step, fnames, moms, masters, lrs, wds)
+        fu.commit(new_moms, new_masters)
+
+    def bulk_step(self, batches=None, batch=None, repeat=None):
+        """Run several full training steps (forward+backward+optimizer
+        update) as ONE XLA dispatch, looping on-device.
+
+        TPU-native counterpart of the reference's bulk-exec segments
+        (MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN, graph_executor.cc:1135):
+        amortizes host dispatch latency over K steps — essential when
+        the accelerator sits behind a high-latency link.  Either pass
+        `batches` (list of DataBatch; stacked on a leading axis and
+        scanned) or `batch` + `repeat=K` (the one batch is reused K
+        times — synthetic/steady-state benchmarking).
+
+        Caveats vs the per-step loop: lr/wd schedules advance in units
+        of the bulk size (evaluated once per call), per-batch metrics
+        are unavailable (only the final step's outputs are kept), and
+        monitors don't fire.  Falls back to the plain loop when the
+        step cannot fuse.
+        """
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if batches is not None:
+            k = len(batches)
+        else:
+            assert batch is not None and repeat is not None
+            k = repeat
+        if k == 0:
+            return
+        if not self._fusable_step():
+            for b in (batches if batches is not None
+                      else [batch] * repeat):
+                self.forward_backward(b)
+                self.update()
+            return
+        self._materialize_fused()
+        import jax.numpy as jnp
+        eg = self._exec_group
+        ex = eg.executor
+        fu = self._fused_updater
+        fnames = ex._diff_names
+        if fu.param_names != fnames:
+            fu.param_names = list(fnames)
+        scan_names = [n for n in eg.data_names + eg.label_names
+                      if n in ex.arg_dict and n not in set(fnames)]
+        scan_stacks = None
+        if batches is not None:
+            if k == 1:
+                return self._single_step(batches[0])
+            eg.load_data_batch(batches[0])  # dtype/shape checks + cast
+            per_name = {n: [] for n in scan_names}
+            for b in batches:
+                vals = dict(zip(eg.data_names, b.data))
+                if eg.label_names and b.label:
+                    vals.update(zip(eg.label_names, b.label))
+                for n in scan_names:
+                    v = vals[n]
+                    v = v._data if isinstance(v, nd.NDArray) else \
+                        jnp.asarray(v)
+                    per_name[n].append(
+                        v.astype(ex.arg_dict[n].dtype))
+            scan_stacks = {n: jnp.stack(per_name[n])
+                           for n in scan_names}
+            if eg.mesh is not None:
+                from ..parallel import mesh as pmesh
+                scan_stacks = {
+                    n: pmesh.shard_batch(eg.mesh, v, dim=1)
+                    for n, v in scan_stacks.items()}
+            cache_key = (ex, fu, 'stacked', k)
+        else:
+            eg.load_data_batch(batch)
+            cache_key = (ex, fu, 'repeat', k)
+        weights = [ex.arg_dict[n] for n in fnames]
+        moms, masters, lrs, wds = fu.host_prep(weights)
+        for _ in range(k - 1):  # host_prep bumped counts once
+            for n in fnames:
+                self._optimizer._update_count(n)
+        if getattr(self, '_bulk_cache_key', None) != cache_key:
+            self._bulk_step_fn = ex.make_fused_multistep(
+                fu.step_math, scan_names,
+                repeat=(k if batches is None else None))
+            self._bulk_cache_key = cache_key
+        new_moms, new_masters = ex.run_fused_multistep(
+            self._bulk_step_fn, fnames, scan_names, scan_stacks,
+            moms, masters, lrs, wds)
+        fu.commit(new_moms, new_masters)
+        self._params_dirty = True
+
+    def _single_step(self, data_batch):
+        self.forward_backward(data_batch)
+        self.update()
 
     def update(self):
         """Reference module.py:615."""
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._pending_fused:
+            self._pending_fused = False
+            self._run_fused_step()
+            return
         if self._fused_updater is not None:
             weights, grads = [], []
             fnames = []
@@ -355,14 +511,17 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
+        self._materialize_fused()
         return self._exec_group.get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and \
             self.inputs_need_grad
+        self._materialize_fused()
         return self._exec_group.get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
+        self._materialize_fused()
         self._exec_group.update_metric(eval_metric, labels)
 
     # -- optimizer states --------------------------------------------------
@@ -390,6 +549,7 @@ class Module(BaseModule):
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
+        self._pending_fused = False  # bound buffers are replaced
         self._data_shapes = list(data_shapes)
         self._label_shapes = list(label_shapes) if label_shapes else []
         self._exec_group.reshape(self._data_shapes, self._label_shapes)

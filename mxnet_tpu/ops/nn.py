@@ -272,6 +272,27 @@ _CONV_DN = {1: ('NCW', 'OIW', 'NCW'),
             2: ('NCHW', 'OIHW', 'NCHW'),
             3: ('NCDHW', 'OIDHW', 'NCDHW')}
 
+_CONV_NHWC = None
+
+
+def _conv_prefer_nhwc():
+    """TPU MXU tiling prefers channels-minor; compute 2-D convs in NHWC
+    internally (user-facing layout stays NCHW — XLA cancels the
+    boundary transposes between consecutive layers).  Env override
+    MXNET_TPU_CONV_LAYOUT={nhwc,nchw,auto}; auto = NHWC on
+    accelerators, NCHW on the CPU backend."""
+    global _CONV_NHWC
+    if _CONV_NHWC is None:
+        import os
+        pref = os.environ.get('MXNET_TPU_CONV_LAYOUT', 'auto')
+        if pref == 'nhwc':
+            _CONV_NHWC = True
+        elif pref == 'nchw':
+            _CONV_NHWC = False
+        else:
+            _CONV_NHWC = jax.default_backend() != 'cpu'
+    return _CONV_NHWC
+
 
 @register('Convolution', input_names=_conv_names,
           infer_shape=_conv_infer_shape, hint='convolution')
@@ -282,6 +303,18 @@ def _convolution(attrs, data, weight, bias=None):
     dilate = astuple(attrs.get('dilate', (1,) * nd), nd)
     pad = astuple(attrs.get('pad', (0,) * nd), nd)
     num_group = asint(attrs.get('num_group', 1))
+    if nd == 2 and _conv_prefer_nhwc():
+        x = jnp.transpose(data, (0, 2, 3, 1))
+        w = jnp.transpose(weight, (2, 3, 1, 0))  # OIHW -> HWIO
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate,
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'),
+            feature_group_count=num_group)
+        if bias is not None:
+            out = out + bias.reshape((1, 1, 1, -1))
+        return jnp.transpose(out, (0, 3, 1, 2))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
@@ -408,12 +441,16 @@ def _bn_infer_dtype(attrs, in_dtypes):
 
 
 def _bn_compute(attrs, inputs, auxs, op_ctx):
+    """HBM-friendly formulation: statistics in ONE pass over the data
+    (fused convert+sum of x and x**2 with fp32 accumulation — the
+    two-pass mean/var costs an extra full read of the activation), and
+    the normalize applied as a per-channel scale/shift multiply-add in
+    the input dtype, so the elementwise pass moves bf16 bytes while all
+    statistic math stays fp32 (the reference's cuDNN BN keeps fp32
+    stats for fp16 data the same way)."""
     data, gamma, beta = inputs
     moving_mean, moving_var = auxs
     in_dtype = data.dtype
-    if data.dtype != jnp.float32:
-        # normalize in fp32 (stats precision), emit in the compute dtype
-        data = data.astype(jnp.float32)
     eps = asfloat(attrs.get('eps', 1e-3))
     momentum = asfloat(attrs.get('momentum', 0.9))
     fix_gamma = asbool(attrs.get('fix_gamma', True))
@@ -425,21 +462,45 @@ def _bn_compute(attrs, inputs, auxs, op_ctx):
     bshape = tuple(shape)
     if fix_gamma:
         gamma = lax.stop_gradient(jnp.ones_like(gamma))
+    gamma = gamma.astype(jnp.float32)
+    beta = beta.astype(jnp.float32)
     red = tuple(i for i in range(data.ndim) if i != axis)
+
+    def apply(mean, var):
+        scale = gamma * lax.rsqrt(var + eps)
+        shift = beta - mean * scale
+        out = data * scale.astype(in_dtype).reshape(bshape) + \
+            shift.astype(in_dtype).reshape(bshape)
+        return out.astype(in_dtype)
+
     if op_ctx.is_train and not use_global:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        nelem = 1
+        for i in red:
+            nelem *= data.shape[i]
+        dataf = data.astype(jnp.float32)
+        if data.dtype == jnp.float32:
+            # full precision: two-pass variance (E[(x-m)^2]) — the
+            # one-pass E[x^2]-m^2 cancels catastrophically when
+            # |mean| >> std, and for f32 data the extra read is the
+            # accuracy-bearing path, not the perf path
+            mean = jnp.mean(dataf, axis=red)
+            var = jnp.var(dataf, axis=red)
+        else:
+            # low precision (the training hot path): one pass over the
+            # activation for both sums; the input's own quantization
+            # (bf16 ~0.4% relative) dominates the cancellation error
+            # for any realistically-normalized activation
+            mean = jnp.sum(dataf, axis=red) / nelem
+            var = jnp.maximum(
+                jnp.sum(dataf * dataf, axis=red) / nelem - mean * mean,
+                0.0)
         smean, svar = lax.stop_gradient(mean), lax.stop_gradient(var)
         new_mean = moving_mean * momentum + smean * (1 - momentum)
         new_var = moving_var * momentum + svar * (1 - momentum)
-        out = (data - mean.reshape(bshape)) * lax.rsqrt(
-            var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
-        out = out.astype(in_dtype)
-        outs = [out, mean, var] if output_mean_var else [out]
+        outs = [apply(mean, var), mean, var] if output_mean_var \
+            else [apply(mean, var)]
         return outs, [new_mean, new_var]
-    out = (data - moving_mean.reshape(bshape)) * lax.rsqrt(
-        moving_var.reshape(bshape) + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
-    out = out.astype(in_dtype)
+    out = apply(moving_mean, moving_var)
     outs = [out, moving_mean, moving_var] if output_mean_var else [out]
     return outs, [moving_mean, moving_var]
 

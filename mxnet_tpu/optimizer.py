@@ -603,11 +603,16 @@ class FusedSGD:
             return new_ws, new_moms, new_masters
 
         self.multi_precision = multi_precision
+        self.step_math = step
         self._jit_step = jax.jit(step, donate_argnums=(0, 2, 3))
 
-    def __call__(self, weights, grads):
-        """weights/grads: lists of NDArray aligned with param_names.
-        Updates weights in place (rebinding device buffers)."""
+    def host_prep(self, weights):
+        """Per-step host-side bookkeeping shared by the standalone
+        update and the whole-step fusion (executor.make_fused_train_step):
+        lazily create momenta / fp32 masters, bump update counts, and
+        evaluate lr/wd schedules.  Returns (moms, masters, lrs, wds)
+        aligned with param_names."""
+        import jax
         import jax.numpy as jnp
         opt = self.optimizer
         for name, w in zip(self.param_names, weights):
@@ -615,7 +620,14 @@ class FusedSGD:
                 (np.dtype(np.float16), jnp.bfloat16)
             if name not in self.states:
                 mdtype = np.float32 if mp else w.dtype
-                self.states[name] = jnp.zeros(w.shape, dtype=mdtype)
+                # commit fresh state to the weight's placement: an
+                # uncommitted zeros on call 1 vs a committed donated
+                # output on call 2 changes the jit sharding signature
+                # and forces a full recompile of the fused step
+                sharding = getattr(w._data, 'sharding', None)
+                zeros = jnp.zeros(w.shape, dtype=mdtype)
+                self.states[name] = jax.device_put(zeros, sharding) \
+                    if sharding is not None else zeros
             if name not in self.masters:
                 # backfill (fresh start or restored checkpoint without
                 # masters): re-derive from the current weight
@@ -626,17 +638,27 @@ class FusedSGD:
             opt._update_count(name)
             lrs.append(opt._get_lr(name))
             wds.append(opt._get_wd(name))
-        ws = [w._data for w in weights]
-        gs = [g._data for g in grads]
         moms = [self.states[n] for n in self.param_names]
         masters = [self.masters[n] for n in self.param_names]
+        return moms, masters, lrs, wds
+
+    def commit(self, new_moms, new_masters):
+        """Write back optimizer state returned by a step execution."""
+        for n, nm, nmw in zip(self.param_names, new_moms, new_masters):
+            self.states[n] = nm
+            self.masters[n] = nmw
+
+    def __call__(self, weights, grads):
+        """weights/grads: lists of NDArray aligned with param_names.
+        Updates weights in place (rebinding device buffers)."""
+        moms, masters, lrs, wds = self.host_prep(weights)
+        ws = [w._data for w in weights]
+        gs = [g._data for g in grads]
         new_ws, new_moms, new_masters = self._jit_step(
             ws, gs, moms, masters, lrs, wds)
         for w, nw in zip(weights, new_ws):
             w._data = nw
-        for n, nm, nmw in zip(self.param_names, new_moms, new_masters):
-            self.states[n] = nm
-            self.masters[n] = nmw
+        self.commit(new_moms, new_masters)
 
     # checkpoint compatibility with Updater.get_states/set_states
     def get_states(self):

@@ -56,9 +56,11 @@ def replicated(mesh):
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh, array, axis='data'):
-    """Place a jax array batch-sharded over the mesh."""
-    spec = P(*([axis] + [None] * (array.ndim - 1)))
+def shard_batch(mesh, array, axis='data', dim=0):
+    """Place a jax array sharded over the mesh along dimension `dim`
+    (the batch dim; dim=1 for K-stacked bulk batches)."""
+    spec = P(*([None] * dim + [axis] +
+               [None] * (array.ndim - dim - 1)))
     return jax.device_put(array, NamedSharding(mesh, spec))
 
 

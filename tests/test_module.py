@@ -205,3 +205,99 @@ def test_metrics():
     assert abs(mse.get()[1] - 0.25) < 1e-6
     comp = mx.metric.create(['acc', 'mse'])
     assert isinstance(comp, mx.metric.CompositeEvalMetric)
+
+
+def _bulk_mod(ctxs, ap=None, ax=None, batch=16, kvstore='local'):
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, name='fc1', num_hidden=16)
+    act = sym.Activation(fc1, act_type='relu')
+    fc2 = sym.FullyConnected(act, name='fc2', num_hidden=4)
+    net = sym.SoftmaxOutput(fc2, name='softmax')
+    mod = mx.mod.Module(net, context=ctxs)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 8))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
+    if ap is None:
+        mod.init_params(initializer=mx.init.Xavier())
+    else:
+        mod.init_params(initializer=None, arg_params=ap, aux_params=ax)
+    mod.init_optimizer(kvstore=kvstore, optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    return mod
+
+
+@pytest.mark.parametrize('n_ctx,kvstore', [(1, 'local'), (4, 'local'),
+                                           (4, None)])
+def test_bulk_step_matches_per_step_loop(n_ctx, kvstore):
+    """Module.bulk_step (K steps in one on-device lax.scan dispatch —
+    the TPU analog of the reference's bulk-exec segments,
+    graph_executor.cc:1135) must produce the same parameters as the
+    plain forward_backward+update loop.  (4, 'local') exercises the
+    kvstore fallback loop; (4, None) the fused mesh-sharded scan path
+    with the stacked batch sharded along dim 1."""
+    rng = np.random.RandomState(0)
+    batches = [mx.io.DataBatch(
+        data=[nd.array(rng.rand(16, 8).astype(np.float32))],
+        label=[nd.array((rng.rand(16) * 4).astype(np.float32))])
+        for _ in range(5)]
+    seed_mod = _bulk_mod([mx.cpu(0)])
+    ap, ax = seed_mod.get_params()
+    ap = {k: v.copy() for k, v in ap.items()}
+    ax = {k: v.copy() for k, v in ax.items()}
+    ctxs = [mx.cpu(i) for i in range(n_ctx)]
+    a = _bulk_mod(ctxs, ap, ax, kvstore=kvstore)
+    b = _bulk_mod(ctxs, ap, ax, kvstore=kvstore)
+    c = _bulk_mod(ctxs, ap, ax, kvstore=kvstore)
+    d = _bulk_mod(ctxs, ap, ax, kvstore=kvstore)
+    if kvstore is None:
+        assert b._fused_updater is not None, \
+            'kvstore=None must enable the fused whole-step path'
+    for bt in batches:
+        a.forward_backward(bt)
+        a.update()
+    b.bulk_step(batches=batches)
+    pa, _ = a.get_params()
+    pb, _ = b.get_params()
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-5)
+    # repeat mode: K steps on one batch == per-step loop on that batch
+    c.bulk_step(batch=batches[0], repeat=3)
+    for _ in range(3):
+        d.forward_backward(batches[0])
+        d.update()
+    pc, _ = c.get_params()
+    pd, _ = d.get_params()
+    for k in pc:
+        np.testing.assert_allclose(pc[k].asnumpy(), pd[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_fused_step_deferred_materialization():
+    """forward_backward defers when the whole step can fuse; accessing
+    outputs before update() must still yield correct results, and the
+    fused path must match the unfused two-dispatch path."""
+    rng = np.random.RandomState(1)
+    bt = mx.io.DataBatch(
+        data=[nd.array(rng.rand(16, 8).astype(np.float32))],
+        label=[nd.array((rng.rand(16) * 4).astype(np.float32))])
+    seed_mod = _bulk_mod([mx.cpu(0)])
+    ap, ax = seed_mod.get_params()
+    ap = {k: v.copy() for k, v in ap.items()}
+    ax = {k: v.copy() for k, v in ax.items()}
+    a = _bulk_mod([mx.cpu(0)], ap, ax)
+    b = _bulk_mod([mx.cpu(0)], ap, ax)
+    # a: read outputs between fwd_bwd and update (materialization path)
+    a.forward_backward(bt)
+    out_a = a.get_outputs()[0].asnumpy()
+    a.update()
+    # b: straight fused path
+    b.forward_backward(bt)
+    b.update()
+    out_b = b.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(out_a, out_b, rtol=1e-5, atol=1e-6)
+    pa, _ = a.get_params()
+    pb, _ = b.get_params()
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-5)
