@@ -70,6 +70,18 @@ class Executor:
         arg_pos = {n: i for i, n in enumerate(self._arg_names)}
         aux_pos = {n: i for i, n in enumerate(self._aux_names)}
         out_entries = [(node_index[id(n)], i) for n, i in sym._outputs]
+        # shape-carrying init ops (zeros(shape=(0,H)) from rnn
+        # begin_state) need their bidirectionally-resolved output
+        # shapes at execution time
+        node_shapes = {}
+        if any(n.op is not None and n.op.needs_out_shapes for n in topo):
+            known = {name: tuple(a.shape)
+                     for name, a in self.arg_dict.items()}
+            known.update({name: tuple(a.shape)
+                          for name, a in self.aux_dict.items()})
+            by_id = sym._infer_node_shapes(known)
+            node_shapes = {node_index[nid]: v for nid, v in by_id.items()
+                           if nid in node_index}
 
         def run_graph(arg_vals, aux_vals, rng, is_train, collect_all=False):
             """Evaluate the DAG; returns (outputs, new_aux_tuple), plus
@@ -92,7 +104,9 @@ class Executor:
                 auxs = vals[len(vals) - n_aux:] if n_aux else []
                 op_ctx = OpContext(
                     is_train=is_train,
-                    rng=jax.random.fold_in(rng, ni) if op.needs_rng else None)
+                    rng=jax.random.fold_in(rng, ni) if op.needs_rng else None,
+                    out_shapes=node_shapes.get(ni)
+                    if op.needs_out_shapes else None)
                 group = node.user_attrs.get('ctx_group')
                 if group is not None and group in self._group2dev:
                     # grouped (model-parallel) execution: inputs
@@ -108,7 +122,7 @@ class Executor:
                         op_ctx.rng = jax.device_put(op_ctx.rng, dev)
                 outs, updated = op.apply(node.attrs, args, auxs, op_ctx)
                 results[ni] = outs
-                if op.mutable_aux and is_train and updated:
+                if op.mutable_aux and (is_train or op.aux_always) and updated:
                     for (src, _), newv in zip(
                             in_entries[len(vals) - n_aux:], updated):
                         if src.op is None and src.name in aux_pos:

@@ -419,7 +419,7 @@ def invoke(op_name, inputs, attrs, out=None):
         outs, new_auxs = op.apply(attrs, in_data, aux_data, op_ctx)
     ctx = args[0]._ctx if args else _attr_ctx(attrs)
     results = [NDArray(o, ctx) for o in outs]
-    if op.mutable_aux and is_train:
+    if op.mutable_aux and (is_train or op.aux_always):
         for holder, new in zip(auxs, new_auxs):
             holder._data = new
     if _autograd.is_recording():

@@ -224,3 +224,147 @@ _register_op('Custom', input_names=_custom_input_names,
              num_outputs=_custom_num_outputs,
              infer_shape=_custom_infer_shape, mode_dependent=True,
              hint='custom', simple=False)(_custom_compute)
+
+
+# ---------------------------------------------------------------------------
+# Legacy pre-CustomOp python op bridges: PythonOp / NumpyOp (_Native) /
+# NDArrayOp (_NDArray) — reference python/mxnet/operator.py:36-382 with
+# C sides src/operator/custom/native_op.cc and ndarray_op.cc.  The
+# v0.8-era API: the op INSTANCE (not a Prop class) carries
+# forward/backward/infer_shape, and get_symbol() captures it.  Instances
+# are kept in a process-level table; the symbol attr carries the handle
+# (the reference passes the same thing as a pointer-valued attr).
+# ---------------------------------------------------------------------------
+
+class PythonOp(object):
+    """Base class for legacy python ops (reference operator.py:36)."""
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError('use NumpyOp or NDArrayOp')
+
+    def forward(self, in_data, out_data):
+        """Write outputs into out_data (numpy arrays / NDArrays)."""
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        """Write input gradients into in_grad."""
+        raise NotImplementedError
+
+    def infer_shape(self, in_shape):
+        """Returns (in_shape, out_shape)."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs())
+
+    def list_outputs(self):
+        return ['output']
+
+    def list_arguments(self):
+        return ['data']
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+_LEGACY_OPS = {}
+
+
+def _legacy_instance(attrs):
+    return _LEGACY_OPS[int(parse_attr_value(attrs['info']))]
+
+
+def _legacy_input_names(attrs):
+    return list(_legacy_instance(attrs).list_arguments())
+
+
+def _legacy_num_outputs(attrs):
+    return len(_legacy_instance(attrs).list_outputs())
+
+
+def _legacy_infer_shape(attrs, in_shapes):
+    if any(s is None for s in in_shapes):
+        return in_shapes
+    op = _legacy_instance(attrs)
+    new_in, _ = op.infer_shape([list(s) for s in in_shapes])
+    return [tuple(s) for s in new_in]
+
+
+@register('_legacy_bridge')
+class _LegacyAdapterProp(CustomOpProp):
+    """Adapts a legacy PythonOp instance onto the CustomOp host-callback
+    bridge, so _Native/_NDArray share one pure_callback + custom_vjp
+    implementation (device placement and per-tensor dtypes included)."""
+
+    def __init__(self, info, **kwargs):
+        super().__init__(need_top_grad=True)
+        self._legacy = _LEGACY_OPS[int(info)]
+
+    def list_arguments(self):
+        return self._legacy.list_arguments()
+
+    def list_outputs(self):
+        return self._legacy.list_outputs()
+
+    def infer_shape(self, in_shape):
+        ins, outs = self._legacy.infer_shape(in_shape)
+        return ins, outs, []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        legacy = self._legacy
+
+        class _Adapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                legacy.forward(in_data, out_data)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                legacy.backward(out_grad, in_data, out_data, in_grad)
+
+        return _Adapter()
+
+
+def _legacy_compute(attrs, inputs, auxs, op_ctx):
+    bridged = {'op_type': '_legacy_bridge',
+               'info': str(parse_attr_value(attrs['info']))}
+    params = (_attrs_key(bridged), bool(op_ctx.is_train))
+    out = _custom_fn(params, *inputs)
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return list(out), []
+
+
+for _legacy_name in ('_Native', '_NDArray'):
+    _register_op(_legacy_name, input_names=_legacy_input_names,
+                 num_outputs=_legacy_num_outputs,
+                 infer_shape=_legacy_infer_shape, mode_dependent=True,
+                 hint=_legacy_name.lstrip('_').lower(),
+                 simple=False)(_legacy_compute)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy-function op (reference operator.py:143; C side
+    native_op.cc).  forward/backward receive numpy arrays."""
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as _sym
+        self.info_ = max(_LEGACY_OPS) + 1 if _LEGACY_OPS else 0
+        _LEGACY_OPS[self.info_] = self
+        return _sym._Native(*args, **dict(kwargs, info=str(self.info_)))
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray-function op (reference operator.py:243; C side
+    ndarray_op.cc).  Same flow as NumpyOp on this substrate — the
+    callback receives host arrays either way; kept as a distinct class
+    and op name for script compatibility."""
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as _sym
+        self.info_ = max(_LEGACY_OPS) + 1 if _LEGACY_OPS else 0
+        _LEGACY_OPS[self.info_] = self
+        return _sym._NDArray(*args, **dict(kwargs, info=str(self.info_)))

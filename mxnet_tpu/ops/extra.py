@@ -12,6 +12,7 @@ import functools
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .registry import register, asbool, asint, asfloat
 from ..base import parse_attr_value
@@ -200,3 +201,121 @@ def _linalg_syrk(attrs, a):
     alpha = asfloat(attrs.get('alpha', 1.0))
     at = _tr(a, ta)
     return alpha * jnp.matmul(at, jnp.swapaxes(at, -1, -2))
+
+
+# ---------------------------------------------------------------------------
+# Fork-specific ops: LSoftmax / MultiLogistic / WeightedL1
+# (reference src/operator/lsoftmax-inl.h, multi_logistic-inl.h,
+# weighted_l1-inl.h — custom ops of the zipingzhao fork)
+# ---------------------------------------------------------------------------
+
+def _lsoftmax_infer_shape(attrs, in_shapes):
+    num_hidden = asint(attrs['num_hidden'])
+    if in_shapes[0] is not None:
+        n, d = in_shapes[0]
+        if in_shapes[1] is None:
+            in_shapes[1] = (num_hidden, d)
+        if in_shapes[2] is None:
+            in_shapes[2] = (n,)
+    return in_shapes
+
+
+@register('LSoftmax', input_names=('data', 'weight', 'label'),
+          num_outputs=3,
+          output_names=('output', 'data_norm', 'weight_norm'),
+          infer_shape=_lsoftmax_infer_shape, mode_dependent=True,
+          simple=False, hint='lsoftmax')
+def _lsoftmax(attrs, inputs, auxs, op_ctx):
+    """Large-Margin Softmax inner product (reference lsoftmax-inl.h;
+    Liu et al. 2016): out = x.w^T, but the label column becomes
+    (((-1)^k cos(m.theta) - 2k)|x||w_yi| + beta*fo) / (1+beta) in train
+    mode.  The discrete angle-bin k is a constant in the gradient
+    (stop_gradient), matching the reference's hand-derived backward."""
+    x, w, label = inputs
+    margin = asint(attrs.get('margin', 2))
+    beta = asfloat(attrs.get('beta', 1.0))
+    out = x @ w.T
+    x_norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1))
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(w), axis=1))
+    if not op_ctx.is_train:
+        return [out, x_norm, w_norm], []
+    n = x.shape[0]
+    yi = label.astype(jnp.int32)
+    rows = jnp.arange(n)
+    fo = out[rows, yi]
+    wn_yi = w_norm[yi]
+    cos_t = fo / (x_norm * wn_yi)
+    # k: which [cos((k+1)pi/m), cos(k pi/m)] bin cos_t falls in
+    ktab = jnp.cos(jnp.arange(1, margin + 1) * (np.pi / margin))
+    k = lax.stop_gradient(
+        jnp.sum(cos_t[:, None] < ktab[None, :], axis=1))
+    # cos(m t) by the binomial expansion over cos^2/sin^2
+    sin2_t = 1.0 - cos_t * cos_t
+    cos_mt = jnp.zeros_like(cos_t)
+    from math import comb
+    for p in range(margin // 2 + 1):
+        term = ((-1.0) ** p) * comb(margin, 2 * p) * \
+            jnp.power(cos_t, margin - 2 * p) * jnp.power(sin2_t, p)
+        cos_mt = cos_mt + term
+    sign_k = 1.0 - 2.0 * (k % 2).astype(out.dtype)
+    f = (sign_k * cos_mt - 2.0 * k.astype(out.dtype)) * (wn_yi * x_norm)
+    newval = (f + beta * fo) / (1.0 + beta)
+    out = out.at[rows, yi].set(newval)
+    return [out, x_norm, w_norm], []
+
+
+def _reg_loss_like(name, fwd_fn, grad_fn, hint):
+    """Loss-style op: forward is elementwise, backward IGNORES the head
+    gradient (reference OperatorProperty loss ops — the gradient is a
+    function of (out, label) only)."""
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+    def fn(params, data, label):
+        return fwd_fn(data)
+
+    def fwd_rule(params, data, label):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def bwd_rule(params, res, g):
+        out, label = res
+        return (grad_fn(params, out, label), jnp.zeros_like(label))
+
+    fn.defvjp(fwd_rule, bwd_rule)
+    return fn
+
+
+_multi_logistic_fn = _reg_loss_like(
+    'MultiLogistic', jax.nn.sigmoid,
+    lambda params, out, label: params[0] * (
+        (out - label) * label * params[1] + (out - label) * (1 - label)),
+    'multilogistic')
+
+
+@register('MultiLogistic', input_names=('data', 'label'),
+          hint='multilogistic',
+          infer_shape=lambda attrs, s: (
+              s if s[0] is None or s[1] is not None else [s[0], s[0]]))
+def _multi_logistic(attrs, data, label):
+    """Multi-label logistic output with positive-class weighting
+    (reference multi_logistic-inl.h: grad = grad_scale*((out-label)*
+    label*weight + (out-label)*(1-label)))."""
+    params = (asfloat(attrs.get('grad_scale', 1.0)),
+              asfloat(attrs.get('weight', 1.0)))
+    return _multi_logistic_fn(params, data, label)
+
+
+_weighted_l1_fn = _reg_loss_like(
+    'WeightedL1', lambda x: x,
+    lambda params, out, label: params[0] * jnp.sign(out - label) *
+    (label > 0).astype(out.dtype),
+    'weightedl1')
+
+
+@register('WeightedL1', input_names=('data', 'label'), hint='weightedl1',
+          infer_shape=lambda attrs, s: (
+              s if s[0] is None or s[1] is not None else [s[0], s[0]]))
+def _weighted_l1(attrs, data, label):
+    """L1 regression masked to positive labels (reference
+    weighted_l1-inl.h: grad = grad_scale*sign(out-label)*(label>0))."""
+    params = (asfloat(attrs.get('grad_scale', 1.0)),)
+    return _weighted_l1_fn(params, data, label)

@@ -42,15 +42,30 @@ def _fc_infer_shape(attrs, in_shapes):
     flatten = asbool(attrs.get('flatten', True))
     if in_shapes[0] is not None and in_shapes[1] is None:
         d = in_shapes[0]
-        in_dim = int(np.prod(d[1:])) if flatten else d[-1]
-        in_shapes[1] = (num_hidden, in_dim)
+        # feature dims must be fully known (batch may still be the
+        # unknown 0 placeholder) before the weight shape can backfill
+        if all(x != 0 for x in d[1:]):
+            in_dim = int(np.prod(d[1:])) if flatten else d[-1]
+            in_shapes[1] = (num_hidden, in_dim)
     if len(in_shapes) > 2 and in_shapes[2] is None:
         in_shapes[2] = (num_hidden,)
     return in_shapes
 
 
+def _fc_infer_shape_bwd(attrs, in_shapes, out_shapes):
+    """Batch dim flows output -> data (bidirectional InferShape:
+    resolves zeros(shape=(0, H)) initial states fed through h2h
+    projections, reference rnn begin_state)."""
+    out = out_shapes[0] if out_shapes else None
+    d = in_shapes[0]
+    if out is not None and out[0] != 0 and d is not None and d[0] == 0:
+        in_shapes[0] = (out[0],) + tuple(d[1:])
+    return in_shapes
+
+
 @register('FullyConnected', input_names=_fc_names,
-          infer_shape=_fc_infer_shape, hint='fullyconnected')
+          infer_shape=_fc_infer_shape, infer_shape_bwd=_fc_infer_shape_bwd,
+          hint='fullyconnected')
 def _fully_connected(attrs, data, weight, bias=None):
     flatten = asbool(attrs.get('flatten', True))
     if flatten:
@@ -295,7 +310,8 @@ def _conv_prefer_nhwc():
 
 
 @register('Convolution', input_names=_conv_names,
-          infer_shape=_conv_infer_shape, hint='convolution')
+          infer_shape=_conv_infer_shape, hint='convolution',
+          aliases=('Convolution_v1',))
 def _convolution(attrs, data, weight, bias=None):
     kernel = astuple(attrs['kernel'])
     nd = len(kernel)

@@ -1,0 +1,167 @@
+"""Standalone optimizer-update operators.
+
+Rebuild of the reference's graph-level optimizer ops
+(/root/reference src/operator/optimizer_op.cc:36-212 — sgd_update,
+sgd_mom_update, mp_sgd_update, mp_sgd_mom_update, adam_update,
+rmsprop_update, rmspropalex_update; kernels in optimizer_op-inl.h).
+The reference mutates the state tensors (momentum/mean/var/...) in
+place inside the kernel; here the states are auxiliary inputs with
+`aux_always` mutation, so `nd.sgd_mom_update(w, g, mom, out=w, lr=...)`
+updates both the weight (via out=) and the momentum buffer exactly like
+the reference, while the math itself is pure and jit-safe.
+
+The fused whole-model updater (optimizer.py FusedSGD) is the fast path
+Module uses; these ops exist for API/graph parity and for users who
+compose update steps manually.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .registry import register, asfloat
+
+
+def _prep_grad(grad, attrs, dtype):
+    rescale = asfloat(attrs.get('rescale_grad', 1.0))
+    clip = asfloat(attrs.get('clip_gradient', -1.0))
+    g = grad.astype(dtype) * rescale
+    if clip >= 0.0:
+        g = jnp.clip(g, -clip, clip)
+    return g
+
+
+@register('sgd_update', input_names=('weight', 'grad'), hint='sgd_update')
+def _sgd_update(attrs, weight, grad):
+    """weight = (1 - lr*wd)*weight - lr*clip(rescale*grad)
+    (reference optimizer_op-inl.h SGDKernel)."""
+    lr = asfloat(attrs['lr'])
+    wd = asfloat(attrs.get('wd', 0.0))
+    g = _prep_grad(grad, attrs, weight.dtype)
+    return (1.0 - lr * wd) * weight - lr * g
+
+
+@register('sgd_mom_update', input_names=('weight', 'grad', 'mom'),
+          num_aux=1, mutable_aux=True, aux_always=True, simple=False,
+          hint='sgd_mom_update')
+def _sgd_mom_update(attrs, inputs, auxs, op_ctx):
+    """mom = momentum*mom - lr*wd*weight - lr*clip(rescale*grad);
+    weight += mom (reference SGDMomKernel)."""
+    weight, grad = inputs
+    mom, = auxs
+    lr = asfloat(attrs['lr'])
+    wd = asfloat(attrs.get('wd', 0.0))
+    momentum = asfloat(attrs.get('momentum', 0.0))
+    g = _prep_grad(grad, attrs, weight.dtype)
+    new_mom = momentum * mom - lr * wd * weight - lr * g
+    return [weight + new_mom], [new_mom]
+
+
+@register('mp_sgd_update', input_names=('weight', 'grad', 'weight32'),
+          num_aux=1, mutable_aux=True, aux_always=True, simple=False,
+          hint='mp_sgd_update')
+def _mp_sgd_update(attrs, inputs, auxs, op_ctx):
+    """Multi-precision SGD: math on the fp32 master, low-precision
+    weight is its cast (reference MP_SGDKernel)."""
+    weight, grad = inputs
+    weight32, = auxs
+    lr = asfloat(attrs['lr'])
+    wd = asfloat(attrs.get('wd', 0.0))
+    g = _prep_grad(grad, attrs, jnp.float32)
+    w = (1.0 - lr * wd) * weight32 - lr * g
+    return [w.astype(weight.dtype)], [w]
+
+
+@register('mp_sgd_mom_update',
+          input_names=('weight', 'grad', 'mom', 'weight32'),
+          num_aux=2, mutable_aux=True, aux_always=True, simple=False,
+          hint='mp_sgd_mom_update')
+def _mp_sgd_mom_update(attrs, inputs, auxs, op_ctx):
+    """Multi-precision momentum SGD (reference MP_SGDMomKernel)."""
+    weight, grad = inputs
+    mom, weight32 = auxs
+    lr = asfloat(attrs['lr'])
+    wd = asfloat(attrs.get('wd', 0.0))
+    momentum = asfloat(attrs.get('momentum', 0.0))
+    g = _prep_grad(grad, attrs, jnp.float32)
+    new_mom = momentum * mom - lr * wd * weight32 - lr * g
+    w = weight32 + new_mom
+    return [w.astype(weight.dtype)], [new_mom, w]
+
+
+@register('adam_update', input_names=('weight', 'grad', 'mean', 'var'),
+          num_aux=2, mutable_aux=True, aux_always=True, simple=False,
+          hint='adam_update')
+def _adam_update(attrs, inputs, auxs, op_ctx):
+    """mean/var EMA then weight -= lr*mean/(sqrt(var)+eps)
+    (reference AdamUpdate; wd folds into the gradient)."""
+    weight, grad = inputs
+    mean, var = auxs
+    lr = asfloat(attrs['lr'])
+    beta1 = asfloat(attrs.get('beta1', 0.9))
+    beta2 = asfloat(attrs.get('beta2', 0.999))
+    eps = asfloat(attrs.get('epsilon', 1e-8))
+    wd = asfloat(attrs.get('wd', 0.0))
+    rescale = asfloat(attrs.get('rescale_grad', 1.0))
+    clip = asfloat(attrs.get('clip_gradient', -1.0))
+    g = grad.astype(weight.dtype) * rescale + wd * weight
+    if clip >= 0.0:
+        g = jnp.clip(g, -clip, clip)
+    new_mean = beta1 * mean + (1.0 - beta1) * g
+    new_var = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    out = weight - lr * new_mean / (jnp.sqrt(new_var) + eps)
+    return [out], [new_mean, new_var]
+
+
+@register('rmsprop_update', input_names=('weight', 'grad', 'n'),
+          num_aux=1, mutable_aux=True, aux_always=True, simple=False,
+          hint='rmsprop_update')
+def _rmsprop_update(attrs, inputs, auxs, op_ctx):
+    """Tieleman & Hinton RMSProp (reference RMSPropUpdate)."""
+    weight, grad = inputs
+    n, = auxs
+    lr = asfloat(attrs['lr'])
+    gamma1 = asfloat(attrs.get('gamma1', 0.95))
+    eps = asfloat(attrs.get('epsilon', 1e-8))
+    wd = asfloat(attrs.get('wd', 0.0))
+    rescale = asfloat(attrs.get('rescale_grad', 1.0))
+    clip = asfloat(attrs.get('clip_gradient', -1.0))
+    clip_w = asfloat(attrs.get('clip_weights', -1.0))
+    g = grad.astype(weight.dtype) * rescale + wd * weight
+    if clip >= 0.0:
+        g = jnp.clip(g, -clip, clip)
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    out = weight - lr * g / jnp.sqrt(new_n + eps)
+    if clip_w >= 0.0:
+        out = jnp.clip(out, -clip_w, clip_w)
+    return [out], [new_n]
+
+
+@register('rmspropalex_update',
+          input_names=('weight', 'grad', 'n', 'g', 'delta'),
+          num_aux=3, mutable_aux=True, aux_always=True, simple=False,
+          hint='rmspropalex_update')
+def _rmspropalex_update(attrs, inputs, auxs, op_ctx):
+    """Graves 2013 RMSProp variant (reference RMSPropAlexUpdate,
+    arxiv 1308.0850 Eq. 38-45)."""
+    weight, grad = inputs
+    n, g_state, delta = auxs
+    lr = asfloat(attrs['lr'])
+    gamma1 = asfloat(attrs.get('gamma1', 0.95))
+    gamma2 = asfloat(attrs.get('gamma2', 0.9))
+    eps = asfloat(attrs.get('epsilon', 1e-8))
+    wd = asfloat(attrs.get('wd', 0.0))
+    rescale = asfloat(attrs.get('rescale_grad', 1.0))
+    clip = asfloat(attrs.get('clip_gradient', -1.0))
+    clip_w = asfloat(attrs.get('clip_weights', -1.0))
+    g = grad.astype(weight.dtype) * rescale + wd * weight
+    if clip >= 0.0:
+        g = jnp.clip(g, -clip, clip)
+    new_n = (1.0 - gamma1) * jnp.square(g) + gamma1 * n
+    new_g = (1.0 - gamma1) * g + gamma1 * g_state
+    # n - g^2 >= 0 mathematically (EMA variance) but can dip negative
+    # in float math once gradient signs alternate; clamp before sqrt
+    variance = jnp.maximum(new_n - jnp.square(new_g), 0.0)
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(variance + eps)
+    out = weight + new_delta
+    if clip_w >= 0.0:
+        out = jnp.clip(out, -clip_w, clip_w)
+    return [out], [new_n, new_g, new_delta]

@@ -24,12 +24,58 @@ import jax.numpy as jnp
 
 
 class OpContext:
-    """Per-invocation execution context: train/test mode and PRNG key."""
-    __slots__ = ('is_train', 'rng')
+    """Per-invocation execution context: train/test mode, PRNG key, and
+    (for shape-carrying init ops like zeros(shape=(0,H))) the
+    bidirectionally-inferred output shapes."""
+    __slots__ = ('is_train', 'rng', 'out_shapes')
 
-    def __init__(self, is_train=False, rng=None):
+    def __init__(self, is_train=False, rng=None, out_shapes=None):
         self.is_train = is_train
         self.rng = rng
+        self.out_shapes = out_shapes
+
+
+# ---------------------------------------------------------------------------
+# Partial shapes — the reference TShape convention: a 0 in a dimension
+# means "unknown" (nnvm InferShape unifies these bidirectionally;
+# graph_executor.cc:506).  None = completely unknown shape.
+# ---------------------------------------------------------------------------
+
+_INFER_KEY = None
+
+
+def _infer_key():
+    """Shared PRNG key for shape-inference eval_shape calls (allocating
+    one per call adds a device op to every rng-op inference)."""
+    global _INFER_KEY
+    if _INFER_KEY is None:
+        _INFER_KEY = jax.random.PRNGKey(0)
+    return _INFER_KEY
+
+
+def shape_is_complete(s):
+    return s is not None and all(d != 0 for d in s)
+
+
+def merge_shape(a, b):
+    """Unify two partial shapes.  Returns the merged shape, or None if
+    they conflict (callers keep their existing value on conflict —
+    backward propagation is strictly additive)."""
+    if a is None:
+        return tuple(b) if b is not None else None
+    if b is None:
+        return tuple(a)
+    if len(a) != len(b):
+        return None
+    out = []
+    for da, db in zip(a, b):
+        if da == 0:
+            out.append(db)
+        elif db == 0 or db == da:
+            out.append(da)
+        else:
+            return None
+    return tuple(out)
 
 
 class OpDef:
@@ -50,7 +96,9 @@ class OpDef:
     def __init__(self, name, fcompute, input_names=('data',), num_aux=0,
                  num_outputs=1, output_names=None, infer_shape=None,
                  infer_dtype=None, needs_rng=False, mode_dependent=False,
-                 mutable_aux=False, hint=None):
+                 mutable_aux=False, hint=None, shape_rule=None,
+                 needs_out_shapes=False, infer_shape_bwd=None,
+                 aux_always=False):
         self.name = name
         self.fcompute = fcompute
         self._input_names = input_names
@@ -62,7 +110,19 @@ class OpDef:
         self.needs_rng = needs_rng
         self.mode_dependent = mode_dependent
         self.mutable_aux = mutable_aux
+        # aux states mutate regardless of train mode (optimizer update
+        # ops: momentum/mean/var states advance on every call)
+        self.aux_always = aux_always
         self.hint = hint or name.lstrip('_').lower()
+        # 'same': all (non-aux) inputs and outputs share one shape —
+        # enables bidirectional unification (nnvm ElemwiseShape)
+        self.shape_rule = shape_rule
+        # op-specific backward rule: fn(attrs, in_shapes, out_shapes)
+        # -> in_shapes (e.g. FullyConnected: batch dim out->data)
+        self.infer_shape_bwd_fn = infer_shape_bwd
+        # op's compute wants the inferred output shapes (init ops whose
+        # attr shape may contain unknown 0-dims)
+        self.needs_out_shapes = needs_out_shapes
 
     # -- metadata ----------------------------------------------------------
     def input_names(self, attrs):
@@ -107,23 +167,56 @@ class OpDef:
         return list(outs), list(new_auxs)
 
     # -- inference ---------------------------------------------------------
-    def infer_shape(self, attrs, in_shapes, in_dtypes=None):
-        """Returns (completed_in_shapes, out_shapes). Unknown shapes are
-        None; raises if forward inference is impossible with what's known."""
+    def infer_shape(self, attrs, in_shapes, in_dtypes=None,
+                    out_shapes=None):
+        """Bidirectional per-op shape inference (nnvm InferShape role).
+
+        in_shapes/out_shapes may be None (unknown) or partial (0-dims
+        unknown).  Returns (in_shapes, out_shapes) with everything this
+        op could deduce filled in; out_shapes is None when the outputs
+        cannot be determined yet.  Generic forward inference runs
+        jax.eval_shape over the compute function once all inputs are
+        complete; shape_rule='same' additionally unifies inputs and
+        outputs in both directions."""
         in_shapes = list(in_shapes)
         if self.infer_shape_fn is not None:
             in_shapes = self.infer_shape_fn(attrs, in_shapes)
-        if any(s is None for s in in_shapes):
-            return in_shapes, None
+        if self.infer_shape_bwd_fn is not None and out_shapes and \
+                any(s is not None for s in out_shapes):
+            in_shapes = self.infer_shape_bwd_fn(attrs, in_shapes,
+                                                out_shapes)
         n_arg = len(in_shapes) - self.num_aux
+        if self.shape_rule == 'same':
+            unified = None
+            cands = in_shapes[:n_arg] + list(out_shapes or [])
+            for s in cands:
+                m = merge_shape(unified, s)
+                if m is not None:
+                    unified = m
+            if unified is not None:
+                for i in range(n_arg):
+                    m = merge_shape(in_shapes[i], unified)
+                    if m is not None:
+                        in_shapes[i] = m
+                if not any(shape_is_complete(s)
+                           for s in in_shapes[:n_arg]) or \
+                        not all(shape_is_complete(s)
+                                for s in in_shapes):
+                    # can't run eval_shape yet — report what we know
+                    return in_shapes, [unified] * self.num_outputs(attrs)
+        if not all(shape_is_complete(s) for s in in_shapes):
+            return in_shapes, None
         if in_dtypes is None:
             in_dtypes = [np.float32] * len(in_shapes)
         args = [jax.ShapeDtypeStruct(tuple(s), dt)
                 for s, dt in zip(in_shapes[:n_arg], in_dtypes[:n_arg])]
         auxs = [jax.ShapeDtypeStruct(tuple(s), dt)
                 for s, dt in zip(in_shapes[n_arg:], in_dtypes[n_arg:])]
+        # a real key: jax.random.* type-checks its key argument, and as
+        # a closure constant it doesn't affect the abstract evaluation
         ctx = OpContext(is_train=False,
-                        rng=jax.ShapeDtypeStruct((2,), np.uint32) if self.needs_rng else None)
+                        rng=_infer_key() if self.needs_rng else None,
+                        out_shapes=list(out_shapes) if out_shapes else None)
         outs, _ = jax.eval_shape(
             lambda a, x: self.apply(attrs, x, a, ctx), auxs, args)
         return in_shapes, [tuple(o.shape) for o in outs]
@@ -145,7 +238,9 @@ _OP_ALIASES = {}
 def register(name, input_names=('data',), num_aux=0, num_outputs=1,
              output_names=None, infer_shape=None, infer_dtype=None,
              needs_rng=False, mode_dependent=False, mutable_aux=False,
-             aliases=(), hint=None, simple=True):
+             aliases=(), hint=None, simple=True, shape_rule=None,
+             needs_out_shapes=False, infer_shape_bwd=None,
+             aux_always=False):
     """Decorator registering an op.
 
     With simple=True (default) the decorated function has signature
@@ -169,7 +264,10 @@ def register(name, input_names=('data',), num_aux=0, num_outputs=1,
                    num_outputs=num_outputs, output_names=output_names,
                    infer_shape=infer_shape, infer_dtype=infer_dtype,
                    needs_rng=needs_rng, mode_dependent=mode_dependent,
-                   mutable_aux=mutable_aux, hint=hint)
+                   mutable_aux=mutable_aux, hint=hint,
+                   shape_rule=shape_rule,
+                   needs_out_shapes=needs_out_shapes,
+                   infer_shape_bwd=infer_shape_bwd, aux_always=aux_always)
         _OP_REGISTRY[name] = op
         for alias in aliases:
             _OP_ALIASES[alias] = name

@@ -204,7 +204,11 @@ def _rnn_infer_shape(attrs, in_shapes):
         in_shapes[1] = (rnn_param_size(attrs, isz),)
     sshape = (nl * ndir, n, h)
     for i in range(2, len(in_shapes)):
-        if in_shapes[i] is None:
+        s = in_shapes[i]
+        if s is None or (len(s) == 3 and 0 in s):
+            # unknown or partially-known (0-dim) state: the data shape
+            # determines it (resolves zeros(shape=(l, 0, h)) states
+            # from FusedRNNCell begin_state)
             in_shapes[i] = sshape
     return in_shapes
 

@@ -203,3 +203,54 @@ def _correlation(attrs, data1, data2):
             outs.append(acc.sum(axis=1))
     out = jnp.stack(outs, axis=1)          # (N, grid*grid, out_h, out_w)
     return out / (c * kernel * kernel)
+
+
+@register('Correlation1D', input_names=('data1', 'data2'),
+          hint='correlation1d')
+def _correlation1d(attrs, data1, data2):
+    """Stereo cost volume: correlation with displacements along width
+    only (reference src/operator/correlation1D.cu Correlate1DData).
+    single_side selects the displacement window: 0 -> [-r, r],
+    -1 -> [-w, -1] (left), 1 -> [0, w-1] (right); output channels =
+    window size; values averaged over kernel*kernel*C elements."""
+    kernel = asint(attrs.get('kernel_size', 1))
+    max_disp = asint(attrs.get('max_displacement', 1))
+    stride1 = asint(attrs.get('stride1', 1))
+    stride2 = asint(attrs.get('stride2', 1))
+    pad = asint(attrs.get('pad_size', 0))
+    single_side = asint(attrs.get('single_side', 0))
+
+    n, c, h, w = data1.shape
+    # width-only padding (correlation1D.cc:78: only paddedbottomwidth)
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (0, 0), (pad, pad)))
+    pw = w + 2 * pad
+    krad = kernel // 2
+    border = max_disp + krad
+    out_h = int(np.ceil((h - 2 * krad) / float(stride1)))
+    out_w = int(np.ceil((pw - 2 * border) / float(stride1)))
+    radius = max_disp // stride2
+    if single_side == 0:
+        grid_w = 2 * radius + 1
+        x_shift = -radius
+    else:
+        grid_w = radius + 1
+        x_shift = -grid_w if single_side == -1 else 0
+
+    ys = jnp.arange(out_h) * stride1          # kernel top row
+    xs = max_disp + jnp.arange(out_w) * stride1  # kernel left col
+    outs = []
+    for tc in range(grid_w):
+        s2o = (tc + x_shift) * stride2
+        acc = 0.0
+        for ky in range(kernel):
+            for kx in range(kernel):
+                a = p1[:, :, ys[:, None] + ky, xs[None] + kx]
+                b = p2[:, :, ys[:, None] + ky,
+                       jnp.clip(xs[None] + kx + s2o, 0, pw - 1)]
+                valid = ((xs[None] + kx + s2o >= 0) &
+                         (xs[None] + kx + s2o < pw)).astype(a.dtype)
+                acc = acc + (a * b) * valid
+        outs.append(acc.sum(axis=1))
+    out = jnp.stack(outs, axis=1)            # (N, grid_w, out_h, out_w)
+    return out / (c * kernel * kernel)

@@ -30,7 +30,8 @@ def _dtype(attrs, default=np.float32):
 # ---------------------------------------------------------------------------
 
 def _reg_binary(name, fn, aliases=()):
-    @register(name, input_names=('lhs', 'rhs'), aliases=aliases, hint=name.lstrip('_'))
+    @register(name, input_names=('lhs', 'rhs'), aliases=aliases,
+              hint=name.lstrip('_'), shape_rule='same')
     def _op(attrs, lhs, rhs, _fn=fn):
         return _fn(lhs, rhs)
     return _op
@@ -51,7 +52,7 @@ for _n, _f in [('_equal', jnp.equal), ('_not_equal', jnp.not_equal),
                ('_lesser', jnp.less), ('_lesser_equal', jnp.less_equal)]:
     def _cmp(attrs, lhs, rhs, _f=_f):
         return _f(lhs, rhs).astype(lhs.dtype)
-    register(_n, input_names=('lhs', 'rhs'))(_cmp)
+    register(_n, input_names=('lhs', 'rhs'), shape_rule='same')(_cmp)
 
 
 # ---------------------------------------------------------------------------
@@ -59,7 +60,7 @@ for _n, _f in [('_equal', jnp.equal), ('_not_equal', jnp.not_equal),
 # ---------------------------------------------------------------------------
 
 def _reg_scalar(name, fn):
-    @register(name, input_names=('data',))
+    @register(name, input_names=('data',), shape_rule='same')
     def _op(attrs, data, _fn=fn):
         s = jnp.asarray(asfloat(attrs['scalar']), dtype=data.dtype)
         return _fn(data, s)
@@ -92,7 +93,8 @@ for _n, _f in [('_equal_scalar', jnp.equal), ('_not_equal_scalar', jnp.not_equal
 # ---------------------------------------------------------------------------
 
 def _reg_unary(name, fn, aliases=()):
-    @register(name, input_names=('data',), aliases=aliases)
+    @register(name, input_names=('data',), aliases=aliases,
+              shape_rule='same')
     def _op(attrs, data, _fn=fn):
         return _fn(data)
     return _op
@@ -177,6 +179,16 @@ def _clip(attrs, data):
 # Broadcast binary — reference elemwise_binary_broadcast_op_*.cc
 # ---------------------------------------------------------------------------
 
+def _reg_broadcast(name, fn, aliases=()):
+    # NO shape_rule='same': operands legitimately differ in shape, so
+    # bidirectional unification must not backfill unknown operands
+    @register(name, input_names=('lhs', 'rhs'), aliases=aliases,
+              hint=name.lstrip('_'))
+    def _op(attrs, lhs, rhs, _fn=fn):
+        return _fn(lhs, rhs)
+    return _op
+
+
 for _n, _f in [('broadcast_add', jnp.add), ('broadcast_plus', jnp.add),
                ('broadcast_sub', jnp.subtract), ('broadcast_minus', jnp.subtract),
                ('broadcast_mul', jnp.multiply), ('broadcast_div', jnp.divide),
@@ -185,7 +197,7 @@ for _n, _f in [('broadcast_add', jnp.add), ('broadcast_plus', jnp.add),
                ('broadcast_maximum', jnp.maximum),
                ('broadcast_minimum', jnp.minimum),
                ('broadcast_hypot', jnp.hypot)]:
-    _reg_binary(_n, _f)
+    _reg_broadcast(_n, _f)
 
 for _n, _f in [('broadcast_equal', jnp.equal),
                ('broadcast_not_equal', jnp.not_equal),
@@ -193,7 +205,7 @@ for _n, _f in [('broadcast_equal', jnp.equal),
                ('broadcast_greater_equal', jnp.greater_equal),
                ('broadcast_lesser', jnp.less),
                ('broadcast_lesser_equal', jnp.less_equal)]:
-    _reg_binary(_n, lambda a, b, _f=_f: _f(a, b).astype(a.dtype))
+    _reg_broadcast(_n, lambda a, b, _f=_f: _f(a, b).astype(a.dtype))
 
 
 @register('broadcast_to', input_names=('data',))
@@ -641,20 +653,36 @@ def _topk(attrs, data):
 # Init ops — reference init_op.cc
 # ---------------------------------------------------------------------------
 
-@register('_zeros', input_names=(), aliases=('zeros',))
-def _zeros(attrs):
-    return jnp.zeros(astuple(attrs['shape']), dtype=_dtype(attrs))
+def _init_shape(attrs, op_ctx):
+    """Init-op shape: the attr may carry unknown 0-dims (reference
+    TShape convention, e.g. zeros(shape=(0, H)) from rnn begin_state);
+    bidirectional inference resolves them and the executor threads the
+    resolved shape in via op_ctx.out_shapes."""
+    shape = astuple(attrs['shape'])
+    if any(d == 0 for d in shape) and op_ctx.out_shapes and \
+            op_ctx.out_shapes[0] is not None:
+        shape = tuple(op_ctx.out_shapes[0])
+    return shape
 
 
-@register('_ones', input_names=(), aliases=('ones',))
-def _ones(attrs):
-    return jnp.ones(astuple(attrs['shape']), dtype=_dtype(attrs))
+@register('_zeros', input_names=(), aliases=('zeros',), simple=False,
+          needs_out_shapes=True)
+def _zeros(attrs, inputs, auxs, op_ctx):
+    return [jnp.zeros(_init_shape(attrs, op_ctx),
+                      dtype=_dtype(attrs))], []
 
 
-@register('_full', input_names=(), aliases=('full',))
-def _full(attrs):
-    return jnp.full(astuple(attrs['shape']), asfloat(attrs['value']),
-                    dtype=_dtype(attrs))
+@register('_ones', input_names=(), aliases=('ones',), simple=False,
+          needs_out_shapes=True)
+def _ones(attrs, inputs, auxs, op_ctx):
+    return [jnp.ones(_init_shape(attrs, op_ctx), dtype=_dtype(attrs))], []
+
+
+@register('_full', input_names=(), aliases=('full',), simple=False,
+          needs_out_shapes=True)
+def _full(attrs, inputs, auxs, op_ctx):
+    return [jnp.full(_init_shape(attrs, op_ctx),
+                     asfloat(attrs['value']), dtype=_dtype(attrs))], []
 
 
 @register('_arange', input_names=(), aliases=('arange',))
@@ -689,3 +717,32 @@ def _add_n(attrs, *args):
     for a in args[1:]:
         out = out + a
     return out
+
+
+# ---------------------------------------------------------------------------
+# Slice-assign — reference tensor/matrix_op.cc:289 (_slice_assign /
+# _crop_assign) and :314 (_crop_assign_scalar): functional form of
+# lhs[begin:end] = rhs (the imperative NDArray.__setitem__ path already
+# exists; these are the graph ops).
+# ---------------------------------------------------------------------------
+
+def _assign_slices(attrs, shape):
+    begin = astuple(attrs['begin'])
+    end = astuple(attrs['end'])
+    idx = tuple(slice(int(b), int(e)) for b, e in zip(begin, end))
+    return idx + tuple(slice(None) for _ in range(len(shape) - len(idx)))
+
+
+@register('_slice_assign', input_names=('lhs', 'rhs'),
+          aliases=('_crop_assign',), hint='slice_assign')
+def _slice_assign(attrs, lhs, rhs):
+    idx = _assign_slices(attrs, lhs.shape)
+    return lhs.at[idx].set(rhs.astype(lhs.dtype))
+
+
+@register('_crop_assign_scalar', input_names=('data',),
+          hint='crop_assign_scalar')
+def _crop_assign_scalar(attrs, data):
+    idx = _assign_slices(attrs, data.shape)
+    val = asfloat(attrs.get('scalar', 0.0))
+    return data.at[idx].set(jnp.asarray(val, dtype=data.dtype))

@@ -45,10 +45,11 @@ class _Node:
 
 class Symbol:
     """A set of (node, output_index) entries."""
-    __slots__ = ('_outputs',)
+    __slots__ = ('_outputs', '_shape_infer_cache')
 
     def __init__(self, outputs):
         self._outputs = list(outputs)  # list of (node, int)
+        self._shape_infer_cache = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -162,6 +163,7 @@ class Symbol:
     def _set_attr(self, **kwargs):
         for node, _ in self._outputs:
             node.user_attrs.update({k: str(v) for k, v in kwargs.items()})
+        self._shape_infer_cache = None  # attrs may carry shape hints
 
     # -- shape / type inference (nnvm InferShape/InferType passes) --------
     def infer_shape(self, *args, **kwargs):
@@ -182,64 +184,133 @@ class Symbol:
         for k, v in kwargs.items():
             if v is not None:
                 known[k] = tuple(v)
+        from .ops.registry import shape_is_complete
         shapes, out_shapes = self._run_shape_inference(known, partial)
         arg_shapes = [shapes.get(n) for n in self.list_arguments()]
         aux_shapes = [shapes.get(n) for n in self.list_auxiliary_states()]
-        if not partial and any(s is None for s in arg_shapes):
+        if not partial and any(not shape_is_complete(s)
+                               for s in arg_shapes):
             missing = [n for n, s in zip(self.list_arguments(), arg_shapes)
-                       if s is None]
+                       if not shape_is_complete(s)]
             raise MXNetError('infer_shape: cannot fully infer shapes of '
                              'arguments %s' % missing)
         return arg_shapes, out_shapes, aux_shapes
 
-    def _run_shape_inference(self, var_shapes, partial=False):
-        """Fixed-point bidirectional shape inference over the DAG."""
+    def _run_shape_inference(self, var_shapes, partial=False,
+                             want_entries=False):
+        """Fixed-point bidirectional shape inference over the DAG
+        (nnvm InferShape semantics, graph_executor.cc:506): shapes are
+        partial — a 0 dimension means unknown (reference TShape
+        convention) — and each round sweeps the topo order forward then
+        backward, merging what every op can deduce about its inputs AND
+        outputs, until nothing changes."""
+        from .ops.registry import merge_shape, shape_is_complete
+        cache_key = tuple(sorted((k, tuple(v))
+                                 for k, v in var_shapes.items()))
+        cached = getattr(self, '_shape_infer_cache', None)
+        if cached is not None and cached[0] == cache_key:
+            var_out, outs, entry_shape = cached[2]
+            if not partial and any(not shape_is_complete(o)
+                                   for o in outs):
+                raise MXNetError('infer_shape: output shapes could not '
+                                 'be inferred (missing input shapes?)')
+            if want_entries:
+                return dict(var_out), list(outs), dict(entry_shape)
+            return dict(var_out), list(outs)
         topo = self._topo()
-        entry_shape = {}   # (id(node), idx) -> shape
+        entry_shape = {}   # (id(node), idx) -> partial shape
         var_shapes = dict(var_shapes)
-        for _ in range(3):  # fixed-point: forward fill + param backfill
+        last_sig = {}      # id(node) -> in/out shapes at last infer call
+
+        def update(key, s):
+            """Merge new info into an entry; conflicts keep the old
+            value (additive propagation).  Returns True if changed."""
+            if s is None:
+                return False
+            old = entry_shape.get(key)
+            merged = merge_shape(old, s)
+            if merged is None or merged == old:
+                return False
+            entry_shape[key] = merged
+            return True
+
+        def visit(node):
+            changed = False
+            if node.op is None:
+                s = var_shapes.get(node.name)
+                if s is None and '__shape__' in node.user_attrs:
+                    # honor Variable(shape=...) hints (reference
+                    # symbol.py var(shape=...))
+                    s = tuple(parse_attr_value(
+                        node.user_attrs['__shape__']))
+                    var_shapes[node.name] = s
+                if update((id(node), 0), s):
+                    changed = True
+                    var_shapes[node.name] = entry_shape[(id(node), 0)]
+                return changed
+            in_shapes = [entry_shape.get((id(src), i))
+                         for src, i in node.inputs]
+            n_out = node.op.num_outputs(node.attrs)
+            cur_outs = [entry_shape.get((id(node), i))
+                        for i in range(n_out)]
+            sig = (tuple(in_shapes), tuple(cur_outs))
+            if last_sig.get(id(node)) == sig:
+                # nothing new since the last infer call for this node —
+                # skip the (eval_shape-backed) per-op inference
+                return False
+            last_sig[id(node)] = sig
+            try:
+                in_shapes, out_shapes = node.op.infer_shape(
+                    node.attrs, in_shapes, out_shapes=cur_outs)
+            except Exception as e:
+                raise MXNetError(
+                    'Error in operator %s: shape inference failed: %s'
+                    % (node.name, e)) from e
+            # back-fill inferred input (incl. parameter) shapes
+            for (src, i), s in zip(node.inputs, in_shapes):
+                if update((id(src), i), s):
+                    changed = True
+                    if src.op is None:
+                        var_shapes[src.name] = entry_shape[(id(src), i)]
+            for i, s in enumerate(out_shapes or []):
+                if update((id(node), i), s):
+                    changed = True
+            return changed
+
+        for _ in range(8):  # fixed-point: forward sweep + backward sweep
             changed = False
             for node in topo:
-                if node.op is None:
-                    s = var_shapes.get(node.name)
-                    if s is None and '__shape__' in node.user_attrs:
-                        # honor Variable(shape=...) hints (reference
-                        # symbol.py var(shape=...))
-                        s = tuple(parse_attr_value(
-                            node.user_attrs['__shape__']))
-                        var_shapes[node.name] = s
-                    if s is not None and entry_shape.get((id(node), 0)) != s:
-                        entry_shape[(id(node), 0)] = tuple(s)
-                        changed = True
-                    continue
-                in_shapes = [entry_shape.get((id(src), i))
-                             for src, i in node.inputs]
-                try:
-                    in_shapes, out_shapes = node.op.infer_shape(
-                        node.attrs, in_shapes)
-                except Exception as e:
-                    raise MXNetError(
-                        'Error in operator %s: shape inference failed: %s'
-                        % (node.name, e)) from e
-                # back-fill newly inferred input (parameter) shapes
-                for (src, i), s in zip(node.inputs, in_shapes):
-                    if s is not None and entry_shape.get((id(src), i)) is None:
-                        entry_shape[(id(src), i)] = tuple(s)
-                        if src.op is None:
-                            var_shapes[src.name] = tuple(s)
-                        changed = True
-                if out_shapes is not None:
-                    for i, s in enumerate(out_shapes):
-                        if entry_shape.get((id(node), i)) != tuple(s):
-                            entry_shape[(id(node), i)] = tuple(s)
-                            changed = True
+                changed |= visit(node)
+            for node in reversed(topo):
+                changed |= visit(node)
             if not changed:
                 break
         outs = [entry_shape.get((id(n), i)) for n, i in self._outputs]
-        if any(o is None for o in outs) and not partial:
+        if not partial and any(not shape_is_complete(o) for o in outs):
             raise MXNetError('infer_shape: output shapes could not be '
                              'inferred (missing input shapes?)')
+        # memoize: bind re-runs inference with the same known shapes
+        # (simple_bind then Executor._infer_node_shapes)
+        self._shape_infer_cache = (cache_key, partial,
+                                   (dict(var_shapes), list(outs),
+                                    dict(entry_shape)))
+        if want_entries:
+            return var_shapes, outs, entry_shape
         return var_shapes, outs
+
+    def _infer_node_shapes(self, var_shapes):
+        """Per-node resolved output shapes, {id(node): [shape, ...]} —
+        used by the executor to thread bidirectionally-inferred shapes
+        into shape-carrying init ops (zeros(shape=(0, H)))."""
+        _, _, entries = self._run_shape_inference(
+            var_shapes, partial=True, want_entries=True)
+        out = {}
+        for node in self._topo():
+            if node.op is None:
+                continue
+            n = node.op.num_outputs(node.attrs)
+            out[id(node)] = [entries.get((id(node), i)) for i in range(n)]
+        return out
 
     def infer_type(self, *args, **kwargs):
         """Forward dtype inference over the DAG via each op's

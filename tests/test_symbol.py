@@ -119,3 +119,54 @@ def test_slice_channel_multi_output():
                                 'slicechannel0_output2'] or len(s.list_outputs()) == 3
     _, out_shapes, _ = s.infer_shape(data=(2, 6, 4))
     assert out_shapes == [(2, 2, 4)] * 3
+
+
+def test_bidirectional_shape_inference():
+    """nnvm InferShape parity (graph_executor.cc:506): a 0 dim means
+    unknown and is resolved from the rest of the graph, in both
+    directions."""
+    data = sym.Variable('data')
+    z = sym.zeros(shape=(0, 8), name='z0')
+    fc = sym.FullyConnected(data, num_hidden=8, name='fc')
+    out = z + fc
+    args, outs, _ = out.infer_shape(data=(4, 5))
+    assert outs[0] == (4, 8)
+    # partial inference: unknowns stay partial, no raise
+    pargs, pouts, _ = out.infer_shape_partial()
+    assert pouts[0] == (0, 8)
+    # execution resolves the zeros node to the full batch shape
+    ex = out.simple_bind(mx.cpu(), grad_req='null', data=(4, 5))
+    ex.forward(is_train=False, data=np.ones((4, 5), np.float32))
+    assert ex.outputs[0].shape == (4, 8)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy().shape, (4, 8))
+
+
+def test_fc_backward_batch_inference():
+    """Batch dim propagates backward through FullyConnected into a
+    zeros(shape=(0, H)) initial state (the rnn begin_state pattern)."""
+    h = sym.zeros(shape=(0, 6), name='h0')
+    h2h = sym.FullyConnected(h, num_hidden=12, name='h2h')
+    x = sym.Variable('x')
+    i2h = sym.FullyConnected(x, num_hidden=12, name='i2h')
+    out = h2h + i2h
+    args, outs, _ = out.infer_shape(x=(3, 5))
+    assert outs[0] == (3, 12)
+    names = out.list_arguments()
+    shapes = dict(zip(names, args))
+    assert shapes['h2h_weight'] == (12, 6)
+
+
+def test_rnn_default_begin_state_binds():
+    """cell.unroll with no begin_state uses sym.zeros((0, H)) like the
+    reference; bind + forward must work end to end."""
+    import mxnet_tpu.rnn as rnn_mod
+    cell = rnn_mod.LSTMCell(num_hidden=16, prefix='bs_')
+    seq = [sym.Variable('t%d' % i) for i in range(3)]
+    outs, states = cell.unroll(3, seq)
+    net = sym.Group(list(outs) + list(states))
+    shapes = {('t%d' % i): (2, 6) for i in range(3)}
+    ex = net.simple_bind(mx.cpu(), grad_req='null', **shapes)
+    ex.forward(is_train=False,
+               **{('t%d' % i): np.random.rand(2, 6).astype(np.float32)
+                  for i in range(3)})
+    assert ex.outputs[0].shape == (2, 16)
