@@ -72,9 +72,24 @@ class Executor:
         out_entries = [(node_index[id(n)], i) for n, i in sym._outputs]
         # shape-carrying init ops (zeros(shape=(0,H)) from rnn
         # begin_state) need their bidirectionally-resolved output
-        # shapes at execution time
+        # shapes at execution time — but only when the attr shape
+        # actually has unknown 0-dims (a plain zeros((2,3)) constant
+        # must not trigger a second full inference pass at bind)
+        def _unresolved_init(n):
+            if n.op is None or not n.op.needs_out_shapes:
+                return False
+            shape = n.attrs.get('shape')
+            if shape is None:
+                return True
+            from .base import parse_attr_value
+            parsed = parse_attr_value(shape)
+            try:
+                return any(int(d) == 0 for d in parsed)
+            except TypeError:
+                return False
+
         node_shapes = {}
-        if any(n.op is not None and n.op.needs_out_shapes for n in topo):
+        if any(_unresolved_init(n) for n in topo):
             known = {name: tuple(a.shape)
                      for name, a in self.arg_dict.items()}
             known.update({name: tuple(a.shape)
@@ -82,6 +97,10 @@ class Executor:
             by_id = sym._infer_node_shapes(known)
             node_shapes = {node_index[nid]: v for nid, v in by_id.items()
                            if nid in node_index}
+        self._node_shapes = node_shapes
+        self._has_aux_always = any(
+            n.op is not None and n.op.mutable_aux and n.op.aux_always
+            for n in topo)
 
         def run_graph(arg_vals, aux_vals, rng, is_train, collect_all=False):
             """Evaluate the DAG; returns (outputs, new_aux_tuple), plus
@@ -416,12 +435,93 @@ class Executor:
             with profiler.scope(self._name('forward')):
                 outs, new_aux = self._fwd_eval(arg_vals, aux_vals, sub)
                 self._maybe_block(outs)
+            if self._has_aux_always:
+                # optimizer-update-style ops advance their states on
+                # every call, train mode or not (run_graph applies
+                # their updates under aux_always) — persist them
+                for n, v in zip(self._aux_names, new_aux):
+                    self.aux_dict[n]._data = v
             new_aux = None
         if is_train and new_aux is not None:
             for n, v in zip(self._aux_names, new_aux):
                 self.aux_dict[n]._data = v
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         return self.outputs
+
+    def partial_forward(self, step=None, is_train=False, **kwargs):
+        """Run the forward graph only up to op-node `step` (reference
+        Executor::PartialForward, graph_executor.cc:54 — memory-limited
+        stepping / debugging).  Executes the topo prefix eagerly and
+        keeps the partial state so successive calls continue where the
+        last one stopped; step=None finishes the graph.  Returns the
+        number of op nodes still to run."""
+        sym = self._symbol
+        topo = sym._topo()
+        op_nodes = [n for n in topo if n.op is not None]
+        total = len(op_nodes)
+        if kwargs:
+            self._set_args(kwargs)
+            self._partial_state = None
+        state = getattr(self, '_partial_state', None)
+        if state is None:
+            arg_vals, aux_vals = self._gather()
+            self._key, sub = jax.random.split(self._key)
+            state = {'done': 0, 'results': {}, 'rng': sub,
+                     'args': arg_vals, 'auxs': aux_vals}
+        arg_pos = {n: i for i, n in enumerate(self._arg_names)}
+        aux_pos = {n: i for i, n in enumerate(self._aux_names)}
+        node_index = {id(n): i for i, n in enumerate(topo)}
+        target = total if step is None else min(int(step), total)
+        done_ops = 0
+        for ni, node in enumerate(topo):
+            if node.op is None:
+                if ni not in state['results']:
+                    if node.name in arg_pos:
+                        state['results'][ni] = [
+                            state['args'][arg_pos[node.name]]]
+                    else:
+                        state['results'][ni] = [
+                            state['auxs'][aux_pos[node.name]]]
+                continue
+            done_ops += 1
+            if done_ops <= state['done']:
+                continue
+            if done_ops > target:
+                break
+            vals = [state['results'][node_index[id(src)]][idx]
+                    for src, idx in node.inputs]
+            n_aux = node.op.num_aux
+            args = vals[:len(vals) - n_aux] if n_aux else vals
+            auxs = vals[len(vals) - n_aux:] if n_aux else []
+            op_ctx = OpContext(
+                is_train=is_train,
+                rng=jax.random.fold_in(state['rng'], ni)
+                if node.op.needs_rng else None,
+                out_shapes=self._node_shapes.get(ni)
+                if node.op.needs_out_shapes else None)
+            outs, updated = node.op.apply(node.attrs, args, auxs, op_ctx)
+            state['results'][ni] = outs
+            if node.op.mutable_aux and (is_train or node.op.aux_always) \
+                    and updated:
+                state['auxs'] = list(state['auxs'])
+                # matches run_graph: consumers keep the pre-update
+                # value (the var's result slot is not rewritten)
+                for (src, _), newv in zip(
+                        node.inputs[len(vals) - n_aux:], updated):
+                    if src.op is None and src.name in aux_pos:
+                        state['auxs'][aux_pos[src.name]] = newv
+        state['done'] = min(target, total)
+        self._partial_state = state
+        if state['done'] == total:
+            out_entries = [(node_index[id(n)], i)
+                           for n, i in sym._outputs]
+            self.outputs = [
+                nd.NDArray(state['results'][ni][oi], self._ctx)
+                for ni, oi in out_entries]
+            for n, v in zip(self._aux_names, state['auxs']):
+                self.aux_dict[n]._data = v
+            self._partial_state = None
+        return total - state['done'] if state['done'] < total else 0
 
     def _name(self, suffix):
         return '%s_%s' % (self._symbol.name or 'executor', suffix)
@@ -469,9 +569,23 @@ class Executor:
         return self.outputs
 
     def _default_head_grads(self, out_grads):
+        """No head grads: all-ones.  Loss outputs (SoftmaxOutput & co)
+        ignore head grads via their custom VJPs, so ones reproduces
+        reference backward() exactly.  For multi-output graphs whose
+        outputs are NOT loss ops, ones-head backward computes
+        d(sum(outputs)) — the reference errors there instead; we warn
+        once so silent sum-gradients don't masquerade as per-output
+        gradients."""
         if out_grads is None:
-            # loss ops ignore head grads (custom VJPs); ones is identity
-            # for them and matches reference backward() semantics
+            if self._n_outputs > 1 and not getattr(
+                    self, '_warned_multi_head', False):
+                self._warned_multi_head = True
+                import warnings
+                warnings.warn(
+                    'backward() without head gradients on a %d-output '
+                    'graph: gradients are of the SUM of outputs '
+                    '(loss ops are unaffected; pass out_grads for '
+                    'per-output control)' % self._n_outputs)
             shapes = [o.shape for o in self.outputs] if self.outputs else None
             if shapes is None:
                 arg_vals, aux_vals = self._gather()

@@ -196,12 +196,13 @@ class KVStore:
         return 0
 
     def barrier(self):
+        """Global barrier across workers.  Failures PROPAGATE: a failed
+        barrier means the process group is broken, and silently
+        continuing would let workers diverge (reference
+        ps::Postoffice::Barrier aborts the process on failure)."""
         if self._is_dist:
-            try:
-                from jax.experimental import multihost_utils
-                multihost_utils.sync_global_devices('kvstore_barrier')
-            except Exception:
-                pass
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices('kvstore_barrier')
 
     def send_command_to_servers(self, head, body):
         pass  # no server processes in the TPU design

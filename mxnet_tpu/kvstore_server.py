@@ -16,7 +16,18 @@ TPU-native rebuild of the reference's ps-lite distribution layer
     optimizer state must live host-side, and for `dist_async`.
 
 Transport is length-prefixed pickles over sockets (ZeroMQ's role in
-ps-lite).  Key sharding across multiple servers follows the reference:
+ps-lite).  TRUST BOUNDARY: like the reference's ps-lite, this protocol
+assumes a private cluster network — pickle deserialization (and
+set_optimizer by design) executes code, so anyone who can speak the
+protocol controls the process.  Two mitigations narrow the surface
+beyond the reference: (1) every frame carries an HMAC-SHA256 tag keyed
+by DMLC_PS_TOKEN (or, absent a token, a key derived from the
+DMLC_PS_ROOT_URI:PORT rendezvous — integrity against stray peers, not
+secrecy; set DMLC_PS_TOKEN for a real shared secret), and frames with
+bad tags are dropped before unpickling; (2) servers bind to
+DMLC_PS_BIND_URI / DMLC_PS_ROOT_URI when that address is local
+(loopback under tools/launch.py local mode) instead of all interfaces.
+Key sharding across multiple servers follows the reference:
 server id = (key_hash * 9973) % num_servers (kvstore_dist.h:292).
 Ports are DMLC_PS_ROOT_PORT + server_id on DMLC_PS_ROOT_URI.
 
@@ -25,6 +36,8 @@ DMLC_PS_ROOT_URI, DMLC_PS_ROOT_PORT, DMLC_NUM_WORKER, DMLC_NUM_SERVER —
 set by tools/launch.py.  `python -m mxnet_tpu.kvstore_server` runs a
 server process until it receives STOP (reference kStopServer).
 """
+import hashlib
+import hmac
 import os
 import pickle
 import socket
@@ -36,12 +49,23 @@ import numpy as np
 
 
 # ---------------------------------------------------------------------------
-# framing
+# framing — length + HMAC-SHA256 tag + pickle (see trust boundary note
+# in the module docstring)
 # ---------------------------------------------------------------------------
+
+def _frame_key():
+    token = os.environ.get('DMLC_PS_TOKEN')
+    if token:
+        return token.encode()
+    seed = '%s:%s' % (os.environ.get('DMLC_PS_ROOT_URI', '127.0.0.1'),
+                      os.environ.get('DMLC_PS_ROOT_PORT', '9091'))
+    return hashlib.sha256(('mxnet_tpu_ps:' + seed).encode()).digest()
+
 
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack('<Q', len(payload)) + payload)
+    tag = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
+    sock.sendall(struct.pack('<Q', len(payload)) + tag + payload)
 
 
 def _recv_exact(sock, n):
@@ -56,7 +80,14 @@ def _recv_exact(sock, n):
 
 def _recv_msg(sock):
     (n,) = struct.unpack('<Q', _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    tag = _recv_exact(sock, 32)
+    payload = _recv_exact(sock, n)
+    want = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
+    if not hmac.compare_digest(tag, want):
+        raise ConnectionError(
+            'kvstore frame failed HMAC verification (wrong '
+            'DMLC_PS_TOKEN or untrusted peer) — dropping connection')
+    return pickle.loads(payload)
 
 
 def _key_to_server(key, num_servers):
@@ -94,7 +125,16 @@ class KVStoreServer(object):
         self.last_seen = {}           # worker rank -> time.time()
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.listener.bind(('', port))
+        # bind the rendezvous interface when it is local (loopback for
+        # tools/launch.py local mode) rather than all interfaces; a
+        # server on a different host than the root falls back to ''
+        bind_addr = os.environ.get(
+            'DMLC_PS_BIND_URI',
+            os.environ.get('DMLC_PS_ROOT_URI', ''))
+        try:
+            self.listener.bind((bind_addr, port))
+        except OSError:
+            self.listener.bind(('', port))
         self.listener.listen(num_workers + 8)
         self.port = self.listener.getsockname()[1]
         self._threads = []

@@ -26,6 +26,8 @@ class DataParallelExecutorGroup:
                  grad_req='write', state_names=None):
         self.symbol = symbol
         self.contexts = contexts
+        if workload:
+            decide_slices(0, workload)  # reject non-uniform workloads
         self.param_names = param_names
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
@@ -213,9 +215,17 @@ class DataParallelExecutorGroup:
 
 
 def decide_slices(batch_size, work_load_list):
-    """Kept for API parity (reference executor_group.py:233); the TPU
-    build shards evenly over the mesh instead of slicing by workload."""
+    """Reference executor_group.py:233.  The TPU build shards the batch
+    evenly over the mesh (SPMD partitioning needs identical per-device
+    shapes), so a non-uniform work_load_list cannot be honored — raise
+    instead of silently ignoring it."""
     n = len(work_load_list)
+    if len(set(work_load_list)) > 1:
+        raise MXNetError(
+            'non-uniform work_load_list %s is not supported: the SPMD '
+            'mesh shards the batch evenly across devices (uneven '
+            'per-device shapes would break XLA partitioning)'
+            % (list(work_load_list),))
     base = batch_size // n
     slices = []
     start = 0

@@ -19,8 +19,9 @@ class Monitor(object):
     def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
         if stat_func is None:
             def stat_func(x):
-                """mean absolute value (reference default |x|/size)"""
-                return nd.norm(x) / (x.size ** 0.5)
+                """mean absolute value (reference default: sum(|x|)/size,
+                monitor.py:23)"""
+                return nd.sum(nd.abs(x)) / x.size
         self.stat_func = stat_func
         self.interval = interval
         self.activated = False

@@ -428,8 +428,11 @@ def _psroi_pooling(attrs, data, rois):
         bi = roi[0].astype(jnp.int32)
         x1 = jnp.round(roi[1]) * spatial_scale
         y1 = jnp.round(roi[2]) * spatial_scale
-        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
-        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        # (round(roi)+1)*scale, NOT round(roi+1)*scale: jnp.round is
+        # half-even, so .5 coordinates would shift the region by one
+        # pixel vs the reference (psroi_pooling.cc)
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bw, bh = rw / p, rh / p

@@ -20,6 +20,16 @@ import jax.numpy as jnp
 from .registry import register, asfloat
 
 
+def _opt_infer_shape(attrs, in_shapes):
+    """Every state tensor (mom/mean/var/n/g/delta/weight32) has the
+    weight's shape — backfill so symbolic binds need only the weight
+    and gradient shapes."""
+    w = in_shapes[0]
+    if w is not None:
+        in_shapes = [w if s is None else s for s in in_shapes]
+    return in_shapes
+
+
 def _prep_grad(grad, attrs, dtype):
     rescale = asfloat(attrs.get('rescale_grad', 1.0))
     clip = asfloat(attrs.get('clip_gradient', -1.0))
@@ -29,7 +39,8 @@ def _prep_grad(grad, attrs, dtype):
     return g
 
 
-@register('sgd_update', input_names=('weight', 'grad'), hint='sgd_update')
+@register('sgd_update', input_names=('weight', 'grad'), hint='sgd_update',
+          infer_shape=_opt_infer_shape)
 def _sgd_update(attrs, weight, grad):
     """weight = (1 - lr*wd)*weight - lr*clip(rescale*grad)
     (reference optimizer_op-inl.h SGDKernel)."""
@@ -41,7 +52,8 @@ def _sgd_update(attrs, weight, grad):
 
 @register('sgd_mom_update', input_names=('weight', 'grad', 'mom'),
           num_aux=1, mutable_aux=True, aux_always=True, simple=False,
-          hint='sgd_mom_update')
+          hint='sgd_mom_update',
+          infer_shape=_opt_infer_shape)
 def _sgd_mom_update(attrs, inputs, auxs, op_ctx):
     """mom = momentum*mom - lr*wd*weight - lr*clip(rescale*grad);
     weight += mom (reference SGDMomKernel)."""
@@ -57,7 +69,8 @@ def _sgd_mom_update(attrs, inputs, auxs, op_ctx):
 
 @register('mp_sgd_update', input_names=('weight', 'grad', 'weight32'),
           num_aux=1, mutable_aux=True, aux_always=True, simple=False,
-          hint='mp_sgd_update')
+          hint='mp_sgd_update',
+          infer_shape=_opt_infer_shape)
 def _mp_sgd_update(attrs, inputs, auxs, op_ctx):
     """Multi-precision SGD: math on the fp32 master, low-precision
     weight is its cast (reference MP_SGDKernel)."""
@@ -73,7 +86,8 @@ def _mp_sgd_update(attrs, inputs, auxs, op_ctx):
 @register('mp_sgd_mom_update',
           input_names=('weight', 'grad', 'mom', 'weight32'),
           num_aux=2, mutable_aux=True, aux_always=True, simple=False,
-          hint='mp_sgd_mom_update')
+          hint='mp_sgd_mom_update',
+          infer_shape=_opt_infer_shape)
 def _mp_sgd_mom_update(attrs, inputs, auxs, op_ctx):
     """Multi-precision momentum SGD (reference MP_SGDMomKernel)."""
     weight, grad = inputs
@@ -89,7 +103,8 @@ def _mp_sgd_mom_update(attrs, inputs, auxs, op_ctx):
 
 @register('adam_update', input_names=('weight', 'grad', 'mean', 'var'),
           num_aux=2, mutable_aux=True, aux_always=True, simple=False,
-          hint='adam_update')
+          hint='adam_update',
+          infer_shape=_opt_infer_shape)
 def _adam_update(attrs, inputs, auxs, op_ctx):
     """mean/var EMA then weight -= lr*mean/(sqrt(var)+eps)
     (reference AdamUpdate; wd folds into the gradient)."""
@@ -113,7 +128,8 @@ def _adam_update(attrs, inputs, auxs, op_ctx):
 
 @register('rmsprop_update', input_names=('weight', 'grad', 'n'),
           num_aux=1, mutable_aux=True, aux_always=True, simple=False,
-          hint='rmsprop_update')
+          hint='rmsprop_update',
+          infer_shape=_opt_infer_shape)
 def _rmsprop_update(attrs, inputs, auxs, op_ctx):
     """Tieleman & Hinton RMSProp (reference RMSPropUpdate)."""
     weight, grad = inputs
@@ -138,7 +154,8 @@ def _rmsprop_update(attrs, inputs, auxs, op_ctx):
 @register('rmspropalex_update',
           input_names=('weight', 'grad', 'n', 'g', 'delta'),
           num_aux=3, mutable_aux=True, aux_always=True, simple=False,
-          hint='rmspropalex_update')
+          hint='rmspropalex_update',
+          infer_shape=_opt_infer_shape)
 def _rmspropalex_update(attrs, inputs, auxs, op_ctx):
     """Graves 2013 RMSProp variant (reference RMSPropAlexUpdate,
     arxiv 1308.0850 Eq. 38-45)."""

@@ -119,15 +119,29 @@ def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
 _VMEM_RESIDENT_BYTES = 10 * 1024 * 1024
 
 
+def _fit_block(t, block_q):
+    """Largest power-of-two block <= block_q dividing t.  Sequence
+    lengths with no small power-of-two factor (e.g. prime T) would
+    degenerate to 1-row blocks that Mosaic rejects or runs
+    pathologically — raise with guidance instead."""
+    block_q = min(block_q, t)
+    while t % block_q:
+        block_q //= 2
+    if block_q < 8 and t > 8:
+        raise ValueError(
+            'flash_attention: sequence length %d has no power-of-two '
+            'block factor >= 8; pad the sequence to a multiple of 128 '
+            'or use full_attention for unaligned lengths' % t)
+    return block_q
+
+
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
     b, h, t, d = q.shape
     bh = b * h
     qf = q.reshape(bh, t, d)
     kf = k.reshape(bh, t, d)
     vf = v.reshape(bh, t, d)
-    block_q = min(block_q, t)
-    while t % block_q:
-        block_q //= 2
+    block_q = _fit_block(t, block_q)
     block_k = block_q
     num_kb = t // block_k
     itemsize = jnp.dtype(q.dtype).itemsize
@@ -184,9 +198,7 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q):
     """Recompute-based gradients, q-block at a time: live memory is
     O(block_q * T) instead of the dense O(T^2)."""
     bh, t, d = q.shape
-    block_q = min(block_q, t)
-    while t % block_q:
-        block_q //= 2
+    block_q = _fit_block(t, block_q)
     nq = t // block_q
     qb = q.reshape(bh, nq, block_q, d)
     gb = g.reshape(bh, nq, block_q, d)

@@ -60,16 +60,64 @@ def profiler_set_state(state='stop'):
 
 def dump_profile():
     """Write accumulated records as a Chrome trace-event file
-    (reference Profiler::DumpProfile, profiler.cc:139-192)."""
-    events = []
+    (reference Profiler::DumpProfile, profiler.cc:139-192).
+
+    When profile_xla was enabled, the XLA trace's per-op spans are
+    merged in as additional process lanes (pid >= 100): on TPU the
+    '/device:TPU:N' lanes carry real device-side op attribution (the
+    reference's per-op OprExecStat timing, §5.1); on the CPU backend
+    the '/host:CPU' XLA runtime lane appears instead.  Python-frame
+    spans ('$...' names) from the XLA trace are dropped — the host
+    story is this profiler's own spans."""
+    events = [{'ph': 'M', 'name': 'process_name', 'pid': 0,
+               'args': {'name': 'mxnet_tpu host spans'}}]
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
         events.append({'name': name, 'cat': cat, 'ph': 'X',
                        'ts': ts, 'dur': dur, 'pid': 0, 'tid': tid})
+    events.extend(_collect_xla_lanes())
     with open(_STATE['filename'], 'w') as f:
         json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
     return _STATE['filename']
+
+
+def _collect_xla_lanes():
+    """Parse the newest XLA trace dump (plugins/profile/<ts>/
+    *.trace.json.gz) and remap its processes to pids 100+."""
+    trace_dir = _STATE['jax_trace_dir']
+    if not _STATE['jax_trace'] or not trace_dir:
+        return []
+    import glob
+    import gzip
+    dumps = sorted(glob.glob(os.path.join(
+        trace_dir, 'plugins', 'profile', '*', '*.trace.json.gz')))
+    if not dumps:
+        return []
+    try:
+        with gzip.open(dumps[-1]) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    raw = data.get('traceEvents', [])
+    names = {}
+    for e in raw:
+        if e.get('ph') == 'M' and e.get('name') == 'process_name':
+            names[e['pid']] = e['args'].get('name', str(e['pid']))
+    pid_map = {pid: 100 + i for i, pid in enumerate(sorted(names))}
+    out = [{'ph': 'M', 'name': 'process_name', 'pid': new,
+            'args': {'name': 'xla %s' % names[old]}}
+           for old, new in pid_map.items()]
+    for e in raw:
+        if e.get('ph') != 'X' or e['pid'] not in pid_map:
+            continue
+        name = e.get('name', '')
+        if name.startswith('$'):
+            continue  # python-frame span, not an XLA op
+        out.append({'name': name, 'cat': 'xla', 'ph': 'X',
+                    'ts': e.get('ts', 0), 'dur': e.get('dur', 0),
+                    'pid': pid_map[e['pid']], 'tid': e.get('tid', 0)})
+    return out
 
 
 def is_running():

@@ -28,6 +28,12 @@ from .ops import registry as _reg
 _py_slice = slice
 
 
+# bumped by _set_attr on ANY symbol: shape-inference caches include it
+# so attr edits through one handle invalidate caches on every handle
+# sharing the nodes
+_ATTR_EPOCH = 0
+
+
 class _Node:
     """One graph node: an operator application or a variable (op=None)."""
     __slots__ = ('op', 'name', 'attrs', 'inputs', 'user_attrs')
@@ -161,9 +167,13 @@ class Symbol:
         return out
 
     def _set_attr(self, **kwargs):
+        global _ATTR_EPOCH
         for node, _ in self._outputs:
             node.user_attrs.update({k: str(v) for k, v in kwargs.items()})
-        self._shape_infer_cache = None  # attrs may carry shape hints
+        # attr changes can carry shape hints and nodes are shared across
+        # Symbol handles (get_internals), so bump the global epoch that
+        # every handle's inference cache is validated against
+        _ATTR_EPOCH += 1
 
     # -- shape / type inference (nnvm InferShape/InferType passes) --------
     def infer_shape(self, *args, **kwargs):
@@ -205,8 +215,9 @@ class Symbol:
         backward, merging what every op can deduce about its inputs AND
         outputs, until nothing changes."""
         from .ops.registry import merge_shape, shape_is_complete
-        cache_key = tuple(sorted((k, tuple(v))
-                                 for k, v in var_shapes.items()))
+        cache_key = (tuple(sorted((k, tuple(v))
+                                  for k, v in var_shapes.items())),
+                     _ATTR_EPOCH)
         cached = getattr(self, '_shape_infer_cache', None)
         if cached is not None and cached[0] == cache_key:
             var_out, outs, entry_shape = cached[2]

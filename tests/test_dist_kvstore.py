@@ -238,3 +238,29 @@ def test_num_dead_node_heartbeats():
     c1 = ps.DistServerClient('127.0.0.1', srv.port, 1, rank=1)
     assert c0.num_dead(timeout_sec=0.12) == 0
     c0.stop_servers()
+
+
+def test_frame_hmac_rejects_tampering():
+    """Frames with bad HMAC tags must be dropped before unpickling
+    (ADVICE.md: unauthenticated pickle-over-TCP surface)."""
+    import socket as _socket
+    import struct
+    import pickle
+    import hashlib
+    import hmac as _hmac
+    from mxnet_tpu import kvstore_server as srv
+    a, b = _socket.socketpair()
+    try:
+        srv._send_msg(a, ('ping', 1))
+        assert srv._recv_msg(b) == ('ping', 1)
+        # tampered payload under a wrong key
+        payload = pickle.dumps(('evil',))
+        bad_tag = _hmac.new(b'wrong-key', payload,
+                            hashlib.sha256).digest()
+        a.sendall(struct.pack('<Q', len(payload)) + bad_tag + payload)
+        import pytest as _pytest
+        with _pytest.raises(ConnectionError):
+            srv._recv_msg(b)
+    finally:
+        a.close()
+        b.close()

@@ -1,6 +1,7 @@
 """Executor tests (model: reference tests/python/unittest/test_executor.py
 + numeric-gradient style checks from test_operator.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import sym, nd
@@ -170,3 +171,85 @@ def test_debug_str():
     assert 'fc (FullyConnected)' in s
     assert 'Total bytes' in s
     assert 'fused XLA' in s
+
+
+def test_partial_forward():
+    """Reference Executor::PartialForward (graph_executor.cc:54):
+    stepwise execution that continues across calls."""
+    data = sym.Variable('data')
+    a = sym.Activation(data, act_type='relu')
+    b = a * 2.0
+    c = b + 1.0
+    ex = c.simple_bind(mx.cpu(), grad_req='null', data=(2, 3))
+    x = np.random.rand(2, 3).astype(np.float32)
+    left = ex.partial_forward(step=1, data=x)
+    assert left > 0
+    left = ex.partial_forward(step=2)
+    assert left > 0
+    left = ex.partial_forward()  # finish
+    assert left == 0
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(),
+                               np.maximum(x, 0) * 2 + 1, rtol=1e-6)
+
+
+def test_multi_output_head_grad_warning():
+    a = sym.Variable('a')
+    net = sym.Group([a * 2.0, a * 3.0])
+    ex = net.simple_bind(mx.cpu(), grad_req='write', a=(2,))
+    ex.forward(is_train=True, a=np.ones(2, np.float32))
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter('always')
+        ex.backward()
+        assert any('head gradients' in str(r.message) for r in rec)
+    np.testing.assert_allclose(ex.grad_dict['a'].asnumpy(), [5.0, 5.0])
+
+
+def test_work_load_list_rejected_when_uneven():
+    from mxnet_tpu.module.executor_group import decide_slices
+    decide_slices(8, [1, 1])  # uniform ok
+    with pytest.raises(mx.base.MXNetError):
+        decide_slices(8, [1, 3])
+    net = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Variable('data'), num_hidden=3,
+                           name='fc'), name='softmax')
+    with pytest.raises(mx.base.MXNetError):
+        mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)],
+                      work_load_list=[1, 2]).bind(
+            data_shapes=[mx.io.DataDesc('data', (4, 4))],
+            label_shapes=[mx.io.DataDesc('softmax_label', (4,))])
+
+
+def test_partial_forward_resolves_init_shapes():
+    """partial_forward must thread bidirectionally-resolved shapes into
+    zeros(shape=(0,H)) init nodes, same as the full forward."""
+    z = sym.zeros(shape=(0, 4), name='z0')
+    fc = sym.FullyConnected(sym.Variable('data'), num_hidden=4,
+                            name='pfc')
+    out = z + fc
+    ex = out.simple_bind(mx.cpu(), grad_req='null', data=(3, 5))
+    x = np.random.rand(3, 5).astype(np.float32)
+    left = ex.partial_forward(step=1, data=x)
+    assert left > 0
+    assert ex.partial_forward() == 0
+    assert ex.outputs[0].shape == (3, 4)
+
+
+def test_symbolic_optimizer_op_state_persists_in_eval_forward():
+    """aux_always ops (sgd_mom_update & co) advance their states even
+    under forward(is_train=False) — graph-mode parity with the
+    reference's in-place state mutation."""
+    w = sym.Variable('w')
+    g = sym.Variable('g')
+    net = sym.sgd_mom_update(w, g, lr=0.1, momentum=0.9,
+                             name='upd')
+    ex = net.simple_bind(mx.cpu(), grad_req='null', w=(3,), g=(3,))
+    ex.arg_dict['w'][:] = 1.0
+    ex.arg_dict['g'][:] = 1.0
+    mom_name = ex.aux_dict and list(ex.aux_dict)[0]
+    ex.forward(is_train=False)
+    m1 = ex.aux_dict[mom_name].asnumpy().copy()
+    np.testing.assert_allclose(m1, -0.1, rtol=1e-6)
+    ex.forward(is_train=False)
+    m2 = ex.aux_dict[mom_name].asnumpy()
+    np.testing.assert_allclose(m2, 0.9 * -0.1 - 0.1, rtol=1e-6)

@@ -37,7 +37,9 @@ def test_profiler_chrome_trace(tmp_path):
     assert any('forward' in n for n in names)
     assert any('backward' in n for n in names)
     for e in trace['traceEvents']:
-        assert e['ph'] == 'X' and e['dur'] >= 0
+        assert e['ph'] in ('X', 'M')
+        if e['ph'] == 'X':
+            assert e['dur'] >= 0
     mx.profiler.clear()
 
 
@@ -248,3 +250,34 @@ def test_rtc_grid_as_list():
     x = nd.array(np.ones((8, 128), np.float32))
     out = k.push([x], out_shapes=[(8, 128)])
     np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_profiler_device_lanes(tmp_path):
+    """profile_xla=True merges XLA per-op spans into dump_profile()'s
+    chrome trace as extra process lanes (pid >= 100) — the reference's
+    per-op device attribution (SURVEY.md §5.1)."""
+    import json
+    out = str(tmp_path / 'prof.json')
+    mx.profiler.profiler_set_config(mode='symbolic', filename=out,
+                                    profile_xla=True,
+                                    xla_trace_dir=str(tmp_path / 'xla'))
+    mx.profiler.profiler_set_state('run')
+    a = nd.array(np.random.rand(64, 64).astype(np.float32))
+    for _ in range(3):
+        b = nd.dot(a, a)
+        b.asnumpy()
+    mx.profiler.profiler_set_state('stop')
+    path = mx.profiler.dump_profile()
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace['traceEvents']
+    lanes = [e for e in events if e.get('ph') == 'M' and
+             e['pid'] >= 100]
+    assert lanes, 'no XLA lanes merged into the dump'
+    xla_spans = [e for e in events if e.get('ph') == 'X' and
+                 e['pid'] >= 100]
+    assert xla_spans, 'no XLA op spans in the dump'
+    # reset so later tests see a clean profiler
+    mx.profiler.profiler_set_config(mode='symbolic',
+                                    filename='profile.json')
+    mx.profiler.clear()
