@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""DCGAN on MNIST-sized images (reference example/gan/dcgan.py).
+
+Generator: z -> Deconvolution stack -> 28x28 image; discriminator:
+Convolution stack -> logistic real/fake.  Two Modules trained
+adversarially with the classic alternating scheme; synthetic blob data
+stands in when MNIST is unavailable (zero-egress environments).
+
+  python examples/gan/dcgan_mnist.py --num-epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import sym               # noqa: E402
+
+
+def make_generator(ngf=32, code_dim=64):
+    z = sym.Variable('code')
+    g = sym.FullyConnected(z, num_hidden=ngf * 2 * 7 * 7, name='g_fc')
+    g = sym.Activation(g, act_type='relu')
+    g = sym.Reshape(g, shape=(-1, ngf * 2, 7, 7))
+    g = sym.Deconvolution(g, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                          num_filter=ngf, name='g_dc1')     # 14x14
+    g = sym.BatchNorm(g, fix_gamma=False, name='g_bn1')
+    g = sym.Activation(g, act_type='relu')
+    g = sym.Deconvolution(g, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                          num_filter=1, name='g_dc2')       # 28x28
+    return sym.Activation(g, act_type='tanh', name='g_out')
+
+
+def make_discriminator(ndf=32):
+    data = sym.Variable('data')
+    d = sym.Convolution(data, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                        num_filter=ndf, name='d_c1')        # 14x14
+    d = sym.LeakyReLU(d, act_type='leaky', slope=0.2)
+    d = sym.Convolution(d, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                        num_filter=ndf * 2, name='d_c2')    # 7x7
+    d = sym.BatchNorm(d, fix_gamma=False, name='d_bn2')
+    d = sym.LeakyReLU(d, act_type='leaky', slope=0.2)
+    d = sym.Flatten(d)
+    d = sym.FullyConnected(d, num_hidden=1, name='d_fc')
+    return sym.LogisticRegressionOutput(d, name='dloss')
+
+
+def real_images(n, seed=0):
+    """MNIST if cached locally, else structured synthetic digits."""
+    try:
+        from mxnet_tpu.gluon.data.vision import MNIST
+        ds = MNIST(train=True)
+        imgs = np.stack([np.asarray(ds[i][0]).reshape(28, 28)
+                         for i in range(n)]) / 127.5 - 1.0
+        return imgs[:, None].astype(np.float32)
+    except Exception:
+        rs = np.random.RandomState(seed)
+        xs, ys = np.meshgrid(np.arange(28), np.arange(28))
+        imgs = []
+        for _ in range(n):
+            cx, cy = rs.uniform(8, 20, 2)
+            r = rs.uniform(3, 8)
+            img = (((xs - cx) ** 2 + (ys - cy) ** 2) < r * r)
+            imgs.append(img * 2.0 - 1.0)
+        return np.asarray(imgs, np.float32)[:, None]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--num-epochs', type=int, default=3)
+    ap.add_argument('--num-images', type=int, default=1024)
+    ap.add_argument('--code-dim', type=int, default=64)
+    ap.add_argument('--lr', type=float, default=2e-4)
+    args = ap.parse_args()
+
+    ctx = mx.current_context()
+    bs = args.batch_size
+    gen = mx.mod.Module(make_generator(code_dim=args.code_dim),
+                        data_names=('code',), label_names=None,
+                        context=ctx)
+    gen.bind(data_shapes=[mx.io.DataDesc('code', (bs, args.code_dim))],
+             label_shapes=None, inputs_need_grad=True)
+    gen.init_params(initializer=mx.init.Normal(0.02))
+    gen.init_optimizer(optimizer='adam',
+                       optimizer_params={'learning_rate': args.lr,
+                                         'beta1': 0.5})
+
+    disc = mx.mod.Module(make_discriminator(),
+                         label_names=('dloss_label',), context=ctx)
+    disc.bind(data_shapes=[mx.io.DataDesc('data', (bs, 1, 28, 28))],
+              label_shapes=[mx.io.DataDesc('dloss_label', (bs, 1))],
+              inputs_need_grad=True)
+    disc.init_params(initializer=mx.init.Normal(0.02))
+    disc.init_optimizer(optimizer='adam',
+                        optimizer_params={'learning_rate': args.lr,
+                                          'beta1': 0.5})
+
+    data = real_images(args.num_images)
+    rs = np.random.RandomState(1)
+    ones = mx.nd.ones((bs, 1))
+    zeros = mx.nd.zeros((bs, 1))
+    n_batches = len(data) // bs
+    for epoch in range(args.num_epochs):
+        perm = rs.permutation(len(data))
+        d_acc = g_fool = 0.0
+        for i in range(n_batches):
+            real = mx.nd.array(data[perm[i * bs:(i + 1) * bs]])
+            code = mx.nd.array(rs.randn(bs, args.code_dim)
+                               .astype(np.float32))
+            # G forward
+            gen.forward(mx.io.DataBatch(data=[code]), is_train=True)
+            fake = gen.get_outputs()[0]
+            # D on fake (label 0), backprop into D
+            disc.forward(mx.io.DataBatch(data=[fake], label=[zeros]),
+                         is_train=True)
+            p_fake = disc.get_outputs()[0].asnumpy()
+            disc.backward()
+            grads_fake = [[g.copy() for g in disc._exec_group
+                           .grad_arrays if g is not None]]
+            # D on real (label 1), accumulate and update
+            disc.forward(mx.io.DataBatch(data=[real], label=[ones]),
+                         is_train=True)
+            p_real = disc.get_outputs()[0].asnumpy()
+            disc.backward()
+            for g, gf in zip([g for g in disc._exec_group.grad_arrays
+                              if g is not None], grads_fake[0]):
+                g += gf
+            disc.update()
+            # G step: D(fake) toward 1, pass dD/dinput back through G
+            disc.forward(mx.io.DataBatch(data=[fake], label=[ones]),
+                         is_train=True)
+            disc.backward()
+            gen.backward(disc.get_input_grads())
+            gen.update()
+            d_acc += ((p_real > 0.5).mean() +
+                      (p_fake < 0.5).mean()) / 2
+            g_fool += (p_fake > 0.5).mean()
+        print('epoch %d: D acc %.3f, G fool-rate %.3f'
+              % (epoch, d_acc / n_batches, g_fool / n_batches))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Model-parallel stacked LSTM (reference example/model-parallel-lstm/):
+each LSTM layer is pinned to a different device via AttrScope
+ctx_group + group2ctx, the reference's model-parallelism mechanism
+(PlaceDevice pass; here executor.py's grouped eager dispatch).
+
+Run under the virtual CPU mesh for a multi-device demo:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python examples/model_parallel/lstm_model_parallel.py
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import sym               # noqa: E402
+
+
+def build(seq_len, vocab, num_hidden, num_layers, num_embed):
+    """Each layer in its own ctx_group ('layer0', 'layer1', ...)."""
+    data = sym.Variable('data')
+    label = sym.Variable('softmax_label')
+    with mx.AttrScope(ctx_group='embed'):
+        inputs = sym.Embedding(data, input_dim=vocab,
+                               output_dim=num_embed, name='embed')
+    outputs = inputs
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group='layer%d' % i):
+            cell = mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                   prefix='lstm_l%d_' % i)
+            outputs, _ = cell.unroll(seq_len, inputs=outputs,
+                                     merge_outputs=True)
+    with mx.AttrScope(ctx_group='head'):
+        pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab, name='pred')
+        lab = sym.Reshape(label, shape=(-1,))
+        net = sym.SoftmaxOutput(pred, label=lab, name='softmax')
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--seq-len', type=int, default=8)
+    ap.add_argument('--vocab', type=int, default=16)
+    ap.add_argument('--num-hidden', type=int, default=64)
+    ap.add_argument('--num-layers', type=int, default=2)
+    ap.add_argument('--num-embed', type=int, default=32)
+    ap.add_argument('--batch-size', type=int, default=16)
+    ap.add_argument('--num-steps', type=int, default=80)
+    ap.add_argument('--lr', type=float, default=5.0)
+    args = ap.parse_args()
+
+    import jax
+    devices = jax.devices()
+    n_dev = len(devices)
+    ctx_of = lambda i: mx.Context(
+        'cpu' if devices[0].platform == 'cpu' else 'tpu', i % n_dev)
+    group2ctx = {'embed': ctx_of(0), 'head': ctx_of(n_dev - 1)}
+    for i in range(args.num_layers):
+        group2ctx['layer%d' % i] = ctx_of(1 + i)
+    print('placement: %s over %d device(s)' % (
+        {k: str(v) for k, v in group2ctx.items()}, n_dev))
+
+    net = build(args.seq_len, args.vocab, args.num_hidden,
+                args.num_layers, args.num_embed)
+    ex = net.simple_bind(ctx_of(0), grad_req='write',
+                         group2ctx=group2ctx,
+                         data=(args.batch_size, args.seq_len),
+                         softmax_label=(args.batch_size, args.seq_len))
+    init = mx.init.Xavier()
+    for name, arr in ex.arg_dict.items():
+        if name not in ('data', 'softmax_label'):
+            init(mx.init.InitDesc(name), arr)
+
+    rs = np.random.RandomState(0)
+    # learnable structure: next token = (token + 1) % vocab
+    base = rs.randint(0, args.vocab,
+                      (args.batch_size, args.seq_len + 1))
+    for i in range(1, args.seq_len + 1):
+        base[:, i] = (base[:, i - 1] + 1) % args.vocab
+    x = base[:, :-1].astype(np.float32)
+    y = base[:, 1:].astype(np.float32)
+
+    lr = args.lr
+    for step in range(args.num_steps):
+        ex.forward_backward(data=x, softmax_label=y)
+        for name, grad in ex.grad_dict.items():
+            if name in ('data', 'softmax_label'):
+                continue
+            ex.arg_dict[name] -= (lr / x.size) * grad
+        if step % 10 == 0 or step == args.num_steps - 1:
+            probs = ex.outputs[0].asnumpy().reshape(
+                args.batch_size, args.seq_len, args.vocab)
+            nll = -np.log(np.maximum(
+                probs[np.arange(args.batch_size)[:, None],
+                      np.arange(args.seq_len)[None],
+                      y.astype(int)], 1e-8)).mean()
+            print('step %3d loss %.4f' % (step, nll))
+    assert np.isfinite(nll)
+    print('done: final loss %.4f' % nll)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Character-level RNN language model (reference example/rnn — the
+char-rnn workload: learn next-character prediction, then sample text).
+
+Trains a stacked-LSTM char model on a text file (or a built-in
+pangram corpus) with the Module API, then greedily samples from it.
+
+  python examples/rnn/char_rnn.py --num-epochs 5 --sample 120
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import numpy as np                      # noqa: E402
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import sym               # noqa: E402
+
+_BUILTIN = ('the quick brown fox jumps over the lazy dog. '
+            'pack my box with five dozen liquor jugs. '
+            'how vexingly quick daft zebras jump! ') * 120
+
+
+def load_corpus(path):
+    if path and os.path.exists(path):
+        with open(path) as f:
+            return f.read()
+    return _BUILTIN
+
+
+def build_sym(vocab, seq_len, num_hidden, num_layers, num_embed,
+              for_training=True):
+    data = sym.Variable('data')
+    embed = sym.Embedding(data, input_dim=vocab, output_dim=num_embed,
+                          name='embed')
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                  prefix='lstm_l%d_' % i))
+    outputs, _ = stack.unroll(seq_len, inputs=embed,
+                              merge_outputs=True)
+    pred = sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = sym.FullyConnected(pred, num_hidden=vocab, name='pred')
+    if not for_training:
+        return sym.softmax(pred), stack
+    label = sym.Reshape(sym.Variable('softmax_label'), shape=(-1,))
+    return sym.SoftmaxOutput(pred, label=label, name='softmax'), stack
+
+
+def make_batches(text, char2idx, seq_len, batch_size):
+    ids = np.array([char2idx[c] for c in text], np.float32)
+    n_seq = (len(ids) - 1) // seq_len
+    x = ids[:n_seq * seq_len].reshape(n_seq, seq_len)
+    y = ids[1:n_seq * seq_len + 1].reshape(n_seq, seq_len)
+    n_batch = n_seq // batch_size * batch_size
+    return x[:n_batch], y[:n_batch]
+
+
+def sample(mod_sym, stack, arg_params, vocab, idx2char, char2idx,
+           seed_text, length, seq_len, ctx):
+    """Greedy sampling: slide a seq_len window, take the argmax of the
+    last position's distribution."""
+    text = seed_text
+    pred_mod = mx.mod.Module(mod_sym, context=ctx, label_names=None)
+    pred_mod.bind(data_shapes=[mx.io.DataDesc('data', (1, seq_len))],
+                  label_shapes=None, for_training=False)
+    pred_mod.set_params(arg_params, {}, allow_missing=True)
+    for _ in range(length):
+        window = text[-seq_len:].rjust(seq_len)
+        ids = np.array([[char2idx.get(c, 0) for c in window]],
+                       np.float32)
+        pred_mod.forward(mx.io.DataBatch(data=[mx.nd.array(ids)]),
+                         is_train=False)
+        probs = pred_mod.get_outputs()[0].asnumpy()
+        nxt = int(probs.reshape(seq_len, -1)[-1].argmax())
+        text += idx2char[nxt]
+    return text
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--corpus', default=None)
+    ap.add_argument('--seq-len', type=int, default=32)
+    ap.add_argument('--batch-size', type=int, default=32)
+    ap.add_argument('--num-hidden', type=int, default=128)
+    ap.add_argument('--num-layers', type=int, default=2)
+    ap.add_argument('--num-embed', type=int, default=64)
+    ap.add_argument('--num-epochs', type=int, default=5)
+    ap.add_argument('--lr', type=float, default=0.01)
+    ap.add_argument('--sample', type=int, default=120)
+    args = ap.parse_args()
+
+    text = load_corpus(args.corpus)
+    chars = sorted(set(text))
+    vocab = len(chars)
+    char2idx = {c: i for i, c in enumerate(chars)}
+    idx2char = {i: c for i, c in enumerate(chars)}
+    print('corpus: %d chars, vocab %d' % (len(text), vocab))
+
+    x, y = make_batches(text, char2idx, args.seq_len, args.batch_size)
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size,
+                           shuffle=True, label_name='softmax_label')
+    net, stack = build_sym(vocab, args.seq_len, args.num_hidden,
+                           args.num_layers, args.num_embed)
+    ctx = mx.current_context()
+    mod = mx.mod.Module(net, context=ctx)
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    mod.fit(it, num_epoch=args.num_epochs, eval_metric=ppl,
+            optimizer='adam',
+            optimizer_params={'learning_rate': args.lr},
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, 20))
+    if args.sample:
+        arg_params, _ = mod.get_params()
+        pred_net, _ = build_sym(vocab, args.seq_len, args.num_hidden,
+                                args.num_layers, args.num_embed,
+                                for_training=False)
+        out = sample(pred_net, stack, arg_params, vocab, idx2char,
+                     char2idx, 'the quick', args.sample, args.seq_len,
+                     ctx)
+        print('sampled: %r' % out)
+
+
+if __name__ == '__main__':
+    main()

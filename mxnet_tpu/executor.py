@@ -51,7 +51,11 @@ class Executor:
         self._diff_names = [n for n in self._arg_names
                             if grad_req_dict.get(n, 'null') != 'null']
         self.outputs = []
-        self._key = _random.next_key()
+        # committed to the executor's device: the fused train step
+        # returns the (donated) key committed, and an uncommitted key
+        # on call 1 vs committed on call 2 would change the jit
+        # sharding signature and force a full recompile
+        self._key = jax.device_put(_random.next_key(), ctx.jax_device())
         self._monitor_callback = None
         self._build()
 
