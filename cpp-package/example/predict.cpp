@@ -1,0 +1,37 @@
+// The C predict demo (examples/c_predict/predict.c) rewritten on the
+// C++ header API — reference cpp-package example style.
+//
+//   predict_cpp <checkpoint-prefix> <epoch> <input.f32> <d0> [d1...]
+#include <cstdlib>
+#include <iostream>
+
+#include "mxnet-tpu-cpp/MxTpuCpp.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::cerr << "usage: " << argv[0]
+              << " prefix epoch in.f32 d0 [d1 d2 d3]\n";
+    return 2;
+  }
+  mxtpu::cpp::Shape shape;
+  size_t n = 1;
+  for (int i = 4; i < argc; ++i) {
+    shape.push_back(std::atoi(argv[i]));
+    n *= shape.back();
+  }
+  std::string raw = mxtpu::cpp::ReadFile(argv[3]);
+  std::vector<float> input(
+      reinterpret_cast<const float*>(raw.data()),
+      reinterpret_cast<const float*>(raw.data()) + n);
+
+  auto pred = mxtpu::cpp::Predictor::FromCheckpoint(
+      argv[1], std::atoi(argv[2]), {{"data", shape}});
+  pred.SetInput("data", input);
+  pred.Forward();
+  std::vector<float> out = pred.GetOutput(0);
+  size_t best = 0;
+  for (size_t i = 1; i < out.size(); ++i)
+    if (out[i] > out[best]) best = i;
+  std::cout << "predicted=" << best << " score=" << out[best] << "\n";
+  return 0;
+}

@@ -1,0 +1,241 @@
+// Header-only C++ API over the C predict ABI (libmxtpu.so).
+//
+// TPU-native counterpart of the reference's cpp-package
+// (/root/reference/cpp-package/include/mxnet-cpp/: NDArray.hpp,
+// predictor usage in example/image-classification/predict-cpp): thin
+// RAII types over the same C ABI every binding consumes.  The training
+// surface of the reference cpp-package maps to the Python/JAX runtime;
+// this header covers the deployment path (load checkpoint, forward,
+// read outputs) plus the param-blob reader.
+//
+//   #include "mxnet-tpu-cpp/MxTpuCpp.hpp"
+//   mxtpu::cpp::Predictor pred(json, params, {{"data", {1, 12}}});
+//   pred.SetInput("data", x);
+//   pred.Forward();
+//   std::vector<float> out = pred.GetOutput(0);
+#ifndef MXNET_TPU_CPP_MXTPUCPP_HPP_
+#define MXNET_TPU_CPP_MXTPUCPP_HPP_
+
+#include <cstdint>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+int MXTPredCreate(const char*, const void*, int, int, int, uint32_t,
+                  const char**, const uint32_t*, const uint32_t*, void**);
+int MXTPredCreatePartialOut(const char*, const void*, int, int, int,
+                            uint32_t, const char**, const uint32_t*,
+                            const uint32_t*, uint32_t, const char**,
+                            void**);
+int MXTPredGetOutputShape(void*, uint32_t, const uint32_t**, uint32_t*);
+int MXTPredSetInput(void*, const char*, const float*, uint32_t);
+int MXTPredForward(void*);
+int MXTPredPartialForward(void*, int, int*);
+int MXTPredGetOutput(void*, uint32_t, float*, uint32_t);
+int MXTPredReshape(void*, uint32_t, const char**, const uint32_t*,
+                   const uint32_t*);
+void MXTPredFree(void*);
+int MXTNDListCreate(const char*, int, void**, uint32_t*);
+int MXTNDListGet(void*, uint32_t, const char**, const float**,
+                 const uint32_t**, uint32_t*);
+void MXTNDListFree(void*);
+const char* MXTPredGetLastError(void);
+}
+
+namespace mxtpu {
+namespace cpp {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0)
+    throw Error(std::string(what) + ": " + MXTPredGetLastError());
+}
+
+inline std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw Error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+using Shape = std::vector<uint32_t>;
+
+enum DeviceType { kCPU = 1, kTPU = 2 };
+
+// Forward-only model server over a Module.save_checkpoint artifact
+// pair (reference MXPredCreate contract).
+class Predictor {
+ public:
+  Predictor(const std::string& symbol_json, const std::string& param_blob,
+            const std::map<std::string, Shape>& input_shapes,
+            DeviceType dev = kCPU, int dev_id = 0,
+            const std::vector<std::string>& output_keys = {}) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    if (output_keys.empty()) {
+      Check(MXTPredCreate(symbol_json.c_str(), param_blob.data(),
+                          static_cast<int>(param_blob.size()), dev,
+                          dev_id, static_cast<uint32_t>(keys.size()),
+                          keys.data(), indptr.data(), dims.data(),
+                          &handle_),
+            "MXTPredCreate");
+    } else {
+      std::vector<const char*> outs;
+      for (const auto& k : output_keys) outs.push_back(k.c_str());
+      Check(MXTPredCreatePartialOut(
+                symbol_json.c_str(), param_blob.data(),
+                static_cast<int>(param_blob.size()), dev, dev_id,
+                static_cast<uint32_t>(keys.size()), keys.data(),
+                indptr.data(), dims.data(),
+                static_cast<uint32_t>(outs.size()), outs.data(),
+                &handle_),
+            "MXTPredCreatePartialOut");
+    }
+  }
+
+  // Load prefix-symbol.json + prefix-%04d.params from disk.
+  static Predictor FromCheckpoint(
+      const std::string& prefix, int epoch,
+      const std::map<std::string, Shape>& input_shapes,
+      DeviceType dev = kCPU, int dev_id = 0) {
+    char buf[32];
+    std::snprintf(buf, sizeof(buf), "-%04d.params", epoch);
+    return Predictor(ReadFile(prefix + "-symbol.json"),
+                     ReadFile(prefix + buf), input_shapes, dev, dev_id);
+  }
+
+  Predictor(Predictor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  Predictor& operator=(Predictor&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  Predictor(const Predictor&) = delete;
+  Predictor& operator=(const Predictor&) = delete;
+  ~Predictor() {
+    if (handle_ != nullptr) MXTPredFree(handle_);
+  }
+
+  void SetInput(const std::string& key, const std::vector<float>& data) {
+    Check(MXTPredSetInput(handle_, key.c_str(), data.data(),
+                          static_cast<uint32_t>(data.size())),
+          "MXTPredSetInput");
+  }
+
+  void Forward() { Check(MXTPredForward(handle_), "MXTPredForward"); }
+
+  // Run the first `step` op nodes; returns how many remain.
+  int PartialForward(int step) {
+    int left = 0;
+    Check(MXTPredPartialForward(handle_, step, &left),
+          "MXTPredPartialForward");
+    return left;
+  }
+
+  Shape GetOutputShape(uint32_t index = 0) const {
+    const uint32_t* data = nullptr;
+    uint32_t ndim = 0;
+    Check(MXTPredGetOutputShape(handle_, index, &data, &ndim),
+          "MXTPredGetOutputShape");
+    return Shape(data, data + ndim);
+  }
+
+  std::vector<float> GetOutput(uint32_t index = 0) const {
+    Shape s = GetOutputShape(index);
+    uint32_t n = 1;
+    for (uint32_t d : s) n *= d;
+    std::vector<float> out(n);
+    Check(MXTPredGetOutput(handle_, index, out.data(), n),
+          "MXTPredGetOutput");
+    return out;
+  }
+
+  void Reshape(const std::map<std::string, Shape>& input_shapes) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, dims;
+    for (const auto& kv : input_shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    Check(MXTPredReshape(handle_, static_cast<uint32_t>(keys.size()),
+                         keys.data(), indptr.data(), dims.data()),
+          "MXTPredReshape");
+  }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+// Named float32 array view into a loaded .params blob (reference
+// MXNDListCreate consumers: mean images, standalone weight readers).
+struct NDArrayView {
+  std::string name;
+  Shape shape;
+  const float* data;  // owned by the NDList
+  size_t size;
+};
+
+class NDList {
+ public:
+  explicit NDList(const std::string& blob) {
+    uint32_t n = 0;
+    Check(MXTNDListCreate(blob.data(), static_cast<int>(blob.size()),
+                          &handle_, &n),
+          "MXTNDListCreate");
+    for (uint32_t i = 0; i < n; ++i) {
+      const char* key = nullptr;
+      const float* data = nullptr;
+      const uint32_t* shp = nullptr;
+      uint32_t ndim = 0;
+      Check(MXTNDListGet(handle_, i, &key, &data, &shp, &ndim),
+            "MXTNDListGet");
+      NDArrayView v;
+      v.name = key;
+      v.shape.assign(shp, shp + ndim);
+      v.data = data;
+      v.size = 1;
+      for (uint32_t d : v.shape) v.size *= d;
+      items_.push_back(std::move(v));
+    }
+  }
+  NDList(const NDList&) = delete;
+  NDList& operator=(const NDList&) = delete;
+  ~NDList() {
+    if (handle_ != nullptr) MXTNDListFree(handle_);
+  }
+
+  size_t size() const { return items_.size(); }
+  const NDArrayView& operator[](size_t i) const { return items_[i]; }
+  std::vector<NDArrayView>::const_iterator begin() const {
+    return items_.begin();
+  }
+  std::vector<NDArrayView>::const_iterator end() const {
+    return items_.end();
+  }
+
+ private:
+  void* handle_ = nullptr;
+  std::vector<NDArrayView> items_;
+};
+
+}  // namespace cpp
+}  // namespace mxtpu
+
+#endif  // MXNET_TPU_CPP_MXTPUCPP_HPP_

@@ -1,0 +1,92 @@
+/* Minimal deployment client for the C predict ABI (libmxtpu.so).
+ *
+ * Mirrors the reference's image-classification/predict-cpp consumer of
+ * c_predict_api.h: load a checkpoint (symbol JSON + param blob) saved
+ * by Module.save_checkpoint, feed one flat float32 input, forward,
+ * print the argmax class.  No Python in this file — the runtime is
+ * behind the C ABI.
+ *
+ *   predict <symbol.json> <weights.params> <input.f32> <d0> [d1 d2 d3]
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+
+extern int MXTPredCreate(const char* symbol_json, const void* param_bytes,
+                         int param_size, int dev_type, int dev_id,
+                         uint32_t num_input_nodes, const char** input_keys,
+                         const uint32_t* input_shape_indptr,
+                         const uint32_t* input_shape_data, void** out);
+extern int MXTPredSetInput(void* h, const char* key, const float* data,
+                           uint32_t size);
+extern int MXTPredForward(void* h);
+extern int MXTPredGetOutputShape(void* h, uint32_t index,
+                                 const uint32_t** shape_data,
+                                 uint32_t* ndim);
+extern int MXTPredGetOutput(void* h, uint32_t index, float* data,
+                            uint32_t size);
+extern void MXTPredFree(void* h);
+extern const char* MXTPredGetLastError(void);
+
+static char* read_file(const char* path, long* size) {
+  FILE* f = fopen(path, "rb");
+  if (!f) { fprintf(stderr, "cannot open %s\n", path); exit(2); }
+  fseek(f, 0, SEEK_END);
+  *size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char* buf = (char*)malloc(*size + 1);
+  if (fread(buf, 1, *size, f) != (size_t)*size) exit(2);
+  buf[*size] = 0;
+  fclose(f);
+  return buf;
+}
+
+#define CHECK(call)                                                     \
+  if ((call) != 0) {                                                    \
+    fprintf(stderr, "%s failed: %s\n", #call, MXTPredGetLastError());   \
+    return 1;                                                           \
+  }
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr, "usage: %s sym.json w.params in.f32 d0 [d1 d2 d3]\n",
+            argv[0]);
+    return 2;
+  }
+  long json_size, param_size, in_size;
+  char* json = read_file(argv[1], &json_size);
+  char* params = read_file(argv[2], &param_size);
+  float* input = (float*)read_file(argv[3], &in_size);
+  uint32_t shape[4], ndim = (uint32_t)argc - 4, n = 1;
+  for (uint32_t i = 0; i < ndim; ++i) {
+    shape[i] = (uint32_t)atoi(argv[4 + i]);
+    n *= shape[i];
+  }
+  const char* input_keys[] = {"data"};
+  uint32_t indptr[] = {0, ndim};
+
+  void* pred = NULL;
+  CHECK(MXTPredCreate(json, params, (int)param_size, 1, 0, 1, input_keys,
+                      indptr, shape, &pred));
+  CHECK(MXTPredSetInput(pred, "data", input, n));
+  CHECK(MXTPredForward(pred));
+
+  const uint32_t* oshape;
+  uint32_t ondim, osize = 1;
+  CHECK(MXTPredGetOutputShape(pred, 0, &oshape, &ondim));
+  for (uint32_t i = 0; i < ondim; ++i) osize *= oshape[i];
+  float* out = (float*)malloc(osize * sizeof(float));
+  CHECK(MXTPredGetOutput(pred, 0, out, osize));
+
+  uint32_t best = 0;
+  for (uint32_t i = 1; i < osize; ++i)
+    if (out[i] > out[best]) best = i;
+  printf("predicted=%u score=%.6f\n", best, out[best]);
+
+  MXTPredFree(pred);
+  free(out);
+  free(input);
+  free(params);
+  free(json);
+  return 0;
+}

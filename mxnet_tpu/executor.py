@@ -57,6 +57,10 @@ class Executor:
         # sharding signature and force a full recompile
         self._key = jax.device_put(_random.next_key(), ctx.jax_device())
         self._monitor_callback = None
+        # observability: how many whole-step fused dispatches ran (the
+        # per-step fusion invariant "1 dispatch per batch" is asserted
+        # on this in tests)
+        self.fused_dispatches = 0
         self._build()
 
     # ------------------------------------------------------------------
@@ -347,6 +351,42 @@ class Executor:
 
         return jax.jit(multistep, donate_argnums=(0, 3, 4, 5, 6))
 
+    def _align_step_placement(self, diff_vals, moms, masters):
+        """A donated jit call requires every committed argument to live
+        on the same device set, and the weights define it: when they are
+        sharded over a multi-device mesh, a PRNG key (or optimizer state
+        restored before the mesh bind) still committed to one device
+        makes jax refuse the dispatch.  Re-commit the key replicated
+        over the weights' mesh and any stale moms/masters to their
+        weight's sharding.  moms/masters are aligned with diff_vals."""
+        shard = mesh = None
+        for v in diff_vals:
+            s = getattr(v, 'sharding', None)
+            m = getattr(s, 'mesh', None)
+            if m is not None and m.devices.size > 1:
+                shard, mesh = s, m
+                break
+        if mesh is None:
+            return moms, masters
+        from jax.sharding import NamedSharding, PartitionSpec
+        devset = shard.device_set
+        key_sh = getattr(self._key, 'sharding', None)
+        if key_sh is None or key_sh.device_set != devset:
+            self._key = jax.device_put(
+                self._key, NamedSharding(mesh, PartitionSpec()))
+
+        def recommit(state, w):
+            if state is None:
+                return state
+            sh = getattr(state, 'sharding', None)
+            if sh is not None and sh.device_set == devset:
+                return state
+            return jax.device_put(state, w.sharding)
+
+        moms = [recommit(m, w) for m, w in zip(moms, diff_vals)]
+        masters = [recommit(m, w) for m, w in zip(masters, diff_vals)]
+        return moms, masters
+
     def run_fused_multistep(self, step, diff_names, scan_names,
                             scan_stacks, moms, masters, lrs, wds):
         """Execute a step from make_fused_multistep over the bound
@@ -367,6 +407,9 @@ class Executor:
                               if n in scan_set and n not in diff_set)
         inv_vals = tuple(self.arg_dict[n]._data for n in inv_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
+        moms, masters = self._align_step_placement(diff_vals, moms,
+                                                   masters)
+        self.fused_dispatches += 1
         with profiler.scope(self._name('fused_multistep')):
             (outs, new_aux, new_ws, new_moms, new_masters,
              self._key) = step(diff_vals, scan_vals, inv_vals, aux_vals,

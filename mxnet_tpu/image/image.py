@@ -68,6 +68,30 @@ def imresize(src, w, h, interp=1):
     return _like(out, src)
 
 
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0):
+    """Pad an image with a border (reference image_io.cc
+    _cvcopyMakeBorder).  border_type 0 = constant fill with `value`;
+    other cv2 border modes pass through when cv2 is present."""
+    img = _asnp(src)
+    if cv2 is not None:
+        # a scalar value must fill every channel; cv2 treats a bare
+        # scalar as Scalar(v, 0, 0, 0) (channel 0 only)
+        fill = value
+        if np.isscalar(fill) and img.ndim == 3:
+            fill = (float(value),) * img.shape[2]
+        out = cv2.copyMakeBorder(img, top, bot, left, right,
+                                 borderType=border_type, value=fill)
+    else:
+        if border_type != 0:
+            raise MXNetError('only constant border without cv2')
+        pads = [(top, bot), (left, right)] + \
+            [(0, 0)] * (img.ndim - 2)
+        out = np.pad(img, pads, mode='constant', constant_values=value)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return _like(out, src)
+
+
 def scale_down(src_size, size):
     """Scale target size down so it fits in src_size, keeping ratio."""
     w, h = size

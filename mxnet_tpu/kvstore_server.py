@@ -15,16 +15,23 @@ TPU-native rebuild of the reference's ps-lite distribution layer
     Useful when worker processes run independent (non-SPMD) programs or
     optimizer state must live host-side, and for `dist_async`.
 
-Transport is length-prefixed pickles over sockets (ZeroMQ's role in
-ps-lite).  TRUST BOUNDARY: like the reference's ps-lite, this protocol
-assumes a private cluster network — pickle deserialization (and
-set_optimizer by design) executes code, so anyone who can speak the
-protocol controls the process.  Two mitigations narrow the surface
-beyond the reference: (1) every frame carries an HMAC-SHA256 tag keyed
-by DMLC_PS_TOKEN (or, absent a token, a key derived from the
+Transport is length-prefixed frames over sockets (ZeroMQ's role in
+ps-lite).  TRUST BOUNDARY (tighter than the reference's ps-lite, which
+trusts the whole network): (1) every frame carries an HMAC-SHA256 tag
+keyed by DMLC_PS_TOKEN (or, absent a token, a key derived from the
 DMLC_PS_ROOT_URI:PORT rendezvous — integrity against stray peers, not
-secrecy; set DMLC_PS_TOKEN for a real shared secret), and frames with
-bad tags are dropped before unpickling; (2) servers bind to
+secrecy), and frames with bad tags are dropped before decoding;
+(2) the data path (push/pull/init/barrier/...) uses a restricted
+binary codec — command tuples of scalars/strings/ndarrays only — so a
+forged-or-replayed frame can at worst corrupt tensor values, never
+execute code.  Pickle exists ONLY on the documented set_optimizer
+channel (the reference ships the optimizer to servers the same way,
+kvstore.py:239), decoded inside its handler — and that channel refuses
+to run unless DMLC_PS_TOKEN is set, so the guessable derived key can
+never reach code execution; (3) a server binding a
+non-loopback interface REFUSES to start unless DMLC_PS_TOKEN is set —
+the derived rendezvous key is guessable by anyone who can reach the
+port, which is acceptable on localhost only; (4) servers bind to
 DMLC_PS_BIND_URI / DMLC_PS_ROOT_URI when that address is local
 (loopback under tools/launch.py local mode) instead of all interfaces.
 Key sharding across multiple servers follows the reference:
@@ -49,8 +56,8 @@ import numpy as np
 
 
 # ---------------------------------------------------------------------------
-# framing — length + HMAC-SHA256 tag + pickle (see trust boundary note
-# in the module docstring)
+# framing — length + HMAC-SHA256 tag + restricted codec (see trust
+# boundary note in the module docstring)
 # ---------------------------------------------------------------------------
 
 def _frame_key():
@@ -62,8 +69,153 @@ def _frame_key():
     return hashlib.sha256(('mxnet_tpu_ps:' + seed).encode()).digest()
 
 
+_MAX_WIRE_DEPTH = 8
+
+
+_ML_DTYPES = ('bfloat16', 'float8_e4m3fn', 'float8_e5m2')
+
+
+def _wire_dtype(name):
+    """dtype by name; the few accelerator dtypes numpy lacks resolve
+    through an explicit ml_dtypes whitelist (never getattr on an
+    attacker-chosen name)."""
+    try:
+        dt = np.dtype(name)
+    except TypeError:
+        if name not in _ML_DTYPES:
+            raise ValueError('dtype %r not allowed on the PS wire'
+                             % name)
+        import ml_dtypes
+        dt = np.dtype(getattr(ml_dtypes, name))
+    if dt.hasobject:
+        raise ValueError('object dtype not allowed on the PS wire')
+    return dt
+
+
+def _encode_obj(obj, out, depth=0):
+    if depth > _MAX_WIRE_DEPTH:
+        raise ValueError('PS wire object too deeply nested')
+    if obj is None:
+        out.append(b'N')
+    elif obj is True:
+        out.append(b'T')
+    elif obj is False:
+        out.append(b'F')
+    elif isinstance(obj, int):
+        s = str(obj).encode()
+        out.append(b'i' + struct.pack('<I', len(s)) + s)
+    elif isinstance(obj, float):
+        out.append(b'f' + struct.pack('<d', obj))
+    elif isinstance(obj, str):
+        s = obj.encode()
+        out.append(b's' + struct.pack('<I', len(s)) + s)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(b'b' + struct.pack('<I', len(obj)) + bytes(obj))
+    elif isinstance(obj, np.generic):
+        _encode_obj(obj.item(), out, depth)
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise ValueError('object arrays not allowed on the PS wire')
+        a = np.ascontiguousarray(obj)
+        name = a.dtype.name.encode()
+        out.append(b'a' + struct.pack('<I', len(name)) + name +
+                   struct.pack('<I', a.ndim) +
+                   struct.pack('<%dq' % a.ndim, *a.shape))
+        out.append(a.tobytes())
+    elif isinstance(obj, (tuple, list)):
+        out.append(b't' + struct.pack('<I', len(obj)))
+        for v in obj:
+            _encode_obj(v, out, depth + 1)
+    elif isinstance(obj, dict):
+        out.append(b'd' + struct.pack('<I', len(obj)))
+        for k, v in obj.items():
+            _encode_obj(k, out, depth + 1)
+            _encode_obj(v, out, depth + 1)
+    else:
+        raise ValueError('type %s not allowed on the PS wire'
+                         % type(obj).__name__)
+
+
+def _decode_obj(buf, pos, depth=0):
+    if depth > _MAX_WIRE_DEPTH:
+        raise ValueError('PS wire object too deeply nested')
+    tag = buf[pos:pos + 1]
+    pos += 1
+    if tag == b'N':
+        return None, pos
+    if tag == b'T':
+        return True, pos
+    if tag == b'F':
+        return False, pos
+    if tag == b'f':
+        return struct.unpack_from('<d', buf, pos)[0], pos + 8
+    if tag in (b'i', b's', b'b'):
+        (n,) = struct.unpack_from('<I', buf, pos)
+        pos += 4
+        raw = bytes(buf[pos:pos + n])
+        if len(raw) != n:
+            raise ValueError('truncated PS frame')
+        pos += n
+        if tag == b'i':
+            return int(raw.decode()), pos
+        if tag == b's':
+            return raw.decode(), pos
+        return raw, pos
+    if tag == b'a':
+        (n,) = struct.unpack_from('<I', buf, pos)
+        pos += 4
+        dt = _wire_dtype(bytes(buf[pos:pos + n]).decode())
+        pos += n
+        (ndim,) = struct.unpack_from('<I', buf, pos)
+        pos += 4
+        if ndim > 32:
+            raise ValueError('bad ndim on PS wire')
+        shape = struct.unpack_from('<%dq' % ndim, buf, pos)
+        pos += 8 * ndim
+        if any(d < 0 for d in shape):
+            raise ValueError('bad shape on PS wire')
+        count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+        nbytes = count * dt.itemsize
+        raw = bytes(buf[pos:pos + nbytes])
+        if len(raw) != nbytes:
+            raise ValueError('truncated PS frame')
+        pos += nbytes
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy(), pos
+    if tag == b't':
+        (n,) = struct.unpack_from('<I', buf, pos)
+        pos += 4
+        items = []
+        for _ in range(n):
+            v, pos = _decode_obj(buf, pos, depth + 1)
+            items.append(v)
+        return tuple(items), pos
+    if tag == b'd':
+        (n,) = struct.unpack_from('<I', buf, pos)
+        pos += 4
+        d = {}
+        for _ in range(n):
+            k, pos = _decode_obj(buf, pos, depth + 1)
+            v, pos = _decode_obj(buf, pos, depth + 1)
+            d[k] = v
+        return d, pos
+    raise ValueError('unknown PS wire tag %r' % tag)
+
+
+def _encode(obj):
+    out = []
+    _encode_obj(obj, out)
+    return b''.join(out)
+
+
+def _decode(payload):
+    obj, pos = _decode_obj(payload, 0)
+    if pos != len(payload):
+        raise ValueError('trailing bytes in PS frame')
+    return obj
+
+
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = _encode(obj)
     tag = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
     sock.sendall(struct.pack('<Q', len(payload)) + tag + payload)
 
@@ -87,7 +239,18 @@ def _recv_msg(sock):
         raise ConnectionError(
             'kvstore frame failed HMAC verification (wrong '
             'DMLC_PS_TOKEN or untrusted peer) — dropping connection')
-    return pickle.loads(payload)
+    try:
+        # any decode failure (truncated struct, bad tag, bad dtype,
+        # over-deep nesting) means a broken or hostile peer: surface
+        # uniformly as ConnectionError so server threads drop the
+        # connection instead of dying with a stray traceback
+        msg = _decode(payload)
+    except Exception as e:
+        raise ConnectionError('malformed kvstore frame: %s' % e)
+    if not isinstance(msg, tuple) or not msg or \
+            not isinstance(msg[0], str):
+        raise ConnectionError('kvstore frame is not a command tuple')
+    return msg
 
 
 def _key_to_server(key, num_servers):
@@ -130,14 +293,45 @@ class KVStoreServer(object):
         # server on a different host than the root falls back to ''
         bind_addr = os.environ.get(
             'DMLC_PS_BIND_URI',
-            os.environ.get('DMLC_PS_ROOT_URI', ''))
+            os.environ.get('DMLC_PS_ROOT_URI', '127.0.0.1'))
+        self._check_bind_policy(bind_addr)
         try:
             self.listener.bind((bind_addr, port))
-        except OSError:
+        except OSError as e:
+            import errno
+            addr_unusable = e.errno == errno.EADDRNOTAVAIL or \
+                isinstance(e, socket.gaierror)
+            if not addr_unusable:
+                raise  # busy port etc. would fail the fallback too —
+                #        don't mask it with a token complaint
+            # a server on a different host than the rendezvous root
+            # cannot bind the root address (EADDRNOTAVAIL) — fall back
+            # to all interfaces, which requires the shared secret
+            self._check_bind_policy('')
             self.listener.bind(('', port))
         self.listener.listen(num_workers + 8)
         self.port = self.listener.getsockname()[1]
         self._threads = []
+
+    @staticmethod
+    def _check_bind_policy(bind_addr):
+        """Refuse a non-loopback bind without a real shared secret: the
+        fallback frame key is derived from the (public) rendezvous
+        address, so off-host it authenticates nothing."""
+        if os.environ.get('DMLC_PS_TOKEN'):
+            return
+        addr = (bind_addr or '').strip('[]')
+        loopback = addr in ('localhost', '::1') or \
+            addr.startswith('127.')
+        if not loopback:
+            raise RuntimeError(
+                'kvstore server: refusing to bind %r without '
+                'DMLC_PS_TOKEN — the default frame key derives from '
+                'the public rendezvous address and cannot '
+                'authenticate remote peers.  Set DMLC_PS_TOKEN to a '
+                'shared secret (tools/launch.py exports it to every '
+                'role), or bind loopback for single-host runs.'
+                % (bind_addr or '<all interfaces>'))
 
     # -- message handlers ---------------------------------------------------
     def _handle_init(self, key, value):
@@ -219,6 +413,17 @@ class KVStoreServer(object):
         return ('ok',)
 
     def _handle_set_optimizer(self, blob):
+        # The ONE channel that deserializes code by design (the
+        # reference ships pickled optimizers to servers the same way,
+        # kvstore.py:239).  A guessable derived frame key must not be
+        # able to reach it: require the real shared secret even on
+        # loopback — launch.py mints one for every job.
+        if not os.environ.get('DMLC_PS_TOKEN'):
+            return ('err',
+                    'set_optimizer requires DMLC_PS_TOKEN (it '
+                    'transports executable optimizer code); set a '
+                    'shared secret or run a worker-side updater '
+                    'instead')
         from . import optimizer as opt
         optimizer = pickle.loads(blob)
         updater = opt.get_updater(optimizer)
@@ -279,7 +484,8 @@ class KVStoreServer(object):
                         self.sync_mode = bool(msg[1])
                     reply = ('ok',)
                 elif op == 'get_states':
-                    reply = ('ok', pickle.dumps(self.store))
+                    with self.cv:
+                        reply = ('ok', dict(self.store))
                 elif op == 'stop':
                     with self.cv:
                         self.stopped = True

@@ -287,14 +287,22 @@ class Module(BaseModule):
                 param_names=self._param_names,
                 update_on_kvstore=update_on_kvstore)
         self._fused_updater = None
-        if update_on_kvstore:
+        if kvstore is None or 'dist' not in kvstore.type:
+            # Single-process store (or none): the executor group is one
+            # SPMD program whose gradient all-reduce is already an
+            # in-step psum over the mesh, so the optimizer update can
+            # fold into the same donated dispatch.  The store stays as
+            # the parameter facade; only the multi-process PS keeps the
+            # per-key eager push/pull path.
+            self._fused_updater = opt_mod.create_fused_updater(
+                optimizer, self._param_names)
+        if self._fused_updater is not None:
+            update_on_kvstore = False
+            self._update_on_kvstore = False
+        elif update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
         else:
-            if kvstore is None:
-                self._fused_updater = opt_mod.create_fused_updater(
-                    optimizer, self._param_names)
-            if self._fused_updater is None:
-                self._updater = opt_mod.get_updater(optimizer)
+            self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)

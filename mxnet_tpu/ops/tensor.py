@@ -139,6 +139,39 @@ def _block_grad(attrs, data):
     return jax.lax.stop_gradient(data)
 
 
+# ---------------------------------------------------------------------------
+# Graph-plumbing ops.  In the reference these are nodes the executor
+# inserts while building/augmenting the graph (gradient aggregation
+# chains graph_executor.cc:122-137, PlaceDevice copies, init_op.cc);
+# here the same jobs are done by jax.vjp and XLA SPMD, so the ops are
+# registered as their plain functional meaning for API parity.
+# ---------------------------------------------------------------------------
+
+_reg_binary('_grad_add', jnp.add)
+
+
+@register('_identity_with_attr_like_rhs', input_names=('lhs', 'rhs'))
+def _identity_like_rhs(attrs, lhs, rhs):
+    # reference init_op.cc: forwards lhs; rhs only contributes node
+    # attrs (storage type/shape) during graph rewrites
+    return lhs
+
+
+@register('_CrossDeviceCopy', input_names=('data',), shape_rule='same')
+def _cross_device_copy(attrs, data):
+    # reference cross_device_copy.cc: explicit inter-device transport at
+    # ctx_group boundaries; under XLA SPMD placement transfers are the
+    # compiler's job, so this is an identity marker
+    return data
+
+
+@register('_NoGradient', input_names=())
+def _no_gradient(attrs):
+    # reference init_op.cc: placeholder head-grad for outputs whose
+    # gradient is undefined; never consumed numerically
+    return jnp.zeros((1,), jnp.float32)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _make_loss_fn(grad_scale, data):
     return data

@@ -14,6 +14,14 @@ import mxnet_tpu as mx
 from mxnet_tpu import kvstore_server as ps
 
 
+@pytest.fixture(autouse=True)
+def _ps_token(monkeypatch):
+    """In-process PS tests run as a launched job would: launch.py mints
+    a DMLC_PS_TOKEN per job (required by the set_optimizer channel).
+    Tests probing the no-token policy delete it explicitly."""
+    monkeypatch.setenv('DMLC_PS_TOKEN', 'test-job-secret')
+
+
 def _start_server(num_workers, sync=True):
     srv = ps.KVStoreServer(0, num_workers, sync_mode=sync)
     t = threading.Thread(target=srv.run, daemon=True)
@@ -62,9 +70,11 @@ def test_dist_sync_arithmetic():
     t.join(timeout=10)
 
 
-def test_dist_sync_server_side_optimizer():
+def test_dist_sync_server_side_optimizer(monkeypatch):
     """Optimizer runs on the server (reference set_optimizer pickles it
-    to servers; weight = -lr * sum(grads) after one round)."""
+    to servers; weight = -lr * sum(grads) after one round).  The
+    channel transports executable code, so it demands the real shared
+    secret: without DMLC_PS_TOKEN the server refuses it."""
     import pickle
     W = 2
     srv, t = _start_server(W)
@@ -73,6 +83,13 @@ def test_dist_sync_server_side_optimizer():
     clients[0].init(3, np.zeros((3,), np.float32))
     opt = mx.optimizer.create('sgd', learning_rate=0.1, rescale_grad=1.0,
                               wd=0.0)
+    monkeypatch.delenv('DMLC_PS_TOKEN', raising=False)
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match='DMLC_PS_TOKEN'):
+        clients[0].set_optimizer(pickle.dumps(opt))
+    monkeypatch.setenv('DMLC_PS_TOKEN', 'job-secret')
+    # NOTE: the token is read by _frame_key on BOTH ends; these
+    # in-process clients pick it up via the same env
     clients[0].set_optimizer(pickle.dumps(opt))
 
     def worker(rank):
@@ -264,3 +281,92 @@ def test_frame_hmac_rejects_tampering():
     finally:
         a.close()
         b.close()
+
+
+def test_wire_codec_roundtrip_and_no_pickle():
+    """The PS data path speaks a restricted codec: command tuples of
+    scalars/strings/ndarrays round-trip exactly, and objects whose
+    decoding could run code (arbitrary classes) are refused at encode
+    time — a verified-but-malicious frame can corrupt numbers, never
+    execute."""
+    from mxnet_tpu import kvstore_server as srv
+    cases = [
+        ('push', 3, np.arange(12, dtype=np.float32).reshape(3, 4)),
+        ('pull', 'fc1_weight', 0),
+        ('ok', {'a': np.zeros((2, 2), np.float64), 7: np.ones(3)}),
+        ('init', -5, np.array(2.5)),
+        ('num_dead', 1.5), ('flag', True, False, None),
+        ('blob', b'\x00\x01pickle-stays-opaque'),
+        ('big', 2 ** 80),  # int keys are not range-limited
+    ]
+    for msg in cases:
+        out = srv._decode(srv._encode(msg))
+        assert out[0] == msg[0]
+        for got, want in zip(out, msg):
+            if isinstance(want, np.ndarray):
+                assert got.dtype == want.dtype
+                np.testing.assert_array_equal(got, want)
+            elif isinstance(want, dict):
+                for k in want:
+                    np.testing.assert_array_equal(got[k], want[k])
+            else:
+                assert got == want and type(got) is type(want)
+
+    class Evil:
+        def __reduce__(self):
+            return (print, ('pwned',))
+
+    with pytest.raises(ValueError):
+        srv._encode(('push', 1, Evil()))
+    with pytest.raises(ValueError):
+        srv._encode(('push', np.array([Evil()], dtype=object)))
+
+
+def test_forged_frame_cannot_execute_code(tmp_path):
+    """Even a frame with a VALID tag (attacker knows the derived key —
+    the no-token loopback case) must not be able to run code: a pickle
+    bomb on the data path fails to decode instead of executing."""
+    import socket as _socket
+    import struct
+    import pickle
+    import hashlib
+    import hmac as _hmac
+    from mxnet_tpu import kvstore_server as srv
+    canary = tmp_path / 'pwned'
+
+    class Bomb:
+        def __reduce__(self):
+            return (open, (str(canary), 'w'))
+
+    payload = pickle.dumps(('push', 1, Bomb()))
+    tag = _hmac.new(srv._frame_key(), payload, hashlib.sha256).digest()
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(struct.pack('<Q', len(payload)) + tag + payload)
+        with pytest.raises(ConnectionError):
+            srv._recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert not canary.exists(), 'forged frame executed code'
+
+
+def test_no_token_refuses_remote_bind(monkeypatch):
+    """A server asked to bind a non-loopback interface without
+    DMLC_PS_TOKEN must refuse to start (the derived frame key is
+    guessable by anyone who can reach the port); with a token, or on
+    loopback, it starts."""
+    from mxnet_tpu import kvstore_server as srv
+    monkeypatch.delenv('DMLC_PS_TOKEN', raising=False)
+    monkeypatch.setenv('DMLC_PS_BIND_URI', '0.0.0.0')
+    with pytest.raises(RuntimeError, match='DMLC_PS_TOKEN'):
+        srv.KVStoreServer(0, 1)
+    # with a token the same bind is allowed
+    monkeypatch.setenv('DMLC_PS_TOKEN', 'secret')
+    s = srv.KVStoreServer(0, 1)
+    s.listener.close()
+    # loopback without a token stays fine (single-host local mode)
+    monkeypatch.delenv('DMLC_PS_TOKEN')
+    monkeypatch.setenv('DMLC_PS_BIND_URI', '127.0.0.1')
+    s = srv.KVStoreServer(0, 1)
+    s.listener.close()

@@ -227,7 +227,8 @@ def _bulk_mod(ctxs, ap=None, ax=None, batch=16, kvstore='local'):
 
 
 @pytest.mark.parametrize('n_ctx,kvstore', [(1, 'local'), (4, 'local'),
-                                           (4, None)])
+                                           (4, None), (8, 'local'),
+                                           (8, None)])
 def test_bulk_step_matches_per_step_loop(n_ctx, kvstore):
     """Module.bulk_step (K steps in one on-device lax.scan dispatch —
     the TPU analog of the reference's bulk-exec segments,
@@ -271,6 +272,56 @@ def test_bulk_step_matches_per_step_loop(n_ctx, kvstore):
     for k in pc:
         np.testing.assert_allclose(pc[k].asnumpy(), pd[k].asnumpy(),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_fused_step_with_device_kvstore_single_dispatch():
+    """A single-process kvstore ('local'/'device') must not forfeit
+    whole-step fusion: the grad all-reduce is already the in-step psum
+    of the one SPMD program, so fit() should issue exactly ONE fused
+    dispatch per batch instead of per-key eager push/pull (reference
+    runs the eager path, model.py:106)."""
+    X, y = _make_blobs(n=64, dim=8, classes=4, seed=7)
+    train = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=False,
+                              label_name='softmax_label')
+    ctxs = [mx.cpu(i) for i in range(8)]
+    mod = mx.mod.Module(_mlp_sym(classes=4), context=ctxs)
+    mod.fit(train, num_epoch=2, kvstore='device',
+            optimizer_params={'learning_rate': 0.1})
+    assert mod._fused_updater is not None, \
+        "kvstore='device' must keep the fused whole-step path"
+    assert not mod._update_on_kvstore
+    ex = mod._exec_group.executor
+    # 2 epochs x 4 batches, one donated dispatch each
+    assert ex.fused_dispatches == 8, ex.fused_dispatches
+
+
+def test_fused_kvstore_matches_no_kvstore():
+    """kvstore='local' (fused in-step update) must produce identical
+    parameters to kvstore=None — the store is a facade, not different
+    math."""
+    rng = np.random.RandomState(11)
+    batches = [mx.io.DataBatch(
+        data=[nd.array(rng.rand(16, 8).astype(np.float32))],
+        label=[nd.array((rng.rand(16) * 4).astype(np.float32))])
+        for _ in range(4)]
+    seed_mod = _bulk_mod([mx.cpu(0)])
+    ap, ax = seed_mod.get_params()
+    ap = {k: v.copy() for k, v in ap.items()}
+    ax = {k: v.copy() for k, v in ax.items()}
+    ctxs = [mx.cpu(i) for i in range(4)]
+    a = _bulk_mod(ctxs, ap, ax, kvstore='local')
+    b = _bulk_mod(ctxs, ap, ax, kvstore=None)
+    assert a._fused_updater is not None
+    for bt in batches:
+        a.forward_backward(bt)
+        a.update()
+        b.forward_backward(bt)
+        b.update()
+    pa, _ = a.get_params()
+    pb, _ = b.get_params()
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
 
 
 def test_fused_step_deferred_materialization():

@@ -16,6 +16,8 @@ Each case runs up to three checks on tiny shapes:
   * dtype: float32 vs bfloat16 forward consistency (the reference's
     check_consistency across dtypes), loose tolerance.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -88,6 +90,9 @@ CASES = {
     # -- binary / scalar -------------------------------------------------
     'elemwise_add': Case(_B), 'elemwise_sub': Case(_B),
     'elemwise_mul': Case(_B),
+    '_grad_add': Case(_B),
+    '_identity_with_attr_like_rhs': Case(_B, grad=False),
+    '_CrossDeviceCopy': u(-1, 1),
     'elemwise_div': Case(_B, low=0.5, high=2.0),
     '_power': Case(_B, low=0.5, high=2.0),
     '_maximum': Case(_B, grad=False), '_minimum': Case(_B, grad=False),
@@ -418,7 +423,28 @@ SKIP = {
     '_crop_assign_scalar': 'covered by tests/test_missing_ops.py',
     'MultiProposal': 'batch variant of Proposal (same kernel), '
                      'covered by tests/test_contrib.py',
+    '_NoGradient': 'zero-input placeholder node (reference '
+                   'init_op.cc); nothing to gradient-check',
 }
+
+
+def test_reference_registry_parity():
+    """Every registration name in the reference (314 NNVM_REGISTER_OP +
+    MXNET_REGISTER_OP_PROPERTY sites, vendored in
+    tests/data_reference_op_names.txt) is either a registered op here
+    or carries an explicit N/A reason in ops.registry.REFERENCE_NA —
+    the mechanical op diff vs the reference is empty-or-annotated."""
+    from mxnet_tpu.ops import registry as reg
+    path = os.path.join(os.path.dirname(__file__),
+                        'data_reference_op_names.txt')
+    names = [ln.strip() for ln in open(path) if ln.strip()]
+    assert len(names) > 300
+    unaccounted = [n for n in names
+                   if not reg.exists(n)
+                   and reg.reference_na_reason(n) is None]
+    assert not unaccounted, (
+        'reference registration names neither registered nor '
+        'N/A-annotated: %s' % unaccounted)
 
 
 def _primary_ops():

@@ -20,6 +20,7 @@ Usage (mirrors the reference CLI):
 """
 import argparse
 import os
+import secrets
 import signal
 import socket
 import subprocess
@@ -58,6 +59,11 @@ def launch_local(args, command):
         'DMLC_PS_ROOT_PORT': str(port),
         'DMLC_NUM_WORKER': str(args.num_workers),
         'DMLC_NUM_SERVER': str(args.num_servers),
+        # a per-job secret even on loopback: frames are then
+        # unforgeable by other local users, and the set_optimizer
+        # channel (which requires a token) works out of the box
+        'DMLC_PS_TOKEN': os.environ.get('DMLC_PS_TOKEN')
+                         or secrets.token_hex(16),
     })
     procs = []
     try:
@@ -98,9 +104,18 @@ def launch_ssh(args, command):
     import shlex
     root = hosts[0]
     port = args.port or 9091
+    # multi-host PS servers refuse to start without a shared secret
+    # (kvstore_server._check_bind_policy); mint one for the job unless
+    # the operator provided their own.  NOTE: the token rides the ssh
+    # argv, so it is visible in `ps` on each host — acceptable for the
+    # cluster-trust model this launcher serves (same as the reference's
+    # DMLC_* env passing); mount a secrets file and set DMLC_PS_TOKEN
+    # in the remote environment for anything stricter.
+    token = os.environ.get('DMLC_PS_TOKEN') or secrets.token_hex(16)
     base = ('DMLC_PS_ROOT_URI=%s DMLC_PS_ROOT_PORT=%d DMLC_NUM_WORKER=%d '
-            'DMLC_NUM_SERVER=%d' % (root, port, args.num_workers,
-                                    args.num_servers))
+            'DMLC_NUM_SERVER=%d DMLC_PS_TOKEN=%s'
+            % (root, port, args.num_workers, args.num_servers,
+               shlex.quote(token)))
     procs = []
     try:
         for sid in range(args.num_servers):
