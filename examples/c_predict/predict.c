@@ -58,6 +58,10 @@ int main(int argc, char** argv) {
   char* params = read_file(argv[2], &param_size);
   float* input = (float*)read_file(argv[3], &in_size);
   uint32_t shape[4], ndim = (uint32_t)argc - 4, n = 1;
+  if (ndim > 4) {
+    fprintf(stderr, "at most 4 input dimensions\n");
+    return 2;
+  }
   for (uint32_t i = 0; i < ndim; ++i) {
     shape[i] = (uint32_t)atoi(argv[4 + i]);
     n *= shape[i];

@@ -82,8 +82,9 @@ def get_output_shape(pred, index):
     if ex.outputs:
         return tuple(int(d) for d in ex.outputs[int(index)].shape)
     # before the first forward: infer from the bound input shapes
+    # (infer_shape returns (arg_shapes, out_shapes, aux_shapes))
     shapes = {n: tuple(a.shape) for n, a in ex.arg_dict.items()}
-    out_shapes, _, _ = pred._symbol.infer_shape(**{
+    _, out_shapes, _ = pred._symbol.infer_shape(**{
         n: shapes[n] for n in pred._input_names})
     return tuple(int(d) for d in out_shapes[int(index)])
 

@@ -267,6 +267,7 @@ class KVStoreDistPS(KVStore):
         """Pickle the optimizer to the server processes — rank 0 only,
         like the reference (kvstore.py:239 sends from one worker; every
         re-send would rebuild the server updater and drop its state)."""
+        err = None
         if self.rank == 0:
             sym_ref = getattr(optimizer, 'sym', None)
             optimizer.sym = None
@@ -274,8 +275,23 @@ class KVStoreDistPS(KVStore):
                 blob = pickle.dumps(optimizer)
             finally:
                 optimizer.sym = sym_ref
-            self._client.set_optimizer(blob)
+            try:
+                self._client.set_optimizer(blob)
+            except MXNetError as e:
+                # a refusal (e.g. no DMLC_PS_TOKEN) must not strand
+                # the other ranks: they are already heading into the
+                # barrier below, so join it first, then raise
+                err = e
         self.barrier()
+        if err is not None:
+            raise err
+        if not self._client.has_updater():
+            # non-rank-0 workers discover a rank-0-side refusal here
+            # instead of silently training against an updater-less
+            # server (which would ASSIGN merged grads to the weights)
+            raise MXNetError(
+                'set_optimizer did not install a server-side updater '
+                '(rank 0 was refused — is DMLC_PS_TOKEN set?)')
         self._update_on_kvstore = True
 
     def set_updater(self, updater):

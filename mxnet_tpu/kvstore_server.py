@@ -486,6 +486,8 @@ class KVStoreServer(object):
                 elif op == 'get_states':
                     with self.cv:
                         reply = ('ok', dict(self.store))
+                elif op == 'has_updater':
+                    reply = ('ok', self.updater is not None)
                 elif op == 'stop':
                     with self.cv:
                         self.stopped = True
@@ -591,6 +593,10 @@ class DistServerClient(object):
     def set_sync_mode(self, sync):
         for sid in range(self.num_servers):
             self._rpc(sid, 'set_sync', sync)
+
+    def has_updater(self):
+        return all(self._rpc(sid, 'has_updater')
+                   for sid in range(self.num_servers))
 
     def heartbeat(self, rank):
         for sid in range(self.num_servers):

@@ -10,9 +10,12 @@ causal q-tiles skip their fully-masked k-blocks.  Available directly as
 `pallas_ops.flash_attention` and opt-in via
 `parallel.ring_attention.full_attention(use_flash=True)`.
 
-Backward uses blocked recompute: gradients are assembled q-block by
-q-block (O(block_q * T) live memory, not O(T^2)) — standard
-flash-attention practice.
+Backward is the fused two-pass FlashAttention recipe in Pallas: the
+forward saves the per-row logsumexp, D = rowsum(dO∘O) is a fused XLA
+preprocess, and two kernels (dK/dV gridded over k-blocks, dQ over
+q-blocks) recompute p = exp(s − lse) tile by tile — nothing O(T^2) is
+materialized.  Sequences too long for the resident-VMEM kernels fall
+back to an XLA-level blocked recompute.
 """
 import functools
 
@@ -49,11 +52,12 @@ def _online_softmax_step(q, kblk, vblk, m, l, acc, scale, causal,
     return m_new, l_new, acc * correction + pv
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
-                 scale, causal, block_q, block_k, num_kb):
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                 acc_ref, *, scale, causal, block_q, block_k, num_kb):
     """One (bh, qi, kb) grid step of the streaming schedule.  kb is the
     minor grid dim: scratch (m, l, acc) carries the online softmax
-    across kb steps; the last live kb writes o_ref."""
+    across kb steps; the last live kb writes o_ref and the per-row
+    logsumexp (saved for the fused backward)."""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -81,10 +85,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     @pl.when(kb == num_kb - 1)
     def _finalize():
         o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l_ref[...])
 
 
-def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
-                          block_q, block_k, num_kb):
+def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                          causal, block_q, block_k, num_kb):
     """Resident-K schedule: the whole K/V sequence for one head sits in
     VMEM (fetched once per head); a fori_loop walks k-blocks with the
     online-softmax recurrence, and causal q-tiles stop at the diagonal
@@ -111,12 +116,19 @@ def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, scale, causal,
         upper = num_kb
     m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
 
 
 # resident-K schedule is used while K+V for one head fit comfortably in
 # VMEM (~16 MB/core); beyond that the 3D-grid streaming schedule keeps
-# VMEM bounded at O(block) regardless of T
-_VMEM_RESIDENT_BYTES = 10 * 1024 * 1024
+# VMEM bounded at O(block) regardless of T.  The budget must leave room
+# for Mosaic's double-buffered window of the SAME resident operands
+# (measured: a 10 MB threshold OOMs at 2x), hence ~6 MB.
+_VMEM_RESIDENT_BYTES = 6 * 1024 * 1024
+
+# backward tile edge (see _flash_bwd_impl); 1024 measured best on
+# v5e-class — 2048 OOMs the 16 MB VMEM with double buffering
+_BWD_BLOCK = 1024
 
 
 def _fit_block(t, block_q):
@@ -135,7 +147,8 @@ def _fit_block(t, block_q):
     return block_q
 
 
-def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
+def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,
+                    return_lse=False):
     b, h, t, d = q.shape
     bh = b * h
     qf = q.reshape(bh, t, d)
@@ -146,9 +159,14 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
     num_kb = t // block_k
     itemsize = jnp.dtype(q.dtype).itemsize
     resident = 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES
+    # lse rides along as (bh, t, 1): the trailing singleton keeps the
+    # row axis on the sublane dim so (block_q, 1) kernel views
+    # broadcast directly against (block_q, block_k) scores
+    out_shapes = [jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                  jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)]
 
     if resident:
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             functools.partial(_attn_kernel_resident, scale=scale,
                               causal=causal, block_q=block_q,
                               block_k=block_k, num_kb=num_kb),
@@ -158,12 +176,15 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
                 pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
                 pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             ],
-            out_specs=pl.BlockSpec((1, block_q, d),
-                                   lambda i, j: (i, j, 0)),
-            out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=out_shapes,
             interpret=interpret,
         )(qf, kf, vf)
-        return out.reshape(b, h, t, d)
+        out = out.reshape(b, h, t, d)
+        return (out, lse) if return_lse else out
 
     grid = (bh, t // block_q, num_kb)
     if causal:
@@ -172,7 +193,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
         kv_index = lambda i, j, n: (i, jnp.minimum(n, j), 0)
     else:
         kv_index = lambda i, j, n: (i, n, 0)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_attn_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
                           num_kb=num_kb),
@@ -182,8 +203,11 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, n: (i, j, 0)),
+        ],
+        out_shape=out_shapes,
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),     # running max
             pltpu.VMEM((block_q, 1), jnp.float32),     # normalizer
@@ -191,7 +215,8 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret):
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    out = out.reshape(b, h, t, d)
+    return (out, lse) if return_lse else out
 
 
 def _blocked_backward(q, k, v, g, causal, scale, block_q):
@@ -235,22 +260,188 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q):
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Fused Pallas backward: the FlashAttention two-pass recipe.  Pass 0 is
+# the (fused, XLA-level) preprocess D = rowsum(dO * O); pass 1 is two
+# kernels — dK/dV with k-blocks as the parallel grid dim, dQ with
+# q-blocks — each recomputing p = exp(s - lse) from the saved
+# logsumexp, so nothing O(T^2) is ever materialized and both kernels
+# stream their counterpart sequence through a fori_loop with causal
+# skipping.  (Reference analog: the hand-tuned cuDNN-class backward
+# kernels, cudnn_convolution-inl.h-level effort, done the Mosaic way.)
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                     dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                     num_qb):
+    kb = pl.program_id(1)
+    kblk = k_ref[0]                       # (block_k, D)
+    vblk = v_ref[0]
+    d = kblk.shape[-1]
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        qblk = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        doblk = do_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]   # (bq, 1)
+        dd = dd_ref[0, pl.ds(qi * block_q, block_q), :]     # (bq, 1)
+        s = lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            cols = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.exp(s - lse)                                # (bq, bk)
+        # p/ds matmuls run in the input dtype: a f32xf32 MXU pass is
+        # several times slower than bf16 and the f32 accumulate
+        # (preferred_element_type) already carries the precision
+        dv = dv + lax.dot_general(
+            p.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # p^T @ dO
+        dp = lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)             # dO @ V^T
+        ds = p * (dp - dd)
+        dk = dk + lax.dot_general(
+            ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # ds^T @ Q
+        return dk, dv
+
+    # causal: the first q-block whose rows reach this k-block's columns
+    lower = (kb * block_k) // block_q if causal else 0
+    dk, dv = lax.fori_loop(lower, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, num_kb):
+    qi = pl.program_id(1)
+    qblk = q_ref[0]                       # (block_q, D)
+    doblk = do_ref[0]
+    lse = lse_ref[0]                      # (block_q, 1)
+    dd = dd_ref[0]
+    d = qblk.shape[-1]
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        vblk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            cols = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        return dq + lax.dot_general(
+            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale     # ds @ K
+
+    if causal:
+        upper = jnp.minimum(
+            (qi * block_q + block_q + block_k - 1) // block_k, num_kb)
+    else:
+        upper = num_kb
+    dq = lax.fori_loop(0, upper, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
+                    interpret):
+    """Fused two-kernel backward over flat (bh, t, d) tensors."""
+    bh, t, d = q.shape
+    # the backward wants larger tiles than the forward: its per-tile
+    # matmul chain (5 MXU passes) amortizes loop overhead better, and
+    # VMEM pressure is lower (no online-softmax scratch)
+    block_q = _fit_block(t, max(block_q, _BWD_BLOCK))
+    block_k = block_q
+    num_qb = t // block_q
+    num_kb = t // block_k
+    # pass 0: D_i = dO_i . O_i — one fused elementwise+reduce XLA pass
+    dd = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1, keepdims=True)                    # (bh, t, 1)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_qb=num_qb),
+        grid=(bh, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda i, n: (i, 0, 0)),   # q
+            pl.BlockSpec((1, t, d), lambda i, n: (i, 0, 0)),   # dO
+            pl.BlockSpec((1, t, 1), lambda i, n: (i, 0, 0)),   # lse
+            pl.BlockSpec((1, t, 1), lambda i, n: (i, 0, 0)),   # D
+            pl.BlockSpec((1, block_k, d), lambda i, n: (i, n, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, n: (i, n, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, n: (i, n, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, n: (i, n, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        interpret=interpret,
+    )(q, g, lse, dd, k, v)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_kb=num_kb),
+        grid=(bh, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # k
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # v
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(k, v, q, g, lse, dd)
+    return dq, dk, dv
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, scale, block_q, interpret):
     return _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret)
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, interpret):
-    return _flash_fwd_impl(q, k, v, causal, scale, block_q,
-                           interpret), (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q,
+                               interpret, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
     b, h, t, d = q.shape
     flat = lambda x: x.reshape(b * h, t, d)
-    dq, dk, dv = _blocked_backward(flat(q), flat(k), flat(v), flat(g),
-                                   causal, scale, block_q)
+    itemsize = jnp.dtype(q.dtype).itemsize
+    # the fused kernels keep one head's full sequence (q+dO or k+v)
+    # resident in VMEM; past that, fall back to the XLA-level blocked
+    # recompute whose live set is O(block_q * T)
+    if 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES:
+        dq, dk, dv = _flash_bwd_impl(
+            flat(q), flat(k), flat(v), flat(g), flat(o),
+            lse.reshape(b * h, t, 1), causal, scale, block_q, interpret)
+    else:
+        dq, dk, dv = _blocked_backward(flat(q), flat(k), flat(v),
+                                       flat(g), causal, scale, block_q)
     unflat = lambda x: x.reshape(b, h, t, d)
     return unflat(dq), unflat(dk), unflat(dv)
 

@@ -297,6 +297,14 @@ def test_c_predict_abi_ctypes(tmp_path):
     rc = lib.MXTPredCreate(json_str, params, len(params), 1, 0, 1,
                            keys, indptr, shape, ctypes.byref(handle))
     assert rc == 0, lib.MXTPredGetLastError()
+    # output shape BEFORE the first forward (the reference
+    # alloc-before-forward flow): inferred from bound input shapes
+    pre_shape = ctypes.POINTER(ctypes.c_uint32)()
+    pre_ndim = ctypes.c_uint32()
+    rc = lib.MXTPredGetOutputShape(handle, 0, ctypes.byref(pre_shape),
+                                   ctypes.byref(pre_ndim))
+    assert rc == 0, lib.MXTPredGetLastError()
+    assert [pre_shape[i] for i in range(pre_ndim.value)] == [1, 4]
     buf = np.ascontiguousarray(sample, dtype='<f4')
     rc = lib.MXTPredSetInput(
         handle, b'data',
