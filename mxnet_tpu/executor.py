@@ -16,17 +16,111 @@ Semantics kept from the reference:
   * backward() with no head grads relies on loss ops' internal gradients
     (custom VJPs — see ops/nn.py)
 """
+import os
 from collections import OrderedDict
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+
+def _maybe_remat(f):
+    """Gradient rematerialization for the fused train step
+    (MXNET_TPU_REMAT): 'conv' saves only convolution/matmul results as
+    forward residuals and recomputes the elementwise chains between
+    them (BatchNorm apply, relu, residual adds) during backward —
+    trading cheap VPU recompute for whole HBM passes of activation
+    traffic.  The jax.checkpoint analog of the reference's
+    MXNET_BACKWARD_DO_MIRROR (graph_executor.cc:243).  'none' keeps
+    XLA's default residual choice."""
+    mode = os.environ.get('MXNET_TPU_REMAT', 'none').lower()
+    if mode in ('none', '0', ''):
+        return f
+    if mode != 'conv':
+        raise ValueError("MXNET_TPU_REMAT must be 'none' or 'conv', "
+                         'got %r' % mode)
+
+    def save_matmuls(prim, *_, **__):
+        return prim in (jax.lax.dot_general_p,
+                        jax.lax.conv_general_dilated_p)
+
+    return jax.checkpoint(f, policy=save_matmuls)
+
 from . import ndarray as nd
 from . import random as _random
 from . import profiler
 from .base import MXNetError
-from .ops.registry import OpContext
+from .ops.registry import OpContext, astuple, normalize_axis
+
+
+# ---------------------------------------------------------------------------
+# NHWC layout planning (executor-level "PlaceLayout" pass).
+#
+# The user-facing tensor semantics are NCHW (MXNet parity), but the MXU
+# wants channels on the minor (lane) dimension.  Round 2 transposed
+# inside each Convolution and relied on XLA to cancel the boundary
+# transposes; profiling the compiled step shows that cancellation FAILS
+# whenever BatchNorm/residual-add/pooling sit between convolutions
+# (each stage paid multi-hundred-MB transpose fusions in fwd AND bwd,
+# several GB of HBM traffic per step on an HBM-bound chip).  This pass
+# instead carries activations physically as NHWC through every
+# layout-flexible op — Convolution/Pooling consume NHWC natively, and
+# BatchNorm re-targets its channel axis — and re-permutes to NCHW only
+# where an op (Flatten/FC/reshape/...) needs the semantic layout.
+# Reference analog: MXNet's cuDNN NHWC layout optimization.
+# Controlled by MXNET_TPU_LAYOUT_OPT={auto,1,0}; auto = on whenever
+# convs prefer NHWC (accelerator backends).
+# ---------------------------------------------------------------------------
+
+# elementwise ops whose outputs follow the input permutation unchanged
+_LAYOUT_FLEX = frozenset((
+    'Activation', 'Dropout', 'elemwise_add', 'elemwise_sub',
+    'elemwise_mul', 'elemwise_div', '_grad_add', '_copy', 'BlockGrad',
+    'Cast', 'relu', 'sigmoid', 'tanh', 'softsign', 'clip',
+    '_plus_scalar', '_minus_scalar', '_mul_scalar', '_div_scalar',
+    '_maximum_scalar', '_minimum_scalar', '_CrossDeviceCopy',
+))
+
+
+def _to_nchw(v, cur):
+    if cur == 'NHWC':
+        return jnp.transpose(v, (0, 3, 1, 2))
+    return v
+
+
+def _to_nhwc(v, cur):
+    if cur == 'NHWC':
+        return v
+    return jnp.transpose(v, (0, 2, 3, 1))
+
+
+def _layout_mode(op, attrs, vals):
+    """'io' = op consumes/produces its data input in NHWC when asked
+    (via the private __layout__ attr); 'elemwise' = op is permutation-
+    transparent; None = op needs semantic NCHW inputs."""
+    name = op.name
+    if name == 'Convolution':
+        try:
+            if len(astuple(attrs['kernel'])) != 2:
+                return None
+        except Exception:
+            return None
+        return 'io'
+    if name == 'Pooling':
+        v = vals[0]
+        return 'io' if getattr(v, 'ndim', 0) == 4 else None
+    if name == 'BatchNorm':
+        v = vals[0]
+        if getattr(v, 'ndim', 0) != 4:
+            return None
+        try:
+            axis = normalize_axis(attrs.get('axis', 1), 4)
+        except Exception:
+            return None
+        return 'io' if axis == 1 else None
+    if name in _LAYOUT_FLEX:
+        return 'elemwise'
+    return None
 
 
 class Executor:
@@ -109,11 +203,25 @@ class Executor:
         self._has_aux_always = any(
             n.op is not None and n.op.mutable_aux and n.op.aux_always
             for n in topo)
+        pref = os.environ.get('MXNET_TPU_LAYOUT_OPT', 'auto')
+        if pref == '1':
+            layout_opt = True
+        elif pref == 'auto':
+            from .ops import nn as _nn
+            layout_opt = not self._grouped and _nn._conv_prefer_nhwc()
+        elif pref in ('0', ''):
+            layout_opt = False
+        else:
+            raise ValueError(
+                "MXNET_TPU_LAYOUT_OPT must be 'auto', '1' or '0', "
+                'got %r' % pref)
+        self._layout_opt = layout_opt
 
         def run_graph(arg_vals, aux_vals, rng, is_train, collect_all=False):
             """Evaluate the DAG; returns (outputs, new_aux_tuple), plus
             every node's outputs when collect_all (monitor mode)."""
             results = [None] * len(topo)   # per node: list of outputs
+            layouts = [None] * len(topo)   # per node: layout per output
             new_aux = list(aux_vals)
             for ni, node in enumerate(topo):
                 if node.op is None:
@@ -121,12 +229,41 @@ class Executor:
                         results[ni] = [arg_vals[arg_pos[node.name]]]
                     else:
                         results[ni] = [new_aux[aux_pos[node.name]]]
+                    layouts[ni] = ['NCHW']
                     continue
                 op = node.op
                 n_aux = op.num_aux
                 in_entries = node.inputs
                 vals = [results[node_index[id(src)]][idx]
                         for src, idx in in_entries]
+                in_l = [layouts[node_index[id(src)]][idx]
+                        for src, idx in in_entries]
+                eff_attrs = node.attrs
+                out_layout = 'NCHW'
+                if layout_opt:
+                    mode = _layout_mode(op, node.attrs, vals)
+                    if mode == 'io':
+                        # data input rides NHWC; params/aux stay as-is
+                        vals = [_to_nhwc(v, l) if j == 0 else
+                                _to_nchw(v, l)
+                                for j, (v, l) in enumerate(zip(vals,
+                                                               in_l))]
+                        eff_attrs = dict(node.attrs,
+                                         __layout__='NHWC')
+                        out_layout = 'NHWC'
+                    elif mode == 'elemwise' and any(
+                            l == 'NHWC' for l in in_l):
+                        # permutation-transparent: align every 4-D
+                        # input to NHWC instead of paying transposes
+                        vals = [_to_nhwc(v, l)
+                                if getattr(v, 'ndim', 0) == 4 else v
+                                for v, l in zip(vals, in_l)]
+                        out_layout = 'NHWC'
+                    else:
+                        vals = [_to_nchw(v, l)
+                                for v, l in zip(vals, in_l)]
+                # layout_opt off: nothing ever carries NHWC, vals pass
+                # through untouched
                 args = vals[:len(vals) - n_aux] if n_aux else vals
                 auxs = vals[len(vals) - n_aux:] if n_aux else []
                 op_ctx = OpContext(
@@ -147,20 +284,25 @@ class Executor:
                     auxs = [jax.device_put(a, dev) for a in auxs]
                     if op_ctx.rng is not None:
                         op_ctx.rng = jax.device_put(op_ctx.rng, dev)
-                outs, updated = op.apply(node.attrs, args, auxs, op_ctx)
+                outs, updated = op.apply(eff_attrs, args, auxs, op_ctx)
                 results[ni] = outs
+                layouts[ni] = [out_layout
+                               if getattr(o, 'ndim', 0) == 4 else 'NCHW'
+                               for o in outs]
                 if op.mutable_aux and (is_train or op.aux_always) and updated:
                     for (src, _), newv in zip(
                             in_entries[len(vals) - n_aux:], updated):
                         if src.op is None and src.name in aux_pos:
                             new_aux[aux_pos[src.name]] = newv
-            outputs = tuple(results[ni][oi] for ni, oi in out_entries)
+            outputs = tuple(_to_nchw(results[ni][oi], layouts[ni][oi])
+                            for ni, oi in out_entries)
             if collect_all:
                 mon = []
-                for node, outs_ in zip(topo, results):
+                for node, outs_, ls in zip(topo, results, layouts):
                     if node.op is None:
                         continue
-                    mon.extend(outs_)
+                    mon.extend(_to_nchw(o, l)
+                               for o, l in zip(outs_, ls))
                 return outputs, tuple(new_aux), tuple(mon)
             return outputs, tuple(new_aux)
 
@@ -305,6 +447,7 @@ class Executor:
                                               sub, True)
                     return outs, new_aux
 
+                f = _maybe_remat(f)
                 outs, vjp_fn, new_aux = jax.vjp(f, tuple(diff_vals),
                                                 has_aux=True)
                 heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)

@@ -319,8 +319,13 @@ def _convolution(attrs, data, weight, bias=None):
     dilate = astuple(attrs.get('dilate', (1,) * nd), nd)
     pad = astuple(attrs.get('pad', (0,) * nd), nd)
     num_group = asint(attrs.get('num_group', 1))
-    if nd == 2 and _conv_prefer_nhwc():
-        x = jnp.transpose(data, (0, 2, 3, 1))
+    nhwc_io = attrs.get('__layout__') == 'NHWC'
+    if nd == 2 and (nhwc_io or _conv_prefer_nhwc()):
+        # nhwc_io: the executor layout pass delivers data already
+        # permuted and consumes the output permuted — no boundary
+        # transposes here (they are exactly the non-cancelling HBM
+        # passes the pass exists to remove)
+        x = data if nhwc_io else jnp.transpose(data, (0, 2, 3, 1))
         w = jnp.transpose(weight, (2, 3, 1, 0))  # OIHW -> HWIO
         out = lax.conv_general_dilated(
             x, w, window_strides=stride,
@@ -330,7 +335,7 @@ def _convolution(attrs, data, weight, bias=None):
             feature_group_count=num_group)
         if bias is not None:
             out = out + bias.reshape((1, 1, 1, -1))
-        return jnp.transpose(out, (0, 3, 1, 2))
+        return out if nhwc_io else jnp.transpose(out, (0, 3, 1, 2))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad],
@@ -392,9 +397,13 @@ def _deconvolution(attrs, data, weight, bias=None):
 def _pooling(attrs, data):
     pool_type = str(parse_attr_value(attrs.get('pool_type', 'max')))
     global_pool = asbool(attrs.get('global_pool', False))
+    # executor layout pass: data arrives channels-last; spatial dims
+    # shift from (2..) to (1..ndim-1) and the output stays permuted
+    nhwc_io = attrs.get('__layout__') == 'NHWC' and data.ndim == 4
+    sp0 = 1 if nhwc_io else 2
     nspatial = data.ndim - 2
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(sp0, sp0 + nspatial))
         if pool_type == 'max':
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type == 'sum':
@@ -406,16 +415,21 @@ def _pooling(attrs, data):
     convention = str(parse_attr_value(attrs.get('pooling_convention', 'valid')))
     pads = []
     for i, (k, s, p) in enumerate(zip(kernel, stride, pad)):
-        size = data.shape[2 + i]
+        size = data.shape[sp0 + i]
         if convention == 'full':
             out = int(np.ceil((size + 2 * p - k) / s)) + 1
         else:
             out = (size + 2 * p - k) // s + 1
         hi = max((out - 1) * s + k - size - p, p)
         pads.append((p, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padcfg = ((0, 0), (0, 0)) + tuple(pads)
+    if nhwc_io:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padcfg = ((0, 0),) + tuple(pads) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padcfg = ((0, 0), (0, 0)) + tuple(pads)
     if pool_type == 'max':
         # scalar -inf init so JAX recognizes the differentiable
         # reduce_window_max pattern
@@ -473,6 +487,10 @@ def _bn_compute(attrs, inputs, auxs, op_ctx):
     use_global = asbool(attrs.get('use_global_stats', False))
     output_mean_var = asbool(attrs.get('output_mean_var', False))
     axis = normalize_axis(attrs.get('axis', 1), data.ndim)
+    if attrs.get('__layout__') == 'NHWC' and axis == 1 and \
+            data.ndim == 4:
+        # executor layout pass: data is channels-last
+        axis = 3
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
     bshape = tuple(shape)

@@ -324,6 +324,78 @@ def test_fused_kvstore_matches_no_kvstore():
                                    rtol=1e-5, atol=1e-5)
 
 
+def test_nhwc_layout_pass_matches_nchw():
+    """The executor's NHWC layout pass (MXNET_TPU_LAYOUT_OPT=1) must be
+    numerically equivalent to semantic NCHW execution across conv/BN/
+    relu/pooling/residual-add/global-pool/FC — same outputs, params,
+    and BN moving stats after training steps."""
+    import os
+
+    seed_params = {}
+    prior = os.environ.get('MXNET_TPU_LAYOUT_OPT')
+
+    def run(layout_env):
+        os.environ['MXNET_TPU_LAYOUT_OPT'] = layout_env
+        try:
+            rng = np.random.RandomState(0)
+            data = sym.Variable('data')
+            c1 = sym.Convolution(data, name='c1', num_filter=8,
+                                 kernel=(3, 3), pad=(1, 1))
+            b1 = sym.BatchNorm(c1, name='b1', fix_gamma=False)
+            a1 = sym.Activation(b1, act_type='relu')
+            p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2),
+                             pool_type='max')
+            c2 = sym.Convolution(p1, name='c2', num_filter=8,
+                                 kernel=(3, 3), pad=(1, 1))
+            res = c2 + sym.Convolution(p1, name='sc', num_filter=8,
+                                       kernel=(1, 1))
+            b2 = sym.BatchNorm(res, name='b2', fix_gamma=False)
+            gp = sym.Pooling(b2, global_pool=True, pool_type='avg',
+                             kernel=(1, 1))
+            fc = sym.FullyConnected(sym.Flatten(gp), num_hidden=4,
+                                    name='fc')
+            net = sym.SoftmaxOutput(fc, name='softmax')
+            mod = mx.mod.Module(net, context=[mx.cpu(0)])
+            mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 3, 16, 16))],
+                     label_shapes=[mx.io.DataDesc('softmax_label', (8,))])
+            if seed_params:
+                mod.init_params(initializer=None,
+                                arg_params=seed_params['arg'],
+                                aux_params=seed_params['aux'])
+            else:
+                mod.init_params(initializer=mx.init.Xavier())
+                ap, ax = mod.get_params()
+                seed_params['arg'] = {k: v.copy() for k, v in ap.items()}
+                seed_params['aux'] = {k: v.copy() for k, v in ax.items()}
+            mod.init_optimizer(optimizer_params={'learning_rate': 0.1})
+            X = mx.nd.array(rng.rand(8, 3, 16, 16).astype(np.float32))
+            y = mx.nd.array((rng.rand(8) * 4).astype(np.float32))
+            bt = mx.io.DataBatch(data=[X], label=[y])
+            for _ in range(3):
+                mod.forward_backward(bt)
+                mod.update()
+            mod.forward(bt, is_train=False)
+            out = mod.get_outputs()[0].asnumpy()
+            params, aux = mod.get_params()
+            return (out, {k: v.asnumpy() for k, v in params.items()},
+                    {k: v.asnumpy() for k, v in aux.items()})
+        finally:
+            if prior is None:
+                os.environ.pop('MXNET_TPU_LAYOUT_OPT', None)
+            else:
+                os.environ['MXNET_TPU_LAYOUT_OPT'] = prior
+
+    o0, p0, a0 = run('0')
+    o1, p1, a1 = run('1')
+    np.testing.assert_allclose(o0, o1, rtol=2e-4, atol=2e-5)
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=2e-4,
+                                   atol=2e-5, err_msg=k)
+    for k in a0:
+        np.testing.assert_allclose(a0[k], a1[k], rtol=2e-4,
+                                   atol=2e-5, err_msg=k)
+
+
 def test_fused_step_deferred_materialization():
     """forward_backward defers when the whole step can fuse; accessing
     outputs before update() must still yield correct results, and the
