@@ -35,11 +35,9 @@ class _NameManager:
         if name:
             return name
         hint = hint.lower()
-        if hint not in self._counter:
-            self._counter[hint] = 0
-        name = '%s%d' % (hint, self._counter[hint])
-        self._counter[hint] += 1
-        return name
+        seq = self._counter.get(hint, 0)
+        self._counter[hint] = seq + 1
+        return '%s%d' % (hint, seq)
 
     def __enter__(self):
         self._old = getattr(_NameManager._current, 'value', None)
@@ -142,8 +140,8 @@ def get_alias_func(base_class, nickname):
 
     def alias(*aliases):
         def reg(klass):
-            for name in aliases:
-                register(klass, name)
+            for extra in aliases:
+                register(klass, extra)
             return klass
         return reg
     return alias

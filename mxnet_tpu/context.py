@@ -29,24 +29,24 @@ class Context:
 
     def __init__(self, device_type, device_id=0):
         if isinstance(device_type, Context):
-            self.device_typeid = device_type.device_typeid
-            self.device_id = device_type.device_id
-        else:
-            self.device_typeid = Context.devstr2type[device_type]
-            self.device_id = device_id
+            device_type, device_id = (device_type.device_type,
+                                      device_type.device_id)
+        self.device_typeid = Context.devstr2type[device_type]
+        self.device_id = device_id
         self._old_ctx = None
 
     @property
     def device_type(self):
         return Context.devtype2str[self.device_typeid]
 
+    def _key(self):
+        return (self.device_typeid, self.device_id)
+
     def __hash__(self):
-        return hash((self.device_typeid, self.device_id))
+        return hash(self._key())
 
     def __eq__(self, other):
-        return (isinstance(other, Context) and
-                self.device_typeid == other.device_typeid and
-                self.device_id == other.device_id)
+        return isinstance(other, Context) and self._key() == other._key()
 
     def __str__(self):
         return '%s(%d)' % (self.device_type, self.device_id)

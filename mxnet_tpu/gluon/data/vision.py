@@ -20,17 +20,15 @@ from .dataset import Dataset
 class _DownloadedDataset(Dataset):
     def __init__(self, root, train, transform):
         self._root = os.path.expanduser(root)
-        self._train = train
-        self._transform = transform
-        self._data = None
-        self._label = None
+        self._train, self._transform = train, transform
+        self._data = self._label = None
         self._get_data()
 
     def __getitem__(self, idx):
-        data = nd.array(self._data[idx], dtype=self._data.dtype)
-        if self._transform is not None:
-            return self._transform(data, self._label[idx])
-        return data, self._label[idx]
+        sample = nd.array(self._data[idx], dtype=self._data.dtype)
+        if self._transform is None:
+            return sample, self._label[idx]
+        return self._transform(sample, self._label[idx])
 
     def __len__(self):
         return len(self._label)

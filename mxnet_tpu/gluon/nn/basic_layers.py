@@ -110,6 +110,7 @@ class Activation(HybridBlock):
         super(Activation, self).__init__(**kwargs)
 
     def _alias(self):
+        """The activation name doubles as the block's name hint."""
         return self._act_type
 
     def hybrid_forward(self, F, x):

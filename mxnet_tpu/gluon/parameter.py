@@ -265,15 +265,19 @@ class ParameterDict(object):
         return len(self._params)
 
     def __iter__(self):
+        """Iterate parameter names."""
         return iter(self._params)
 
     def items(self):
+        """(name, Parameter) pairs, insertion-ordered."""
         return self._params.items()
 
     def keys(self):
+        """Parameter names, insertion-ordered."""
         return self._params.keys()
 
     def values(self):
+        """Parameter objects, insertion-ordered."""
         return self._params.values()
 
     def __getitem__(self, key):

@@ -83,6 +83,20 @@ class RecurrentCell(HybridBlock):
     def _infer_param_shapes(self, x, *args):
         self._infer_param_shapes_rnn(x, None)
 
+    def _register_fc_params(self, gate_mult, hidden_size, input_size,
+                            i2h_weight_init, h2h_weight_init,
+                            i2h_bias_init, h2h_bias_init):
+        """Register the cell's stacked i2h/h2h projection parameters
+        (gate_mult = gates per step: 1 rnn, 4 lstm, 3 gru)."""
+        wide = gate_mult * hidden_size
+        specs = (('i2h_weight', (wide, input_size), i2h_weight_init),
+                 ('h2h_weight', (wide, hidden_size), h2h_weight_init),
+                 ('i2h_bias', (wide,), i2h_bias_init),
+                 ('h2h_bias', (wide,), h2h_bias_init))
+        for pname, shape, init in specs:
+            setattr(self, pname, self.params.get(
+                pname, shape=shape, init=init, allow_deferred_init=True))
+
 
 class RNNCell(RecurrentCell):
     """Simple Elman RNN cell: h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
@@ -92,21 +106,12 @@ class RNNCell(RecurrentCell):
                  i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
                  input_size=0, prefix=None, params=None):
         super(RNNCell, self).__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
+        self._hidden_size, self._input_size = hidden_size, input_size
         self._activation = activation
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            'i2h_weight', shape=(hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            'h2h_weight', shape=(hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            'i2h_bias', shape=(hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            'h2h_bias', shape=(hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._register_fc_params(1, hidden_size, input_size,
+                                 i2h_weight_initializer,
+                                 h2h_weight_initializer,
+                                 i2h_bias_initializer, h2h_bias_initializer)
 
     def _alias(self):
         return 'rnn'
@@ -133,20 +138,11 @@ class LSTMCell(RecurrentCell):
                  i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
                  input_size=0, prefix=None, params=None):
         super(LSTMCell, self).__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            'i2h_weight', shape=(4 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            'h2h_weight', shape=(4 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            'i2h_bias', shape=(4 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            'h2h_bias', shape=(4 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._hidden_size, self._input_size = hidden_size, input_size
+        self._register_fc_params(4, hidden_size, input_size,
+                                 i2h_weight_initializer,
+                                 h2h_weight_initializer,
+                                 i2h_bias_initializer, h2h_bias_initializer)
 
     def _alias(self):
         return 'lstm'
@@ -180,20 +176,11 @@ class GRUCell(RecurrentCell):
                  i2h_bias_initializer='zeros', h2h_bias_initializer='zeros',
                  input_size=0, prefix=None, params=None):
         super(GRUCell, self).__init__(prefix=prefix, params=params)
-        self._hidden_size = hidden_size
-        self._input_size = input_size
-        self.i2h_weight = self.params.get(
-            'i2h_weight', shape=(3 * hidden_size, input_size),
-            init=i2h_weight_initializer, allow_deferred_init=True)
-        self.h2h_weight = self.params.get(
-            'h2h_weight', shape=(3 * hidden_size, hidden_size),
-            init=h2h_weight_initializer, allow_deferred_init=True)
-        self.i2h_bias = self.params.get(
-            'i2h_bias', shape=(3 * hidden_size,),
-            init=i2h_bias_initializer, allow_deferred_init=True)
-        self.h2h_bias = self.params.get(
-            'h2h_bias', shape=(3 * hidden_size,),
-            init=h2h_bias_initializer, allow_deferred_init=True)
+        self._hidden_size, self._input_size = hidden_size, input_size
+        self._register_fc_params(3, hidden_size, input_size,
+                                 i2h_weight_initializer,
+                                 h2h_weight_initializer,
+                                 i2h_bias_initializer, h2h_bias_initializer)
 
     def _alias(self):
         return 'gru'
@@ -365,20 +352,26 @@ class BidirectionalCell(RecurrentCell):
 
     def __init__(self, l_cell, r_cell, output_prefix='bi_'):
         super(BidirectionalCell, self).__init__(prefix='', params=None)
-        self.register_child(l_cell)
-        self.register_child(r_cell)
         self._output_prefix = output_prefix
+        for child in (l_cell, r_cell):
+            self.register_child(child)
 
     def __call__(self, inputs, states):
         raise NotImplementedError(
             'Bidirectional cells cannot be stepped. Please use unroll')
 
     def state_info(self, batch_size=0):
-        return sum([c.state_info(batch_size) for c in self._children], [])
+        out = []
+        for c in self._children:
+            out.extend(c.state_info(batch_size))
+        return out
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return sum([c.begin_state(**kwargs) for c in self._children], [])
+        out = []
+        for c in self._children:
+            out.extend(c.begin_state(**kwargs))
+        return out
 
     def unroll(self, length, inputs, begin_state=None, layout='NTC',
                merge_outputs=None):

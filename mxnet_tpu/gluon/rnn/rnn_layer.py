@@ -29,10 +29,8 @@ class _RNNLayer(Block):
         super(_RNNLayer, self).__init__(**kwargs)
         assert layout in ('TNC', 'NTC'), \
             'Invalid layout %s; must be one of TNC or NTC' % layout
-        self._hidden_size = hidden_size
-        self._num_layers = num_layers
-        self._mode = mode
-        self._layout = layout
+        self._hidden_size, self._num_layers = hidden_size, num_layers
+        self._mode, self._layout = mode, layout
         self._dropout = dropout
         self._dir = 2 if bidirectional else 1
         self._input_size = input_size

@@ -231,11 +231,12 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetBorrowAug(
             ColorJitterAug(brightness, contrast, saturation)))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+        # Same ImageNet PCA basis as the classification CreateAugmenter.
+        imagenet_pca = (np.array([55.46, 4.794, 1.148]),
+                        np.array([[-0.5675, 0.7192, 0.4009],
+                                  [-0.5808, -0.0045, -0.8140],
+                                  [-0.5836, -0.6948, 0.4203]]))
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, *imagenet_pca)))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:

@@ -94,12 +94,12 @@ def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0):
 
 def scale_down(src_size, size):
     """Scale target size down so it fits in src_size, keeping ratio."""
-    w, h = size
     sw, sh = src_size
+    w, h = size
     if sh < h:
-        w, h = float(w * sh) / h, sh
+        w, h = w * sh / float(h), sh
     if sw < w:
-        w, h = sw, float(h * sw) / w
+        w, h = sw, h * sw / float(w)
     return int(w), int(h)
 
 
@@ -291,14 +291,11 @@ class SaturationJitterAug(Augmenter):
 
 def ColorJitterAug(brightness, contrast, saturation):
     """Composite jitter in random order (reference ColorJitterAug)."""
-    ts = []
-    if brightness > 0:
-        ts.append(BrightnessJitterAug(brightness))
-    if contrast > 0:
-        ts.append(ContrastJitterAug(contrast))
-    if saturation > 0:
-        ts.append(SaturationJitterAug(saturation))
-    return RandomOrderAug(ts)
+    parts = [(brightness, BrightnessJitterAug),
+             (contrast, ContrastJitterAug),
+             (saturation, SaturationJitterAug)]
+    return RandomOrderAug([cls(amount) for amount, cls in parts
+                           if amount > 0])
 
 
 class LightingAug(Augmenter):
@@ -348,30 +345,29 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     contrast=0, saturation=0, pca_noise=0, inter_method=2):
     """Standard augmenter list builder (reference image.py
     CreateAugmenter — order preserved for convergence parity)."""
-    auglist = []
-    if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
+    auglist = [ResizeAug(resize, inter_method)] if resize > 0 else []
     if rand_resize:
         assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
-                                                           4.0 / 3.0),
-                                          inter_method))
+        cropper = RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                                     inter_method)
     elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        cropper = RandomCropAug(crop_size, inter_method)
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
+        cropper = CenterCropAug(crop_size, inter_method)
+    auglist.append(cropper)
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        # ImageNet PCA basis (AlexNet lighting noise constants).
+        imagenet_pca = (np.array([55.46, 4.794, 1.148]),
+                        np.array([[-0.5675, 0.7192, 0.4009],
+                                  [-0.5808, -0.0045, -0.8140],
+                                  [-0.5836, -0.6948, 0.4203]]))
+        auglist.append(LightingAug(pca_noise, *imagenet_pca))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
@@ -441,14 +437,13 @@ class ImageIter(mxio.DataIter):
             self.seq = list(result.keys())
         self.path_root = path_root
         if num_parts > 1 and self.seq is not None:
+            # Data-parallel sharding: keep only this worker's slice.
             assert part_index < num_parts
-            N = len(self.seq)
-            C = N // num_parts
-            self.seq = self.seq[part_index * C:(part_index + 1) * C]
-        if aug_list is None:
-            self.auglist = CreateAugmenter(data_shape, **kwargs)
-        else:
-            self.auglist = aug_list
+            span = len(self.seq) // num_parts
+            lo = part_index * span
+            self.seq = self.seq[lo:lo + span]
+        self.auglist = (CreateAugmenter(data_shape, **kwargs)
+                        if aug_list is None else aug_list)
         self.cur = 0
         self.reset()
 
@@ -485,23 +480,23 @@ class ImageIter(mxio.DataIter):
 
     def next_sample(self):
         """Returns (label, decoded image as numpy HWC)."""
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
+        if self.seq is None:
+            # Pure-record mode: stream the .rec file in order.
+            packed = self.imgrec.read()
+            if packed is None:
                 raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                return header.label, self._decode_np(img)
-            label, fname = self.imglist[idx]
-            with open(os.path.join(self.path_root, fname), 'rb') as f:
-                return label, self._decode_np(f.read())
-        s = self.imgrec.read()
-        if s is None:
+            header, img = recordio.unpack(packed)
+            return header.label, self._decode_np(img)
+        if self.cur >= len(self.seq):
             raise StopIteration
-        header, img = recordio.unpack(s)
-        return header.label, self._decode_np(img)
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            header, img = recordio.unpack(self.imgrec.read_idx(idx))
+            return header.label, self._decode_np(img)
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root, fname), 'rb') as f:
+            return label, self._decode_np(f.read())
 
     def next(self):
         batch_data = np.zeros((self.batch_size,) + self.data_shape,

@@ -64,20 +64,24 @@ class Initializer:
         else:
             self._init_default(desc, arr)
 
+    @staticmethod
+    def _fill(arr, value):
+        arr[:] = float(value)
+
     def _init_zero(self, _, arr):
-        arr[:] = 0.0
+        self._fill(arr, 0)
 
     def _init_one(self, _, arr):
-        arr[:] = 1.0
+        self._fill(arr, 1)
 
     def _init_bias(self, _, arr):
-        arr[:] = 0.0
+        self._fill(arr, 0)
 
     def _init_gamma(self, _, arr):
-        arr[:] = 1.0
+        self._fill(arr, 1)
 
     def _init_beta(self, _, arr):
-        arr[:] = 0.0
+        self._fill(arr, 0)
 
     def _init_weight(self, name, arr):
         raise NotImplementedError
@@ -155,10 +159,8 @@ class FusedRNN(Initializer):
                          bidirectional=bidirectional,
                          forget_bias=forget_bias)
         self._init = init
-        self._num_hidden = num_hidden
-        self._num_layers = num_layers
-        self._mode = mode
-        self._bidirectional = bidirectional
+        self._num_hidden, self._num_layers = num_hidden, num_layers
+        self._mode, self._bidirectional = mode, bidirectional
         self._forget_bias = forget_bias
 
     def _init_weight(self, desc, arr):
@@ -220,28 +222,21 @@ class Xavier(Initializer):
     def __init__(self, rnd_type='uniform', factor_type='avg', magnitude=3):
         super().__init__(rnd_type=rnd_type, factor_type=factor_type,
                          magnitude=magnitude)
-        self.rnd_type = rnd_type
-        self.factor_type = factor_type
+        self.rnd_type, self.factor_type = rnd_type, factor_type
         self.magnitude = float(magnitude)
 
     def _init_weight(self, name, arr):
         shape = arr.shape
-        hw_scale = 1.
         if len(shape) < 2:
             raise ValueError('Xavier initializer needs at least 2D: %s %s'
                              % (name, shape))
-        if len(shape) > 2:
-            hw_scale = np.prod(shape[2:])
+        hw_scale = np.prod(shape[2:]) if len(shape) > 2 else 1.
         fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
-        factor = 1.
-        if self.factor_type == 'avg':
-            factor = (fan_in + fan_out) / 2.0
-        elif self.factor_type == 'in':
-            factor = fan_in
-        elif self.factor_type == 'out':
-            factor = fan_out
-        else:
+        by_type = {'avg': (fan_in + fan_out) / 2.0,
+                   'in': fan_in, 'out': fan_out}
+        if self.factor_type not in by_type:
             raise ValueError('Incorrect factor type')
+        factor = by_type[self.factor_type]
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == 'uniform':
             arr[:] = nd.random_uniform(-scale, scale, arr.shape)
@@ -326,12 +321,13 @@ class Mixed:
 
     def __init__(self, patterns, initializers):
         assert len(patterns) == len(initializers)
-        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
 
     def __call__(self, name, arr):
-        for prog, init in self.map:
-            if prog.match(name):
-                init(name, arr)
-                return
-        raise ValueError('Parameter name %s did not match any pattern'
-                         % name)
+        matched = next((init for prog, init in self.map
+                        if prog.match(name)), None)
+        if matched is None:
+            raise ValueError('Parameter name %s did not match any pattern'
+                             % name)
+        matched(name, arr)

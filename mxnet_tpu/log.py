@@ -3,12 +3,9 @@ level-prefixed formatter and `get_logger` factory."""
 import logging
 import sys
 
-CRITICAL = logging.CRITICAL
-ERROR = logging.ERROR
-WARNING = logging.WARNING
-INFO = logging.INFO
-DEBUG = logging.DEBUG
-NOTSET = logging.NOTSET
+CRITICAL, ERROR, WARNING, INFO, DEBUG, NOTSET = (
+    logging.CRITICAL, logging.ERROR, logging.WARNING,
+    logging.INFO, logging.DEBUG, logging.NOTSET)
 
 PY3 = True
 

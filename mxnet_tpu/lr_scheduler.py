@@ -20,21 +20,22 @@ class FactorScheduler(LRScheduler):
             raise ValueError('Schedule step must be greater or equal than 1')
         if factor > 1.0:
             raise ValueError('Factor must be no more than 1 to make lr reduce')
-        self.step = step
-        self.factor = factor
+        self.step, self.factor = step, factor
         self.stop_factor_lr = stop_factor_lr
         self.count = 0
 
     def __call__(self, num_update):
+        # Catch up: every crossed step boundary decays the rate once.
         while num_update > self.count + self.step:
             self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
+            decayed = self.base_lr * self.factor
+            if decayed < self.stop_factor_lr:
                 self.base_lr = self.stop_factor_lr
                 logging.info('Update[%d]: now learning rate arrived at %0.5e,'
                              ' will not change in the future', num_update,
                              self.base_lr)
             else:
+                self.base_lr = decayed
                 logging.info('Update[%d]: Change learning rate to %0.5e',
                              num_update, self.base_lr)
         return self.base_lr

@@ -9,6 +9,16 @@ from . import base
 from .ndarray import NDArray
 
 
+def _as_numpy(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def _column(label):
+    """Regression metrics compare column vectors; lift 1-D labels."""
+    arr = _as_numpy(label)
+    return arr.reshape(-1, 1) if arr.ndim == 1 else arr
+
+
 def check_label_shapes(labels, preds, shape=0):
     if shape == 0:
         label_shape, pred_shape = len(labels), len(preds)
@@ -38,15 +48,11 @@ class EvalMetric:
         return config
 
     def update_dict(self, label, pred):
-        if self.output_names is not None:
-            pred = [pred[name] for name in self.output_names]
-        else:
-            pred = list(pred.values())
-        if self.label_names is not None:
-            label = [label[name] for name in self.label_names]
-        else:
-            label = list(label.values())
-        self.update(label, pred)
+        picked_preds = (list(pred.values()) if self.output_names is None
+                        else [pred[name] for name in self.output_names])
+        picked_labels = (list(label.values()) if self.label_names is None
+                         else [label[name] for name in self.label_names])
+        self.update(picked_labels, picked_preds)
 
     def update(self, labels, preds):
         raise NotImplementedError
@@ -62,11 +68,9 @@ class EvalMetric:
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names = name if isinstance(name, list) else [name]
+        values = value if isinstance(value, list) else [value]
+        return list(zip(names, values))
 
 
 register = base.get_register_func(EvalMetric, 'metric')
@@ -232,52 +236,45 @@ class Perplexity(EvalMetric):
         self.num_inst += max(num, 1)
 
 
+class _RegressionMetric(EvalMetric):
+    """Scaffold for metrics that average a per-batch error statistic."""
+
+    def _measure(self, diff):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            diff = _column(label) - _as_numpy(pred)
+            self.sum_metric += self._measure(diff)
+            self.num_inst += 1
+
+
 @register
-class MAE(EvalMetric):
+class MAE(_RegressionMetric):
     def __init__(self, name='mae', output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += np.abs(label - pred).mean()
-            self.num_inst += 1
+    def _measure(self, diff):
+        return np.abs(diff).mean()
 
 
 @register
-class MSE(EvalMetric):
+class MSE(_RegressionMetric):
     def __init__(self, name='mse', output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _measure(self, diff):
+        return (diff ** 2.0).mean()
 
 
 @register
-class RMSE(EvalMetric):
+class RMSE(_RegressionMetric):
     def __init__(self, name='rmse', output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += np.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def _measure(self, diff):
+        return np.sqrt((diff ** 2.0).mean())
 
 
 @register
@@ -291,13 +288,12 @@ class CrossEntropy(EvalMetric):
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[np.arange(label.shape[0]), np.int64(label)]
-            self.sum_metric += (-np.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+            prob = _as_numpy(pred)
+            idx = _as_numpy(label).ravel().astype(np.int64)
+            assert idx.shape[0] == prob.shape[0]
+            picked = prob[np.arange(idx.shape[0]), idx]
+            self.sum_metric += -np.log(picked + self.eps).sum()
+            self.num_inst += idx.shape[0]
 
 
 @register
@@ -324,11 +320,10 @@ class CustomMetric(EvalMetric):
     def __init__(self, feval, name=None, allow_extra_outputs=False,
                  output_names=None, label_names=None):
         if name is None:
-            name = feval.__name__
-            if name.find('<') != -1:
-                name = 'custom(%s)' % name
-        super().__init__(name, output_names, label_names,
-                         feval=feval, allow_extra_outputs=allow_extra_outputs)
+            fname = feval.__name__
+            name = 'custom(%s)' % fname if '<' in fname else fname
+        super().__init__(name, output_names, label_names, feval=feval,
+                         allow_extra_outputs=allow_extra_outputs)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
@@ -336,16 +331,11 @@ class CustomMetric(EvalMetric):
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
         for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+            verdict = self._feval(_as_numpy(label), _as_numpy(pred))
+            delta, count = (verdict if isinstance(verdict, tuple)
+                            else (verdict, 1))
+            self.sum_metric += delta
+            self.num_inst += count
 
 
 def np_metric(numpy_feval, name=None, allow_extra_outputs=False):

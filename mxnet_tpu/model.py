@@ -23,26 +23,21 @@ except ImportError:  # pragma: no cover
 def _create_kvstore(kvstore, num_device, arg_params):
     """Decide kvstore + update_on_kvstore (reference model.py:57).
     The >16M-params heuristic for turning off update_on_kvstore is kept."""
-    update_on_kvstore = True
     if kvstore is None:
-        kv = None
-    elif isinstance(kvstore, kvs.KVStore):
-        kv = kvstore
-    elif isinstance(kvstore, str):
-        if num_device == 1 and 'dist' not in kvstore:
-            kv = None
-        else:
-            kv = kvs.create(kvstore)
-            if kvstore == 'local':
-                max_size = max(p.size for p in arg_params.values()) \
-                    if arg_params else 0
-                if max_size > 1024 * 1024 * 16:
-                    update_on_kvstore = False
-    else:
+        return None, False
+    if isinstance(kvstore, kvs.KVStore):
+        return kvstore, True
+    if not isinstance(kvstore, str):
         raise TypeError('kvstore must be KVStore, str or None')
-    if kv is None:
-        update_on_kvstore = False
-    return (kv, update_on_kvstore)
+    if num_device == 1 and 'dist' not in kvstore:
+        return None, False
+    kv = kvs.create(kvstore)
+    update_on_kvstore = True
+    if kvstore == 'local' and arg_params:
+        # Very large (embedding-style) params update faster device-side.
+        biggest = max(p.size for p in arg_params.values())
+        update_on_kvstore = biggest <= 1024 * 1024 * 16
+    return kv, update_on_kvstore
 
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
@@ -102,17 +97,16 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
 def load_checkpoint(prefix, epoch):
     """Load symbol + params (reference model.py load_checkpoint)."""
-    symbol = sym.load('%s-symbol.json' % prefix)
-    save_dict = nd.load('%s-%04d.params' % (prefix, epoch))
-    arg_params = {}
-    aux_params = {}
-    for k, v in save_dict.items():
-        tp, name = k.split(':', 1)
-        if tp == 'arg':
-            arg_params[name] = v
-        if tp == 'aux':
-            aux_params[name] = v
-    return (symbol, arg_params, aux_params)
+    loaded = nd.load('%s-%04d.params' % (prefix, epoch))
+    split = {'arg': {}, 'aux': {}}
+    for key, value in loaded.items():
+        kind, _, name = key.partition(':')
+        if kind not in split:
+            raise ValueError('invalid checkpoint key %r (expected '
+                             'arg:/aux: prefix)' % key)
+        split[kind][name] = value
+    return (sym.load('%s-symbol.json' % prefix),
+            split['arg'], split['aux'])
 
 
 class FeedForward(object):

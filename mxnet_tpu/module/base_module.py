@@ -27,14 +27,27 @@ def _as_list(obj):
     return [obj]
 
 
+def _fire(callbacks, *cb_args):
+    """Invoke a callback or list of callbacks (no-op on None)."""
+    if callbacks is None:
+        return
+    for cb in _as_list(callbacks):
+        cb(*cb_args)
+
+
+def _trim_pad(arrays, pad):
+    """Drop the trailing `pad` rows that a padded final batch carries."""
+    if not pad:
+        return list(arrays)
+    return [a[:a.shape[0] - pad] for a in arrays]
+
+
 class BaseModule:
     def __init__(self, logger=logging):
         self.logger = logger
-        self.binded = False
-        self.for_training = False
-        self.inputs_need_grad = False
-        self.params_initialized = False
-        self.optimizer_initialized = False
+        for flag in ('binded', 'for_training', 'inputs_need_grad',
+                     'params_initialized', 'optimizer_initialized'):
+            setattr(self, flag, False)
         self._symbol = None
         self._total_exec_bytes = 0
 
@@ -81,67 +94,52 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
+        seen = 0
+        for eval_batch in eval_data:
+            if num_batch is not None and seen >= num_batch:
                 break
             self.forward(eval_batch, is_train=False)
             self.update_metric(eval_metric, eval_batch.label)
             if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=seen,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
+            seen += 1
         if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            _fire(score_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=seen,
+                                eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
+        # Pair each batch with its index; zip bounds the stream when a
+        # batch budget is given.
+        stream = (enumerate(eval_data) if num_batch is None
+                  else zip(range(num_batch), eval_data))
+        for nbatch, eval_batch in stream:
             self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in
-                       self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+            yield (_trim_pad(self.get_outputs(), eval_batch.pad),
+                   nbatch, eval_batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
         """Run prediction (reference base_module.py predict)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    'Cannot merge batches: different number of outputs'
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        collected = [[out.copy() for out in outputs]
+                     for outputs, _, _ in self.iter_predict(
+                         eval_data, num_batch=num_batch, reset=reset)]
+        if not collected or not merge_batches:
+            return collected
+        widths = {len(outs) for outs in collected}
+        assert len(widths) == 1, \
+            'Cannot merge batches: different number of outputs'
+        merged = [nd.concatenate(list(column)) for column in zip(*collected)]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
     def fit(self, train_data, eval_data=None, eval_metric='acc',
             epoch_end_callback=None, batch_end_callback=None,
@@ -155,8 +153,8 @@ class BaseModule:
         """The training loop (reference base_module.py:376)."""
         assert num_epoch is not None, 'please specify number of epochs'
         self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
-                  for_training=True, force_rebind=force_rebind)
+                  label_shapes=train_data.provide_label, for_training=True,
+                  force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer, arg_params=arg_params,
@@ -164,55 +162,45 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
-        if validation_metric is None:
-            validation_metric = eval_metric
+        validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            epoch_start = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
+            for nbatch, data_batch in enumerate(train_data):
                 if monitor is not None:
                     monitor.tic()
                 self.forward_backward(data_batch)
                 self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                except StopIteration:
-                    end_of_batch = True
                 self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
+                    _fire(batch_end_callback,
+                          BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                        eval_metric=eval_metric,
+                                        locals=locals()))
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
-            toc = time.time()
-            self.logger.info('Epoch[%d] Time cost=%.3f', epoch, (toc - tic))
+            self.logger.info('Epoch[%d] Time cost=%.3f', epoch,
+                             time.time() - epoch_start)
 
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
+            # Sync a parameter snapshot host-side so checkpoints see the
+            # post-epoch weights, then hand it to the epoch callbacks.
+            arg_snap, aux_snap = self.get_params()
+            self.set_params(arg_snap, aux_snap)
             if epoch_end_callback is not None:
                 for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                    callback(epoch, self.symbol, arg_snap, aux_snap)
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
                     self.logger.info('Epoch[%d] Validation-%s=%f',
                                      epoch, name, val)
             train_data.reset()

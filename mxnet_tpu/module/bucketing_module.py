@@ -150,8 +150,8 @@ class BucketingModule(BaseModule):
                 module.borrow_optimizer(
                     self._buckets[self._default_bucket_key])
             self._buckets[bucket_key] = module
-        self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
+        self._curr_module = self._buckets[bucket_key]
 
     def init_optimizer(self, kvstore='local', optimizer='sgd',
                        optimizer_params=(('learning_rate', 0.01),),

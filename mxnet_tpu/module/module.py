@@ -42,23 +42,18 @@ class Module(BaseModule):
         self._param_names = [x for x in arg_names if x not in input_names]
         self._fixed_param_names = list(fixed_param_names or [])
         self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
+        self._data_names, self._label_names = data_names, label_names
         self._state_names = list(state_names or [])
         self._output_names = symbol.list_outputs()
 
-        self._arg_params = None
-        self._aux_params = None
+        self._arg_params = self._aux_params = None
         self._params_dirty = False
 
-        self._optimizer = None
-        self._kvstore = None
+        self._optimizer = self._kvstore = self._updater = None
         self._update_on_kvstore = None
-        self._updater = None
         self._preload_opt_states = None
         self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+        self._data_shapes = self._label_shapes = None
         # whole-step fusion (fwd+bwd+update in one donated XLA dispatch)
         self._pending_fused = False
         self._fused_step = None
@@ -93,18 +88,13 @@ class Module(BaseModule):
         nd.save(fname, save_dict)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(':', 1)
-            if arg_type == 'arg':
-                arg_params[name] = value
-            elif arg_type == 'aux':
-                aux_params[name] = value
-            else:
+        buckets = {'arg': {}, 'aux': {}}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(':')
+            if kind not in buckets:
                 raise ValueError('Invalid param file ' + fname)
-        self.set_params(arg_params, aux_params)
+            buckets[kind][name] = value
+        self.set_params(buckets['arg'], buckets['aux'])
 
     # -- properties --------------------------------------------------------
     @property

@@ -24,12 +24,10 @@ class Monitor(object):
                 return nd.sum(nd.abs(x)) / x.size
         self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
+        self.activated, self.sort = False, sort
+        self.queue, self.exes = [], []
         self.step = 0
-        self.exes = []
         self.re_pattern = re.compile(pattern)
-        self.sort = sort
 
         def stat_helper(name, array):
             if not self.activated or not self.re_pattern.match(name):

@@ -70,12 +70,8 @@ class CustomOpProp(object):
         return self.need_top_grad_
 
     def declare_backward_dependency(self, out_grad, in_data, out_data):
-        deps = []
-        if self.need_top_grad():
-            deps.extend(out_grad)
-        deps.extend(in_data)
-        deps.extend(out_data)
-        return deps
+        head = out_grad if self.need_top_grad() else []
+        return list(head) + list(in_data) + list(out_data)
 
     def create_operator(self, ctx, in_shapes, in_dtypes):
         return CustomOp()
@@ -240,13 +236,13 @@ class PythonOp(object):
     """Base class for legacy python ops (reference operator.py:36)."""
 
     def __init__(self, need_top_grad=True):
-        self.info_ = None
-        self.need_top_grad_ = need_top_grad
+        self.info_, self.need_top_grad_ = None, need_top_grad
 
     def __call__(self, *args, **kwargs):
         return self.get_symbol(*args, **kwargs)
 
     def get_symbol(self, *args, **kwargs):
+        """Subclasses (NumpyOp / NDArrayOp) build the bound symbol."""
         raise NotImplementedError('use NumpyOp or NDArrayOp')
 
     def forward(self, in_data, out_data):

@@ -25,22 +25,17 @@ class Optimizer:
     def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0):
-        self.rescale_grad = rescale_grad
-        self.lr = learning_rate
+        self.lr, self.wd = learning_rate, wd
+        self.rescale_grad, self.clip_gradient = rescale_grad, clip_gradient
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
+            lr_scheduler.base_lr = learning_rate
+        self.begin_num_update = self.num_update = begin_num_update
         self._index_update_count = {}
-        self.clip_gradient = clip_gradient
         if param_idx2name is None:
             param_idx2name = {}
         assert isinstance(param_idx2name, dict)
-        self.idx2name = param_idx2name.copy()
+        self.idx2name = dict(param_idx2name)
         self.sym = sym
         self.set_lr_mult({})
         self.set_wd_mult({})
@@ -68,25 +63,26 @@ class Optimizer:
         raise NotImplementedError
 
     # -- multipliers (reference optimizer.py set_lr_mult/set_wd_mult) -----
+    def _mults_from_sym(self, attr_key):
+        """Per-arg multiplier overrides declared as symbol attributes
+        (__lr_mult__ / __wd_mult__)."""
+        if self.sym is None:
+            return {}
+        attrs = self.sym.attr_dict()
+        return {name: float(attrs[name][attr_key])
+                for name in self.sym.list_arguments()
+                if attr_key in attrs.get(name, {})}
+
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and '__lr_mult__' in attr[name]:
-                    self.lr_mult[name] = float(attr[name]['__lr_mult__'])
+        self.lr_mult = self._mults_from_sym('__lr_mult__')
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            if not (n.endswith('_weight') or n.endswith('_gamma')):
-                self.wd_mult[n] = 0.0
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and '__wd_mult__' in attr[name]:
-                    self.wd_mult[name] = float(attr[name]['__wd_mult__'])
+        # Parity contract with the reference: only *_weight / *_gamma
+        # params decay by default; biases/betas/running stats are exempt.
+        self.wd_mult = {name: 0.0 for name in self.idx2name.values()
+                        if not name.endswith(('_weight', '_gamma'))}
+        self.wd_mult.update(self._mults_from_sym('__wd_mult__'))
         self.wd_mult.update(args_wd_mult)
 
     def _update_count(self, index):
@@ -305,10 +301,8 @@ class RMSProp(Optimizer):
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
-        self.epsilon = epsilon
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered, self.epsilon = centered, epsilon
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
@@ -426,10 +420,8 @@ class Nadam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
-        self.schedule_decay = schedule_decay
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon, self.schedule_decay = epsilon, schedule_decay
         self.m_schedule = 1.
 
     def create_state(self, index, weight):

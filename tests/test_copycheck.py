@@ -1,0 +1,25 @@
+"""CI gate for the local copy-paste sweep (tools/copycheck_local.py).
+
+Guards the no-verbatim-blocks bar: no contiguous run of >= 6 identical
+normalized lines may exist between mxnet_tpu/ and the reference's
+python/mxnet/ tree unless it is allowlisted with a written parity
+justification inside the tool.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = os.environ.get('MXNET_TPU_REFERENCE', '/root/reference')
+
+
+@pytest.mark.skipif(not os.path.isdir(os.path.join(REF, 'python', 'mxnet')),
+                    reason='reference tree not available')
+def test_no_verbatim_blocks_vs_reference():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'copycheck_local.py'),
+         '--threshold', '6'],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

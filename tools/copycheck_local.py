@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Local copy-paste sweep: find contiguous verbatim line matches between this
+repo's Python tree and the reference's python/mxnet tree.
+
+For every repo .py file we normalize lines (strip whitespace, drop blanks and
+comment-only lines) and report every contiguous run of >= THRESHOLD identical
+normalized lines that also appears contiguously in some reference file.
+
+Usage:
+    python tools/copycheck_local.py [--threshold 6] [--json]
+
+Exit status is 1 if any block at or above the threshold is found that is not
+covered by the allowlist below, 0 otherwise.  CI runs this via
+tests/test_copycheck.py.
+"""
+import argparse
+import json
+import os
+import sys
+from difflib import SequenceMatcher
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = os.environ.get('MXNET_TPU_REFERENCE', '/root/reference')
+
+# Lines too generic to count toward a "verbatim block" on their own: they
+# appear in any Python codebase and would chain unrelated code into runs.
+TRIVIAL = {
+    '', 'else:', 'try:', 'pass', 'continue', 'break', 'return', 'return None',
+    'return True', 'return False', 'return self', '}', ')', '])', '))', '},',
+    'else{', '@property', 'def reset(self):', 'def __iter__(self):',
+    'import numpy as np', 'import logging', 'import os', 'import sys',
+    'import time', 'import threading', 'import math', 'import struct',
+    'import ctypes', 'import json', 'import pickle', 'raise StopIteration',
+    'def __next__(self):', 'return self.next()',
+}
+
+# (repo_relpath, first_normalized_line_prefix) -> justification.  Every entry
+# here must be a parity-forced contract (the lines ARE the spec), not a
+# convenience copy.  Keep this list short and argued.
+ALLOWLIST = {}
+
+
+def normalize(path):
+    """Return [(orig_lineno, normalized_line)] for substantive lines."""
+    out = []
+    try:
+        with open(path, encoding='utf-8', errors='replace') as f:
+            lines = f.readlines()
+    except OSError:
+        return out
+    for i, raw in enumerate(lines, 1):
+        s = ' '.join(raw.split())
+        if not s or s.startswith('#'):
+            continue
+        out.append((i, s))
+    return out
+
+
+def walk_py(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ('.git', '__pycache__', 'build', 'node_modules')]
+        for fn in filenames:
+            if fn.endswith('.py'):
+                yield os.path.join(dirpath, fn)
+
+
+def substantive_len(lines):
+    return sum(1 for ln in lines if ln not in TRIVIAL)
+
+
+def find_blocks(repo_lines, ref_lines, threshold):
+    """Contiguous equal runs between two normalized-line sequences."""
+    a = [s for _, s in repo_lines]
+    b = [s for _, s in ref_lines]
+    sm = SequenceMatcher(None, a, b, autojunk=False)
+    blocks = []
+    for m in sm.get_matching_blocks():
+        if m.size < threshold:
+            continue
+        seg = a[m.a:m.a + m.size]
+        if substantive_len(seg) < threshold:
+            continue
+        blocks.append({
+            'repo_lines': (repo_lines[m.a][0], repo_lines[m.a + m.size - 1][0]),
+            'ref_lines': (ref_lines[m.b][0], ref_lines[m.b + m.size - 1][0]),
+            'size': m.size,
+            'first_line': seg[0],
+        })
+    return blocks
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--threshold', type=int, default=6)
+    ap.add_argument('--json', action='store_true')
+    ap.add_argument('--repo-dir', default=os.path.join(REPO, 'mxnet_tpu'))
+    ap.add_argument('--ref-dir', default=os.path.join(REF, 'python', 'mxnet'))
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.ref_dir):
+        print(json.dumps({'error': 'reference tree not found', 'ref': args.ref_dir}))
+        return 0  # nothing to compare against (e.g. deployment install)
+
+    ref_files = [(p, normalize(p)) for p in walk_py(args.ref_dir)]
+    ref_files = [(p, ls) for p, ls in ref_files if ls]
+    # Prefilter index: normalized line -> set of ref file indices containing it.
+    line_index = {}
+    for idx, (_, ls) in enumerate(ref_files):
+        for _, s in ls:
+            if s not in TRIVIAL:
+                line_index.setdefault(s, set()).add(idx)
+
+    findings = []
+    for rp in walk_py(args.repo_dir):
+        repo_lines = normalize(rp)
+        if not repo_lines:
+            continue
+        rel = os.path.relpath(rp, REPO)
+        # Candidate reference files: share >= threshold substantive lines.
+        counts = {}
+        for _, s in repo_lines:
+            for idx in line_index.get(s, ()):
+                counts[idx] = counts.get(idx, 0) + 1
+        for idx, n_shared in counts.items():
+            if n_shared < args.threshold:
+                continue
+            ref_path, ref_lines = ref_files[idx]
+            for blk in find_blocks(repo_lines, ref_lines, args.threshold):
+                key = (rel, blk['first_line'][:60])
+                blk['repo_file'] = rel
+                blk['ref_file'] = os.path.relpath(ref_path, REF)
+                blk['allowed'] = ALLOWLIST.get(key)
+                findings.append(blk)
+
+    # Dedup: same repo span reported against several ref files -> keep largest.
+    best = {}
+    for blk in findings:
+        key = (blk['repo_file'], blk['repo_lines'])
+        if key not in best or blk['size'] > best[key]['size']:
+            best[key] = blk
+    findings = sorted(best.values(),
+                      key=lambda b: (-b['size'], b['repo_file']))
+
+    violations = [b for b in findings if not b['allowed']]
+    if args.json:
+        print(json.dumps({'threshold': args.threshold,
+                          'findings': findings,
+                          'violations': len(violations)}, indent=1))
+    else:
+        for b in findings:
+            tag = 'ALLOWED ' if b['allowed'] else ''
+            print('%s%s:%d-%d ~ %s:%d-%d (%d lines) | %s' % (
+                tag, b['repo_file'], b['repo_lines'][0], b['repo_lines'][1],
+                b['ref_file'], b['ref_lines'][0], b['ref_lines'][1],
+                b['size'], b['first_line'][:70]))
+        print('%d finding(s), %d violation(s) at threshold %d'
+              % (len(findings), len(violations), args.threshold))
+    return 1 if violations else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
