@@ -53,7 +53,13 @@ def _dev_name(dev_type):
 
 def set_input(pred, key, buf):
     """MXTPredSetInput: flat float32 little-endian bytes, reshaped to
-    the input's bound shape."""
+    the input's bound shape.  Only declared input nodes are writable —
+    the reference MXPredSetInput likewise refuses weight names, and a
+    silent same-size weight overwrite would be a miserable bug."""
+    if key not in pred._input_names:
+        raise ValueError(
+            '%r is not an input of this predictor (inputs: %s)'
+            % (key, sorted(pred._input_names)))
     arr = pred._executor.arg_dict[key]
     data = np.frombuffer(buf, dtype='<f4')
     if data.size != int(np.prod(arr.shape)):

@@ -78,17 +78,19 @@ _ML_DTYPES = ('bfloat16', 'float8_e4m3fn', 'float8_e5m2')
 def _wire_dtype(name):
     """dtype by name; the few accelerator dtypes numpy lacks resolve
     through an explicit ml_dtypes whitelist (never getattr on an
-    attacker-chosen name)."""
+    attacker-chosen name).  Only numeric kinds are accepted — str/void/
+    datetime dtypes have surprising frombuffer semantics and the data
+    path never needs them."""
+    if name in _ML_DTYPES:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
     try:
         dt = np.dtype(name)
     except TypeError:
-        if name not in _ML_DTYPES:
-            raise ValueError('dtype %r not allowed on the PS wire'
-                             % name)
-        import ml_dtypes
-        dt = np.dtype(getattr(ml_dtypes, name))
-    if dt.hasobject:
-        raise ValueError('object dtype not allowed on the PS wire')
+        raise ValueError('dtype %r not allowed on the PS wire' % name)
+    if dt.kind not in 'biufc':
+        raise ValueError('non-numeric dtype %r not allowed on the PS wire'
+                         % name)
     return dt
 
 
@@ -230,8 +232,20 @@ def _recv_exact(sock, n):
     return buf
 
 
+# Upper bound on a single wire frame.  The length prefix arrives before
+# HMAC verification, so an unauthenticated peer could otherwise force
+# multi-GB allocations; anything legitimate (one tensor + envelope) fits
+# far below this.  Override via MXNET_TPU_PS_MAX_FRAME (bytes).
+_MAX_FRAME_BYTES = int(os.environ.get('MXNET_TPU_PS_MAX_FRAME',
+                                      4 * 1024 * 1024 * 1024))
+
+
 def _recv_msg(sock):
     (n,) = struct.unpack('<Q', _recv_exact(sock, 8))
+    if n > _MAX_FRAME_BYTES:
+        raise ConnectionError(
+            'kvstore frame length %d exceeds limit %d (set '
+            'MXNET_TPU_PS_MAX_FRAME to raise)' % (n, _MAX_FRAME_BYTES))
     tag = _recv_exact(sock, 32)
     payload = _recv_exact(sock, n)
     want = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
@@ -397,7 +411,10 @@ class KVStoreServer(object):
                 self.cv.wait()
             if key not in self.store:
                 return ('err', 'key %r not initialized' % (key,))
-            return ('ok', self.store[key])
+            # Snapshot while still holding the lock: the frame is encoded
+            # after release, and an async-mode in-place updater write could
+            # otherwise serialize a torn tensor.
+            return ('ok', self.store[key].copy())
 
     def _handle_barrier(self):
         with self.cv:
@@ -485,7 +502,10 @@ class KVStoreServer(object):
                     reply = ('ok',)
                 elif op == 'get_states':
                     with self.cv:
-                        reply = ('ok', dict(self.store))
+                        # Deep-copy under the lock (same torn-tensor
+                        # hazard as _handle_pull).
+                        reply = ('ok', {k: v.copy()
+                                        for k, v in self.store.items()})
                 elif op == 'has_updater':
                     reply = ('ok', self.updater is not None)
                 elif op == 'stop':

@@ -351,6 +351,34 @@ def test_forged_frame_cannot_execute_code(tmp_path):
     assert not canary.exists(), 'forged frame executed code'
 
 
+def test_oversize_frame_rejected_before_allocation():
+    """An unauthenticated peer must not be able to force a multi-GB
+    allocation via the 64-bit length prefix (ADVICE.md round 3)."""
+    import socket as _socket
+    import struct
+    from mxnet_tpu import kvstore_server as srv
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(struct.pack('<Q', srv._MAX_FRAME_BYTES + 1))
+        with pytest.raises(ConnectionError, match='exceeds limit'):
+            srv._recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_dtype_rejects_non_numeric():
+    """Only numeric dtypes (plus the ml_dtypes whitelist) ride the wire;
+    strN/void/datetime have surprising frombuffer semantics."""
+    from mxnet_tpu import kvstore_server as srv
+    for good in ('float32', 'int64', 'uint8', 'bool', 'complex64',
+                 'bfloat16'):
+        assert srv._wire_dtype(good).itemsize > 0
+    for bad in ('U8', 'S16', 'V4', 'datetime64[ns]', 'object'):
+        with pytest.raises(ValueError):
+            srv._wire_dtype(bad)
+
+
 def test_no_token_refuses_remote_bind(monkeypatch):
     """A server asked to bind a non-loopback interface without
     DMLC_PS_TOKEN must refuse to start (the derived frame key is

@@ -106,27 +106,33 @@ def launch_ssh(args, command):
     port = args.port or 9091
     # multi-host PS servers refuse to start without a shared secret
     # (kvstore_server._check_bind_policy); mint one for the job unless
-    # the operator provided their own.  NOTE: the token rides the ssh
-    # argv, so it is visible in `ps` on each host — acceptable for the
-    # cluster-trust model this launcher serves (same as the reference's
-    # DMLC_* env passing); mount a secrets file and set DMLC_PS_TOKEN
-    # in the remote environment for anything stricter.
+    # the operator provided their own.  The token is shipped over ssh
+    # stdin (read into the remote environment), never on the remote
+    # argv, so it does not show up in `ps` on the hosts.
     token = os.environ.get('DMLC_PS_TOKEN') or secrets.token_hex(16)
     base = ('DMLC_PS_ROOT_URI=%s DMLC_PS_ROOT_PORT=%d DMLC_NUM_WORKER=%d '
-            'DMLC_NUM_SERVER=%d DMLC_PS_TOKEN=%s'
-            % (root, port, args.num_workers, args.num_servers,
-               shlex.quote(token)))
+            'DMLC_NUM_SERVER=%d'
+            % (root, port, args.num_workers, args.num_servers))
+
+    def spawn(host, cmd):
+        wrapped = ('IFS= read -r DMLC_PS_TOKEN; export DMLC_PS_TOKEN; '
+                   + cmd)
+        proc = subprocess.Popen(['ssh', host, wrapped],
+                                stdin=subprocess.PIPE, text=True)
+        proc.stdin.write(token + '\n')
+        proc.stdin.close()
+        return proc
+
     procs = []
     try:
         for sid in range(args.num_servers):
             cmd = '%s DMLC_ROLE=server DMLC_SERVER_ID=%d python3 -m ' \
                 'mxnet_tpu.kvstore_server' % (base, sid)
-            procs.append(subprocess.Popen(
-                ['ssh', hosts[sid % len(hosts)], cmd]))
+            procs.append(spawn(hosts[sid % len(hosts)], cmd))
         for wid in range(args.num_workers):
             cmd = '%s DMLC_ROLE=worker DMLC_WORKER_ID=%d %s' % (
                 base, wid, ' '.join(shlex.quote(c) for c in command))
-            procs.append(subprocess.Popen(['ssh', hosts[wid], cmd]))
+            procs.append(spawn(hosts[wid], cmd))
         rc = 0
         for p in procs[args.num_servers:]:
             rc = p.wait() or rc
