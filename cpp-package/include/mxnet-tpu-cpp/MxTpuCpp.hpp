@@ -45,6 +45,44 @@ int MXTNDListGet(void*, uint32_t, const char**, const float**,
                  const uint32_t**, uint32_t*);
 void MXTNDListFree(void*);
 const char* MXTPredGetLastError(void);
+
+// training ABI (src/c_api_train.cc)
+const char* MXTTrainGetLastError(void);
+int MXTNDArrayCreate(const uint32_t*, uint32_t, int, int, void**);
+int MXTNDArrayCreateFromBytes(const uint32_t*, uint32_t, const float*,
+                              int, int, void**);
+int MXTNDArraySyncCopyFromCPU(void*, const float*, size_t);
+int MXTNDArraySyncCopyToCPU(void*, float*, size_t);
+int MXTNDArrayGetShape(void*, uint32_t*, const uint32_t**);
+void MXTNDArrayFree(void*);
+int MXTSymbolCreateVariable(const char*, void**);
+int MXTSymbolCreate(const char*, const char*, uint32_t, const char**,
+                    const char**, uint32_t, const char**, void**, void**);
+int MXTSymbolCreateFromJSON(const char*, void**);
+int MXTSymbolSaveToJSON(void*, const char**);
+int MXTSymbolListArguments(void*, uint32_t*, const char***);
+int MXTSymbolListOutputs(void*, uint32_t*, const char***);
+int MXTSymbolListAuxiliaryStates(void*, uint32_t*, const char***);
+void MXTSymbolFree(void*);
+int MXTExecutorSimpleBind(void*, int, int, const char*, uint32_t,
+                          const char**, const uint32_t*, const uint32_t*,
+                          void**);
+int MXTExecutorForward(void*, int);
+int MXTExecutorBackward(void*);
+int MXTExecutorNumOutputs(void*, uint32_t*);
+int MXTExecutorOutput(void*, uint32_t, void**);
+int MXTExecutorArgArray(void*, const char*, void**);
+int MXTExecutorGradArray(void*, const char*, void**);
+void MXTExecutorFree(void*);
+int MXTUpdaterCreate(const char*, uint32_t, const char**, const char**,
+                     void**);
+int MXTUpdaterStep(void*, int, void*, void*);
+void MXTUpdaterFree(void*);
+int MXTKVStoreCreate(const char*, void**);
+int MXTKVStoreInit(void*, const char*, void*);
+int MXTKVStorePush(void*, const char*, void*);
+int MXTKVStorePull(void*, const char*, void*);
+void MXTKVStoreFree(void*);
 }
 
 namespace mxtpu {
@@ -233,6 +271,289 @@ class NDList {
  private:
   void* handle_ = nullptr;
   std::vector<NDArrayView> items_;
+};
+
+// ---------------------------------------------------------------------------
+// Training surface (reference cpp-package trains an MLP end-to-end from
+// C++, /root/reference/cpp-package/example/mlp.cpp; these RAII types sit
+// on the training C ABI in src/c_api_train.cc).
+// ---------------------------------------------------------------------------
+
+inline void CheckT(int rc, const char* what) {
+  if (rc != 0)
+    throw Error(std::string(what) + ": " + MXTTrainGetLastError());
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const Shape& shape, DeviceType dev = kCPU, int dev_id = 0) {
+    CheckT(MXTNDArrayCreate(shape.data(),
+                            static_cast<uint32_t>(shape.size()), dev,
+                            dev_id, &handle_),
+           "MXTNDArrayCreate");
+  }
+  NDArray(const Shape& shape, const std::vector<float>& data,
+          DeviceType dev = kCPU, int dev_id = 0) {
+    CheckT(MXTNDArrayCreateFromBytes(
+               shape.data(), static_cast<uint32_t>(shape.size()),
+               data.data(), dev, dev_id, &handle_),
+           "MXTNDArrayCreateFromBytes");
+  }
+  static NDArray FromHandle(void* h) {
+    NDArray a;
+    a.handle_ = h;
+    return a;
+  }
+  NDArray(NDArray&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  NDArray& operator=(NDArray&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  ~NDArray() {
+    if (handle_ != nullptr) MXTNDArrayFree(handle_);
+  }
+
+  void CopyFrom(const std::vector<float>& data) {
+    CheckT(MXTNDArraySyncCopyFromCPU(handle_, data.data(), data.size()),
+           "MXTNDArraySyncCopyFromCPU");
+  }
+  std::vector<float> ToVector() const {
+    Shape s = GetShape();
+    size_t n = 1;
+    for (uint32_t d : s) n *= d;
+    std::vector<float> out(n);
+    CheckT(MXTNDArraySyncCopyToCPU(handle_, out.data(), n),
+           "MXTNDArraySyncCopyToCPU");
+    return out;
+  }
+  Shape GetShape() const {
+    uint32_t ndim = 0;
+    const uint32_t* dims = nullptr;
+    CheckT(MXTNDArrayGetShape(handle_, &ndim, &dims),
+           "MXTNDArrayGetShape");
+    return Shape(dims, dims + ndim);
+  }
+  void* handle() const { return handle_; }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+class Symbol {
+ public:
+  Symbol() = default;
+  static Symbol Variable(const std::string& name) {
+    Symbol s;
+    CheckT(MXTSymbolCreateVariable(name.c_str(), &s.handle_),
+           "MXTSymbolCreateVariable");
+    return s;
+  }
+  // Operator application: attrs as strings, inputs as named symbols.
+  static Symbol Create(
+      const std::string& op, const std::string& name,
+      const std::vector<std::pair<std::string, std::string>>& attrs,
+      const std::vector<std::pair<std::string, const Symbol*>>& args) {
+    std::vector<const char*> ak, av, an;
+    std::vector<void*> ah;
+    for (const auto& kv : attrs) {
+      ak.push_back(kv.first.c_str());
+      av.push_back(kv.second.c_str());
+    }
+    for (const auto& kv : args) {
+      an.push_back(kv.first.c_str());
+      ah.push_back(kv.second->handle_);
+    }
+    Symbol s;
+    CheckT(MXTSymbolCreate(op.c_str(), name.c_str(),
+                           static_cast<uint32_t>(ak.size()), ak.data(),
+                           av.data(), static_cast<uint32_t>(an.size()),
+                           an.data(), ah.data(), &s.handle_),
+           "MXTSymbolCreate");
+    return s;
+  }
+  static Symbol FromJSON(const std::string& json) {
+    Symbol s;
+    CheckT(MXTSymbolCreateFromJSON(json.c_str(), &s.handle_),
+           "MXTSymbolCreateFromJSON");
+    return s;
+  }
+  std::string ToJSON() const {
+    const char* out = nullptr;
+    CheckT(MXTSymbolSaveToJSON(handle_, &out), "MXTSymbolSaveToJSON");
+    return out;
+  }
+  std::vector<std::string> ListArguments() const {
+    return NameList(&MXTSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return NameList(&MXTSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return NameList(&MXTSymbolListAuxiliaryStates);
+  }
+
+  Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Symbol& operator=(Symbol&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+  ~Symbol() {
+    if (handle_ != nullptr) MXTSymbolFree(handle_);
+  }
+  void* handle() const { return handle_; }
+
+ private:
+  std::vector<std::string> NameList(
+      int (*fn)(void*, uint32_t*, const char***)) const {
+    uint32_t n = 0;
+    const char** items = nullptr;
+    CheckT(fn(handle_, &n, &items), "MXTSymbolList*");
+    std::vector<std::string> out;
+    for (uint32_t i = 0; i < n; ++i) out.emplace_back(items[i]);
+    return out;
+  }
+  void* handle_ = nullptr;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, DeviceType dev, int dev_id,
+           const std::string& grad_req,
+           const std::map<std::string, Shape>& shapes) {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, dims;
+    for (const auto& kv : shapes) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    CheckT(MXTExecutorSimpleBind(sym.handle(), dev, dev_id,
+                                 grad_req.c_str(),
+                                 static_cast<uint32_t>(keys.size()),
+                                 keys.data(), indptr.data(), dims.data(),
+                                 &handle_),
+           "MXTExecutorSimpleBind");
+  }
+  Executor(Executor&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  Executor& operator=(Executor&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  ~Executor() {
+    if (handle_ != nullptr) MXTExecutorFree(handle_);
+  }
+
+  void Forward(bool is_train) {
+    CheckT(MXTExecutorForward(handle_, is_train ? 1 : 0),
+           "MXTExecutorForward");
+  }
+  void Backward() {
+    CheckT(MXTExecutorBackward(handle_), "MXTExecutorBackward");
+  }
+  uint32_t NumOutputs() const {
+    uint32_t n = 0;
+    CheckT(MXTExecutorNumOutputs(handle_, &n), "MXTExecutorNumOutputs");
+    return n;
+  }
+  NDArray Output(uint32_t index) const {
+    void* h = nullptr;
+    CheckT(MXTExecutorOutput(handle_, index, &h), "MXTExecutorOutput");
+    return NDArray::FromHandle(h);
+  }
+  NDArray Arg(const std::string& name) const {
+    void* h = nullptr;
+    CheckT(MXTExecutorArgArray(handle_, name.c_str(), &h),
+           "MXTExecutorArgArray");
+    return NDArray::FromHandle(h);
+  }
+  NDArray Grad(const std::string& name) const {
+    void* h = nullptr;
+    CheckT(MXTExecutorGradArray(handle_, name.c_str(), &h),
+           "MXTExecutorGradArray");
+    return NDArray::FromHandle(h);
+  }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+// Optimizer updater (same index -> same state slot, the reference's
+// kvstore-updater contract).
+class Updater {
+ public:
+  Updater(const std::string& opt,
+          const std::vector<std::pair<std::string, std::string>>& attrs) {
+    std::vector<const char*> ak, av;
+    for (const auto& kv : attrs) {
+      ak.push_back(kv.first.c_str());
+      av.push_back(kv.second.c_str());
+    }
+    CheckT(MXTUpdaterCreate(opt.c_str(),
+                            static_cast<uint32_t>(ak.size()), ak.data(),
+                            av.data(), &handle_),
+           "MXTUpdaterCreate");
+  }
+  Updater(Updater&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Updater& operator=(Updater&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  Updater(const Updater&) = delete;
+  Updater& operator=(const Updater&) = delete;
+  ~Updater() {
+    if (handle_ != nullptr) MXTUpdaterFree(handle_);
+  }
+  void Step(int index, const NDArray& grad, NDArray* weight) {
+    CheckT(MXTUpdaterStep(handle_, index, grad.handle(),
+                          weight->handle()),
+           "MXTUpdaterStep");
+  }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& kind = "local") {
+    CheckT(MXTKVStoreCreate(kind.c_str(), &handle_), "MXTKVStoreCreate");
+  }
+  KVStore(KVStore&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  KVStore& operator=(KVStore&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+  ~KVStore() {
+    if (handle_ != nullptr) MXTKVStoreFree(handle_);
+  }
+  void Init(const std::string& key, const NDArray& value) {
+    CheckT(MXTKVStoreInit(handle_, key.c_str(), value.handle()),
+           "MXTKVStoreInit");
+  }
+  void Push(const std::string& key, const NDArray& value) {
+    CheckT(MXTKVStorePush(handle_, key.c_str(), value.handle()),
+           "MXTKVStorePush");
+  }
+  void Pull(const std::string& key, NDArray* out) {
+    CheckT(MXTKVStorePull(handle_, key.c_str(), out->handle()),
+           "MXTKVStorePull");
+  }
+
+ private:
+  void* handle_ = nullptr;
 };
 
 }  // namespace cpp

@@ -1,0 +1,177 @@
+"""Python side of the TRAINING C ABI (src/c_api_train.cc).
+
+The reference exposes its full training surface through 139 C functions
+(/root/reference/include/mxnet/c_api.h: NDArray create/copy, Symbol
+compose/infer, Executor bind/forward/backward, KVStore push/pull) so
+that every language binding — cpp-package first of all
+(/root/reference/cpp-package/example/mlp.cpp trains end-to-end from
+C++) — can train without Python in the caller.  This module is the
+TPU-era equivalent: src/c_api_train.cc embeds CPython and drives these
+functions through a minimal scalar/bytes call surface; each returned
+object (NDArray / Symbol / Executor / KVStore / updater) is held by the
+C side as an opaque PyObject* handle.
+
+Everything here is a thin adapter over the public mxnet_tpu API — no
+logic of its own beyond argument shaping, so the C ABI can never drift
+from what Python users get.
+"""
+import numpy as np
+
+from . import context as ctx_mod
+from . import kvstore as kv_mod
+from . import ndarray as nd
+from . import optimizer as opt_mod
+from . import symbol as sym_mod
+
+
+def _ctx(dev_type, dev_id):
+    # reference dev_type convention: 1 = cpu, 2 = accelerator
+    return ctx_mod.cpu(dev_id) if int(dev_type) == 1 \
+        else ctx_mod.tpu(dev_id)
+
+
+# -- NDArray ----------------------------------------------------------------
+
+def nd_create(shape, dev_type, dev_id):
+    return nd.zeros(tuple(int(d) for d in shape), ctx=_ctx(dev_type, dev_id))
+
+
+def nd_from_bytes(shape, buf, dev_type, dev_id):
+    arr = np.frombuffer(buf, dtype='<f4').reshape(
+        tuple(int(d) for d in shape))
+    return nd.array(arr, ctx=_ctx(dev_type, dev_id), dtype=np.float32)
+
+
+def nd_to_bytes(arr):
+    return np.ascontiguousarray(
+        arr.asnumpy().astype('<f4', copy=False)).tobytes()
+
+
+def nd_copy_from(arr, buf):
+    """In-place refill from flat float32 bytes (shape preserved)."""
+    src = np.frombuffer(buf, dtype='<f4').reshape(arr.shape)
+    arr[:] = nd.array(src, dtype=np.float32)
+
+
+def nd_shape(arr):
+    return tuple(int(d) for d in arr.shape)
+
+
+# -- Symbol -----------------------------------------------------------------
+
+def sym_variable(name):
+    return sym_mod.Variable(name)
+
+
+def sym_create(op_name, name, attr_keys, attr_vals, arg_names, arg_syms):
+    """Atomic symbol creation + composition in one call (the reference
+    splits this into MXSymbolCreateAtomicSymbol + MXSymbolCompose)."""
+    op = getattr(sym_mod, op_name, None)
+    if op is None:
+        raise ValueError('unknown operator %r' % op_name)
+    kwargs = dict(zip(attr_keys, attr_vals))
+    for aname, asym in zip(arg_names, arg_syms):
+        kwargs[aname] = asym
+    if name:
+        kwargs['name'] = name
+    return op(**kwargs)
+
+
+def sym_from_json(text):
+    return sym_mod.load_json(text)
+
+
+def sym_to_json(sym):
+    return sym.tojson()
+
+
+def sym_list_arguments(sym):
+    return list(sym.list_arguments())
+
+
+def sym_list_outputs(sym):
+    return list(sym.list_outputs())
+
+
+def sym_list_aux(sym):
+    return list(sym.list_auxiliary_states())
+
+
+def sym_infer_shape(sym, names, shapes):
+    known = {n: tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**known)
+    return (list(arg_shapes or []), list(out_shapes or []),
+            list(aux_shapes or []))
+
+
+# -- Executor ---------------------------------------------------------------
+
+def simple_bind(sym, dev_type, dev_id, grad_req, names, shapes):
+    known = {n: tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    return sym.simple_bind(_ctx(dev_type, dev_id), grad_req=grad_req,
+                           **known)
+
+
+def ex_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def ex_backward(ex):
+    ex.backward()
+
+
+def ex_num_outputs(ex):
+    return len(ex.outputs)
+
+
+def ex_output(ex, index):
+    return ex.outputs[int(index)]
+
+
+def ex_arg(ex, name):
+    return ex.arg_dict[name]
+
+
+def ex_grad(ex, name):
+    grad = ex.grad_dict.get(name)
+    if grad is None:
+        raise KeyError('no gradient bound for %r' % name)
+    return grad
+
+
+# -- Optimizer --------------------------------------------------------------
+
+def updater_create(opt_name, attr_keys, attr_vals):
+    """An updater closure over a fresh optimizer (reference
+    MXOptimizerCreateOptimizer + KVStore updater role)."""
+    kwargs = {}
+    for k, v in zip(attr_keys, attr_vals):
+        try:
+            kwargs[k] = float(v) if '.' in v or 'e' in v.lower() \
+                else int(v)
+        except ValueError:
+            kwargs[k] = v
+    optimizer = opt_mod.create(opt_name, **kwargs)
+    return opt_mod.get_updater(optimizer)
+
+
+def updater_step(updater, index, grad, weight):
+    updater(int(index), grad, weight)
+
+
+# -- KVStore ----------------------------------------------------------------
+
+def kv_create(kind):
+    return kv_mod.create(kind)
+
+
+def kv_init(kv, key, value):
+    kv.init(key, value)
+
+
+def kv_push(kv, key, value):
+    kv.push(key, value)
+
+
+def kv_pull(kv, key, out):
+    kv.pull(key, out=out)

@@ -1,0 +1,517 @@
+// Training C ABI — NDArray / Symbol / Executor / KVStore from plain C.
+//
+// TPU-native counterpart of the reference's training c_api surface
+// (/root/reference/include/mxnet/c_api.h, 139 MXNET_DLL functions;
+// src/c_api.cc) — the subset every language binding needs to TRAIN, not
+// just predict: create NDArrays, compose symbols, simple_bind an
+// executor, forward/backward, run an optimizer step, talk to a kvstore.
+// The reference's cpp-package example trains an MLP end-to-end on
+// exactly this surface (/root/reference/cpp-package/example/mlp.cpp).
+//
+// Architecture: same embedded-CPython pattern as c_predict_api.cc — the
+// compute runtime is JAX/XLA, so each C call acquires the GIL and
+// drives mxnet_tpu/_c_api_bridge.py; opaque handles returned to C are
+// PyObject* (NDArray / Symbol / Executor / KVStore / updater).
+// String/shape lists returned to C are cached per-handle with
+// C-pointer lifetime (valid until the next call on the same handle),
+// like the reference's MXAPIThreadLocalEntry scratch space.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string train_last_error;
+
+std::string py_err_str() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+bool ensure_python_rt() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      train_last_error = "failed to initialize embedded Python";
+      return false;
+    }
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+struct GIL {
+  GIL() : state(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state); }
+  PyGILState_STATE state;
+};
+
+PyObject* bridge() {
+  PyObject* mod = PyImport_ImportModule("mxnet_tpu._c_api_bridge");
+  if (mod == nullptr) train_last_error = py_err_str();
+  return mod;
+}
+
+// Every handle wraps the bridge object plus per-handle caches for
+// C-lifetime string/shape/byte returns.
+struct Handle {
+  PyObject* obj = nullptr;
+  std::vector<std::string> str_store;
+  std::vector<const char*> str_ptrs;
+  std::vector<uint32_t> shape_store;
+  std::string byte_store;
+};
+
+Handle* wrap(PyObject* obj) {
+  Handle* h = new Handle();
+  h->obj = obj;
+  return h;
+}
+
+PyObject* obj_of(void* h) { return static_cast<Handle*>(h)->obj; }
+
+PyObject* str_list(uint32_t n, const char** items) {
+  PyObject* list = PyList_New(n);
+  if (list == nullptr) return nullptr;
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SET_ITEM(list, i, PyUnicode_FromString(items[i]));
+  return list;
+}
+
+PyObject* shape_tuple(uint32_t ndim, const uint32_t* dims) {
+  PyObject* tup = PyTuple_New(ndim);
+  if (tup == nullptr) return nullptr;
+  for (uint32_t i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(tup, i, PyLong_FromUnsignedLong(dims[i]));
+  return tup;
+}
+
+// CSR-style shape pack (indptr[i]..indptr[i+1] owns input i's dims).
+PyObject* shapes_csr(uint32_t num, const uint32_t* indptr,
+                     const uint32_t* data) {
+  PyObject* list = PyList_New(num);
+  if (list == nullptr) return nullptr;
+  for (uint32_t i = 0; i < num; ++i) {
+    PyObject* tup = shape_tuple(indptr[i + 1] - indptr[i],
+                                data + indptr[i]);
+    if (tup == nullptr) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, i, tup);
+  }
+  return list;
+}
+
+// Call bridge.<fn>(...) returning a new reference (nullptr on error).
+PyObject* call(const char* fn, const char* fmt, ...) {
+  PyObject* mod = bridge();
+  if (mod == nullptr) return nullptr;
+  PyObject* meth = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (meth == nullptr) {
+    train_last_error = py_err_str();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  PyObject* out = nullptr;
+  if (args != nullptr) {
+    out = PyObject_CallObject(meth, args);
+    Py_DECREF(args);
+  }
+  Py_DECREF(meth);
+  if (out == nullptr) train_last_error = py_err_str();
+  return out;
+}
+
+int store_strings(PyObject* list, Handle* h, uint32_t* out_n,
+                  const char*** out) {
+  h->str_store.clear();
+  h->str_ptrs.clear();
+  for (Py_ssize_t i = 0; i < PyList_GET_SIZE(list); ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GET_ITEM(list, i));
+    if (c == nullptr) {
+      train_last_error = py_err_str();
+      return -1;
+    }
+    h->str_store.emplace_back(c);
+  }
+  for (const std::string& s : h->str_store) h->str_ptrs.push_back(s.c_str());
+  *out_n = static_cast<uint32_t>(h->str_ptrs.size());
+  *out = h->str_ptrs.empty() ? nullptr : h->str_ptrs.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTTrainGetLastError() { return train_last_error.c_str(); }
+
+// -- NDArray ---------------------------------------------------------------
+
+// Zero-filled float32 NDArray.  dev_type: 1 = cpu, 2 = accelerator.
+int MXTNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                     int dev_id, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* tup = shape_tuple(ndim, shape);
+  if (tup == nullptr) return -1;
+  PyObject* arr = call("nd_create", "(Oii)", tup, dev_type, dev_id);
+  Py_DECREF(tup);
+  if (arr == nullptr) return -1;
+  *out = wrap(arr);
+  return 0;
+}
+
+// Create + fill from a flat little-endian float32 buffer.
+int MXTNDArrayCreateFromBytes(const uint32_t* shape, uint32_t ndim,
+                              const float* data, int dev_type, int dev_id,
+                              void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  size_t n = 1;
+  for (uint32_t i = 0; i < ndim; ++i) n *= shape[i];
+  PyObject* tup = shape_tuple(ndim, shape);
+  if (tup == nullptr) return -1;
+  PyObject* arr = call("nd_from_bytes", "(Oy#ii)", tup,
+                       reinterpret_cast<const char*>(data),
+                       static_cast<Py_ssize_t>(n * sizeof(float)),
+                       dev_type, dev_id);
+  Py_DECREF(tup);
+  if (arr == nullptr) return -1;
+  *out = wrap(arr);
+  return 0;
+}
+
+// Refill an existing NDArray in place from host memory (reference
+// MXNDArraySyncCopyFromCPU).
+int MXTNDArraySyncCopyFromCPU(void* handle, const float* data,
+                              size_t size) {
+  GIL gil;
+  PyObject* r = call("nd_copy_from", "(Oy#)", obj_of(handle),
+                     reinterpret_cast<const char*>(data),
+                     static_cast<Py_ssize_t>(size * sizeof(float)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Fetch to host memory as float32 (reference MXNDArraySyncCopyToCPU).
+int MXTNDArraySyncCopyToCPU(void* handle, float* data, size_t size) {
+  GIL gil;
+  PyObject* bytes = call("nd_to_bytes", "(O)", obj_of(handle));
+  if (bytes == nullptr) return -1;
+  char* buf = nullptr;
+  Py_ssize_t blen = 0;
+  if (PyBytes_AsStringAndSize(bytes, &buf, &blen) != 0 ||
+      static_cast<size_t>(blen) != size * sizeof(float)) {
+    train_last_error = "MXTNDArraySyncCopyToCPU: size mismatch";
+    Py_DECREF(bytes);
+    return -1;
+  }
+  std::memcpy(data, buf, blen);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXTNDArrayGetShape(void* handle, uint32_t* out_dim,
+                       const uint32_t** out_data) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* tup = call("nd_shape", "(O)", h->obj);
+  if (tup == nullptr) return -1;
+  h->shape_store.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_GET_SIZE(tup); ++i)
+    h->shape_store.push_back(static_cast<uint32_t>(
+        PyLong_AsUnsignedLong(PyTuple_GET_ITEM(tup, i))));
+  Py_DECREF(tup);
+  *out_dim = static_cast<uint32_t>(h->shape_store.size());
+  *out_data = h->shape_store.empty() ? nullptr : h->shape_store.data();
+  return 0;
+}
+
+void MXTNDArrayFree(void* handle) {
+  if (handle == nullptr) return;
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  Py_XDECREF(h->obj);
+  delete h;
+}
+
+// -- Symbol ----------------------------------------------------------------
+
+int MXTSymbolCreateVariable(const char* name, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* s = call("sym_variable", "(s)", name);
+  if (s == nullptr) return -1;
+  *out = wrap(s);
+  return 0;
+}
+
+// Atomic symbol creation + composition in one call: op attrs as
+// key/value strings, symbol inputs as (arg_keys[i], args[i]) pairs.
+// (The reference splits this into CreateAtomicSymbol + Compose.)
+int MXTSymbolCreate(const char* op, const char* name, uint32_t num_attr,
+                    const char** attr_keys, const char** attr_vals,
+                    uint32_t num_args, const char** arg_keys, void** args,
+                    void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* keys = str_list(num_attr, attr_keys);
+  PyObject* vals = str_list(num_attr, attr_vals);
+  PyObject* anames = str_list(num_args, arg_keys);
+  PyObject* asyms = PyList_New(num_args);
+  if (keys && vals && anames && asyms) {
+    for (uint32_t i = 0; i < num_args; ++i) {
+      PyObject* o = obj_of(args[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(asyms, i, o);
+    }
+  }
+  PyObject* s = nullptr;
+  if (keys && vals && anames && asyms)
+    s = call("sym_create", "(ssOOOO)", op, name ? name : "", keys, vals,
+             anames, asyms);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  Py_XDECREF(anames);
+  Py_XDECREF(asyms);
+  if (s == nullptr) return -1;
+  *out = wrap(s);
+  return 0;
+}
+
+int MXTSymbolCreateFromJSON(const char* json, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* s = call("sym_from_json", "(s)", json);
+  if (s == nullptr) return -1;
+  *out = wrap(s);
+  return 0;
+}
+
+int MXTSymbolSaveToJSON(void* handle, const char** out_json) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* s = call("sym_to_json", "(O)", h->obj);
+  if (s == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(s);
+  if (c == nullptr) {
+    train_last_error = py_err_str();
+    Py_DECREF(s);
+    return -1;
+  }
+  h->byte_store = c;
+  Py_DECREF(s);
+  *out_json = h->byte_store.c_str();
+  return 0;
+}
+
+static int sym_name_list(void* handle, const char* fn, uint32_t* out_n,
+                         const char*** out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* list = call(fn, "(O)", h->obj);
+  if (list == nullptr) return -1;
+  int rc = store_strings(list, h, out_n, out);
+  Py_DECREF(list);
+  return rc;
+}
+
+int MXTSymbolListArguments(void* handle, uint32_t* out_n,
+                           const char*** out) {
+  return sym_name_list(handle, "sym_list_arguments", out_n, out);
+}
+
+int MXTSymbolListOutputs(void* handle, uint32_t* out_n,
+                         const char*** out) {
+  return sym_name_list(handle, "sym_list_outputs", out_n, out);
+}
+
+int MXTSymbolListAuxiliaryStates(void* handle, uint32_t* out_n,
+                                 const char*** out) {
+  return sym_name_list(handle, "sym_list_aux", out_n, out);
+}
+
+void MXTSymbolFree(void* handle) { MXTNDArrayFree(handle); }
+
+// -- Executor --------------------------------------------------------------
+
+// simple_bind: shapes for the named args arrive CSR-style.
+int MXTExecutorSimpleBind(void* sym, int dev_type, int dev_id,
+                          const char* grad_req, uint32_t num_provided,
+                          const char** keys, const uint32_t* indptr,
+                          const uint32_t* shape_data, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* names = str_list(num_provided, keys);
+  PyObject* shapes = shapes_csr(num_provided, indptr, shape_data);
+  PyObject* ex = nullptr;
+  if (names && shapes)
+    ex = call("simple_bind", "(OiisOO)", obj_of(sym), dev_type, dev_id,
+              grad_req, names, shapes);
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  if (ex == nullptr) return -1;
+  *out = wrap(ex);
+  return 0;
+}
+
+int MXTExecutorForward(void* handle, int is_train) {
+  GIL gil;
+  PyObject* r = call("ex_forward", "(Oi)", obj_of(handle), is_train);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTExecutorBackward(void* handle) {
+  GIL gil;
+  PyObject* r = call("ex_backward", "(O)", obj_of(handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTExecutorNumOutputs(void* handle, uint32_t* out_n) {
+  GIL gil;
+  PyObject* r = call("ex_num_outputs", "(O)", obj_of(handle));
+  if (r == nullptr) return -1;
+  *out_n = static_cast<uint32_t>(PyLong_AsUnsignedLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int wrap_call1(const char* fn, void* handle, void* arg_or_idx,
+                      uint32_t idx, bool by_name, const char* name,
+                      void** out) {
+  GIL gil;
+  PyObject* o = by_name
+      ? call(fn, "(Os)", obj_of(handle), name)
+      : call(fn, "(OI)", obj_of(handle), idx);
+  (void)arg_or_idx;
+  if (o == nullptr) return -1;
+  *out = wrap(o);
+  return 0;
+}
+
+// Output i as a new NDArray handle (shares the device buffer).
+int MXTExecutorOutput(void* handle, uint32_t index, void** out) {
+  *out = nullptr;
+  return wrap_call1("ex_output", handle, nullptr, index, false, nullptr,
+                    out);
+}
+
+// Bound argument / gradient arrays by name (the reference returns
+// positional arrays from Bind; by-name is the simpler contract and maps
+// 1:1 onto arg_dict/grad_dict).
+int MXTExecutorArgArray(void* handle, const char* name, void** out) {
+  *out = nullptr;
+  return wrap_call1("ex_arg", handle, nullptr, 0, true, name, out);
+}
+
+int MXTExecutorGradArray(void* handle, const char* name, void** out) {
+  *out = nullptr;
+  return wrap_call1("ex_grad", handle, nullptr, 0, true, name, out);
+}
+
+void MXTExecutorFree(void* handle) { MXTNDArrayFree(handle); }
+
+// -- Optimizer -------------------------------------------------------------
+
+// An updater = optimizer instance + per-index state (reference
+// kvstore updater semantics: same index -> same state slot).
+int MXTUpdaterCreate(const char* opt_name, uint32_t num_attr,
+                     const char** attr_keys, const char** attr_vals,
+                     void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* keys = str_list(num_attr, attr_keys);
+  PyObject* vals = str_list(num_attr, attr_vals);
+  PyObject* u = nullptr;
+  if (keys && vals)
+    u = call("updater_create", "(sOO)", opt_name, keys, vals);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  if (u == nullptr) return -1;
+  *out = wrap(u);
+  return 0;
+}
+
+int MXTUpdaterStep(void* updater, int index, void* grad, void* weight) {
+  GIL gil;
+  PyObject* r = call("updater_step", "(OiOO)", obj_of(updater), index,
+                     obj_of(grad), obj_of(weight));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+void MXTUpdaterFree(void* handle) { MXTNDArrayFree(handle); }
+
+// -- KVStore ---------------------------------------------------------------
+
+int MXTKVStoreCreate(const char* kind, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* kv = call("kv_create", "(s)", kind);
+  if (kv == nullptr) return -1;
+  *out = wrap(kv);
+  return 0;
+}
+
+static int kv_op(const char* fn, void* kv, const char* key, void* nd) {
+  GIL gil;
+  PyObject* r = call(fn, "(OsO)", obj_of(kv), key, obj_of(nd));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTKVStoreInit(void* kv, const char* key, void* nd) {
+  return kv_op("kv_init", kv, key, nd);
+}
+
+int MXTKVStorePush(void* kv, const char* key, void* nd) {
+  return kv_op("kv_push", kv, key, nd);
+}
+
+int MXTKVStorePull(void* kv, const char* key, void* nd) {
+  return kv_op("kv_pull", kv, key, nd);
+}
+
+void MXTKVStoreFree(void* handle) { MXTNDArrayFree(handle); }
+
+}  // extern "C"
