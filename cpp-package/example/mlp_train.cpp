@@ -16,6 +16,7 @@
 //   g++ -std=c++14 mlp_train.cpp -I../include -L../../mxnet_tpu \
 //       -lmxtpu -o mlp_train
 #include <algorithm>
+#include <cmath>
 #include <cstdio>
 #include <random>
 #include <vector>
@@ -31,7 +32,8 @@ constexpr int kBatch = 128;
 constexpr int kEpochs = 6;
 
 // 10 Gaussian blobs, one per class, centers drawn once from a fixed
-// seed; inputs are shuffled into minibatch order.
+// seed.  Labels cycle 0..9 so every minibatch is class-balanced; swap
+// in a real reader (and shuffle) for actual datasets.
 void GenerateBlobs(std::vector<float>* xs, std::vector<float>* ys) {
   std::mt19937 gen(42);
   std::normal_distribution<float> unit(0.f, 1.f);
@@ -101,6 +103,13 @@ int main() {
                           {"wd", "0.0001"}});
   mc::NDArray data_arr = exec.Arg("data");
   mc::NDArray label_arr = exec.Arg("softmax_label");
+  // Hoist the per-parameter weight/grad handles out of the hot loop —
+  // they alias the executor's buffers, so one fetch each suffices.
+  std::vector<mc::NDArray> weights, grads;
+  for (const std::string& name : params) {
+    weights.push_back(exec.Arg(name));
+    grads.push_back(exec.Grad(name));
+  }
 
   const int batches = kTrain / kBatch;
   float accuracy = 0.f, best = 0.f;
@@ -115,11 +124,8 @@ int main() {
       label_arr.CopyFrom(yb);
       exec.Forward(true);
       exec.Backward();
-      for (size_t p = 0; p < params.size(); ++p) {
-        mc::NDArray w = exec.Arg(params[p]);
-        mc::NDArray g = exec.Grad(params[p]);
-        sgd.Step(static_cast<int>(p), g, &w);
-      }
+      for (size_t p = 0; p < params.size(); ++p)
+        sgd.Step(static_cast<int>(p), grads[p], &weights[p]);
       std::vector<float> probs = exec.Output(0).ToVector();
       for (int i = 0; i < kBatch; ++i) {
         const float* row = probs.data() + i * kClasses;

@@ -63,6 +63,11 @@ int MXTSymbolSaveToJSON(void*, const char**);
 int MXTSymbolListArguments(void*, uint32_t*, const char***);
 int MXTSymbolListOutputs(void*, uint32_t*, const char***);
 int MXTSymbolListAuxiliaryStates(void*, uint32_t*, const char***);
+int MXTSymbolInferShape(void*, uint32_t, const char**, const uint32_t*,
+                        const uint32_t*, uint32_t*, const uint32_t**,
+                        const uint32_t**, uint32_t*, const uint32_t**,
+                        const uint32_t**, uint32_t*, const uint32_t**,
+                        const uint32_t**);
 void MXTSymbolFree(void*);
 int MXTExecutorSimpleBind(void*, int, int, const char*, uint32_t,
                           const char**, const uint32_t*, const uint32_t*,
@@ -395,6 +400,39 @@ class Symbol {
   }
   std::vector<std::string> ListAuxiliaryStates() const {
     return NameList(&MXTSymbolListAuxiliaryStates);
+  }
+
+  // Bidirectional shape inference: given shapes for some arguments,
+  // returns the complete (args, outputs, auxes) shape lists.
+  void InferShape(const std::map<std::string, Shape>& known,
+                  std::vector<Shape>* arg_shapes,
+                  std::vector<Shape>* out_shapes,
+                  std::vector<Shape>* aux_shapes) const {
+    std::vector<const char*> keys;
+    std::vector<uint32_t> indptr{0}, dims;
+    for (const auto& kv : known) {
+      keys.push_back(kv.first.c_str());
+      dims.insert(dims.end(), kv.second.begin(), kv.second.end());
+      indptr.push_back(static_cast<uint32_t>(dims.size()));
+    }
+    uint32_t counts[3] = {0, 0, 0};
+    const uint32_t* iptr[3] = {nullptr, nullptr, nullptr};
+    const uint32_t* data[3] = {nullptr, nullptr, nullptr};
+    CheckT(MXTSymbolInferShape(handle_,
+                               static_cast<uint32_t>(keys.size()),
+                               keys.data(), indptr.data(), dims.data(),
+                               &counts[0], &iptr[0], &data[0],
+                               &counts[1], &iptr[1], &data[1],
+                               &counts[2], &iptr[2], &data[2]),
+           "MXTSymbolInferShape");
+    std::vector<Shape>* outs[3] = {arg_shapes, out_shapes, aux_shapes};
+    for (int g = 0; g < 3; ++g) {
+      if (outs[g] == nullptr) continue;
+      outs[g]->clear();
+      for (uint32_t i = 0; i < counts[g]; ++i)
+        outs[g]->emplace_back(data[g] + iptr[g][i],
+                              data[g] + iptr[g][i + 1]);
+    }
   }
 
   Symbol(Symbol&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }

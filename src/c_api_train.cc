@@ -15,8 +15,7 @@
 // String/shape lists returned to C are cached per-handle with
 // C-pointer lifetime (valid until the next call on the same handle),
 // like the reference's MXAPIThreadLocalEntry scratch space.
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
+#include "py_embed.h"
 
 #include <cstdint>
 #include <cstring>
@@ -28,42 +27,13 @@ namespace {
 
 thread_local std::string train_last_error;
 
-std::string py_err_str() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  std::string msg = "unknown python error";
-  if (value != nullptr) {
-    PyObject* s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c != nullptr) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  return msg;
-}
+using pyembed::GIL;
+
+std::string py_err_str() { return pyembed::err_string(); }
 
 bool ensure_python_rt() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    if (!Py_IsInitialized()) {
-      train_last_error = "failed to initialize embedded Python";
-      return false;
-    }
-    PyEval_SaveThread();
-  }
-  return true;
+  return pyembed::ensure_interpreter(&train_last_error);
 }
-
-struct GIL {
-  GIL() : state(PyGILState_Ensure()) {}
-  ~GIL() { PyGILState_Release(state); }
-  PyGILState_STATE state;
-};
 
 PyObject* bridge() {
   PyObject* mod = PyImport_ImportModule("mxnet_tpu._c_api_bridge");
@@ -79,6 +49,9 @@ struct Handle {
   std::vector<const char*> str_ptrs;
   std::vector<uint32_t> shape_store;
   std::string byte_store;
+  // infer_shape result caches: CSR (indptr, data) per group.
+  std::vector<uint32_t> infer_indptr[3];
+  std::vector<uint32_t> infer_data[3];
 };
 
 Handle* wrap(PyObject* obj) {
@@ -362,6 +335,59 @@ int MXTSymbolListAuxiliaryStates(void* handle, uint32_t* out_n,
   return sym_name_list(handle, "sym_list_aux", out_n, out);
 }
 
+// Bidirectional shape inference (reference MXSymbolInferShape): provide
+// shapes for some args CSR-style; receive complete arg/out/aux shape
+// lists, each returned CSR-style with handle-cached lifetime.
+int MXTSymbolInferShape(void* handle, uint32_t num_provided,
+                        const char** keys, const uint32_t* indptr,
+                        const uint32_t* shape_data,
+                        uint32_t* arg_count, const uint32_t** arg_indptr,
+                        const uint32_t** arg_data,
+                        uint32_t* out_count, const uint32_t** out_indptr,
+                        const uint32_t** out_data,
+                        uint32_t* aux_count, const uint32_t** aux_indptr,
+                        const uint32_t** aux_data) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* names = str_list(num_provided, keys);
+  PyObject* shapes = shapes_csr(num_provided, indptr, shape_data);
+  PyObject* triple = nullptr;
+  if (names && shapes)
+    triple = call("sym_infer_shape", "(OOO)", h->obj, names, shapes);
+  Py_XDECREF(names);
+  Py_XDECREF(shapes);
+  if (triple == nullptr) return -1;
+  uint32_t* counts[3] = {arg_count, out_count, aux_count};
+  const uint32_t** iptrs[3] = {arg_indptr, out_indptr, aux_indptr};
+  const uint32_t** datas[3] = {arg_data, out_data, aux_data};
+  for (int g = 0; g < 3; ++g) {
+    PyObject* group = PyTuple_GET_ITEM(triple, g);
+    h->infer_indptr[g].assign(1, 0);
+    h->infer_data[g].clear();
+    Py_ssize_t n = PyList_GET_SIZE(group);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* tup = PyList_GET_ITEM(group, i);
+      if (PyTuple_Check(tup)) {
+        for (Py_ssize_t j = 0; j < PyTuple_GET_SIZE(tup); ++j)
+          h->infer_data[g].push_back(static_cast<uint32_t>(
+              PyLong_AsUnsignedLong(PyTuple_GET_ITEM(tup, j))));
+      }
+      h->infer_indptr[g].push_back(
+          static_cast<uint32_t>(h->infer_data[g].size()));
+    }
+    *counts[g] = static_cast<uint32_t>(n);
+    *iptrs[g] = h->infer_indptr[g].data();
+    *datas[g] = h->infer_data[g].empty() ? nullptr
+                                         : h->infer_data[g].data();
+  }
+  Py_DECREF(triple);
+  if (PyErr_Occurred()) {
+    train_last_error = py_err_str();
+    return -1;
+  }
+  return 0;
+}
+
 void MXTSymbolFree(void* handle) { MXTNDArrayFree(handle); }
 
 // -- Executor --------------------------------------------------------------
@@ -412,14 +438,19 @@ int MXTExecutorNumOutputs(void* handle, uint32_t* out_n) {
   return 0;
 }
 
-static int wrap_call1(const char* fn, void* handle, void* arg_or_idx,
-                      uint32_t idx, bool by_name, const char* name,
-                      void** out) {
+static int handle_by_index(const char* fn, void* handle, uint32_t idx,
+                           void** out) {
   GIL gil;
-  PyObject* o = by_name
-      ? call(fn, "(Os)", obj_of(handle), name)
-      : call(fn, "(OI)", obj_of(handle), idx);
-  (void)arg_or_idx;
+  PyObject* o = call(fn, "(OI)", obj_of(handle), idx);
+  if (o == nullptr) return -1;
+  *out = wrap(o);
+  return 0;
+}
+
+static int handle_by_name(const char* fn, void* handle, const char* name,
+                          void** out) {
+  GIL gil;
+  PyObject* o = call(fn, "(Os)", obj_of(handle), name);
   if (o == nullptr) return -1;
   *out = wrap(o);
   return 0;
@@ -428,8 +459,7 @@ static int wrap_call1(const char* fn, void* handle, void* arg_or_idx,
 // Output i as a new NDArray handle (shares the device buffer).
 int MXTExecutorOutput(void* handle, uint32_t index, void** out) {
   *out = nullptr;
-  return wrap_call1("ex_output", handle, nullptr, index, false, nullptr,
-                    out);
+  return handle_by_index("ex_output", handle, index, out);
 }
 
 // Bound argument / gradient arrays by name (the reference returns
@@ -437,12 +467,12 @@ int MXTExecutorOutput(void* handle, uint32_t index, void** out) {
 // 1:1 onto arg_dict/grad_dict).
 int MXTExecutorArgArray(void* handle, const char* name, void** out) {
   *out = nullptr;
-  return wrap_call1("ex_arg", handle, nullptr, 0, true, name, out);
+  return handle_by_name("ex_arg", handle, name, out);
 }
 
 int MXTExecutorGradArray(void* handle, const char* name, void** out) {
   *out = nullptr;
-  return wrap_call1("ex_grad", handle, nullptr, 0, true, name, out);
+  return handle_by_name("ex_grad", handle, name, out);
 }
 
 void MXTExecutorFree(void* handle) { MXTNDArrayFree(handle); }

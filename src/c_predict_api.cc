@@ -15,8 +15,7 @@
 // shape/output buffers on the C++ side so returned pointers have
 // C-pointer lifetime (valid until the next call on the same handle),
 // exactly like the reference's MXAPIThreadLocalEntry scratch.
-#define PY_SSIZE_T_CLEAN
-#include <Python.h>
+#include "py_embed.h"
 
 #include <cstdint>
 #include <cstring>
@@ -27,40 +26,12 @@ namespace {
 
 thread_local std::string pred_last_error;
 
-std::string py_err_string() {
-  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
-  PyErr_Fetch(&type, &value, &tb);
-  PyErr_NormalizeException(&type, &value, &tb);
-  std::string msg = "unknown python error";
-  if (value != nullptr) {
-    PyObject* s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c != nullptr) msg = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(tb);
-  return msg;
-}
+using pyembed::GIL;
 
-// Lazily bring up the interpreter when this library is used from a
-// plain C program; inside a Python process Py_IsInitialized() is
-// already true and this is a no-op.
+std::string py_err_string() { return pyembed::err_string(); }
+
 bool ensure_python() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    if (!Py_IsInitialized()) {
-      pred_last_error = "failed to initialize embedded Python";
-      return false;
-    }
-    // Drop the GIL the init acquired so every API call can use the
-    // uniform PyGILState_Ensure/Release pairing regardless of thread.
-    PyEval_SaveThread();
-  }
-  return true;
+  return pyembed::ensure_interpreter(&pred_last_error);
 }
 
 PyObject* bridge_module() {
@@ -68,12 +39,6 @@ PyObject* bridge_module() {
   if (mod == nullptr) pred_last_error = py_err_string();
   return mod;
 }
-
-struct GIL {
-  GIL() : state(PyGILState_Ensure()) {}
-  ~GIL() { PyGILState_Release(state); }
-  PyGILState_STATE state;
-};
 
 struct PredHandle {
   PyObject* obj = nullptr;                       // bridge Predictor

@@ -1,0 +1,62 @@
+// Shared embedded-CPython glue for the C ABI translation units
+// (c_predict_api.cc, c_api_train.cc): interpreter bring-up, GIL RAII,
+// and python-exception -> string capture.  Header-only; each TU keeps
+// its own thread_local last-error string (separate polling domains,
+// like the reference's per-API error slots).
+#ifndef MXNET_TPU_SRC_PY_EMBED_H_
+#define MXNET_TPU_SRC_PY_EMBED_H_
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string>
+
+namespace pyembed {
+
+inline std::string err_string() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  return msg;
+}
+
+// Lazily bring up the interpreter when the library is used from a plain
+// C program; inside a Python process Py_IsInitialized() is already true
+// and this is a no-op.  (First call from multiple raw threads at once
+// would race Py_InitializeEx; callers start single-threaded, matching
+// the reference's implicit init contract.)
+inline bool ensure_interpreter(std::string* err) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    if (!Py_IsInitialized()) {
+      if (err != nullptr) *err = "failed to initialize embedded Python";
+      return false;
+    }
+    // Drop the GIL the init acquired so every API call can use the
+    // uniform PyGILState_Ensure/Release pairing regardless of thread.
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+struct GIL {
+  GIL() : state(PyGILState_Ensure()) {}
+  ~GIL() { PyGILState_Release(state); }
+  PyGILState_STATE state;
+};
+
+}  // namespace pyembed
+
+#endif  // MXNET_TPU_SRC_PY_EMBED_H_
