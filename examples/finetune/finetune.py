@@ -1,0 +1,101 @@
+"""Fine-tune a checkpointed network on a new task.
+
+Capability demonstrated (reference example/image-classification
+fine-tune.py role): load a saved checkpoint, cut the graph at a feature
+layer with get_internals(), attach a fresh output head for a different
+number of classes, freeze the backbone with fixed_param_names, and train
+only the head — then unfreeze and train end-to-end for a final boost.
+
+Data: synthetic quadrant digits for pretraining, and a HARDER 8-class
+variant (quadrant + brightness) for the fine-tune target, so transfer is
+real: the pretrained conv features help.
+
+Run: python examples/finetune/finetune.py [--quick]
+"""
+import argparse
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def quadrant_digits(n, classes=4, seed=0):
+    """Class = quadrant of a bright square; for 8 classes the square is
+    either dim (0.4) or bright (1.2), so the fine-tune target needs a
+    feature (absolute level) the pretraining task never used."""
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 1, 16, 16).astype(np.float32) * 0.2
+    y = rs.randint(0, classes, n)
+    for i in range(n):
+        quad = int(y[i]) % 4
+        level = 0.4 + 0.8 * (int(y[i]) // 4)
+        r, c = divmod(quad, 2)
+        X[i, 0, r * 8:r * 8 + 8, c * 8:c * 8 + 8] += level
+    return X, y.astype(np.float32)
+
+
+def conv_net(num_classes):
+    data = sym.Variable('data')
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, name='conv1')
+    net = sym.Activation(net, act_type='relu', name='relu1')
+    net = sym.Pooling(net, pool_type='max', kernel=(2, 2), stride=(2, 2))
+    net = sym.Flatten(net, name='flat')
+    net = sym.FullyConnected(net, num_hidden=32, name='feat')
+    net = sym.Activation(net, act_type='relu', name='featact')
+    net = sym.FullyConnected(net, num_hidden=num_classes, name='head')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def fit_once(net, X, y, epochs, batch_size=64, fixed=None, params=None):
+    train = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True)
+    mod = mx.mod.Module(net, label_names=['softmax_label'],
+                        fixed_param_names=fixed)
+    mod.fit(train, optimizer='adam',
+            optimizer_params={'learning_rate': 1e-3},
+            arg_params=params[0] if params else None,
+            aux_params=params[1] if params else None,
+            allow_missing=params is not None,
+            num_epoch=epochs)
+    train.reset()
+    return mod, dict(mod.score(train, 'acc'))['accuracy']
+
+
+def main(quick=False):
+    n = 1024 if quick else 4096
+    epochs = 6 if quick else 10
+    tmp = tempfile.mkdtemp()
+    prefix = os.path.join(tmp, 'base')
+
+    # 1) pretrain on the 4-class task and checkpoint it
+    Xa, ya = quadrant_digits(n, classes=4, seed=0)
+    base_mod, base_acc = fit_once(conv_net(4), Xa, ya, epochs)
+    base_mod.save_checkpoint(prefix, 1)
+    print('pretrain accuracy %.3f' % base_acc)
+
+    # 2) surgery: reload, cut at the feature layer, new 8-way head
+    loaded_sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 1)
+    feat = loaded_sym.get_internals()['featact_output']
+    new_head = sym.FullyConnected(feat, num_hidden=8, name='newhead')
+    new_net = sym.SoftmaxOutput(new_head, name='softmax')
+    backbone = [k for k in arg_params if not k.startswith('newhead')]
+
+    Xb, yb = quadrant_digits(n, classes=8, seed=3)
+    # 3) head-only training (backbone frozen)
+    head_mod, head_acc = fit_once(new_net, Xb, yb, epochs, fixed=backbone,
+                                  params=(arg_params, aux_params))
+    # 4) unfreeze and continue end-to-end from the head-trained weights
+    _, full_acc = fit_once(new_net, Xb, yb, epochs,
+                           params=head_mod.get_params())
+    print('head-only accuracy %.3f, full fine-tune accuracy %.3f'
+          % (head_acc, full_acc))
+    return base_acc, head_acc, full_acc
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    base, head, full = main(quick=ap.parse_args().quick)
+    assert base > 0.9 and full > 0.9 and head > 0.5, (base, head, full)

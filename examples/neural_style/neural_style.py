@@ -1,0 +1,129 @@
+"""Neural style transfer: optimize an IMAGE against fixed network features.
+
+Capability demonstrated (reference example/neural-style role): the
+trainable thing is the input, not the weights — bind with
+inputs_need_grad=True and grad_req='null' for all parameters, then run
+gradient descent on the image against a content loss (feature match) and
+a style loss (Gram-matrix match) taken from intermediate layers via
+get_internals().
+
+With no pretrained VGG available (zero egress) the feature extractor is
+a fixed randomly-initialized conv net — random-feature style transfer is
+a known-working degenerate case (features are still a multi-scale linear
+filter bank), and the optimization itself (the point of the example) is
+identical.  Plug VGG weights into `arg_params` to get the classic look.
+
+Run: python examples/neural_style/neural_style.py [--quick]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def feature_net():
+    """A small conv stack; two taps (relu1, relu2) serve as the style
+    and content layers."""
+    data = sym.Variable('data')
+    net = sym.Convolution(data, kernel=(3, 3), pad=(1, 1), num_filter=16,
+                          name='conv1')
+    net = sym.Activation(net, act_type='relu', name='relu1')
+    net = sym.Pooling(net, pool_type='avg', kernel=(2, 2), stride=(2, 2))
+    net = sym.Convolution(net, kernel=(3, 3), pad=(1, 1), num_filter=32,
+                          name='conv2')
+    net = sym.Activation(net, act_type='relu', name='relu2')
+    return net
+
+
+def make_image(kind, size, seed):
+    """Deterministic synthetic 'photographs': blobs for content,
+    stripes for style."""
+    rs = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32) / size
+    if kind == 'content':
+        img = np.stack([np.exp(-((xx - .3) ** 2 + (yy - .4) ** 2) * 8),
+                        np.exp(-((xx - .7) ** 2 + (yy - .6) ** 2) * 12),
+                        0.5 * np.ones_like(xx)])
+    else:
+        img = np.stack([0.5 + 0.5 * np.sin(14 * np.pi * xx),
+                        0.5 + 0.5 * np.sin(14 * np.pi * (xx + yy)),
+                        0.5 + 0.5 * np.cos(10 * np.pi * yy)])
+    img += 0.02 * rs.randn(3, size, size).astype(np.float32)
+    return img[None].astype(np.float32)
+
+
+def gram(feat):
+    """Channel Gram matrix of a (1, C, H, W) feature block."""
+    c = feat.shape[1]
+    flat = feat.reshape((c, -1))
+    return np.dot(flat, flat.T) / flat.shape[1]
+
+
+def main(quick=False):
+    size = 32 if quick else 64
+    steps = 60 if quick else 300
+    internals = feature_net().get_internals()
+    taps = sym.Group([internals['relu1_output'],
+                      internals['relu2_output']])
+
+    # Only the image wants a gradient; every parameter is frozen.
+    exe = taps.simple_bind(mx.cpu(), grad_req={'data': 'write'},
+                           data=(1, 3, size, size))
+    rs = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name != 'data':
+            arr[:] = (rs.randn(*arr.shape) *
+                      np.sqrt(2.0 / max(1, int(np.prod(arr.shape[1:])))
+                              )).astype(np.float32)
+
+    def features(img):
+        exe.arg_dict['data'][:] = img
+        exe.forward(is_train=False)
+        return [o.asnumpy() for o in exe.outputs]
+
+    content_feats = features(make_image('content', size, 1))
+    style_feats = features(make_image('style', size, 2))
+    style_grams = [gram(f) for f in style_feats]
+
+    # Optimize the canvas: match relu2 to content, Grams to style.
+    canvas = make_image('content', size, 3).copy()
+    content_w, style_w, lr = 1.0, 50.0, 0.5
+    first_loss = None
+    for step in range(steps):
+        exe.arg_dict['data'][:] = canvas
+        exe.forward(is_train=True)
+        feats = [o.asnumpy() for o in exe.outputs]
+        # Gradients of the two losses w.r.t. the tap outputs:
+        head_grads = []
+        loss = 0.0
+        for i, f in enumerate(feats):
+            g_content = np.zeros_like(f)
+            if i == 1:
+                diff = f - content_feats[i]
+                loss += content_w * float((diff ** 2).mean())
+                g_content = content_w * 2 * diff / diff.size
+            c = f.shape[1]
+            flat = f.reshape(c, -1)
+            gdiff = gram(f) - style_grams[i]
+            loss += style_w * float((gdiff ** 2).mean())
+            g_style = (style_w * 4 / (gdiff.size * flat.shape[1]) *
+                       np.dot(gdiff, flat)).reshape(f.shape)
+            head_grads.append(nd.array(g_content + g_style))
+        exe.backward(head_grads)
+        canvas -= lr * exe.grad_dict['data'].asnumpy()
+        canvas = np.clip(canvas, -1.5, 1.5)
+        if first_loss is None:
+            first_loss = loss
+        if step % max(1, steps // 6) == 0:
+            print('step %4d loss %.5f' % (step, loss))
+    print('loss %.5f -> %.5f' % (first_loss, loss))
+    return first_loss, loss
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    first, last = main(quick=ap.parse_args().quick)
+    assert last < 0.5 * first, (first, last)

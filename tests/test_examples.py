@@ -1,0 +1,45 @@
+"""Smoke checks for the examples/ ports (reference test strategy: each
+example is an end-to-end regression of one distinct API surface —
+input-gradient attacks, input optimization, embeddings, checkpoint
+surgery)."""
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(relpath, name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_fgsm_adversary():
+    mod = _load('examples/adversary/fgsm.py', 'ex_fgsm')
+    clean, adv = mod.main(quick=True)
+    assert clean > 0.9, clean
+    assert adv < clean - 0.2, (clean, adv)
+
+
+def test_matrix_factorization():
+    mod = _load('examples/recommender/matrix_factorization.py', 'ex_mf')
+    rmse, baseline = mod.main(quick=True)
+    assert rmse < 0.6 * baseline, (rmse, baseline)
+
+
+def test_neural_style():
+    mod = _load('examples/neural_style/neural_style.py', 'ex_style')
+    first, last = mod.main(quick=True)
+    assert last < 0.5 * first, (first, last)
+
+
+def test_finetune():
+    mod = _load('examples/finetune/finetune.py', 'ex_finetune')
+    base, head, full = mod.main(quick=True)
+    assert base > 0.9, base
+    assert full > 0.9, full
+    assert head > 0.5, head
