@@ -359,6 +359,178 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
+def _bwd_dkdv_stream_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
+                            dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                            causal, block_q, block_k, num_qb):
+    """Streaming dK/dV: grid (bh, kb, qi) with the q-block axis
+    innermost; q/dO/lse/D arrive one block per grid step (O(block)
+    VMEM regardless of T), dk/dv accumulate in f32 scratch and write
+    once on the final q-block.  Causal q-blocks below the diagonal are
+    fetch-clamped and compute-gated, matching the resident schedule's
+    FLOP skipping."""
+    kb = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    lower = (kb * block_k) // block_q if causal else 0
+
+    @pl.when(qi >= lower)
+    def _compute():
+        qblk = q_ref[0]
+        doblk = do_ref[0]
+        lse = lse_ref[0]
+        dd = dd_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        s = lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            cols = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        dv_acc[:] = dv_acc[:] + lax.dot_general(
+            p.astype(doblk.dtype), doblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dk_acc[:] = dk_acc[:] + lax.dot_general(
+            ds.astype(qblk.dtype), qblk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(qi == num_qb - 1)
+    def _store():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_stream_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
+                          dq_ref, dq_acc, *, scale, causal, block_q,
+                          block_k, num_kb):
+    """Streaming dQ: grid (bh, qi, kb) with the k-block axis innermost;
+    k/v stream one block per step, dq accumulates in f32 scratch."""
+    qi = pl.program_id(1)
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    if causal:
+        upper = (qi * block_q + block_q + block_k - 1) // block_k
+    else:
+        upper = num_kb
+
+    @pl.when(kb < upper)
+    def _compute():
+        qblk = q_ref[0]
+        doblk = do_ref[0]
+        lse = lse_ref[0]
+        dd = dd_ref[0]
+        kblk = k_ref[0]
+        vblk = v_ref[0]
+        s = lax.dot_general(
+            qblk, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            cols = kb * block_k + lax.broadcasted_iota(
+                jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(
+            doblk, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dd)
+        dq_acc[:] = dq_acc[:] + lax.dot_general(
+            ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kb == num_kb - 1)
+    def _store():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
+                           interpret):
+    """HBM-streaming backward: same math as _flash_bwd_impl but no
+    operand is sequence-resident — VMEM stays O(block) for any T."""
+    bh, t, d = q.shape
+    block_q = _fit_block(t, max(block_q, _BWD_BLOCK))
+    block_k = block_q
+    num_qb = t // block_q
+    num_kb = t // block_k
+    dd = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                 axis=-1, keepdims=True)
+
+    if causal:
+        # fetch-clamp skipped diagonal blocks (compute is pl.when-gated)
+        q_index = lambda i, n, j: (
+            i, jnp.maximum(j, (n * block_k) // block_q), 0)
+        k_index_dq = lambda i, j, n: (
+            i, jnp.minimum(n, (j * block_q + block_q - 1) // block_k), 0)
+    else:
+        q_index = lambda i, n, j: (i, j, 0)
+        k_index_dq = lambda i, j, n: (i, n, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_stream_kernel, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, num_qb=num_qb),
+        grid=(bh, num_kb, num_qb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), q_index),            # q
+            pl.BlockSpec((1, block_q, d), q_index),            # dO
+            pl.BlockSpec((1, block_q, 1), q_index),            # lse
+            pl.BlockSpec((1, block_q, 1), q_index),            # D
+            pl.BlockSpec((1, block_k, d), lambda i, n, j: (i, n, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, n, j: (i, n, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, n, j: (i, n, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, n, j: (i, n, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, g, lse, dd, k, v)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_stream_kernel, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, num_kb=num_kb),
+        grid=(bh, num_qb, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), k_index_dq),         # k
+            pl.BlockSpec((1, block_k, d), k_index_dq),         # v
+            pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, n: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, n: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda i, j, n: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(k, v, q, g, lse, dd)
+    return dq, dk, dv
+
+
 def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
                     interpret):
     """Fused two-kernel backward over flat (bh, t, d) tensors."""
@@ -432,13 +604,19 @@ def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
     b, h, t, d = q.shape
     flat = lambda x: x.reshape(b * h, t, d)
     itemsize = jnp.dtype(q.dtype).itemsize
-    # the fused kernels keep one head's full sequence (q+dO or k+v)
-    # resident in VMEM; past that, fall back to the XLA-level blocked
-    # recompute whose live set is O(block_q * T)
-    if 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES:
-        dq, dk, dv = _flash_bwd_impl(
-            flat(q), flat(k), flat(v), flat(g), flat(o),
+    args = (flat(q), flat(k), flat(v), flat(g), flat(o),
             lse.reshape(b * h, t, 1), causal, scale, block_q, interpret)
+    fitted = min(max(block_q, _BWD_BLOCK), t)
+    while t % fitted:
+        fitted //= 2
+    if 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES:
+        # resident schedule: one head's full sequence (q+dO / k+v) in
+        # VMEM — fewer grid steps, best for short-to-mid T
+        dq, dk, dv = _flash_bwd_impl(*args)
+    elif fitted >= 8:
+        # streaming schedule: O(block) VMEM for any T (the long-context
+        # path — T=32k+ stays on the fused Pallas kernels)
+        dq, dk, dv = _flash_bwd_stream_impl(*args)
     else:
         dq, dk, dv = _blocked_backward(flat(q), flat(k), flat(v),
                                        flat(g), causal, scale, block_q)
@@ -449,14 +627,21 @@ def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     interpret=None):
     """Streaming Pallas attention.
 
     q, k, v: (batch, heads, seq, head_dim) with equal seq lengths
-    (square self-attention).  Returns the same shape.  On non-TPU
-    backends runs in Pallas interpret mode (slow but correct) unless
-    `interpret` is passed explicitly.
+    (square self-attention; cross-attention / KV-cache decode take
+    `full_attention` — a documented v1 constraint).  Returns the same
+    shape.  On non-TPU backends runs in Pallas interpret mode (slow but
+    correct) unless `interpret` is passed explicitly.
+
+    block_q: row-tile edge.  Default (None) auto-scales with the
+    sequence — 256 for short T, up to 1024 for long T, where the
+    smaller grid measures 170 -> 117 ms at T=32k (docs/PERF.md).  An
+    explicit value is honored exactly (e.g. to bound VMEM for large
+    head_dim).
     """
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(
@@ -466,6 +651,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
             % (q.shape, k.shape, v.shape))
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if block_q is None:
+        block_q = max(256, min(1024, q.shape[2] // 32))
     if not _HAS_PALLAS:
         from .parallel.ring_attention import full_attention
         return full_attention(q, k, v, causal=causal, scale=scale)

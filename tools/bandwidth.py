@@ -89,17 +89,119 @@ def measure_ps(size_mb, iters, num_workers):
     return gb / dt
 
 
+def _cliff_model():
+    from mxnet_tpu import sym
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=1024, name='fc1')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=1024, name='fc2')
+    net = sym.Activation(net, act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=10, name='fc3')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def _cliff_train(kvstore, batch, steps):
+    """samples/sec for the same model+batch under a given kvstore mode
+    (the PS-vs-fused training cliff, docs/PERF.md)."""
+    import mxnet_tpu as mx
+    net = _cliff_model()
+    mod = mx.mod.Module(net, label_names=['softmax_label'])
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 784))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
+    np.random.seed(0)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(kvstore=kvstore, optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.01})
+    rs = np.random.RandomState(1)
+    batchobj = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(batch, 784).astype(np.float32))],
+        label=[mx.nd.array((rs.rand(batch) * 10).astype(np.float32))])
+
+    def sync():
+        float(mod._exec_group.executor.arg_dict['fc1_weight']
+              ._data.ravel()[0])
+
+    for _ in range(3):
+        mod.forward_backward(batchobj)
+        mod.update()
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        mod.forward_backward(batchobj)
+        mod.update()
+    sync()
+    return batch * steps / (time.perf_counter() - t0)
+
+
+def measure_train_cliff(batch, steps):
+    """Quantifies the dist-PS fusion cliff: single-process fused
+    kvstore='device' vs 2-process dist_sync through the localhost PS
+    (launch.py local), same model and per-worker batch."""
+    import subprocess
+    import sys as _sys
+    rate_fused = _cliff_train('device', batch, steps)
+    print('single-process kvstore=device: %.0f samples/s' % rate_fused)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    # apples-to-apples: the fused baseline above is pinned to cpu, so
+    # the workers must be too, even if the caller exported a platform
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = os.path.dirname(here) + os.pathsep + \
+        env.get('PYTHONPATH', '')
+    for stale in ('DMLC_PS_ROOT_URI', 'DMLC_PS_ROOT_PORT', 'DMLC_ROLE'):
+        env.pop(stale, None)
+    res = subprocess.run(
+        [_sys.executable, os.path.join(here, 'launch.py'),
+         '-n', '2', '-s', '1', '--launcher', 'local', _sys.executable,
+         os.path.abspath(__file__), '--test', 'train-cliff-worker',
+         '--iters', str(steps), '--batch', str(batch)],
+        capture_output=True, text=True, timeout=900, env=env)
+    if res.returncode != 0:
+        raise RuntimeError('dist run failed: %s\n%s'
+                           % (res.stdout, res.stderr))
+    rates = [float(line.split()[1]) for line in res.stdout.splitlines()
+             if line.startswith('CLIFF ')]
+    assert len(rates) == 2, res.stdout
+    agg = sum(rates)
+    print('2-process dist_sync PS:        %.0f samples/s aggregate '
+          '(per-worker %s)' % (agg, ['%.0f' % r for r in rates]))
+    print('fusion cliff: fused/dist = x%.1f   (per-worker x%.1f)'
+          % (rate_fused / agg, rate_fused / (agg / 2)))
+    return rate_fused, agg
+
+
+def _train_cliff_worker(batch, steps):
+    rate = _cliff_train('dist_sync', batch, steps)
+    print('CLIFF %.2f' % rate, flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument('--test', choices=['mesh', 'ps'], default='mesh')
+    p.add_argument('--test', choices=['mesh', 'ps', 'train-cliff',
+                                      'train-cliff-worker'],
+                   default='mesh')
     p.add_argument('--size-mb', type=float, default=64.0)
     p.add_argument('--iters', type=int, default=10)
+    p.add_argument('--batch', type=int, default=256)
     p.add_argument('-n', '--num-workers', type=int, default=2)
     args = p.parse_args()
     if args.test == 'mesh':
         measure_mesh(args.size_mb, args.iters)
-    else:
+    elif args.test == 'ps':
         measure_ps(args.size_mb, args.iters, args.num_workers)
+    elif args.test == 'train-cliff':
+        # apples-to-apples on one backend: the cliff isolates the
+        # kvstore path difference, not chip dispatch (a sitecustomize
+        # may have pinned the accelerator platform already — force it
+        # back before first device use)
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        measure_train_cliff(args.batch, args.iters)
+    else:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        _train_cliff_worker(args.batch, args.iters)
 
 
 if __name__ == '__main__':
