@@ -84,7 +84,9 @@ def main():
         else [256, 128, 64]
     steps = int(os.environ.get('BENCH_STEPS', 6))
     warmup = int(os.environ.get('BENCH_WARMUP', 2))
-    bulk = int(os.environ.get('BENCH_BULK', 8))
+    # 16 steps/dispatch measured +3.2% over 8 (the dependent-dispatch
+    # tunnel RTT amortizes further); 32 OOMs holding the input batches
+    bulk = int(os.environ.get('BENCH_BULK', 16))
     dtype = os.environ.get('BENCH_DTYPE', 'bfloat16')
     best = None
     err = None

@@ -1,0 +1,89 @@
+"""Undercomplete MLP autoencoder on synthetic low-rank data.
+
+Capability demonstrated (reference example/autoencoder role):
+unsupervised training — a reconstruction objective where the LABEL is
+the INPUT (LinearRegressionOutput against the data itself), a
+bottleneck that must discover the generating factors, and encode-only
+inference through get_internals().
+
+Run: python examples/autoencoder/autoencoder.py [--quick]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+DIM, RANK = 64, 4
+
+
+_BASIS = np.linalg.qr(np.random.RandomState(42).randn(DIM, RANK))[0]
+
+
+def make_data(n, seed=0):
+    """Points near a fixed RANK-dim linear manifold in DIM dims (the
+    basis is shared across seeds so train/val describe the same
+    manifold; the seed varies only the sampled codes and noise)."""
+    rs = np.random.RandomState(seed)
+    codes = rs.randn(n, RANK)
+    return (codes @ _BASIS.T + 0.02 * rs.randn(n, DIM)).astype(np.float32)
+
+
+def build_net(bottleneck=RANK):
+    # tanh, not relu: the manifold is signed, and a relu encoder wastes
+    # half the bottleneck on sign recovery (measured: plateaus at ~70%
+    # of the data variance; tanh reaches <1%)
+    data = sym.Variable('data')
+    target = sym.Variable('target')
+    h = sym.Activation(sym.FullyConnected(data, num_hidden=64,
+                                          name='enc1'), act_type='tanh')
+    code = sym.FullyConnected(h, num_hidden=bottleneck, name='code')
+    h = sym.Activation(sym.FullyConnected(code, num_hidden=64,
+                                          name='dec1'), act_type='tanh')
+    recon = sym.FullyConnected(h, num_hidden=DIM, name='recon')
+    return sym.LinearRegressionOutput(recon, target, name='lro')
+
+
+def main(quick=False):
+    n = 2048 if quick else 8192
+    epochs = 15 if quick else 40
+    batch_size = 128
+    X = make_data(n)
+    # unsupervised: the reconstruction target IS the input
+    train = mx.io.NDArrayIter({'data': X}, {'target': X},
+                              batch_size=batch_size, shuffle=True)
+    mod = mx.mod.Module(build_net(), data_names=['data'],
+                        label_names=['target'])
+    mod.fit(train, optimizer='adam',
+            optimizer_params={'learning_rate': 5e-3},
+            eval_metric='mse', num_epoch=epochs)
+
+    Xv = make_data(512, seed=5)
+    val = mx.io.NDArrayIter({'data': Xv}, {'target': Xv},
+                            batch_size=batch_size)
+    recon = mod.predict(val).asnumpy()
+    mse = float(((recon - Xv) ** 2).mean())
+    var = float(Xv.var())
+    print('reconstruction MSE %.5f (data variance %.5f)' % (mse, var))
+
+    # encode-only inference: cut the graph at the bottleneck
+    codes_sym = build_net().get_internals()['code_output']
+    enc = mx.mod.Module(codes_sym, data_names=['data'], label_names=None)
+    enc.bind(data_shapes=[mx.io.DataDesc('data', (batch_size, DIM))],
+             for_training=False)
+    arg_params, aux_params = mod.get_params()
+    enc.set_params({k: v for k, v in arg_params.items()
+                    if k in codes_sym.list_arguments()}, aux_params,
+                   allow_missing=True)
+    val.reset()
+    codes = enc.predict(val).asnumpy()
+    assert codes.shape == (512, RANK)
+    return mse, var
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    mse, var = main(quick=ap.parse_args().quick)
+    assert mse < 0.05 * var, (mse, var)

@@ -43,3 +43,15 @@ def test_finetune():
     assert base > 0.9, base
     assert full > 0.9, full
     assert head > 0.5, head
+
+
+def test_bi_lstm_sort():
+    mod = _load('examples/bi_lstm_sort/sort.py', 'ex_sort')
+    acc = mod.main(quick=True)
+    assert acc > 0.8, acc
+
+
+def test_autoencoder():
+    mod = _load('examples/autoencoder/autoencoder.py', 'ex_ae')
+    mse, var = mod.main(quick=True)
+    assert mse < 0.05 * var, (mse, var)

@@ -1,0 +1,75 @@
+"""Equivalence + gradient tests for the fused conv+BN-stats Pallas
+kernel (mxnet_tpu/pallas_conv.py) against the unfused XLA oracle.
+
+Runs in interpret mode on the CPU test platform; the on-chip perf
+comparison lives in tools/bench_conv_bn.py and docs/PERF.md."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import pallas_conv as pc
+
+
+CASES = [
+    ((4, 14, 14, 32), (3, 3, 32, 128), (1, 1), (1, 1)),
+    ((4, 14, 14, 32), (1, 1, 32, 128), (1, 1), (0, 0)),
+    ((4, 14, 14, 32), (1, 1, 32, 128), (2, 2), (0, 0)),
+    ((8, 8, 8, 16), (3, 3, 16, 64), (1, 1), (1, 1)),
+]
+
+
+@pytest.mark.parametrize('xs,ws,stride,pad', CASES)
+def test_conv_bn_stats_matches_xla(xs, ws, stride, pad):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*xs), jnp.float32)
+    w = jnp.asarray(rng.randn(*ws) * 0.1, jnp.float32)
+    y, s1, s2 = pc.conv2d_bn_stats(x, w, stride, pad, True)
+    yr, s1r, s2r = pc.reference_conv_bn_stats(x, w, stride, pad)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(yr),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s1r),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(s2r),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_conv_bn_stats_gradients():
+    """The custom VJP folds stats-output gradients into dy; both paths
+    must agree exactly (same XLA transposed convs underneath)."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 8, 16), jnp.float32)
+    w = jnp.asarray(rng.randn(3, 3, 16, 64) * 0.1, jnp.float32)
+
+    def loss_fused(x, w):
+        y, s1, s2 = pc.conv2d_bn_stats(x, w, (1, 1), (1, 1), True)
+        return (y * 0.3).sum() + (s1 * 0.7).sum() - (s2 * 0.2).sum()
+
+    def loss_ref(x, w):
+        y, s1, s2 = pc.reference_conv_bn_stats(x, w, (1, 1), (1, 1))
+        return (y * 0.3).sum() + (s1 * 0.7).sum() - (s2 * 0.2).sum()
+
+    gx, gw = jax.grad(loss_fused, (0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_supported_gates():
+    bf16 = jnp.bfloat16
+    assert pc.supported((256, 28, 28, 128), (3, 3, 128, 128), (1, 1),
+                        (1, 1), bf16)
+    assert pc.supported((256, 28, 28, 256), (1, 1, 256, 512), (2, 2),
+                        (0, 0), bf16)
+    # stem conv: Cin too small
+    assert not pc.supported((256, 224, 224, 3), (7, 7, 3, 64), (2, 2),
+                            (3, 3), bf16)
+    # strided 3x3 not handled
+    assert not pc.supported((256, 28, 28, 128), (3, 3, 128, 128), (2, 2),
+                            (1, 1), bf16)
+    # non-lane-aligned cout
+    assert not pc.supported((256, 28, 28, 128), (1, 1, 128, 96), (1, 1),
+                            (0, 0), bf16)

@@ -1,0 +1,49 @@
+#!/usr/bin/env python3
+"""Kill stray training processes across a job's hosts (role of
+reference tools/kill-mxnet.py): for every host in the hostfile, ssh in
+and terminate processes of the given user running the given program.
+
+Usage: python tools/kill_mxnet.py hostfile [prog] [--user U] [--dry-run]
+"""
+import argparse
+import getpass
+import subprocess
+import sys
+
+
+def kill_on_host(host, user, prog, dry_run=False):
+    # Bracket the first character so the pattern never matches the
+    # remote shell carrying this very command line ('[p]ython' matches
+    # 'python' but not itself) — else pkill signals its own parent.
+    safe = '[%s]%s' % (prog[0], prog[1:]) if prog else prog
+    remote = "pkill -u %s -f '%s'" % (user, safe)
+    cmd = ['ssh', '-o', 'StrictHostKeyChecking=no', host, remote]
+    if dry_run:
+        print(' '.join(cmd))
+        return 0
+    rc = subprocess.call(cmd)
+    # pkill rc 1 = "nothing matched": a clean host, not a failure
+    return 0 if rc in (0, 1) else rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('hostfile')
+    ap.add_argument('prog', nargs='?', default='python',
+                    help='command-line substring to kill (default: python)')
+    ap.add_argument('--user', default=getpass.getuser())
+    ap.add_argument('--dry-run', action='store_true')
+    args = ap.parse_args(argv)
+    with open(args.hostfile) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    failures = 0
+    for host in hosts:
+        rc = kill_on_host(host, args.user, args.prog,
+                          dry_run=args.dry_run)
+        print('%s: %s' % (host, 'ok' if rc == 0 else 'rc=%d' % rc))
+        failures += rc != 0
+    return 1 if failures == len(hosts) and hosts else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

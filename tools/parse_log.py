@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Summarize a training log into a table (role of reference
+tools/parse_log.py): collects per-epoch Train-*/Validation-* metric
+values and the epoch time cost from the standard callback log lines
+
+    Epoch[3] Train-accuracy=0.948
+    Epoch[3] Time cost=12.400
+    Epoch[3] Validation-accuracy=0.913
+
+Usage: python tools/parse_log.py train.log [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+from collections import OrderedDict
+
+_LINE = re.compile(
+    r'Epoch\[(\d+)\]\s+'
+    r'(?:(Train|Validation)-([\w-]+)=([0-9.eE+-]+)'
+    r'|Time cost=([0-9.eE+-]+))')
+
+
+def scan(lines):
+    """-> (ordered column names, {epoch: {column: value}})."""
+    columns = OrderedDict()
+    table = OrderedDict()
+    for line in lines:
+        m = _LINE.search(line)
+        if not m:
+            continue
+        epoch = int(m.group(1))
+        row = table.setdefault(epoch, {})
+        if m.group(5) is not None:
+            name = 'time'
+            value = float(m.group(5))
+        else:
+            name = '%s-%s' % (m.group(2).lower(), m.group(3))
+            value = float(m.group(4))
+        columns.setdefault(name, None)
+        row[name] = value
+    return list(columns), table
+
+
+def render(columns, table, fmt):
+    header = ['epoch'] + columns
+    rows = [[str(epoch)] + ['%g' % row[c] if c in row else ''
+                            for c in columns]
+            for epoch, row in sorted(table.items())]
+    if fmt == 'csv':
+        return '\n'.join(','.join(r) for r in [header] + rows)
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    def line(cells):
+        return '| ' + ' | '.join(c.ljust(w)
+                                 for c, w in zip(cells, widths)) + ' |'
+    sep = '|' + '|'.join('-' * (w + 2) for w in widths) + '|'
+    return '\n'.join([line(header), sep] + [line(r) for r in rows])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('logfile')
+    ap.add_argument('--format', choices=('markdown', 'csv'),
+                    default='markdown')
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        columns, table = scan(f)
+    if not table:
+        print('no epoch records found in %s' % args.logfile,
+              file=sys.stderr)
+        return 1
+    print(render(columns, table, args.format))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
