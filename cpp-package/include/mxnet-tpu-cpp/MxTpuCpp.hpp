@@ -55,6 +55,11 @@ int MXTNDArraySyncCopyFromCPU(void*, const float*, size_t);
 int MXTNDArraySyncCopyToCPU(void*, float*, size_t);
 int MXTNDArrayGetShape(void*, uint32_t*, const uint32_t**);
 void MXTNDArrayFree(void*);
+int MXTNDArraySave(const char*, uint32_t, void**, const char**);
+int MXTNDArrayLoad(const char*, void**, uint32_t*);
+int MXTNDArrayLoadGet(void*, uint32_t, const char**, void**);
+int MXTNDArraySlice(void*, uint32_t, uint32_t, void**);
+int MXTNDArrayReshape(void*, uint32_t, const uint32_t*, void**);
 int MXTSymbolCreateVariable(const char*, void**);
 int MXTSymbolCreate(const char*, const char*, uint32_t, const char**,
                     const char**, uint32_t, const char**, void**, void**);
@@ -68,6 +73,11 @@ int MXTSymbolInferShape(void*, uint32_t, const char**, const uint32_t*,
                         const uint32_t**, uint32_t*, const uint32_t**,
                         const uint32_t**, uint32_t*, const uint32_t**,
                         const uint32_t**);
+int MXTSymbolGetInternals(void*, void**);
+int MXTSymbolGetOutput(void*, uint32_t, void**);
+int MXTSymbolGetInternalByName(void*, const char*, void**);
+int MXTSymbolGetAttr(void*, const char*, const char**);
+int MXTSymbolSetAttr(void*, const char*, const char*);
 void MXTSymbolFree(void*);
 int MXTExecutorSimpleBind(void*, int, int, const char*, uint32_t,
                           const char**, const uint32_t*, const uint32_t*,
@@ -343,6 +353,55 @@ class NDArray {
            "MXTNDArrayGetShape");
     return Shape(dims, dims + ndim);
   }
+  // Row-range COPY of [begin, end).  Unlike the reference's slice
+  // views, writes to the result do not propagate to the parent
+  // (functional arrays underneath); refill the parent via CopyFrom.
+  NDArray Slice(uint32_t begin, uint32_t end) const {
+    void* h = nullptr;
+    CheckT(MXTNDArraySlice(handle_, begin, end, &h), "MXTNDArraySlice");
+    return FromHandle(h);
+  }
+  NDArray Reshape(const Shape& shape) const {
+    void* h = nullptr;
+    CheckT(MXTNDArrayReshape(handle_, static_cast<uint32_t>(shape.size()),
+                             shape.data(), &h),
+           "MXTNDArrayReshape");
+    return FromHandle(h);
+  }
+  // Save named arrays in the .params container format.
+  static void Save(const std::string& fname,
+                   const std::vector<std::pair<std::string,
+                                               const NDArray*>>& items) {
+    std::vector<const char*> keys;
+    std::vector<void*> handles;
+    for (const auto& kv : items) {
+      keys.push_back(kv.first.c_str());
+      handles.push_back(kv.second->handle());
+    }
+    CheckT(MXTNDArraySave(fname.c_str(),
+                          static_cast<uint32_t>(handles.size()),
+                          handles.data(), keys.data()),
+           "MXTNDArraySave");
+  }
+  static std::vector<std::pair<std::string, NDArray>> Load(
+      const std::string& fname) {
+    void* list = nullptr;
+    uint32_t n = 0;
+    CheckT(MXTNDArrayLoad(fname.c_str(), &list, &n), "MXTNDArrayLoad");
+    std::vector<std::pair<std::string, NDArray>> out;
+    for (uint32_t i = 0; i < n; ++i) {
+      const char* key = nullptr;
+      void* nd = nullptr;
+      int rc = MXTNDArrayLoadGet(list, i, &key, &nd);
+      if (rc != 0) {
+        MXTNDArrayFree(list);
+        CheckT(rc, "MXTNDArrayLoadGet");
+      }
+      out.emplace_back(key, FromHandle(nd));
+    }
+    MXTNDArrayFree(list);
+    return out;
+  }
   void* handle() const { return handle_; }
 
  private:
@@ -400,6 +459,37 @@ class Symbol {
   }
   std::vector<std::string> ListAuxiliaryStates() const {
     return NameList(&MXTSymbolListAuxiliaryStates);
+  }
+
+  // Graph surgery: every internal node's outputs as one grouped symbol,
+  // or a single tap by index / internal name.
+  Symbol GetInternals() const {
+    Symbol s;
+    CheckT(MXTSymbolGetInternals(handle_, &s.handle_),
+           "MXTSymbolGetInternals");
+    return s;
+  }
+  Symbol GetOutput(uint32_t index) const {
+    Symbol s;
+    CheckT(MXTSymbolGetOutput(handle_, index, &s.handle_),
+           "MXTSymbolGetOutput");
+    return s;
+  }
+  Symbol GetInternalByName(const std::string& name) const {
+    Symbol s;
+    CheckT(MXTSymbolGetInternalByName(handle_, name.c_str(), &s.handle_),
+           "MXTSymbolGetInternalByName");
+    return s;
+  }
+  std::string GetAttr(const std::string& key) const {
+    const char* out = nullptr;
+    CheckT(MXTSymbolGetAttr(handle_, key.c_str(), &out),
+           "MXTSymbolGetAttr");
+    return out;
+  }
+  void SetAttr(const std::string& key, const std::string& value) {
+    CheckT(MXTSymbolSetAttr(handle_, key.c_str(), value.c_str()),
+           "MXTSymbolSetAttr");
   }
 
   // Bidirectional shape inference: given shapes for some arguments,

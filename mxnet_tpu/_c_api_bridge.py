@@ -57,6 +57,27 @@ def nd_shape(arr):
     return tuple(int(d) for d in arr.shape)
 
 
+def nd_save(fname, keys, arrays):
+    nd.save(fname, dict(zip(keys, arrays)) if keys else list(arrays))
+
+
+def nd_load(fname):
+    """-> (keys, arrays); keys are '' for list-style files."""
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return names, [loaded[k] for k in names]
+    return [''] * len(loaded), list(loaded)
+
+
+def nd_slice(arr, begin, end):
+    return arr[int(begin):int(end)]
+
+
+def nd_reshape(arr, shape):
+    return arr.reshape(tuple(int(d) for d in shape))
+
+
 # -- Symbol -----------------------------------------------------------------
 
 def sym_variable(name):
@@ -95,6 +116,27 @@ def sym_list_outputs(sym):
 
 def sym_list_aux(sym):
     return list(sym.list_auxiliary_states())
+
+
+def sym_get_internals(sym):
+    return sym.get_internals()
+
+
+def sym_get_output(sym, index):
+    return sym[int(index)]
+
+
+def sym_get_internal_by_name(sym, name):
+    return sym.get_internals()[name]
+
+
+def sym_attr_get(sym, key):
+    value = sym.attr(key)
+    return '' if value is None else str(value)
+
+
+def sym_attr_set(sym, key, value):
+    sym._set_attr(**{key: value})
 
 
 def sym_infer_shape(sym, names, shapes):

@@ -133,7 +133,8 @@ int store_strings(PyObject* list, Handle* h, uint32_t* out_n,
   }
   for (const std::string& s : h->str_store) h->str_ptrs.push_back(s.c_str());
   *out_n = static_cast<uint32_t>(h->str_ptrs.size());
-  *out = h->str_ptrs.empty() ? nullptr : h->str_ptrs.data();
+  if (out != nullptr)
+    *out = h->str_ptrs.empty() ? nullptr : h->str_ptrs.data();
   return 0;
 }
 
@@ -236,6 +237,96 @@ void MXTNDArrayFree(void* handle) {
   delete h;
 }
 
+// Save named NDArrays to the .params container format (reference
+// MXNDArraySave).  keys may be null for list-style files.
+int MXTNDArraySave(const char* fname, uint32_t num, void** handles,
+                   const char** keys) {
+  GIL gil;
+  PyObject* names = keys != nullptr ? str_list(num, keys)
+                                    : PyList_New(0);
+  PyObject* arrays = PyList_New(num);
+  if (names != nullptr && arrays != nullptr) {
+    for (uint32_t i = 0; i < num; ++i) {
+      PyObject* o = obj_of(handles[i]);
+      Py_INCREF(o);
+      PyList_SET_ITEM(arrays, i, o);
+    }
+  }
+  PyObject* r = nullptr;
+  if (names != nullptr && arrays != nullptr)
+    r = call("nd_save", "(sOO)", fname, names, arrays);
+  Py_XDECREF(names);
+  Py_XDECREF(arrays);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Load a .params container.  The returned list handle owns the
+// (keys, arrays) pair; fetch entries with MXTNDArrayLoadGet and free
+// it with MXTNDArrayFree.  All key pointers stay valid until the list
+// handle is freed (they are materialized up front into the handle's
+// string cache).
+int MXTNDArrayLoad(const char* fname, void** out_list, uint32_t* out_n) {
+  *out_list = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* pair = call("nd_load", "(s)", fname);
+  if (pair == nullptr) return -1;
+  Handle* h = wrap(pair);
+  uint32_t n = 0;
+  if (store_strings(PyTuple_GET_ITEM(pair, 0), h, &n, nullptr) != 0) {
+    MXTNDArrayFree(h);
+    return -1;
+  }
+  *out_n = n;
+  *out_list = h;
+  return 0;
+}
+
+int MXTNDArrayLoadGet(void* list, uint32_t index, const char** out_key,
+                      void** out_nd) {
+  *out_nd = nullptr;
+  GIL gil;
+  Handle* h = static_cast<Handle*>(list);
+  PyObject* arrays = PyTuple_GET_ITEM(h->obj, 1);
+  if (index >= h->str_ptrs.size()) {
+    train_last_error = "MXTNDArrayLoadGet: index out of range";
+    return -1;
+  }
+  *out_key = h->str_ptrs[index];
+  PyObject* arr = PyList_GET_ITEM(arrays, index);
+  Py_INCREF(arr);
+  *out_nd = wrap(arr);
+  return 0;
+}
+
+// Row-range COPY of [begin, end) (functional arrays underneath: unlike
+// the reference's MXNDArraySlice view, writes to the result do NOT
+// propagate to the parent — refill the parent with SyncCopyFromCPU).
+int MXTNDArraySlice(void* handle, uint32_t begin, uint32_t end,
+                    void** out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject* o = call("nd_slice", "(OII)", obj_of(handle), begin, end);
+  if (o == nullptr) return -1;
+  *out = wrap(o);
+  return 0;
+}
+
+int MXTNDArrayReshape(void* handle, uint32_t ndim, const uint32_t* dims,
+                      void** out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject* tup = shape_tuple(ndim, dims);
+  if (tup == nullptr) return -1;
+  PyObject* o = call("nd_reshape", "(OO)", obj_of(handle), tup);
+  Py_DECREF(tup);
+  if (o == nullptr) return -1;
+  *out = wrap(o);
+  return 0;
+}
+
 // -- Symbol ----------------------------------------------------------------
 
 int MXTSymbolCreateVariable(const char* name, void** out) {
@@ -333,6 +424,64 @@ int MXTSymbolListOutputs(void* handle, uint32_t* out_n,
 int MXTSymbolListAuxiliaryStates(void* handle, uint32_t* out_n,
                                  const char*** out) {
   return sym_name_list(handle, "sym_list_aux", out_n, out);
+}
+
+static int handle_by_index(const char* fn, void* handle, uint32_t idx,
+                           void** out);
+static int handle_by_name(const char* fn, void* handle, const char* name,
+                          void** out);
+
+static int handle_plain(const char* fn, void* handle, void** out) {
+  GIL gil;
+  PyObject* o = call(fn, "(O)", obj_of(handle));
+  if (o == nullptr) return -1;
+  *out = wrap(o);
+  return 0;
+}
+
+// Graph surgery handles (reference MXSymbolGetInternals/GetOutput).
+int MXTSymbolGetInternals(void* handle, void** out) {
+  *out = nullptr;
+  return handle_plain("sym_get_internals", handle, out);
+}
+
+int MXTSymbolGetOutput(void* handle, uint32_t index, void** out) {
+  *out = nullptr;
+  return handle_by_index("sym_get_output", handle, index, out);
+}
+
+int MXTSymbolGetInternalByName(void* handle, const char* name,
+                               void** out) {
+  *out = nullptr;
+  return handle_by_name("sym_get_internal_by_name", handle, name, out);
+}
+
+// Attribute get/set (reference MXSymbolGetAttr/SetAttr).  Get returns
+// an empty string for unset keys; the pointer is handle-cached.
+int MXTSymbolGetAttr(void* handle, const char* key, const char** out) {
+  GIL gil;
+  Handle* h = static_cast<Handle*>(handle);
+  PyObject* s = call("sym_attr_get", "(Os)", h->obj, key);
+  if (s == nullptr) return -1;
+  const char* c = PyUnicode_AsUTF8(s);
+  if (c == nullptr) {
+    train_last_error = py_err_str();
+    Py_DECREF(s);
+    return -1;
+  }
+  h->byte_store = c;
+  Py_DECREF(s);
+  *out = h->byte_store.c_str();
+  return 0;
+}
+
+int MXTSymbolSetAttr(void* handle, const char* key, const char* value) {
+  GIL gil;
+  PyObject* r = call("sym_attr_set", "(Oss)", obj_of(handle), key,
+                     value);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
 }
 
 // Bidirectional shape inference (reference MXSymbolInferShape): provide

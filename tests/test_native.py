@@ -349,31 +349,44 @@ def test_c_predict_abi_ctypes(tmp_path):
     lib.MXTNDListFree(nd_handle)
 
 
+
+def _build_and_run_native(tmp_path, src_path, run_args, compiler='g++',
+                          timeout=300):
+    """Compile one source file against libmxtpu + the cpp-package
+    headers and run it with the repo on PYTHONPATH (shared scaffolding
+    for every embedded-interpreter ABI test)."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    libdir = os.path.join(repo, 'mxnet_tpu')
+    exe = str(tmp_path / 'native_prog')
+    cmd = [compiler, '-O2']
+    if compiler == 'g++':
+        cmd += ['-std=c++14',
+                '-I' + os.path.join(repo, 'cpp-package', 'include')]
+    cmd += [str(src_path), '-o', exe, '-L' + libdir, '-lmxtpu',
+            '-Wl,-rpath,' + libdir, '-Wl,-rpath,/usr/local/lib']
+    subprocess.run(cmd, check=True)
+    env = dict(os.environ)
+    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    return subprocess.run([exe] + [str(a) for a in run_args],
+                          capture_output=True, text=True, env=env,
+                          timeout=timeout)
+
+
 @native
 def test_c_predict_standalone_program(tmp_path):
     """The VERDICT gate: a small C program (examples/c_predict/
     predict.c, zero Python in the source) links libmxtpu.so, loads a
     saved checkpoint, and classifies a sample correctly."""
-    import subprocess
-    import sys
     prefix, sample, expect = _train_and_save_mlp(tmp_path)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src = os.path.join(repo, 'examples', 'c_predict', 'predict.c')
-    libdir = os.path.join(repo, 'mxnet_tpu')
-    exe = str(tmp_path / 'predict')
-    subprocess.run(
-        ['gcc', '-O2', src, '-o', exe, '-L' + libdir, '-lmxtpu',
-         '-Wl,-rpath,' + libdir, '-Wl,-rpath,/usr/local/lib'],
-        check=True)
     inp = str(tmp_path / 'input.f32')
     np.ascontiguousarray(sample, dtype='<f4').tofile(inp)
-    env = dict(os.environ)
-    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
-    env.setdefault('JAX_PLATFORMS', 'cpu')
-    proc = subprocess.run(
-        [exe, prefix + '-symbol.json', prefix + '-0001.params', inp,
-         '1', str(sample.size)],
-        capture_output=True, text=True, env=env, timeout=300)
+    proc = _build_and_run_native(
+        tmp_path, os.path.join(repo, 'examples', 'c_predict', 'predict.c'),
+        [prefix + '-symbol.json', prefix + '-0001.params', inp, 1,
+         sample.size], compiler='gcc')
     assert proc.returncode == 0, proc.stderr
     assert 'predicted=%d' % expect in proc.stdout, \
         (proc.stdout, proc.stderr)
@@ -384,29 +397,83 @@ def test_cpp_package_predictor(tmp_path):
     """cpp-package parity: the header-only C++ API
     (cpp-package/include/mxnet-tpu-cpp/MxTpuCpp.hpp) compiles and the
     ~35-line example classifies the same sample as the C ABI demo."""
-    import subprocess
     prefix, sample, expect = _train_and_save_mlp(tmp_path)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src = os.path.join(repo, 'cpp-package', 'example', 'predict.cpp')
-    inc = os.path.join(repo, 'cpp-package', 'include')
-    libdir = os.path.join(repo, 'mxnet_tpu')
-    exe = str(tmp_path / 'predict_cpp')
-    subprocess.run(
-        ['g++', '-O2', '-std=c++14', src, '-I' + inc, '-o', exe,
-         '-L' + libdir, '-lmxtpu', '-Wl,-rpath,' + libdir,
-         '-Wl,-rpath,/usr/local/lib'],
-        check=True)
     inp = str(tmp_path / 'input.f32')
     np.ascontiguousarray(sample, dtype='<f4').tofile(inp)
-    env = dict(os.environ)
-    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
-    env.setdefault('JAX_PLATFORMS', 'cpu')
-    proc = subprocess.run(
-        [exe, prefix, '1', inp, '1', str(sample.size)],
-        capture_output=True, text=True, env=env, timeout=300)
+    proc = _build_and_run_native(
+        tmp_path,
+        os.path.join(repo, 'cpp-package', 'example', 'predict.cpp'),
+        [prefix, 1, inp, 1, sample.size])
     assert proc.returncode == 0, proc.stderr
     assert 'predicted=%d' % expect in proc.stdout, \
         (proc.stdout, proc.stderr)
+
+
+_CPP_SURFACE_SRC = r'''
+// Exercises the widened C ABI from C++: NDArray save/load/slice/
+// reshape, Symbol internals/attrs/infer-shape.  Zero Python in source.
+#include <cassert>
+#include <cstdio>
+#include <vector>
+#include "mxnet-tpu-cpp/MxTpuCpp.hpp"
+namespace mc = mxtpu::cpp;
+
+int main(int argc, char** argv) {
+  const std::string params = std::string(argv[1]) + "/weights.params";
+  // NDArray: build, reshape, slice, save, load
+  std::vector<float> vals(12);
+  for (int i = 0; i < 12; ++i) vals[i] = static_cast<float>(i);
+  mc::NDArray a({3, 4}, vals);
+  mc::NDArray r = a.Reshape({4, 3});
+  assert(r.GetShape()[0] == 4 && r.GetShape()[1] == 3);
+  mc::NDArray s = a.Slice(1, 3);
+  assert(s.GetShape()[0] == 2);
+  assert(s.ToVector()[0] == 4.0f);
+  mc::NDArray::Save(params, {{"arg:w", &a}});
+  auto loaded = mc::NDArray::Load(params);
+  assert(loaded.size() == 1 && loaded[0].first == "arg:w");
+  assert(loaded[0].second.ToVector()[5] == 5.0f);
+
+  // Symbol: compose, attrs, internals, infer shape
+  mc::Symbol data = mc::Symbol::Variable("data");
+  mc::Symbol fc = mc::Symbol::Create(
+      "FullyConnected", "fc", {{"num_hidden", "8"}}, {{"data", &data}});
+  mc::Symbol act = mc::Symbol::Create(
+      "Activation", "relu", {{"act_type", "relu"}}, {{"data", &fc}});
+  act.SetAttr("lr_mult", "2.5");
+  assert(act.GetAttr("lr_mult") == "2.5");
+  mc::Symbol tap = act.GetInternalByName("fc_output");
+  assert(tap.ListOutputs().size() == 1);
+  mc::Symbol all = act.GetInternals();
+  assert(all.ListOutputs().size() >= 3);
+  std::vector<mc::Shape> args, outs, auxs;
+  act.InferShape({{"data", {2, 6}}}, &args, &outs, &auxs);
+  assert(outs.size() == 1 && outs[0][0] == 2 && outs[0][1] == 8);
+  bool found_weight = false;
+  auto names = act.ListArguments();
+  for (size_t i = 0; i < names.size(); ++i)
+    if (names[i] == "fc_weight") {
+      found_weight = true;
+      assert(args[i][0] == 8 && args[i][1] == 6);
+    }
+  assert(found_weight);
+  std::printf("CPP_SURFACE_OK\n");
+  return 0;
+}
+'''
+
+
+@native
+def test_cpp_surface_ndarray_symbol(tmp_path):
+    """The widened C ABI (NDArray save/load/slice/reshape, Symbol
+    internals/attrs/infer-shape) drives from C++ with zero Python in
+    the source."""
+    src = tmp_path / 'surface.cpp'
+    src.write_text(_CPP_SURFACE_SRC)
+    proc = _build_and_run_native(tmp_path, src, [tmp_path])
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert 'CPP_SURFACE_OK' in proc.stdout, proc.stdout
 
 
 @native
@@ -416,21 +483,10 @@ def test_cpp_package_trains_mlp(tmp_path):
     the training C ABI (src/c_api_train.cc: Symbol/Executor/Updater),
     runs minibatch SGD, and reaches >90% train accuracy — the parity
     bar set by the reference cpp-package's own trainable example."""
-    import subprocess
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src = os.path.join(repo, 'cpp-package', 'example', 'mlp_train.cpp')
-    inc = os.path.join(repo, 'cpp-package', 'include')
-    libdir = os.path.join(repo, 'mxnet_tpu')
-    exe = str(tmp_path / 'mlp_train')
-    subprocess.run(
-        ['g++', '-O2', '-std=c++14', src, '-I' + inc, '-o', exe,
-         '-L' + libdir, '-lmxtpu', '-Wl,-rpath,' + libdir,
-         '-Wl,-rpath,/usr/local/lib'],
-        check=True)
-    env = dict(os.environ)
-    env['PYTHONPATH'] = repo + os.pathsep + env.get('PYTHONPATH', '')
-    env.setdefault('JAX_PLATFORMS', 'cpu')
-    proc = subprocess.run([exe], capture_output=True, text=True, env=env,
-                          timeout=600)
+    proc = _build_and_run_native(
+        tmp_path,
+        os.path.join(repo, 'cpp-package', 'example', 'mlp_train.cpp'),
+        [], timeout=600)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert 'final train-accuracy' in proc.stdout, proc.stdout

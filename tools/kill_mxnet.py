@@ -42,7 +42,8 @@ def main(argv=None):
                           dry_run=args.dry_run)
         print('%s: %s' % (host, 'ok' if rc == 0 else 'rc=%d' % rc))
         failures += rc != 0
-    return 1 if failures == len(hosts) and hosts else 0
+    # ANY unreachable/failed host leaves a possibly-live trainer behind
+    return 1 if failures else 0
 
 
 if __name__ == '__main__':
