@@ -76,7 +76,7 @@ int MXTSymbolInferShape(void*, uint32_t, const char**, const uint32_t*,
 int MXTSymbolGetInternals(void*, void**);
 int MXTSymbolGetOutput(void*, uint32_t, void**);
 int MXTSymbolGetInternalByName(void*, const char*, void**);
-int MXTSymbolGetAttr(void*, const char*, const char**);
+int MXTSymbolGetAttr(void*, const char*, const char**, int*);
 int MXTSymbolSetAttr(void*, const char*, const char*);
 void MXTSymbolFree(void*);
 int MXTExecutorSimpleBind(void*, int, int, const char*, uint32_t,
@@ -481,11 +481,21 @@ class Symbol {
            "MXTSymbolGetInternalByName");
     return s;
   }
-  std::string GetAttr(const std::string& key) const {
+  // Presence-aware lookup: returns false for unset keys (an attribute
+  // explicitly set to "" returns true with *value empty).
+  bool TryGetAttr(const std::string& key, std::string* value) const {
     const char* out = nullptr;
-    CheckT(MXTSymbolGetAttr(handle_, key.c_str(), &out),
+    int present = 0;
+    CheckT(MXTSymbolGetAttr(handle_, key.c_str(), &out, &present),
            "MXTSymbolGetAttr");
-    return out;
+    if (value != nullptr) *value = out;
+    return present != 0;
+  }
+  // Convenience: '' for unset keys.
+  std::string GetAttr(const std::string& key) const {
+    std::string value;
+    TryGetAttr(key, &value);
+    return value;
   }
   void SetAttr(const std::string& key, const std::string& value) {
     CheckT(MXTSymbolSetAttr(handle_, key.c_str(), value.c_str()),

@@ -1,0 +1,91 @@
+"""Bayesian linear regression with stochastic gradient Langevin dynamics.
+
+Capability demonstrated (reference example/bayesian-methods role): the
+SGLD optimizer — gradient steps plus calibrated Gaussian noise turn the
+SGD trajectory into posterior samples.  On a conjugate Gaussian linear
+model the exact posterior is known, so the sampler is CHECKED, not just
+run: the empirical mean/uncertainty of collected samples must bracket
+the analytic posterior.
+
+Run: python examples/bayesian_methods/sgld_regression.py [--quick]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+DIM = 8
+NOISE = 0.5
+PRIOR_VAR = 4.0
+
+
+def make_data(n, seed=0):
+    rs = np.random.RandomState(seed)
+    w_true = rs.randn(DIM)
+    X = rs.randn(n, DIM).astype(np.float32)
+    y = (X @ w_true + NOISE * rs.randn(n)).astype(np.float32)
+    return X, y, w_true
+
+
+def exact_posterior(X, y):
+    """Conjugate Gaussian posterior N(mu, Sigma) for the weights."""
+    prec = np.eye(DIM) / PRIOR_VAR + X.T @ X / NOISE ** 2
+    sigma = np.linalg.inv(prec)
+    mu = sigma @ (X.T @ y) / NOISE ** 2
+    return mu, sigma
+
+
+def main(quick=False):
+    n = 512
+    steps = 1500 if quick else 6000
+    burn = steps // 3
+    X, y, w_true = make_data(n)
+    mu, sigma = exact_posterior(X, y)
+
+    # negative log posterior as a training graph: squared error scaled
+    # to the Gaussian likelihood + weight decay as the Gaussian prior
+    data = sym.Variable('data')
+    label = sym.Variable('reg_label')
+    pred = sym.FullyConnected(data, num_hidden=1, no_bias=True, name='w')
+    net = sym.LinearRegressionOutput(pred, label, name='reg')
+
+    mod = mx.mod.Module(net, data_names=['data'], label_names=['reg_label'])
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (n, DIM))],
+             label_shapes=[mx.io.DataDesc('reg_label', (n, 1))])
+    mod.init_params(initializer=mx.init.Zero())
+    # SGLD: lr is the Langevin step size; rescale/wd encode the
+    # likelihood precision and the prior.  (LinearRegressionOutput
+    # grads are summed over the batch, so 1/sigma^2 is the whole
+    # likelihood scaling.)
+    mod.init_optimizer(
+        optimizer='sgld',
+        optimizer_params={'learning_rate': 2e-4 * NOISE ** 2,
+                          'rescale_grad': 1.0 / NOISE ** 2,
+                          'wd': 1.0 / PRIOR_VAR})
+    batch = mx.io.DataBatch(data=[nd.array(X)],
+                            label=[nd.array(y[:, None])])
+    samples = []
+    for step in range(steps):
+        mod.forward_backward(batch)
+        mod.update()
+        if step >= burn and step % 10 == 0:
+            samples.append(mod.get_params()[0]['w_weight']
+                           .asnumpy().ravel().copy())
+    S = np.stack(samples)
+    emp_mu = S.mean(0)
+    mu_err = float(np.abs(emp_mu - mu).max())
+    sd_ratio = float(np.median(S.std(0) / np.sqrt(np.diag(sigma))))
+    print('posterior mean max err %.4f (posterior sd ~%.4f); '
+          'empirical/exact sd ratio %.2f'
+          % (mu_err, float(np.sqrt(np.diag(sigma)).mean()), sd_ratio))
+    return mu_err, float(np.sqrt(np.diag(sigma)).max()), sd_ratio
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    mu_err, sd, ratio = main(quick=ap.parse_args().quick)
+    assert mu_err < 6 * sd, (mu_err, sd)
+    assert 0.3 < ratio < 3.0, ratio

@@ -1,0 +1,82 @@
+"""One backbone, two supervised heads trained jointly.
+
+Capability demonstrated (reference example/multi-task role): a Group
+symbol with TWO loss outputs (classification + regression), a Module
+with two label inputs, and a CompositeEvalMetric with output/label
+routing (output_names/label_names) scoring each head separately.
+
+Run: python examples/multi_task/multi_task.py [--quick]
+"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def make_data(n, seed=0):
+    """Inputs carry both a class (blob identity) and a regression
+    target (distance from origin)."""
+    rs = np.random.RandomState(seed)
+    centers = 3.0 * rs.randn(4, 16)
+    y_cls = (np.arange(n) % 4).astype(np.float32)
+    X = (centers[y_cls.astype(int)] + rs.randn(n, 16)).astype(np.float32)
+    # standardized distance-from-origin (unit-ish scale, so the RMSE
+    # threshold reads as fraction-of-std)
+    norm = np.linalg.norm(X, axis=1, keepdims=True)
+    y_reg = ((norm - norm.mean()) / norm.std()).astype(np.float32)
+    return X, y_cls, y_reg
+
+
+def build_net():
+    data = sym.Variable('data')
+    cls_label = sym.Variable('cls_label')
+    reg_label = sym.Variable('reg_label')
+    body = sym.Activation(sym.FullyConnected(data, num_hidden=64,
+                                             name='shared1'),
+                          act_type='relu')
+    body = sym.Activation(sym.FullyConnected(body, num_hidden=32,
+                                             name='shared2'),
+                          act_type='relu')
+    cls = sym.SoftmaxOutput(sym.FullyConnected(body, num_hidden=4,
+                                               name='cls_fc'),
+                            cls_label, name='cls')
+    reg = sym.LinearRegressionOutput(
+        sym.FullyConnected(body, num_hidden=1, name='reg_fc'),
+        reg_label, grad_scale=0.1, name='reg')
+    return sym.Group([cls, reg])
+
+
+def main(quick=False):
+    n = 2048 if quick else 8192
+    epochs = 16 if quick else 24
+    batch_size = 128
+    X, y_cls, y_reg = make_data(n)
+    train = mx.io.NDArrayIter(
+        {'data': X}, {'cls_label': y_cls, 'reg_label': y_reg},
+        batch_size=batch_size, shuffle=True)
+
+    metric = mx.metric.CompositeEvalMetric()
+    metric.add(mx.metric.Accuracy(output_names=['cls_output'],
+                                  label_names=['cls_label']))
+    metric.add(mx.metric.RMSE(output_names=['reg_output'],
+                              label_names=['reg_label']))
+
+    mod = mx.mod.Module(build_net(), data_names=['data'],
+                        label_names=['cls_label', 'reg_label'])
+    mod.fit(train, optimizer='adam',
+            optimizer_params={'learning_rate': 5e-3},
+            eval_metric=metric, num_epoch=epochs)
+    train.reset()
+    scores = dict(mod.score(train, metric))
+    print('joint heads:', scores)
+    return scores
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    scores = main(quick=ap.parse_args().quick)
+    assert scores['accuracy'] > 0.9, scores
+    assert scores['rmse'] < 0.5, scores

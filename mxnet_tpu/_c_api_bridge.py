@@ -71,7 +71,11 @@ def nd_load(fname):
 
 
 def nd_slice(arr, begin, end):
-    return arr[int(begin):int(end)]
+    begin, end = int(begin), int(end)
+    if not 0 <= begin < end <= arr.shape[0]:
+        raise ValueError('invalid slice [%d, %d) for axis of length %d'
+                         % (begin, end, arr.shape[0]))
+    return arr[begin:end]
 
 
 def nd_reshape(arr, shape):
@@ -131,8 +135,11 @@ def sym_get_internal_by_name(sym, name):
 
 
 def sym_attr_get(sym, key):
+    """-> (present, value); '' value with present=0 means unset."""
     value = sym.attr(key)
-    return '' if value is None else str(value)
+    if value is None:
+        return 0, ''
+    return 1, str(value)
 
 
 def sym_attr_set(sym, key, value):

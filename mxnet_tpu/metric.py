@@ -105,6 +105,19 @@ class CompositeEvalMetric(EvalMetric):
     def get_metric(self, index):
         return self.metrics[index]
 
+    def update_dict(self, label, pred):
+        # The composite's own names restrict what children may see;
+        # then each child's output_names/label_names routing applies
+        # (a child filtering to one head must not see the others).
+        if self.output_names is not None:
+            pred = {k: v for k, v in pred.items()
+                    if k in self.output_names}
+        if self.label_names is not None:
+            label = {k: v for k, v in label.items()
+                     if k in self.label_names}
+        for metric in self.metrics:
+            metric.update_dict(label, pred)
+
     def update(self, labels, preds):
         for metric in self.metrics:
             metric.update(labels, preds)

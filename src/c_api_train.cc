@@ -456,22 +456,26 @@ int MXTSymbolGetInternalByName(void* handle, const char* name,
   return handle_by_name("sym_get_internal_by_name", handle, name, out);
 }
 
-// Attribute get/set (reference MXSymbolGetAttr/SetAttr).  Get returns
-// an empty string for unset keys; the pointer is handle-cached.
-int MXTSymbolGetAttr(void* handle, const char* key, const char** out) {
+// Attribute get/set (reference MXSymbolGetAttr/SetAttr).  out_present
+// carries the set/unset distinction (an attribute explicitly set to ""
+// reports present=1); the string pointer is handle-cached.
+int MXTSymbolGetAttr(void* handle, const char* key, const char** out,
+                     int* out_present) {
   GIL gil;
   Handle* h = static_cast<Handle*>(handle);
-  PyObject* s = call("sym_attr_get", "(Os)", h->obj, key);
-  if (s == nullptr) return -1;
-  const char* c = PyUnicode_AsUTF8(s);
+  PyObject* pair = call("sym_attr_get", "(Os)", h->obj, key);
+  if (pair == nullptr) return -1;
+  long present = PyLong_AsLong(PyTuple_GET_ITEM(pair, 0));
+  const char* c = PyUnicode_AsUTF8(PyTuple_GET_ITEM(pair, 1));
   if (c == nullptr) {
     train_last_error = py_err_str();
-    Py_DECREF(s);
+    Py_DECREF(pair);
     return -1;
   }
   h->byte_store = c;
-  Py_DECREF(s);
+  Py_DECREF(pair);
   *out = h->byte_store.c_str();
+  if (out_present != nullptr) *out_present = static_cast<int>(present);
   return 0;
 }
 

@@ -55,3 +55,23 @@ def test_autoencoder():
     mod = _load('examples/autoencoder/autoencoder.py', 'ex_ae')
     mse, var = mod.main(quick=True)
     assert mse < 0.05 * var, (mse, var)
+
+
+def test_numpy_custom_op():
+    mod = _load('examples/numpy_ops/custom_softmax.py', 'ex_npop')
+    acc = mod.main(quick=True)
+    assert acc > 0.9, acc
+
+
+def test_multi_task():
+    mod = _load('examples/multi_task/multi_task.py', 'ex_mt')
+    scores = mod.main(quick=True)
+    assert scores['accuracy'] > 0.9, scores
+    assert scores['rmse'] < 0.5, scores
+
+
+def test_sgld_regression():
+    mod = _load('examples/bayesian_methods/sgld_regression.py', 'ex_sgld')
+    mu_err, sd, ratio = mod.main(quick=True)
+    assert mu_err < 6 * sd, (mu_err, sd)
+    assert 0.3 < ratio < 3.0, ratio

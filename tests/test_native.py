@@ -443,6 +443,9 @@ int main(int argc, char** argv) {
       "Activation", "relu", {{"act_type", "relu"}}, {{"data", &fc}});
   act.SetAttr("lr_mult", "2.5");
   assert(act.GetAttr("lr_mult") == "2.5");
+  std::string probe;
+  assert(act.TryGetAttr("lr_mult", &probe) && probe == "2.5");
+  assert(!act.TryGetAttr("never_set", &probe));
   mc::Symbol tap = act.GetInternalByName("fc_output");
   assert(tap.ListOutputs().size() == 1);
   mc::Symbol all = act.GetInternals();

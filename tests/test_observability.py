@@ -281,3 +281,24 @@ def test_profiler_device_lanes(tmp_path):
     mx.profiler.profiler_set_config(mode='symbolic',
                                     filename='profile.json')
     mx.profiler.clear()
+
+
+def test_composite_metric_routes_named_heads():
+    """Per-child output_names/label_names routing must survive the
+    composite: each child sees ONLY its head (regression guard for the
+    bug where CompositeEvalMetric.update_dict degraded to positional
+    zipping and children scored the wrong heads)."""
+    comp = mx.metric.CompositeEvalMetric()
+    comp.add(mx.metric.Accuracy(output_names=['cls_output'],
+                                label_names=['cls_label']))
+    comp.add(mx.metric.RMSE(output_names=['reg_output'],
+                            label_names=['reg_label']))
+    preds = {'cls_output': nd.array(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                             np.float32)),
+             'reg_output': nd.array(np.array([[1.0], [2.0]], np.float32))}
+    labels = {'cls_label': nd.array(np.array([1.0, 0.0], np.float32)),
+              'reg_label': nd.array(np.array([1.5, 2.5], np.float32))}
+    comp.update_dict(labels, preds)
+    scores = dict(comp.get_name_value())
+    assert scores['accuracy'] == 1.0, scores
+    np.testing.assert_allclose(scores['rmse'], 0.5, rtol=1e-6)
