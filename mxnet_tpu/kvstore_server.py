@@ -219,17 +219,32 @@ def _decode(payload):
 def _send_msg(sock, obj):
     payload = _encode(obj)
     tag = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
-    sock.sendall(struct.pack('<Q', len(payload)) + tag + payload)
+    header = struct.pack('<Q', len(payload)) + tag
+    # scatter-gather send: no multi-MB header+payload concat copy
+    if hasattr(sock, 'sendmsg'):
+        total = len(header) + len(payload)
+        sent = sock.sendmsg([header, payload])
+        while sent < total:
+            joined = header + payload if sent < len(header) else payload
+            offset = sent if sent < len(header) else sent - len(header)
+            sock.sendall(memoryview(joined)[offset:])
+            sent = total
+    else:  # pragma: no cover - every CPython socket has sendmsg
+        sock.sendall(header + payload)
 
 
 def _recv_exact(sock, n):
-    buf = b''
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+    # recv_into a preallocated buffer: the bytes-concat loop is
+    # quadratic for multi-MB tensors
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError('socket closed')
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
 
 
 # Upper bound on a single wire frame.  The length prefix arrives before
@@ -531,6 +546,8 @@ class KVStoreServer(object):
                     break
             try:
                 conn, _ = self.listener.accept()
+                # small 'ok' replies must not wait out Nagle+delayed-ACK
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except socket.timeout:
                 continue
             t = threading.Thread(target=self._serve_conn, args=(conn,),
@@ -642,6 +659,16 @@ def main():
     """Server-process entry: `python -m mxnet_tpu.kvstore_server`
     (the reference's `import mxnet` auto-runs kvstore_server when
     DMLC_ROLE=server)."""
+    # The PS is a HOST-side component (the reference's servers are CPU
+    # processes): pin jax to the CPU backend so the server-side
+    # optimizer never dispatches through an accelerator — measured on a
+    # tunneled chip, a server that silently targets the TPU pays the
+    # ~100 ms link round trip per key per round (docs/PERF.md).
+    try:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:  # pragma: no cover - jax always importable here
+        pass
     role = os.environ.get('DMLC_ROLE', 'server')
     assert role in ('server', 'scheduler'), role
     num_workers = int(os.environ['DMLC_NUM_WORKER'])
