@@ -221,16 +221,12 @@ def _send_msg(sock, obj):
     tag = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
     header = struct.pack('<Q', len(payload)) + tag
     # scatter-gather send: no multi-MB header+payload concat copy
-    if hasattr(sock, 'sendmsg'):
-        total = len(header) + len(payload)
-        sent = sock.sendmsg([header, payload])
-        while sent < total:
-            joined = header + payload if sent < len(header) else payload
-            offset = sent if sent < len(header) else sent - len(header)
-            sock.sendall(memoryview(joined)[offset:])
-            sent = total
-    else:  # pragma: no cover - every CPython socket has sendmsg
-        sock.sendall(header + payload)
+    sent = sock.sendmsg([header, payload])
+    if sent < len(header):
+        sock.sendall(header[sent:])
+        sock.sendall(payload)
+    elif sent < len(header) + len(payload):
+        sock.sendall(memoryview(payload)[sent - len(header):])
 
 
 def _recv_exact(sock, n):
@@ -663,12 +659,14 @@ def main():
     # processes): pin jax to the CPU backend so the server-side
     # optimizer never dispatches through an accelerator — measured on a
     # tunneled chip, a server that silently targets the TPU pays the
-    # ~100 ms link round trip per key per round (docs/PERF.md).
-    try:
-        import jax
-        jax.config.update('jax_platforms', 'cpu')
-    except Exception:  # pragma: no cover - jax always importable here
-        pass
+    # ~100 ms link round trip per key per round (docs/PERF.md).  The
+    # assert keeps this regression loud (the pin silently no-ops once a
+    # backend has initialized, e.g. under an eager sitecustomize).
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    assert jax.default_backend() == 'cpu', \
+        'kvstore server must run on the CPU backend (got %s)' \
+        % jax.default_backend()
     role = os.environ.get('DMLC_ROLE', 'server')
     assert role in ('server', 'scheduler'), role
     num_workers = int(os.environ['DMLC_NUM_WORKER'])

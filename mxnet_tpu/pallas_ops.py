@@ -219,18 +219,21 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,
     return (out, lse) if return_lse else out
 
 
-def _blocked_backward(q, k, v, g, causal, scale, block_q):
+def _blocked_backward(q, k, v, g, causal, scale, block_q, glse=None):
     """Recompute-based gradients, q-block at a time: live memory is
-    O(block_q * T) instead of the dense O(T^2)."""
+    O(block_q * T) instead of the dense O(T^2).  glse: optional
+    logsumexp cotangent, folded into the softmax vjp."""
     bh, t, d = q.shape
     block_q = _fit_block(t, block_q)
     nq = t // block_q
     qb = q.reshape(bh, nq, block_q, d)
     gb = g.reshape(bh, nq, block_q, d)
+    lb = (jnp.zeros((bh, nq, block_q, 1), jnp.float32) if glse is None
+          else glse.astype(jnp.float32).reshape(bh, nq, block_q, 1))
 
     def one_block(carry, blk):
         dk, dv = carry
-        qi, qblk, gblk = blk
+        qi, qblk, gblk, lblk = blk
         s = jnp.einsum('bqd,bkd->bqk', qblk, k).astype(
             jnp.float32) * scale                       # (bh, bq, T)
         if causal:
@@ -241,8 +244,8 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q):
         p = jax.nn.softmax(s, axis=-1)
         pv = p.astype(v.dtype)
         dp = jnp.einsum('bqd,bkd->bqk', gblk, v).astype(jnp.float32)
-        # softmax vjp: ds = p * (dp - sum(dp * p))
-        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+        # softmax vjp (+ lse cotangent): ds = p * (dp - sum(dp*p) + glse)
+        ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True) + lblk)
         dq_blk = jnp.einsum('bqk,bkd->bqd', ds, k.astype(
             jnp.float32)) * scale
         dk = dk + jnp.einsum('bqk,bqd->bkd', ds, qblk.astype(
@@ -255,7 +258,8 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q):
     (dk, dv), dq_blocks = lax.scan(
         one_block,
         (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
-        (idx, qb.transpose(1, 0, 2, 3), gb.transpose(1, 0, 2, 3)))
+        (idx, qb.transpose(1, 0, 2, 3), gb.transpose(1, 0, 2, 3),
+         lb.transpose(1, 0, 2, 3)))
     dq = dq_blocks.transpose(1, 0, 2, 3).reshape(bh, t, d)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -462,9 +466,11 @@ def _bwd_dq_stream_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
 
 
 def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
-                           interpret):
+                           interpret, glse=None):
     """HBM-streaming backward: same math as _flash_bwd_impl but no
-    operand is sequence-resident — VMEM stays O(block) for any T."""
+    operand is sequence-resident — VMEM stays O(block) for any T.
+    glse: optional cotangent on the logsumexp output — it folds exactly
+    into the D preprocess (ds = p*(dp - (D - glse)))."""
     bh, t, d = q.shape
     block_q = _fit_block(t, max(block_q, _BWD_BLOCK))
     block_k = block_q
@@ -472,6 +478,8 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
     num_kb = t // block_k
     dd = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                  axis=-1, keepdims=True)
+    if glse is not None:
+        dd = dd - glse.astype(jnp.float32)
 
     if causal:
         # fetch-clamp skipped diagonal blocks (compute is pl.when-gated)
@@ -532,7 +540,7 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
 
 
 def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
-                    interpret):
+                    interpret, glse=None):
     """Fused two-kernel backward over flat (bh, t, d) tensors."""
     bh, t, d = q.shape
     # the backward wants larger tiles than the forward: its per-tile
@@ -542,9 +550,12 @@ def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
     block_k = block_q
     num_qb = t // block_q
     num_kb = t // block_k
-    # pass 0: D_i = dO_i . O_i — one fused elementwise+reduce XLA pass
+    # pass 0: D_i = dO_i . O_i — one fused elementwise+reduce XLA pass.
+    # A logsumexp cotangent folds in here: ds = p*(dp - (D - glse)).
     dd = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                  axis=-1, keepdims=True)                    # (bh, t, 1)
+    if glse is not None:
+        dd = dd - glse.astype(jnp.float32)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
@@ -599,11 +610,15 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
+def _flash_bwd_shared(causal, scale, block_q, interpret, res, g,
+                      glse=None):
+    """Schedule-selecting backward shared by the plain and with-lse
+    custom VJPs; glse is the optional logsumexp cotangent."""
     q, k, v, o, lse = res
     b, h, t, d = q.shape
     flat = lambda x: x.reshape(b * h, t, d)
     itemsize = jnp.dtype(q.dtype).itemsize
+    glse_flat = None if glse is None else glse.reshape(b * h, t, 1)
     args = (flat(q), flat(k), flat(v), flat(g), flat(o),
             lse.reshape(b * h, t, 1), causal, scale, block_q, interpret)
     fitted = min(max(block_q, _BWD_BLOCK), t)
@@ -612,19 +627,79 @@ def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
     if 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES:
         # resident schedule: one head's full sequence (q+dO / k+v) in
         # VMEM — fewer grid steps, best for short-to-mid T
-        dq, dk, dv = _flash_bwd_impl(*args)
+        dq, dk, dv = _flash_bwd_impl(*args, glse=glse_flat)
     elif fitted >= 8:
         # streaming schedule: O(block) VMEM for any T (the long-context
         # path — T=32k+ stays on the fused Pallas kernels)
-        dq, dk, dv = _flash_bwd_stream_impl(*args)
+        dq, dk, dv = _flash_bwd_stream_impl(*args, glse=glse_flat)
     else:
         dq, dk, dv = _blocked_backward(flat(q), flat(k), flat(v),
-                                       flat(g), causal, scale, block_q)
+                                       flat(g), causal, scale, block_q,
+                                       glse=glse_flat)
     unflat = lambda x: x.reshape(b, h, t, d)
     return unflat(dq), unflat(dk), unflat(dv)
 
 
+def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
+    return _flash_bwd_shared(causal, scale, block_q, interpret, res, g)
+
+
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, scale, block_q, interpret):
+    return _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,
+                           return_lse=True)
+
+
+def _flash_lse_fwd_rule(q, k, v, causal, scale, block_q, interpret):
+    out, lse = _flash_fwd_impl(q, k, v, causal, scale, block_q,
+                               interpret, return_lse=True)
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd_rule(causal, scale, block_q, interpret, res, cts):
+    g, glse = cts
+    b, h, t, d = res[0].shape
+    return _flash_bwd_shared(causal, scale, block_q, interpret, res, g,
+                             glse=glse.reshape(b, h, t, 1))
+
+
+_flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=None, interpret=None):
+    """flash_attention variant that ALSO returns the per-row logsumexp
+    (bh, t, 1) — the merge currency for ring attention / partial
+    softmax combination — and is differentiable in BOTH outputs (the
+    lse cotangent folds into the backward's D preprocess).  Falls back
+    to a dense jnp computation when Pallas is unavailable."""
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError('flash_attention_with_lse requires equal '
+                         'q/k/v shapes; got %s / %s / %s'
+                         % (q.shape, k.shape, v.shape))
+    b, h, t, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if block_q is None:
+        block_q = max(256, min(1024, t // 32))
+    if not _HAS_PALLAS:
+        s = jnp.einsum('bhqd,bhkd->bhqk', q, k).astype(
+            jnp.float32) * scale
+        if causal:
+            mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
+            s = jnp.where(mask, s, -jnp.inf)
+        lse = jax.scipy.special.logsumexp(s, axis=-1)
+        out = jnp.einsum('bhqk,bhkd->bhqd',
+                         jnp.exp(s - lse[..., None]), v.astype(
+                             jnp.float32)).astype(q.dtype)
+        return out, lse.reshape(b * h, t, 1)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != 'tpu'
+    return _flash_lse(q, k, v, bool(causal), float(scale), int(block_q),
+                      bool(interpret))
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=None,

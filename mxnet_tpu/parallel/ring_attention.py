@@ -35,11 +35,88 @@ def _block_attn(q, k, v, scale, q_pos, k_pos, causal, m, l, o):
     return m_new, l_new, o_new
 
 
-def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+def _flash_hop(q, k_blk, v_blk, scale, my_idx, k_idx, causal, interpret):
+    """One ring hop through the Pallas flash kernel (the differentiable
+    with-lse entry point, so jax.grad flows through the whole ring):
+    returns this block's NORMALIZED partial output and its per-row
+    logsumexp, with the hop's causal relationship (past / diagonal /
+    future) selected by lax.switch so only one kernel runs."""
+    from .. import pallas_ops
+
+    b_h_t_d = q.shape  # (B, H, T_local, D)
+
+    def past(_):
+        out, lse = pallas_ops.flash_attention_with_lse(
+            q, k_blk, v_blk, causal=False, scale=scale,
+            interpret=interpret)
+        return out.astype(jnp.float32), lse
+
+    def diag(_):
+        out, lse = pallas_ops.flash_attention_with_lse(
+            q, k_blk, v_blk, causal=True, scale=scale,
+            interpret=interpret)
+        return out.astype(jnp.float32), lse
+
+    def future(_):
+        bh = b_h_t_d[0] * b_h_t_d[1]
+        return (jnp.zeros(b_h_t_d, jnp.float32),
+                jnp.full((bh, b_h_t_d[2], 1), -jnp.inf, jnp.float32))
+
+    if not causal:
+        return past(None)
+    case = jnp.clip(k_idx - my_idx + 1, 0, 2)  # 0 past, 1 diag, 2 future
+    return lax.switch(case, [past, diag, future], None)
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale, interpret):
+    """Flash-kernel ring: each hop's local attention runs through the
+    Pallas kernel (O(block) VMEM, no T_local^2 scores); hops combine in
+    flash style — unnormalized output accumulator + running max +
+    running weight sum over the per-hop logsumexps."""
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, t_local, d = q.shape
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def body(carry, _):
+        k_blk, v_blk, k_idx, o_u, m, l = carry
+        o_new, lse_new = _flash_hop(q, k_blk, v_blk, scale, idx, k_idx,
+                                    causal, interpret)
+        lse_new = lse_new.reshape(b, h, t_local, 1)
+        m2 = jnp.maximum(m, lse_new)
+        safe_m2 = jnp.where(jnp.isfinite(m2), m2, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m2), 0.0)
+        w = jnp.where(jnp.isfinite(lse_new),
+                      jnp.exp(lse_new - safe_m2), 0.0)
+        o_u = o_u * corr + o_new * w
+        l = l * corr + w
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        k_idx = lax.ppermute(k_idx, axis_name, perm)
+        return (k_blk, v_blk, k_idx, o_u, m2, l), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
+    if hasattr(lax, 'pvary'):
+        o0, m0, l0 = (lax.pvary(t, (axis_name,)) for t in (o0, m0, l0))
+    (_, _, _, o_u, _, l), _ = lax.scan(body, (k, v, idx, o0, m0, l0),
+                                       None, length=n)
+    return (o_u / jnp.maximum(l, 1e-37)).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None,
+                   use_flash=False):
     """Attention over a sequence sharded on `axis_name`.
 
     Call inside shard_map/pjit-sharded code.  q,k,v: [..., T_local, D]
     local shards; returns the local output shard [..., T_local, D].
+
+    use_flash=True routes each hop's local attention through the Pallas
+    streaming kernel (4-D [B, H, T_local, D] shards only): peak memory
+    drops from O(T_local^2) scores to O(block * T_local), which is what
+    makes long per-shard sequences viable.  Hops combine by the
+    associative logsumexp merge, so numerics match the XLA path.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -47,6 +124,11 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if use_flash:
+        assert q.ndim == 4, 'use_flash needs [B, H, T_local, D] shards'
+        interpret = jax.devices()[0].platform != 'tpu'
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale,
+                                     interpret)
     q_pos = idx * t_local + jnp.arange(t_local)
     perm = [(j, (j - 1) % n) for j in range(n)]  # send to previous; recv from next
 
@@ -73,13 +155,19 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     return out.astype(q.dtype)
 
 
-def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False):
-    """Wrapper: full [B, H, T, D] arrays, T sharded over `seq_axis`."""
+def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False,
+                        use_flash=False):
+    """Wrapper: full [B, H, T, D] arrays, T sharded over `seq_axis`.
+    use_flash routes each hop through the Pallas kernel (Pallas calls
+    carry no vma metadata, so the flash path disables shard_map's vma
+    checking for this call)."""
     from jax import shard_map
     spec = P(None, None, seq_axis, None)
+    kwargs = {'check_vma': False} if use_flash else {}
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        functools.partial(ring_attention, axis_name=seq_axis,
+                          causal=causal, use_flash=use_flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
     return fn(q, k, v)
 
 
