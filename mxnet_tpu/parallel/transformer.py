@@ -26,9 +26,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .ring_attention import ring_attention
 
 
-def lm_config(vocab=64, dim=32, heads=4, layers=2, mlp_mult=4):
+def lm_config(vocab=64, dim=32, heads=4, layers=2, mlp_mult=4,
+              use_flash=False):
+    """use_flash routes the sp ring attention through the Pallas
+    kernels (flash-merge hops; see ring_attention) — the long-context
+    setting.  Default off: tiny shapes (tests, dryruns) are faster and
+    simpler on the XLA path."""
     return dict(vocab=vocab, dim=dim, heads=heads, layers=layers,
-                mlp_mult=mlp_mult, head_dim=dim // heads)
+                mlp_mult=mlp_mult, head_dim=dim // heads,
+                use_flash=use_flash)
 
 
 def init_params(cfg, key, dtype=jnp.float32):
@@ -86,7 +92,8 @@ def _local_forward(cfg, params, tokens):
             b, tt, _ = t.shape
             return t.reshape(b, tt, heads_local, dh).transpose(0, 2, 1, 3)
         q, k, v = split_heads(q), split_heads(k), split_heads(v)
-        att = ring_attention(q, k, v, 'sp', causal=True)  # [B,h,T,dh]
+        att = ring_attention(q, k, v, 'sp', causal=True,
+                             use_flash=cfg.get('use_flash', False))
         att = att.transpose(0, 2, 1, 3).reshape(
             x.shape[0], x.shape[1], heads_local * dh)
         o = jnp.einsum('btf,fd->btd', att, lp['wo'])
