@@ -1,0 +1,76 @@
+"""Profile and introspect a training run.
+
+Capability demonstrated (reference example/profiler role + the Monitor
+surface): mx.profiler producing a Chrome-trace JSON of host spans and
+device lanes, plus mx.mon.Monitor streaming per-layer output statistics
+during training, and visualization.print_summary for the parameter
+census — the observability toolkit in one script.
+
+Run: python examples/profiling/profile_training.py [--quick]
+"""
+import argparse
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def build_net():
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=32, name='fc1')
+    net = sym.Activation(net, act_type='relu', name='relu1')
+    net = sym.FullyConnected(net, num_hidden=4, name='fc2')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def main(quick=False):
+    n = 512
+    batch_size = 64
+    rs = np.random.RandomState(0)
+    centers = 3.0 * rs.randn(4, 16)
+    y = (np.arange(n) % 4).astype(np.float32)
+    X = (centers[y.astype(int)] + rs.randn(n, 16)).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, batch_size=batch_size, shuffle=True)
+
+    net = build_net()
+    # 1) parameter census before training
+    mx.visualization.print_summary(net, shape={'data': (batch_size, 16)})
+
+    # 2) per-layer statistics every other batch via Monitor
+    seen = []
+    mon = mx.mon.Monitor(2, stat_func=lambda a: mx.nd.max(mx.nd.abs(a)),
+                         pattern='fc.*')
+    mod = mx.mod.Module(net, label_names=['softmax_label'])
+    mod.fit(train, optimizer='adam',
+            optimizer_params={'learning_rate': 5e-3}, num_epoch=2,
+            monitor=mon,
+            batch_end_callback=lambda p: seen.append(p.nbatch))
+
+    # 3) a profiled step dumped as a Chrome trace
+    trace_path = os.path.join(tempfile.mkdtemp(), 'train_profile.json')
+    mx.profiler.profiler_set_config(mode='symbolic', filename=trace_path)
+    mx.profiler.profiler_set_state('run')
+    train.reset()
+    batch = next(iter(train))
+    mod.forward_backward(batch)
+    mod.update()
+    mx.nd.waitall() if hasattr(mx.nd, 'waitall') else None
+    mx.profiler.profiler_set_state('stop')
+    dumped = mx.profiler.dump_profile()
+    with open(dumped) as f:
+        events = json.load(f)['traceEvents']
+    spans = [e for e in events if e.get('ph') == 'X']
+    print('profiler captured %d spans -> %s' % (len(spans), dumped))
+    mx.profiler.clear()
+    return len(spans), seen
+
+
+if __name__ == '__main__':
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true')
+    spans, seen = main(quick=ap.parse_args().quick)
+    assert spans > 0 and seen, (spans, seen)

@@ -75,3 +75,16 @@ def test_sgld_regression():
     mu_err, sd, ratio = mod.main(quick=True)
     assert mu_err < 6 * sd, (mu_err, sd)
     assert 0.3 < ratio < 3.0, ratio
+
+
+def test_csv_tabular():
+    mod = _load('examples/csv_tabular/csv_train.py', 'ex_csv')
+    acc = mod.main(quick=True)
+    assert acc > 0.9, acc
+
+
+def test_profiling_example():
+    mod = _load('examples/profiling/profile_training.py', 'ex_prof')
+    spans, seen = mod.main(quick=True)
+    assert spans > 0, spans
+    assert seen, seen
