@@ -685,7 +685,12 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
         scale = 1.0 / (d ** 0.5)
     if block_q is None:
         block_q = max(256, min(1024, t // 32))
-    if not _HAS_PALLAS:
+    # dense fallback: no Pallas, or a sequence length with no usable
+    # power-of-two block factor (natively differentiable either way)
+    fitted = min(block_q, t)
+    while t % fitted:
+        fitted //= 2
+    if not _HAS_PALLAS or (fitted < 8 and t > 8):
         s = jnp.einsum('bhqd,bhkd->bhqk', q, k).astype(
             jnp.float32) * scale
         if causal:

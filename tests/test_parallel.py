@@ -495,6 +495,53 @@ def test_pallas_flash_streaming_backward():
                                        rtol=5e-3, atol=5e-4)
 
 
+def test_flash_attention_with_lse():
+    """The with-lse entry point: out/lse match the dense formulas, the
+    lse cotangent is honored (the ring-merge currency), and odd
+    sequence lengths fall back to the dense path."""
+    from mxnet_tpu import pallas_ops
+    rs = np.random.RandomState(4)
+    B, H, T, D = 1, 2, 64, 16
+    q, k, v = (jnp.asarray(rs.randn(B, H, T, D).astype(np.float32) * 0.4)
+               for _ in range(3))
+    out, lse = pallas_ops.flash_attention_with_lse(q, k, v, causal=True,
+                                                   interpret=True)
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * (D ** -0.5)
+    mask = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+    s = jnp.where(mask, s, -jnp.inf)
+    lse_ref = jax.scipy.special.logsumexp(s, axis=-1)
+    out_ref = jnp.einsum('bhqk,bhkd->bhqd',
+                         jnp.exp(s - lse_ref[..., None]), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse).reshape(B, H, T),
+                               np.asarray(lse_ref), rtol=2e-4, atol=2e-5)
+
+    w = jnp.asarray(rs.randn(B * H, T, 1).astype(np.float32) * 0.3)
+
+    def loss_flash(q):
+        o, l = pallas_ops.flash_attention_with_lse(q, k, v, causal=True,
+                                                   interpret=True)
+        return (o * out_ref).sum() + (l * w).sum()
+
+    def loss_dense(q):
+        s = jnp.einsum('bhqd,bhkd->bhqk', q, k) * (D ** -0.5)
+        s = jnp.where(mask, s, -jnp.inf)
+        l = jax.scipy.special.logsumexp(s, axis=-1)
+        o = jnp.einsum('bhqk,bhkd->bhqd', jnp.exp(s - l[..., None]), v)
+        return (o * out_ref).sum() + (l.reshape(B * H, T, 1) * w).sum()
+
+    gf = jax.grad(loss_flash)(q)
+    gd = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                               rtol=5e-4, atol=5e-5)
+
+    # prime-ish length -> dense fallback, still correct
+    qq = jnp.asarray(rs.randn(1, 1, 30, 8).astype(np.float32))
+    o2, l2 = pallas_ops.flash_attention_with_lse(qq, qq, qq)
+    assert o2.shape == qq.shape and l2.shape == (1, 30, 1)
+
+
 def test_pallas_flash_rejects_cross_attention():
     from mxnet_tpu import pallas_ops
     q = jnp.ones((1, 1, 4, 8))
