@@ -16,6 +16,18 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def _mark_varying(t, axis_name):
+    """Mark an accumulator as varying over the ring axis so scan carry
+    types line up under JAX's manual-axes (vma) checking.  pcast is the
+    jax>=0.9 spelling; pvary its deprecated predecessor; older JAX has
+    neither and needs no marking."""
+    if hasattr(lax, 'pcast'):
+        return lax.pcast(t, (axis_name,), to='varying')
+    if hasattr(lax, 'pvary'):
+        return lax.pvary(t, (axis_name,))
+    return t
+
+
 def _block_attn(q, k, v, scale, q_pos, k_pos, causal, m, l, o):
     """One block's contribution with online-softmax accumulation."""
     s = jnp.einsum('...qd,...kd->...qk', q, k) * scale
@@ -98,8 +110,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale, interpret):
     o0 = jnp.zeros(q.shape, jnp.float32)
     m0 = jnp.full((b, h, t_local, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t_local, 1), jnp.float32)
-    if hasattr(lax, 'pvary'):
-        o0, m0, l0 = (lax.pvary(t, (axis_name,)) for t in (o0, m0, l0))
+    o0, m0, l0 = (_mark_varying(t, axis_name) for t in (o0, m0, l0))
     (_, _, _, o_u, _, l), _ = lax.scan(body, (k, v, idx, o0, m0, l0),
                                        None, length=n)
     return (o_u / jnp.maximum(l, 1e-37)).astype(q.dtype)
@@ -145,10 +156,9 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
     m0 = jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32)
     l0 = jnp.zeros(q.shape[:-1], dtype=jnp.float32)
     o0 = jnp.zeros(q.shape, dtype=jnp.float32)
-    if hasattr(lax, 'pvary'):
-        # mark accumulators as varying over the ring axis so scan carry
-        # types line up under JAX's manual-axes checking
-        m0, l0, o0 = (lax.pvary(t, (axis_name,)) for t in (m0, l0, o0))
+    # mark accumulators as varying over the ring axis so scan carry
+    # types line up under JAX's manual-axes checking
+    m0, l0, o0 = (_mark_varying(t, axis_name) for t in (m0, l0, o0))
     (k, v, _, m, l, o), _ = lax.scan(
         body, (k, v, idx, m0, l0, o0), None, length=n)
     out = o / jnp.maximum(l, 1e-37)[..., None]
