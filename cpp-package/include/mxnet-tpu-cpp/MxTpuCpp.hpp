@@ -98,6 +98,18 @@ int MXTKVStoreInit(void*, const char*, void*);
 int MXTKVStorePush(void*, const char*, void*);
 int MXTKVStorePull(void*, const char*, void*);
 void MXTKVStoreFree(void*);
+int MXTImperativeInvoke(const char*, uint32_t, void**, uint32_t,
+                        const char**, const char**, uint32_t*, void**,
+                        uint32_t);
+int MXTAutogradSetIsRecording(int, int*);
+int MXTAutogradSetIsTraining(int, int*);
+int MXTAutogradMarkVariables(uint32_t, void**, const char**);
+int MXTAutogradBackward(uint32_t, void**, int);
+int MXTNDArrayGetGrad(void*, void**);
+int MXTCachedOpCreate(void*, void**);
+int MXTCachedOpInvoke(void*, uint32_t, void**, uint32_t*, void**,
+                      uint32_t);
+void MXTCachedOpFree(void*);
 }
 
 namespace mxtpu {

@@ -17,11 +17,13 @@ from what Python users get.
 """
 import numpy as np
 
+from . import autograd as ag
 from . import context as ctx_mod
 from . import kvstore as kv_mod
 from . import ndarray as nd
 from . import optimizer as opt_mod
 from . import symbol as sym_mod
+from .ops import registry as _reg
 
 
 def _ctx(dev_type, dev_id):
@@ -186,6 +188,116 @@ def ex_grad(ex, name):
     if grad is None:
         raise KeyError('no gradient bound for %r' % name)
     return grad
+
+
+# -- Imperative invoke + autograd -------------------------------------------
+
+def imperative_invoke(op_name, inputs, attr_keys, attr_vals):
+    """Run any registered op by name on NDArray inputs (reference
+    MXImperativeInvoke, c_api_ndarray.cc:423).  Attr values arrive as
+    strings — the same convention symbol composition uses; ops parse
+    their own attrs.  -> list of output NDArrays."""
+    if not _reg.exists(op_name):
+        raise ValueError('unknown operator %r' % op_name)
+    out = nd.invoke(op_name, list(inputs), dict(zip(attr_keys, attr_vals)))
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def autograd_set_recording(flag):
+    """-> previous state (reference MXAutogradSetIsRecording)."""
+    prev = ag.is_recording()
+    ag.set_recording(bool(flag))
+    return int(prev)
+
+
+def autograd_set_training(flag):
+    prev = ag.is_training()
+    ag.set_training(bool(flag))
+    return int(prev)
+
+
+def autograd_mark_variables(variables, grad_reqs):
+    ag.mark_variables(list(variables), grad_reqs=list(grad_reqs))
+
+
+def autograd_backward(heads, retain_graph):
+    ag.backward(list(heads), retain_graph=bool(retain_graph))
+
+
+def nd_get_grad(arr):
+    """Gradient buffer attached by mark_variables + backward (reference
+    MXNDArrayGetGrad)."""
+    if arr._grad is None:
+        raise ValueError('array has no gradient: mark it with '
+                         'MXTAutogradMarkVariables and run backward first')
+    return arr._grad
+
+
+# -- CachedOp ---------------------------------------------------------------
+
+class _CachedOp(object):
+    """Mini-JIT graph replay (reference CachedOp, c_api_ndarray.cc:464).
+
+    TPU-native design: the symbol's whole DAG executes as ONE jitted XLA
+    callable per distinct input signature (shape/dtype/context), and the
+    invocation is tape-recorded as a single op — so an enclosing
+    autograd.record() scope differentiates straight through the cached
+    graph, exactly like the reference's CachedOp under MXAutogradBackward.
+    Inputs arrive in list_arguments() + list_auxiliary_states() order.
+    """
+
+    def __init__(self, sym):
+        self._sym = sym
+        self.arg_names = sym.list_arguments()
+        self.aux_names = sym.list_auxiliary_states()
+        self.n_outputs = len(sym.list_outputs())
+        self._cache = {}
+
+    def _compiled(self, args, ctx):
+        import jax
+        key = (str(ctx),) + tuple((tuple(a.shape), str(a.dtype))
+                                  for a in args)
+        fn = self._cache.get(key)
+        if fn is None:
+            shapes = {n: tuple(a.shape)
+                      for n, a in zip(self.arg_names, args)}
+            ex = self._sym.simple_bind(ctx, grad_req='null', **shapes)
+            fn = jax.jit(ex._run_graph, static_argnums=(3,))
+            self._cache[key] = fn
+        return fn
+
+    def invoke(self, inputs):
+        n_args = len(self.arg_names)
+        n_aux = len(self.aux_names)
+        if len(inputs) != n_args + n_aux:
+            raise ValueError(
+                'CachedOp expects %d inputs (%d args + %d aux), got %d'
+                % (n_args + n_aux, n_args, n_aux, len(inputs)))
+        args, auxs = list(inputs[:n_args]), list(inputs[n_args:])
+        ctx = args[0].context if args else ctx_mod.current_context()
+        fn = self._compiled(args, ctx)
+
+        def fcompute(attrs, in_data, aux_data, op_ctx):
+            outs, new_aux = fn(tuple(in_data[:n_args]),
+                               tuple(in_data[n_args:]),
+                               op_ctx.rng, op_ctx.is_train)
+            return list(outs) + list(new_aux), []
+
+        results = nd.invoke_fn(fcompute, args + auxs, name='_cached_op')
+        outs = results[:self.n_outputs]
+        # write updated auxiliary state (BN moving stats) back into the
+        # caller's arrays, mirroring executor semantics
+        for holder, new in zip(auxs, results[self.n_outputs:]):
+            holder._data = new._data
+        return outs
+
+
+def cached_op_create(sym):
+    return _CachedOp(sym)
+
+
+def cached_op_invoke(op, inputs):
+    return op.invoke(list(inputs))
 
 
 # -- Optimizer --------------------------------------------------------------

@@ -697,4 +697,178 @@ int MXTKVStorePull(void* kv, const char* key, void* nd) {
 
 void MXTKVStoreFree(void* handle) { MXTNDArrayFree(handle); }
 
+// -- Imperative invoke + autograd ------------------------------------------
+//
+// The reference's imperative heart (MXImperativeInvoke,
+// /root/reference/src/c_api/c_api_ndarray.cc:423): any registered op,
+// by name, on NDArray handles — plus autograd record/backward
+// (c_api_ndarray.cc:545-621) so a C caller can differentiate outside a
+// bound executor, and the CachedOp mini-JIT (c_api_ndarray.cc:464-485).
+
+namespace {
+
+PyObject* handle_list(uint32_t n, void** handles) {
+  PyObject* list = PyList_New(n);
+  if (list == nullptr) return nullptr;
+  for (uint32_t i = 0; i < n; ++i) {
+    PyObject* o = obj_of(handles[i]);
+    Py_INCREF(o);
+    PyList_SET_ITEM(list, i, o);
+  }
+  return list;
+}
+
+// Unpack a bridge list of NDArrays into caller-supplied handle slots.
+int unpack_outputs(PyObject* list, uint32_t max_outputs,
+                   uint32_t* num_outputs, void** outputs) {
+  Py_ssize_t n = PyList_GET_SIZE(list);
+  if (static_cast<uint32_t>(n) > max_outputs) {
+    train_last_error = "output array too small: need " +
+                       std::to_string(n) + " slots";
+    return -1;
+  }
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GET_ITEM(list, i);
+    Py_INCREF(o);
+    outputs[i] = wrap(o);
+  }
+  *num_outputs = static_cast<uint32_t>(n);
+  return 0;
+}
+
+}  // namespace
+
+// Run a registered operator imperatively.  `outputs` is a caller array
+// with `max_outputs` slots; on success `*num_outputs` handles are
+// written (each freed with MXTNDArrayFree).
+int MXTImperativeInvoke(const char* op_name, uint32_t num_inputs,
+                        void** inputs, uint32_t num_params,
+                        const char** param_keys, const char** param_vals,
+                        uint32_t* num_outputs, void** outputs,
+                        uint32_t max_outputs) {
+  *num_outputs = 0;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* ins = handle_list(num_inputs, inputs);
+  PyObject* keys = str_list(num_params, param_keys);
+  PyObject* vals = str_list(num_params, param_vals);
+  PyObject* outs = nullptr;
+  if (ins && keys && vals)
+    outs = call("imperative_invoke", "(sOOO)", op_name, ins, keys, vals);
+  Py_XDECREF(ins);
+  Py_XDECREF(keys);
+  Py_XDECREF(vals);
+  if (outs == nullptr) return -1;
+  int rc = unpack_outputs(outs, max_outputs, num_outputs, outputs);
+  Py_DECREF(outs);
+  return rc;
+}
+
+// Toggle tape recording / train mode; previous state lands in *prev
+// (reference MXAutogradSetIsRecording / MXAutogradSetIsTraining).
+int MXTAutogradSetIsRecording(int flag, int* prev) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* r = call("autograd_set_recording", "(i)", flag);
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTAutogradSetIsTraining(int flag, int* prev) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* r = call("autograd_set_training", "(i)", flag);
+  if (r == nullptr) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+// Attach gradient buffers to arrays (reference MXAutogradMarkVariables).
+// grad_reqs may be null (every variable gets 'write').
+int MXTAutogradMarkVariables(uint32_t num, void** vars,
+                             const char** grad_reqs) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* vs = handle_list(num, vars);
+  PyObject* reqs;
+  if (grad_reqs != nullptr) {
+    reqs = str_list(num, grad_reqs);
+  } else {
+    reqs = PyList_New(num);
+    if (reqs != nullptr)
+      for (uint32_t i = 0; i < num; ++i)
+        PyList_SET_ITEM(reqs, i, PyUnicode_FromString("write"));
+  }
+  PyObject* r = nullptr;
+  if (vs && reqs) r = call("autograd_mark_variables", "(OO)", vs, reqs);
+  Py_XDECREF(vs);
+  Py_XDECREF(reqs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Backprop from heads through the recorded tape (reference
+// MXAutogradBackwardEx); gradients land in the marked variables'
+// buffers, readable via MXTNDArrayGetGrad.
+int MXTAutogradBackward(uint32_t num_heads, void** heads,
+                        int retain_graph) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* hs = handle_list(num_heads, heads);
+  if (hs == nullptr) return -1;
+  PyObject* r = call("autograd_backward", "(Oi)", hs, retain_graph);
+  Py_DECREF(hs);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// The gradient buffer of a marked variable (reference MXNDArrayGetGrad).
+int MXTNDArrayGetGrad(void* handle, void** out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject* g = call("nd_get_grad", "(O)", obj_of(handle));
+  if (g == nullptr) return -1;
+  *out = wrap(g);
+  return 0;
+}
+
+// -- CachedOp --------------------------------------------------------------
+
+// Compile a symbol for repeated imperative invocation (reference
+// MXCreateCachedOp).  Invocation inputs arrive in list_arguments() +
+// list_auxiliary_states() order; each distinct input signature jits
+// once and replays thereafter.  Invoked under recording, the whole
+// cached graph differentiates as one tape op.
+int MXTCachedOpCreate(void* sym, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* op = call("cached_op_create", "(O)", obj_of(sym));
+  if (op == nullptr) return -1;
+  *out = wrap(op);
+  return 0;
+}
+
+int MXTCachedOpInvoke(void* cached, uint32_t num_inputs, void** inputs,
+                      uint32_t* num_outputs, void** outputs,
+                      uint32_t max_outputs) {
+  *num_outputs = 0;
+  GIL gil;
+  PyObject* ins = handle_list(num_inputs, inputs);
+  if (ins == nullptr) return -1;
+  PyObject* outs = call("cached_op_invoke", "(OO)", obj_of(cached), ins);
+  Py_DECREF(ins);
+  if (outs == nullptr) return -1;
+  int rc = unpack_outputs(outs, max_outputs, num_outputs, outputs);
+  Py_DECREF(outs);
+  return rc;
+}
+
+void MXTCachedOpFree(void* handle) { MXTNDArrayFree(handle); }
+
 }  // extern "C"

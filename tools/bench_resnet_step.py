@@ -27,12 +27,9 @@ import glob
 import gzip
 import json
 import os
-import sys
 import time
 
 import numpy as np
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -221,6 +218,12 @@ def profile_dispatch(run, params, mom, x, labels, outdir, topk=40):
             continue
         pname = pid_name.get(ev.get('pid'), '')
         if not any(k in pname.lower() for k in ('tpu', 'device', 'xla')):
+            continue
+        # leaf HLO kernels only: module-level spans (jit_* / while bodies)
+        # nest the per-kernel spans and would double-count the totals
+        args = ev.get('args', {})
+        cat = args.get('hlo_category')
+        if cat is None or cat == 'while':
             continue
         dur = ev.get('dur', 0)
         lane_total[pname] = lane_total.get(pname, 0) + dur
