@@ -110,6 +110,16 @@ int MXTCachedOpCreate(void*, void**);
 int MXTCachedOpInvoke(void*, uint32_t, void**, uint32_t*, void**,
                       uint32_t);
 void MXTCachedOpFree(void*);
+int MXTListDataIters(uint32_t*, const char***);
+int MXTDataIterCreate(const char*, uint32_t, const char**, const char**,
+                      void**);
+int MXTDataIterBeforeFirst(void*);
+int MXTDataIterNext(void*, int*);
+int MXTDataIterGetData(void*, void**);
+int MXTDataIterGetLabel(void*, void**);
+int MXTDataIterGetPadNum(void*, int*);
+void MXTDataIterFree(void*);
+int MXTNDArrayCopyFromNDArray(void*, void*);
 }
 
 namespace mxtpu {
@@ -348,6 +358,11 @@ class NDArray {
   void CopyFrom(const std::vector<float>& data) {
     CheckT(MXTNDArraySyncCopyFromCPU(handle_, data.data(), data.size()),
            "MXTNDArraySyncCopyFromCPU");
+  }
+  // Device-side refill from another NDArray (no host round-trip).
+  void CopyFrom(const NDArray& src) {
+    CheckT(MXTNDArrayCopyFromNDArray(handle_, src.handle()),
+           "MXTNDArrayCopyFromNDArray");
   }
   std::vector<float> ToVector() const {
     Shape s = GetShape();
@@ -700,6 +715,71 @@ class KVStore {
   void Pull(const std::string& key, NDArray* out) {
     CheckT(MXTKVStorePull(handle_, key.c_str(), out->handle()),
            "MXTKVStorePull");
+  }
+
+ private:
+  void* handle_ = nullptr;
+};
+
+// Data iterator over the framework's IO pipeline (reference
+// MXDataIterCreateIter family; trains from .rec/.csv files without
+// Python in the caller).  Params are the same strings the Python
+// constructors take, e.g. {{"path_imgrec", "train.rec"},
+// {"data_shape", "(3,28,28)"}, {"batch_size", "16"}}.
+class DataIter {
+ public:
+  DataIter(const std::string& name,
+           const std::vector<std::pair<std::string, std::string>>& params) {
+    std::vector<const char*> pk, pv;
+    for (const auto& kv : params) {
+      pk.push_back(kv.first.c_str());
+      pv.push_back(kv.second.c_str());
+    }
+    CheckT(MXTDataIterCreate(name.c_str(),
+                             static_cast<uint32_t>(pk.size()), pk.data(),
+                             pv.data(), &handle_),
+           "MXTDataIterCreate");
+  }
+  DataIter(DataIter&& o) noexcept : handle_(o.handle_) {
+    o.handle_ = nullptr;
+  }
+  DataIter& operator=(DataIter&& o) noexcept {
+    std::swap(handle_, o.handle_);
+    return *this;
+  }
+  DataIter(const DataIter&) = delete;
+  DataIter& operator=(const DataIter&) = delete;
+  ~DataIter() {
+    if (handle_ != nullptr) MXTDataIterFree(handle_);
+  }
+  static std::vector<std::string> List() {
+    uint32_t n = 0;
+    const char** names = nullptr;
+    CheckT(MXTListDataIters(&n, &names), "MXTListDataIters");
+    return std::vector<std::string>(names, names + n);
+  }
+  void BeforeFirst() {
+    CheckT(MXTDataIterBeforeFirst(handle_), "MXTDataIterBeforeFirst");
+  }
+  bool Next() {
+    int has = 0;
+    CheckT(MXTDataIterNext(handle_, &has), "MXTDataIterNext");
+    return has != 0;
+  }
+  NDArray GetData() const {
+    void* h = nullptr;
+    CheckT(MXTDataIterGetData(handle_, &h), "MXTDataIterGetData");
+    return NDArray::FromHandle(h);
+  }
+  NDArray GetLabel() const {
+    void* h = nullptr;
+    CheckT(MXTDataIterGetLabel(handle_, &h), "MXTDataIterGetLabel");
+    return NDArray::FromHandle(h);
+  }
+  int GetPadNum() const {
+    int pad = 0;
+    CheckT(MXTDataIterGetPadNum(handle_, &pad), "MXTDataIterGetPadNum");
+    return pad;
   }
 
  private:

@@ -263,6 +263,12 @@ class _CachedOp(object):
                       for n, a in zip(self.arg_names, args)}
             ex = self._sym.simple_bind(ctx, grad_req='null', **shapes)
             fn = jax.jit(ex._run_graph, static_argnums=(3,))
+            # run_graph takes its values positionally; the executor's
+            # bound zero-arrays are dead weight the cached jit closure
+            # would otherwise pin for the CachedOp's lifetime
+            ex.arg_dict.clear()
+            ex.grad_dict.clear()
+            ex.aux_dict.clear()
             self._cache[key] = fn
         return fn
 
@@ -318,6 +324,109 @@ def updater_create(opt_name, attr_keys, attr_vals):
 
 def updater_step(updater, index, grad, weight):
     updater(int(index), grad, weight)
+
+
+# -- DataIter ---------------------------------------------------------------
+#
+# The reference exposes its data pipeline to every binding through
+# MXListDataIters / MXDataIterCreateIter / Next / GetData / GetLabel
+# (/root/reference/src/c_api/c_api.cc iter block; include/mxnet/c_api.h)
+# — its C++/Scala/R frontends all train from .rec files through it.
+# Same contract here: create by registered name with string params.
+
+def _parse_iter_param(value):
+    s = str(value).strip()
+    low = s.lower()
+    if low in ('true', 'false'):
+        return low == 'true'
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    if s.startswith('(') and s.endswith(')'):
+        items = [x for x in s[1:-1].split(',') if x.strip()]
+        return tuple(int(float(x)) for x in items)
+    return value
+
+
+def _iter_registry():
+    from . import io as io_mod
+    # the string-creatable iterators (NDArrayIter needs in-memory
+    # arrays, so like the reference it is not in the C create registry)
+    return {
+        'CSVIter': io_mod.CSVIter,
+        'ImageRecordIter': io_mod.ImageRecordIter,
+        'MNISTIter': io_mod.MNISTIter,
+    }
+
+
+def list_data_iters():
+    return sorted(_iter_registry().keys())
+
+
+class _CDataIter(object):
+    """C-handle wrapper: the iterator plus its current batch, so
+    GetData/GetLabel have a stable batch to hand out between Next
+    calls (the reference's DataIter::Value() contract)."""
+
+    def __init__(self, it):
+        self.it = it
+        self.cur = None
+
+
+def data_iter_create(name, keys, vals):
+    registry = _iter_registry()
+    if name not in registry:
+        raise ValueError('unknown data iter %r (have: %s)'
+                         % (name, ', '.join(sorted(registry))))
+    kwargs = {k: _parse_iter_param(v) for k, v in zip(keys, vals)}
+    return _CDataIter(registry[name](**kwargs))
+
+
+def data_iter_before_first(handle):
+    handle.it.reset()
+    handle.cur = None
+
+
+def data_iter_next(handle):
+    try:
+        handle.cur = handle.it.next()
+    except StopIteration:
+        handle.cur = None
+        return 0
+    return 1
+
+
+def _current_batch(handle):
+    if handle.cur is None:
+        raise ValueError('no current batch: call Next first')
+    return handle.cur
+
+
+def data_iter_get_data(handle):
+    return _current_batch(handle).data[0]
+
+
+def data_iter_get_label(handle):
+    return _current_batch(handle).label[0]
+
+
+def data_iter_get_pad(handle):
+    return int(_current_batch(handle).pad or 0)
+
+
+def nd_copy_from_nd(dst, src):
+    """Device-side refill: dst[:] = src (the reference's
+    _copyto/_load_general path; used by C callers to feed executor-bound
+    arrays from iterator batches without a host round-trip)."""
+    if tuple(dst.shape) != tuple(src.shape):
+        raise ValueError('shape mismatch: dst %s vs src %s'
+                         % (dst.shape, src.shape))
+    dst[:] = src
 
 
 # -- KVStore ----------------------------------------------------------------

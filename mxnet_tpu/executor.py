@@ -203,6 +203,64 @@ class Executor:
         self._has_aux_always = any(
             n.op is not None and n.op.mutable_aux and n.op.aux_always
             for n in topo)
+
+        # -- input-BN / conv linearity split (MXNET_TPU_STEM_SPLIT) -------
+        # Pattern: Convolution(no_bias) fed by BatchNorm(fix_gamma=True)
+        # whose own input carries no gradient (a data leaf, possibly
+        # through Cast) — the ResNet "bn_data" stem.  Autodiff of the
+        # straight form needs dL/d(bn_out) = full-batch conv dgrad just
+        # to reduce it to dβ (C numbers); measured 4.1 ms at 220 GB/s on
+        # ResNet-50 batch 256 (docs/PERF.md round 5).  Because conv is
+        # linear in its input,  conv(x̂γ + β·1) = conv(x̂γ) + conv(β·1),
+        # and the second term is a batch-1 conv of a constant image — so
+        # computing the split form gives autodiff a β path that costs a
+        # batch-1 dgrad (~1/N of the work) and lets XLA drop the
+        # full-batch dgrad entirely (x̂γ needs no gradient).
+        split_bn = set()       # BN node idx: compute with β zeroed
+        split_conv = {}        # conv node idx -> its BN node idx
+        if os.environ.get('MXNET_TPU_STEM_SPLIT', '1') not in ('0', ''):
+            from .ops.registry import asbool as _asbool, \
+                astuple as _astuple
+            uses = {}
+            for n in topo:
+                for src, oi in n.inputs:
+                    uses[(id(src), oi)] = uses.get((id(src), oi), 0) + 1
+            for n, oi in sym._outputs:
+                uses[(id(n), oi)] = uses.get((id(n), oi), 0) + 1
+
+            def _grad_free(n):
+                while n.op is not None and n.op.name == 'Cast':
+                    n = n.inputs[0][0]
+                if n.op is not None:
+                    return False
+                if n.name in aux_pos:
+                    return True
+                return self._grad_req.get(n.name, 'null') == 'null'
+
+            for ci, cnode in enumerate(topo):
+                if cnode.op is None or cnode.op.name != 'Convolution':
+                    continue
+                if not _asbool(cnode.attrs.get('no_bias', False)):
+                    continue
+                if len(_astuple(cnode.attrs.get('kernel', ()))) != 2:
+                    continue
+                bnode, boi = cnode.inputs[0]
+                if bnode.op is None or bnode.op.name != 'BatchNorm' \
+                        or boi != 0:
+                    continue
+                if not _asbool(bnode.attrs.get('fix_gamma', False)):
+                    continue
+                if _asbool(bnode.attrs.get('output_mean_var', False)):
+                    continue
+                if uses.get((id(bnode), 0), 0) != 1:
+                    continue
+                if not _grad_free(bnode.inputs[0][0]):
+                    continue
+                bi = node_index[id(bnode)]
+                split_bn.add(bi)
+                split_conv[ci] = bi
+        # introspection (tests assert the pattern engaged)
+        self._split_conv = dict(split_conv)
         pref = os.environ.get('MXNET_TPU_LAYOUT_OPT', 'auto')
         if pref == '1':
             layout_opt = True
@@ -223,6 +281,10 @@ class Executor:
             results = [None] * len(topo)   # per node: list of outputs
             layouts = [None] * len(topo)   # per node: layout per output
             new_aux = list(aux_vals)
+            # collect_all (monitor) must expose every node's TRUE output,
+            # so the β-split is disabled for that mode
+            do_split = not collect_all
+            split_beta = {}                # BN node idx -> β value
             for ni, node in enumerate(topo):
                 if node.op is None:
                     if node.name in arg_pos:
@@ -284,7 +346,27 @@ class Executor:
                     auxs = [jax.device_put(a, dev) for a in auxs]
                     if op_ctx.rng is not None:
                         op_ctx.rng = jax.device_put(op_ctx.rng, dev)
+                if do_split and ni in split_bn:
+                    # β-split stem: run the BN with β zeroed (stats and
+                    # aux updates are β-independent); the partner conv
+                    # adds conv(β·1) back — see the pattern comment above
+                    args = list(args)
+                    split_beta[ni] = args[2]
+                    args[2] = jnp.zeros_like(args[2])
                 outs, updated = op.apply(eff_attrs, args, auxs, op_ctx)
+                if do_split and ni in split_conv:
+                    bval = split_beta[split_conv[ni]]
+                    x1 = args[0]
+                    bval = bval.astype(x1.dtype)
+                    if eff_attrs.get('__layout__') == 'NHWC':
+                        b_in = jnp.broadcast_to(bval,
+                                                (1,) + x1.shape[1:])
+                    else:
+                        b_in = jnp.broadcast_to(bval[:, None, None],
+                                                (1,) + x1.shape[1:])
+                    outs2, _ = op.apply(eff_attrs, [b_in, args[1]], [],
+                                        op_ctx)
+                    outs = [outs[0] + outs2[0]]
                 results[ni] = outs
                 layouts[ni] = [out_layout
                                if getattr(o, 'ndim', 0) == 4 else 'NCHW'
@@ -429,6 +511,11 @@ class Executor:
                     if n in scan_set and n not in diff_set]
         inv_idx = [i for i, n in enumerate(self._arg_names)
                    if n not in diff_set and n not in scan_set]
+        # scan stacks may arrive in a narrower storage dtype than the
+        # bound arg (bulk_step scan_dtype); restore the bound dtype at
+        # the top of each step so the graph sees its declared inputs
+        scan_dt = [self.arg_dict[self._arg_names[i]]._data.dtype
+                   for i in scan_idx]
 
         def multistep(diff_vals, scan_vals, inv_vals, aux_vals, key,
                       moms, masters, lrs, wds):
@@ -439,8 +526,8 @@ class Executor:
                     merged = [None] * n_args
                     for i, v in zip(diff_idx, dv):
                         merged[i] = v
-                    for i, v in zip(scan_idx, sv):
-                        merged[i] = v
+                    for i, v, dt in zip(scan_idx, sv, scan_dt):
+                        merged[i] = v if v.dtype == dt else v.astype(dt)
                     for i, v in zip(inv_idx, inv_vals):
                         merged[i] = v
                     outs, new_aux = run_graph(tuple(merged), aux_vals,

@@ -380,7 +380,8 @@ class Module(BaseModule):
             self._fused_step, fnames, moms, masters, lrs, wds)
         fu.commit(new_moms, new_masters)
 
-    def bulk_step(self, batches=None, batch=None, repeat=None):
+    def bulk_step(self, batches=None, batch=None, repeat=None,
+                  scan_dtype=None):
         """Run several full training steps (forward+backward+optimizer
         update) as ONE XLA dispatch, looping on-device.
 
@@ -397,6 +398,15 @@ class Module(BaseModule):
         are unavailable (only the final step's outputs are kept), and
         monitors don't fire.  Falls back to the plain loop when the
         step cannot fuse.
+
+        scan_dtype: optional storage dtype for the stacked DATA arrays
+        (labels keep their bound dtype — low-precision floats can't
+        represent large class indices exactly).  The fused step casts
+        back to the bound dtype before the graph runs, so this is
+        value-preserving exactly when the graph's first use of the data
+        is itself a cast to (or below) scan_dtype — e.g. a bfloat16
+        mixed-precision model — and halves the device memory the K
+        stacked batches occupy, allowing larger K.
         """
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
@@ -428,6 +438,7 @@ class Module(BaseModule):
             if k == 1:
                 return self._single_step(batches[0])
             eg.load_data_batch(batches[0])  # dtype/shape checks + cast
+            data_set = set(eg.data_names)
             per_name = {n: [] for n in scan_names}
             for b in batches:
                 vals = dict(zip(eg.data_names, b.data))
@@ -437,8 +448,10 @@ class Module(BaseModule):
                     v = vals[n]
                     v = v._data if isinstance(v, nd.NDArray) else \
                         jnp.asarray(v)
-                    per_name[n].append(
-                        v.astype(ex.arg_dict[n].dtype))
+                    store = scan_dtype if (scan_dtype is not None and
+                                           n in data_set) else \
+                        ex.arg_dict[n].dtype
+                    per_name[n].append(v.astype(store))
             scan_stacks = {n: jnp.stack(per_name[n])
                            for n in scan_names}
             if eg.mesh is not None:
@@ -446,7 +459,7 @@ class Module(BaseModule):
                 scan_stacks = {
                     n: pmesh.shard_batch(eg.mesh, v, dim=1)
                     for n, v in scan_stacks.items()}
-            cache_key = (ex, fu, 'stacked', k)
+            cache_key = (ex, fu, 'stacked', k, str(scan_dtype))
         else:
             eg.load_data_batch(batch)
             cache_key = (ex, fu, 'repeat', k)

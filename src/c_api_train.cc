@@ -871,4 +871,114 @@ int MXTCachedOpInvoke(void* cached, uint32_t num_inputs, void** inputs,
 
 void MXTCachedOpFree(void* handle) { MXTNDArrayFree(handle); }
 
+// -- DataIter --------------------------------------------------------------
+//
+// The reference's iterator C surface (MXListDataIters /
+// MXDataIterCreateIter / Next / GetData / GetLabel,
+// /root/reference/src/c_api/c_api.cc) — what lets every language
+// binding train from .rec/.csv files without touching Python.
+
+// List the string-creatable iterators.  Pointers stay valid for the
+// process lifetime (cached in a static handle).
+int MXTListDataIters(uint32_t* out_n, const char*** out_names) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  static Handle* cache = nullptr;
+  if (cache == nullptr) {
+    PyObject* names = call("list_data_iters", "()");
+    if (names == nullptr) return -1;
+    Handle* h = wrap(names);
+    uint32_t n = 0;
+    if (store_strings(names, h, &n, nullptr) != 0) {
+      MXTNDArrayFree(h);
+      return -1;
+    }
+    cache = h;
+  }
+  *out_n = static_cast<uint32_t>(cache->str_ptrs.size());
+  *out_names = cache->str_ptrs.data();
+  return 0;
+}
+
+// Create an iterator by registered name with string params (reference
+// MXDataIterCreateIter; params are the same key=value strings the
+// Python constructors take).
+int MXTDataIterCreate(const char* name, uint32_t num_param,
+                      const char** keys, const char** vals, void** out) {
+  *out = nullptr;
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* k = str_list(num_param, keys);
+  PyObject* v = str_list(num_param, vals);
+  PyObject* it = nullptr;
+  if (k && v) it = call("data_iter_create", "(sOO)", name, k, v);
+  Py_XDECREF(k);
+  Py_XDECREF(v);
+  if (it == nullptr) return -1;
+  *out = wrap(it);
+  return 0;
+}
+
+int MXTDataIterBeforeFirst(void* handle) {
+  GIL gil;
+  PyObject* r = call("data_iter_before_first", "(O)", obj_of(handle));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+// Advance; *out_has_next = 0 at end of epoch (reference MXDataIterNext).
+int MXTDataIterNext(void* handle, int* out_has_next) {
+  *out_has_next = 0;
+  GIL gil;
+  PyObject* r = call("data_iter_next", "(O)", obj_of(handle));
+  if (r == nullptr) return -1;
+  *out_has_next = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+static int iter_get(const char* fn, void* handle, void** out) {
+  *out = nullptr;
+  GIL gil;
+  PyObject* arr = call(fn, "(O)", obj_of(handle));
+  if (arr == nullptr) return -1;
+  *out = wrap(arr);
+  return 0;
+}
+
+// Current batch's data / label as NDArray handles (freed by caller).
+int MXTDataIterGetData(void* handle, void** out) {
+  return iter_get("data_iter_get_data", handle, out);
+}
+
+int MXTDataIterGetLabel(void* handle, void** out) {
+  return iter_get("data_iter_get_label", handle, out);
+}
+
+// Pad count of the current batch (tail-batch refill, reference
+// MXDataIterGetPadNum).
+int MXTDataIterGetPadNum(void* handle, int* out_pad) {
+  *out_pad = 0;
+  GIL gil;
+  PyObject* r = call("data_iter_get_pad", "(O)", obj_of(handle));
+  if (r == nullptr) return -1;
+  *out_pad = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+void MXTDataIterFree(void* handle) { MXTNDArrayFree(handle); }
+
+// Device-side copy dst[:] = src — feeds executor-bound arrays straight
+// from iterator batches (reference _copyto / executor _load_general).
+int MXTNDArrayCopyFromNDArray(void* dst, void* src) {
+  GIL gil;
+  PyObject* r = call("nd_copy_from_nd", "(OO)", obj_of(dst),
+                     obj_of(src));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 }  // extern "C"

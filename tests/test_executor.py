@@ -253,3 +253,56 @@ def test_symbolic_optimizer_op_state_persists_in_eval_forward():
     ex.forward(is_train=False)
     m2 = ex.aux_dict[mom_name].asnumpy()
     np.testing.assert_allclose(m2, 0.9 * -0.1 - 0.1, rtol=1e-6)
+
+
+def test_input_bn_conv_split_equivalence(monkeypatch):
+    """The MXNET_TPU_STEM_SPLIT executor optimization (docs/PERF.md
+    round 5): Convolution(no_bias) fed by BatchNorm(fix_gamma=True) on
+    a gradient-free input computes conv(x̂γ) + conv(β·1) instead of
+    conv(x̂γ + β·1) — autodiff's β path then costs a batch-1 dgrad
+    instead of a full-batch one.  Outputs, every gradient (incl. dβ),
+    and the BN aux-stat updates must match the straight form."""
+    def run(split):
+        monkeypatch.setenv('MXNET_TPU_STEM_SPLIT', split)
+        rng = np.random.RandomState(0)
+        data = sym.Variable('data')
+        bn = sym.BatchNorm(data, fix_gamma=True, eps=2e-5,
+                           momentum=0.9, name='bn_data')
+        conv = sym.Convolution(bn, num_filter=8, kernel=(3, 3),
+                               stride=(2, 2), pad=(1, 1), no_bias=True,
+                               name='conv0')
+        bn2 = sym.BatchNorm(conv, fix_gamma=False, name='bn2')
+        out = sym.sum(sym.square(bn2), name='loss')
+        # data must be gradient-free for the pattern to fire — the
+        # Module binding convention (inputs grad_req null)
+        req = {n: ('null' if n == 'data' else 'write')
+               for n in out.list_arguments()}
+        ex = out.simple_bind(mx.cpu(), grad_req=req,
+                             data=(4, 3, 16, 16))
+        assert bool(ex._split_conv) == (split == '1'), \
+            'split engagement mismatch: %r' % (ex._split_conv,)
+        for n, a in ex.arg_dict.items():
+            if 'gamma' in n:
+                a[:] = nd.array(np.ones(a.shape, np.float32))
+            else:
+                scale = 1.0 if n in ('data', 'bn_data_beta') else 0.1
+                a[:] = nd.array(
+                    rng.randn(*a.shape).astype(np.float32) * scale)
+        ex.forward(is_train=True)
+        y = ex.outputs[0].asnumpy().copy()
+        ex.backward()
+        grads = {n: g.asnumpy().copy()
+                 for n, g in ex.grad_dict.items() if g is not None}
+        auxs = {n: a.asnumpy().copy() for n, a in ex.aux_dict.items()}
+        return y, grads, auxs
+
+    y1, g1, a1 = run('1')
+    y0, g0, a0 = run('0')
+    np.testing.assert_allclose(y1, y0, rtol=1e-4, atol=1e-4)
+    assert np.abs(g0['bn_data_beta']).max() > 0
+    for n in g0:
+        np.testing.assert_allclose(g1[n], g0[n], rtol=1e-3, atol=1e-4,
+                                   err_msg=n)
+    for n in a0:
+        np.testing.assert_allclose(a1[n], a0[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)

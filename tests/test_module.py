@@ -274,6 +274,37 @@ def test_bulk_step_matches_per_step_loop(n_ctx, kvstore):
                                    rtol=2e-5, atol=2e-5)
 
 
+def test_bulk_step_scan_dtype_storage():
+    """bulk_step(scan_dtype=...) stores the stacked data batches in a
+    narrower dtype and the fused step casts back before the graph
+    (docs/PERF.md round 5) — for inputs the model itself quantizes on
+    entry the result must match the default-storage path exactly, and
+    labels must keep their bound dtype."""
+    rng = np.random.RandomState(1)
+    # quantize the data to bf16-representable values so bf16 storage is
+    # lossless for this check regardless of the model's own entry cast
+    raw = rng.rand(16, 8).astype(np.float32)
+    import jax.numpy as jnp
+    raw = np.asarray(jnp.asarray(raw, jnp.bfloat16).astype(jnp.float32))
+    batches = [mx.io.DataBatch(
+        data=[nd.array(raw * (2.0 ** i))],  # ×2^i stays bf16-exact
+        label=[nd.array((rng.rand(16) * 4).astype(np.float32))])
+        for i in range(3)]
+    seed_mod = _bulk_mod([mx.cpu(0)], kvstore=None)
+    ap, ax = seed_mod.get_params()
+    ap = {k: v.copy() for k, v in ap.items()}
+    ax = {k: v.copy() for k, v in ax.items()}
+    a = _bulk_mod([mx.cpu(0)], ap, ax, kvstore=None)
+    b = _bulk_mod([mx.cpu(0)], ap, ax, kvstore=None)
+    a.bulk_step(batches=batches)
+    b.bulk_step(batches=batches, scan_dtype='bfloat16')
+    pa, _ = a.get_params()
+    pb, _ = b.get_params()
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   rtol=2e-5, atol=2e-5, err_msg=k)
+
+
 def test_fused_step_with_device_kvstore_single_dispatch():
     """A single-process kvstore ('local'/'device') must not forfeit
     whole-step fusion: the grad all-reduce is already the in-step psum
