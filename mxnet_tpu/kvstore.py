@@ -123,6 +123,16 @@ class KVStore:
                     val = jax.device_put(val, dsh)
                 o._data = val
 
+    def push_pull_all(self, keys, grad_lists, out_lists):
+        """Push every gradient, then pull every weight — the per-step
+        kvstore round as ONE call so dist stores can batch the wire
+        protocol (reference: ps-lite batches ZPush/ZPull at the engine
+        level, kvstore_dist.h:123-149).  Local semantics are identical
+        to the per-key push/pull loop."""
+        for k, g, o in zip(keys, grad_lists, out_lists):
+            self.push(k, g)
+            self.pull(k, o)
+
     # -- updater / optimizer ----------------------------------------------
     def _key_index(self, key):
         return key if isinstance(key, int) else key
@@ -248,13 +258,21 @@ class KVStoreDistPS(KVStore):
                 self._client.init(k, vlist[0].asnumpy())
         self.barrier()
 
+    @staticmethod
+    def _merge_grads(value):
+        """Sum a (possibly multi-device) gradient list to one host
+        array — the single definition both the per-key and batched
+        paths share."""
+        vlist = value if isinstance(value, list) else [value]
+        merged = vlist[0]
+        for v in vlist[1:]:
+            merged = merged + v
+        return merged.asnumpy()
+
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
-            merged = vlist[0]
-            for v in vlist[1:]:
-                merged = merged + v
-            self._client.push(k, merged.asnumpy())
+            self._client.push(k, self._merge_grads(vlist))
 
     def pull(self, key, out=None, priority=0):
         keys, outs = _ctype_key_value(key, out)
@@ -262,6 +280,27 @@ class KVStoreDistPS(KVStore):
             val = self._client.pull(k)
             for o in olist:
                 o[:] = nd.array(val, dtype=o.dtype)
+
+    def push_pull_all(self, keys, grad_lists, out_lists):
+        """Batched per-step round: ALL gradients ride one frame per
+        server (one HMAC each), then ALL weights pull back the same way
+        — collapsing 2×#keys round trips to 2×#servers and letting the
+        server overlap rounds across keys (docs/PERF.md round 5)."""
+        pairs = [(k, self._merge_grads(value))
+                 for k, value in zip(keys, grad_lists)]
+        vals = self._client.push_pull_multi(pairs)
+        import jax
+        import numpy as _np
+        for k, out in zip(keys, out_lists):
+            olist = out if isinstance(out, list) else [out]
+            for o in olist:
+                # direct buffer replacement (no setitem op dispatch per
+                # key), preserving the destination's device/sharding —
+                # the _pull_impl placement contract
+                val = _np.asarray(vals[k], dtype=o.dtype)
+                sh = getattr(o._data, 'sharding', None)
+                o._data = jax.device_put(val, sh) if sh is not None \
+                    else jax.numpy.asarray(val)
 
     def set_optimizer(self, optimizer):
         """Pickle the optimizer to the server processes — rank 0 only,

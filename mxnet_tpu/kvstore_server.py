@@ -69,6 +69,79 @@ def _frame_key():
     return hashlib.sha256(('mxnet_tpu_ps:' + seed).encode()).digest()
 
 
+_MAC_TEMPLATE = (None, None)   # (key, primed hmac object)
+
+
+def _mac():
+    """Fresh HMAC for the current frame key.  OpenSSL 3 makes every
+    `hmac.new` pay a multi-ms algorithm fetch (measured 2.9 ms — more
+    than hashing a 16 MB tensor); cloning a primed template via
+    HMAC.copy() is microseconds.  Keyed so an env-var token change
+    (tests do this) still takes effect."""
+    global _MAC_TEMPLATE
+    key = _frame_key()
+    tkey, tmpl = _MAC_TEMPLATE
+    if tkey != key:
+        tmpl = hmac.new(key, digestmod=hashlib.sha256)
+        _MAC_TEMPLATE = (key, tmpl)
+    return tmpl.copy()
+
+
+# Frame MAC algorithms.  HMAC-SHA256 measures ~1.3 GB/s on this class
+# of host — for multi-MB tensors the MAC, not the socket, bounds PS
+# throughput (docs/PERF.md round 5).  When the `cryptography` package
+# is present, frames authenticate with Poly1305 (~9 GB/s measured)
+# under a fresh one-time key derived per frame:
+#     k_frame = HMAC-SHA256(frame_key, nonce16);  tag = Poly1305(k_frame)
+# (the standard one-time-MAC construction — deriving the per-message
+# key through a PRF is exactly how ChaCha20-Poly1305 uses it; a
+# tampered nonce derives a different key and the tag check fails).
+# Override with MXNET_TPU_PS_MAC=hmac|poly; both peers must agree
+# (same install + env — a mismatch fails loudly at verification).
+_ALG_HMAC = 0
+_ALG_POLY = 1
+_POLY1305 = None
+
+
+def _poly1305_cls():
+    global _POLY1305
+    if _POLY1305 is None:
+        try:
+            from cryptography.hazmat.primitives.poly1305 import Poly1305
+            _POLY1305 = Poly1305
+        except ImportError:
+            _POLY1305 = False
+    return _POLY1305
+
+
+def _mac_alg():
+    pref = os.environ.get('MXNET_TPU_PS_MAC', 'auto')
+    if pref == 'hmac':
+        return _ALG_HMAC
+    if pref == 'poly':
+        if not _poly1305_cls():
+            raise RuntimeError('MXNET_TPU_PS_MAC=poly needs the '
+                               '"cryptography" package')
+        return _ALG_POLY
+    return _ALG_POLY if _poly1305_cls() else _ALG_HMAC
+
+
+def _frame_tag(alg, nonce, parts):
+    """MAC over the payload parts under the current frame key.
+    Returns a 32-byte tag (Poly1305's 16-byte tag is zero-padded)."""
+    if alg == _ALG_POLY:
+        kdf = _mac()
+        kdf.update(nonce)
+        p = _poly1305_cls()(kdf.digest())
+        for v in parts:
+            p.update(v)
+        return p.finalize() + b'\x00' * 16
+    mac = _mac()
+    for v in parts:
+        mac.update(v)
+    return mac.digest()
+
+
 _MAX_WIRE_DEPTH = 8
 
 
@@ -123,7 +196,12 @@ def _encode_obj(obj, out, depth=0):
         out.append(b'a' + struct.pack('<I', len(name)) + name +
                    struct.pack('<I', a.ndim) +
                    struct.pack('<%dq' % a.ndim, *a.shape))
-        out.append(a.tobytes())
+        # zero-copy: the array's buffer rides to sendmsg/hmac directly
+        # (the caller must not mutate it until the frame is sent — all
+        # call sites pass freshly-merged or snapshot arrays).  The
+        # uint8 view — not memoryview.cast — handles dtypes the buffer
+        # protocol can't format (bfloat16/float8) and 0-d arrays.
+        out.append(memoryview(a.reshape(-1).view(np.uint8)))
     elif isinstance(obj, (tuple, list)):
         out.append(b't' + struct.pack('<I', len(obj)))
         for v in obj:
@@ -178,11 +256,14 @@ def _decode_obj(buf, pos, depth=0):
             raise ValueError('bad shape on PS wire')
         count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
         nbytes = count * dt.itemsize
-        raw = bytes(buf[pos:pos + nbytes])
-        if len(raw) != nbytes:
+        if len(buf) - pos < nbytes:
             raise ValueError('truncated PS frame')
+        raw = memoryview(buf)[pos:pos + nbytes]
         pos += nbytes
-        return np.frombuffer(raw, dtype=dt).reshape(shape).copy(), pos
+        # zero-copy view into the recv buffer: every consumer (push
+        # merge, init, client-side device upload) copies or reduces
+        # immediately, so nothing pins the frame long-term
+        return np.frombuffer(raw, dtype=dt).reshape(shape), pos
     if tag == b't':
         (n,) = struct.unpack_from('<I', buf, pos)
         pos += 4
@@ -216,22 +297,64 @@ def _decode(payload):
     return obj
 
 
+def _build_frame(obj):
+    """Encode + MAC a message into a scatter-gather parts list
+    (header first).  The payload is never concatenated: the MAC runs
+    incrementally over the parts and sendmsg takes the list, so a
+    multi-MB tensor costs zero framing copies.
+    Header layout: length u64 | alg u8 | nonce 16 | tag 32."""
+    out = []
+    _encode_obj(obj, out)
+    total = 0
+    parts = []
+    for p in out:
+        v = p if isinstance(p, memoryview) else memoryview(p)
+        total += v.nbytes
+        parts.append(v)
+    alg = _mac_alg()
+    nonce = os.urandom(16) if alg == _ALG_POLY else b'\x00' * 16
+    tag = _frame_tag(alg, nonce, parts)
+    header = struct.pack('<QB', total, alg) + nonce + tag
+    return [memoryview(header)] + parts
+
+
+_IOV_MAX = 1024  # kernel sendmsg iovec limit; more parts -> EMSGSIZE
+
+
+def _send_parts(sock, parts):
+    """Scatter-gather send with partial-send continuation, chunked to
+    the kernel's iovec limit (multi-key frames can carry thousands of
+    parts)."""
+    parts = list(parts)
+    while parts:
+        batch = parts[:_IOV_MAX]
+        total = sum(p.nbytes for p in batch)
+        sent = sock.sendmsg(batch)
+        while sent < total:
+            # drop fully-sent parts, trim the partial one, resend
+            rest = []
+            for p in batch:
+                if sent >= p.nbytes:
+                    sent -= p.nbytes
+                elif sent > 0:
+                    rest.append(p[sent:])
+                    sent = 0
+                else:
+                    rest.append(p)
+            batch = rest
+            total = sum(p.nbytes for p in batch)
+            sent = sock.sendmsg(batch)
+        parts = parts[_IOV_MAX:]
+
+
 def _send_msg(sock, obj):
-    payload = _encode(obj)
-    tag = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
-    header = struct.pack('<Q', len(payload)) + tag
-    # scatter-gather send: no multi-MB header+payload concat copy
-    sent = sock.sendmsg([header, payload])
-    if sent < len(header):
-        sock.sendall(header[sent:])
-        sock.sendall(payload)
-    elif sent < len(header) + len(payload):
-        sock.sendall(memoryview(payload)[sent - len(header):])
+    _send_parts(sock, _build_frame(obj))
 
 
 def _recv_exact(sock, n):
     # recv_into a preallocated buffer: the bytes-concat loop is
-    # quadratic for multi-MB tensors
+    # quadratic for multi-MB tensors.  Returns the bytearray itself —
+    # decoding slices it through memoryviews, so no whole-frame copy.
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -240,7 +363,7 @@ def _recv_exact(sock, n):
         if not r:
             raise ConnectionError('socket closed')
         got += r
-    return bytes(buf)
+    return buf
 
 
 # Upper bound on a single wire frame.  The length prefix arrives before
@@ -252,17 +375,26 @@ _MAX_FRAME_BYTES = int(os.environ.get('MXNET_TPU_PS_MAX_FRAME',
 
 
 def _recv_msg(sock):
-    (n,) = struct.unpack('<Q', _recv_exact(sock, 8))
+    head = _recv_exact(sock, 8 + 1 + 16 + 32)
+    n, alg = struct.unpack_from('<QB', head, 0)
     if n > _MAX_FRAME_BYTES:
         raise ConnectionError(
             'kvstore frame length %d exceeds limit %d (set '
             'MXNET_TPU_PS_MAX_FRAME to raise)' % (n, _MAX_FRAME_BYTES))
-    tag = _recv_exact(sock, 32)
+    if alg not in (_ALG_HMAC, _ALG_POLY):
+        raise ConnectionError('unknown kvstore frame MAC alg %d' % alg)
+    if alg == _ALG_POLY and not _poly1305_cls():
+        raise ConnectionError(
+            'peer sent a Poly1305-tagged frame but the "cryptography" '
+            'package is missing here — install it or set '
+            'MXNET_TPU_PS_MAC=hmac on every role')
+    nonce = bytes(head[9:25])
+    tag = bytes(head[25:57])
     payload = _recv_exact(sock, n)
-    want = hmac.new(_frame_key(), payload, hashlib.sha256).digest()
+    want = _frame_tag(alg, nonce, (payload,))
     if not hmac.compare_digest(tag, want):
         raise ConnectionError(
-            'kvstore frame failed HMAC verification (wrong '
+            'kvstore frame failed MAC verification (wrong '
             'DMLC_PS_TOKEN or untrusted peer) — dropping connection')
     try:
         # any decode failure (truncated struct, bad tag, bad dtype,
@@ -278,6 +410,17 @@ def _recv_msg(sock):
     return msg
 
 
+def _tune_sock_bufs(sock, nbytes=4 * 1024 * 1024):
+    """Multi-MB tensor frames drain far fewer syscalls with MB-scale
+    kernel buffers than the ~200 KB defaults (best-effort; the kernel
+    clamps to its rmem/wmem caps)."""
+    for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, opt, nbytes)
+        except OSError:
+            pass
+
+
 def _key_to_server(key, num_servers):
     """Reference key sharding: (key * 9973) % n (kvstore_dist.h:292);
     string keys hash first."""
@@ -289,6 +432,56 @@ def _key_to_server(key, num_servers):
 # ---------------------------------------------------------------------------
 # server
 # ---------------------------------------------------------------------------
+
+def _generic_updater(optimizer, store):
+    """Any pickled optimizer, driven through the framework's NDArray
+    machinery (JAX on the CPU backend).  Correct for every optimizer
+    but pays per-key eager dispatch (~5 ms per 4 MB key, measured)."""
+    from . import optimizer as opt
+    updater = opt.get_updater(optimizer)
+
+    def np_updater(key, grad):
+        from . import ndarray as nd
+        w = nd.array(store[key])
+        updater(key, nd.array(grad), w)
+        store[key] = w.asnumpy()
+    return np_updater
+
+
+def _np_fast_updater(optimizer, store):
+    """Pure-numpy server-side update for stock plain SGD(+momentum) —
+    the role of the reference server's native C++ updaters
+    (kvstore_dist_server.h): the PS is a host component and must not
+    pay accelerator-runtime dispatch per key per round.  Mirrors
+    SGD.update exactly (rescale → clip → +wd·w → momentum); returns
+    None for anything it can't reproduce bit-for-bit in numpy, and the
+    generic NDArray-driven path takes over."""
+    from . import optimizer as opt
+    if type(optimizer) is not opt.SGD or optimizer.multi_precision:
+        return None
+    states = {}
+
+    def upd(key, grad):
+        w = store[key]
+        lr = optimizer._get_lr(key)
+        wd = optimizer._get_wd(key)
+        optimizer._update_count(key)
+        g = np.asarray(grad, dtype=w.dtype) * optimizer.rescale_grad
+        if optimizer.clip_gradient is not None:
+            np.clip(g, -optimizer.clip_gradient,
+                    optimizer.clip_gradient, out=g)
+        g += wd * w
+        if optimizer.momentum == 0.0:
+            store[key] = w - lr * g
+        else:
+            m = states.get(key)
+            if m is None:
+                m = np.zeros_like(w)
+            m = optimizer.momentum * m - lr * g
+            states[key] = m
+            store[key] = w + m
+    return upd
+
 
 class KVStoreServer(object):
     """One parameter-server process (reference KVStoreDistServer)."""
@@ -311,6 +504,12 @@ class KVStoreServer(object):
         # start, so a worker that dies during startup is detectable.
         self.start_time = time.time()
         self.last_seen = {}           # worker rank -> time.time()
+        self._frame_cache = {}        # (key,ver)-tuple -> reply frame
+        # single-flight for reply-frame builds: with the fused
+        # push_pull round every worker's handler thread wakes on the
+        # same version bump and would otherwise encode+MAC the same
+        # frame concurrently (pure waste on shared-core hosts)
+        self._frame_build_lock = threading.Lock()
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         # bind the rendezvous interface when it is local (loopback for
@@ -409,23 +608,67 @@ class KVStoreServer(object):
         else:
             self.store[key] = merged
 
-    def _handle_pull(self, key, min_version=0):
+    def _pull_value(self, key, min_version=0):
         """Sync semantics, deadlock-free: the pull carries the calling
         worker's own push count for this key and waits until that many
         rounds have been APPLIED (every round completes from the other
         workers' pushes, never from this worker's pull) — the versioned
         equivalent of the reference answering queued pulls after the
-        update (kvstore_dist_server.h:182-218)."""
+        update (kvstore_dist_server.h:182-218).
+        -> (array_snapshot, version) or raises KeyError."""
         with self.cv:
             while self.sync_mode and \
                     self.version.get(key, 0) < min_version:
                 self.cv.wait()
             if key not in self.store:
-                return ('err', 'key %r not initialized' % (key,))
-            # Snapshot while still holding the lock: the frame is encoded
-            # after release, and an async-mode in-place updater write could
-            # otherwise serialize a torn tensor.
-            return ('ok', self.store[key].copy())
+                raise KeyError(key)
+            # No snapshot copy needed: _apply REPLACES self.store[key]
+            # (both updater and plain paths) rather than mutating in
+            # place, so the grabbed reference stays internally
+            # consistent while the frame is encoded after release.
+            return self.store[key], self.version.get(key, 0)
+
+    def _pull_frame(self, keys_versions):
+        """Encoded ('ok', values...) reply frame for a pull at a known
+        (key, version) snapshot — cached so N workers pulling the same
+        round pay ONE encode+MAC (sync rounds always converge on the
+        same versions).  Only the latest snapshot per key set is kept."""
+        cache_key = tuple(keys_versions)
+        with self.cv:
+            # async mode: versions advance independently of the request,
+            # so a version-keyed cache would serve stale weights
+            cacheable = self.sync_mode
+            hit = self._frame_cache.get(cache_key) if cacheable else None
+        if hit is not None:
+            return hit
+        try:
+            # wait for the rounds BEFORE taking the build lock, so a
+            # builder never blocks pushes that complete its own wait
+            values = [self._pull_value(k, v)[0] for k, v in keys_versions]
+        except KeyError as e:
+            return _build_frame(('err',
+                                 'key %r not initialized' % (e.args[0],)))
+        if not cacheable:
+            reply = ('ok', values[0]) if len(values) == 1 else \
+                ('ok', tuple(values))
+            return _build_frame(reply)
+        with self._frame_build_lock:
+            with self.cv:
+                hit = self._frame_cache.get(cache_key)
+            if hit is not None:
+                return hit
+            reply = ('ok', values[0]) if len(values) == 1 else \
+                ('ok', tuple(values))
+            frame = _build_frame(reply)
+            with self.cv:
+                # one live entry per key-set: stale rounds are never
+                # re-requested, so the cache stays O(#distinct key groups)
+                self._frame_cache = {
+                    ck: fr for ck, fr in self._frame_cache.items()
+                    if tuple(k for k, _ in ck) != tuple(
+                        k for k, _ in cache_key)}
+                self._frame_cache[cache_key] = frame
+        return frame
 
     def _handle_barrier(self):
         with self.cv:
@@ -454,14 +697,8 @@ class KVStoreServer(object):
                     'instead')
         from . import optimizer as opt
         optimizer = pickle.loads(blob)
-        updater = opt.get_updater(optimizer)
-
-        def np_updater(key, grad):
-            from . import ndarray as nd
-            w = nd.array(self.store[key])
-            updater(key, nd.array(grad), w)
-            self.store[key] = w.asnumpy()
-        self.updater = np_updater
+        self.updater = _np_fast_updater(optimizer, self.store) or \
+            _generic_updater(optimizer, self.store)
         return ('ok',)
 
     # -- loop ---------------------------------------------------------------
@@ -500,9 +737,41 @@ class KVStoreServer(object):
                     reply = self._handle_init(msg[1], msg[2])
                 elif op == 'push':
                     reply = self._handle_push(msg[1], msg[2])
+                elif op == 'push_multi':
+                    # one frame, many keys: one MAC per round instead
+                    # of one per key (reference ZPush batching role)
+                    for k, v in msg[1]:
+                        reply = self._handle_push(k, v)
+                        if reply[0] != 'ok':
+                            break
+                elif op == 'push_pull_multi':
+                    # the whole training-step round in ONE round trip:
+                    # push every key, wait for the rounds, reply with
+                    # the updated weights (the ack and pull-request
+                    # legs of the two-RPC form disappear)
+                    err = None
+                    for k, v, _ in msg[1]:
+                        r = self._handle_push(k, v)
+                        if r[0] != 'ok':
+                            err = r
+                            break
+                    if err is not None:
+                        reply = err
+                    else:
+                        frame = self._pull_frame(tuple(
+                            (k, mv) for k, _, mv in msg[1]))
+                        _send_parts(conn, frame)
+                        continue
                 elif op == 'pull':
-                    reply = self._handle_pull(
-                        msg[1], msg[2] if len(msg) > 2 else 0)
+                    frame = self._pull_frame(
+                        ((msg[1], msg[2] if len(msg) > 2 else 0),))
+                    _send_parts(conn, frame)
+                    continue
+                elif op == 'pull_multi':
+                    frame = self._pull_frame(tuple(
+                        (k, v) for k, v in msg[1]))
+                    _send_parts(conn, frame)
+                    continue
                 elif op == 'barrier':
                     reply = self._handle_barrier()
                 elif op == 'set_optimizer':
@@ -514,7 +783,7 @@ class KVStoreServer(object):
                 elif op == 'get_states':
                     with self.cv:
                         # Deep-copy under the lock (same torn-tensor
-                        # hazard as _handle_pull).
+                        # hazard as _pull_value).
                         reply = ('ok', {k: v.copy()
                                         for k, v in self.store.items()})
                 elif op == 'has_updater':
@@ -544,6 +813,7 @@ class KVStoreServer(object):
                 conn, _ = self.listener.accept()
                 # small 'ok' replies must not wait out Nagle+delayed-ACK
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_sock_bufs(conn)
             except socket.timeout:
                 continue
             t = threading.Thread(target=self._serve_conn, args=(conn,),
@@ -571,6 +841,7 @@ class DistServerClient(object):
             # peers that may still be starting up (jax import is slow)
             s.settimeout(None)
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_sock_bufs(s)
             self.socks.append(s)
             self.locks.append(threading.Lock())
         if rank is not None:
@@ -614,6 +885,71 @@ class DistServerClient(object):
     def pull(self, key):
         return self._rpc(self._sid(key), 'pull', key,
                          self.push_counts.get(key, 0))
+
+    def _multi_rpc(self, op, by_sid):
+        """One frame per server, all servers in flight before any reply
+        is read — per-key round trips collapse to one per server and
+        the servers work concurrently."""
+        sids = sorted(by_sid)
+        for sid in sids:
+            self.locks[sid].acquire()
+        try:
+            for sid in sids:
+                _send_msg(self.socks[sid], (op, by_sid[sid]))
+            out = {}
+            for sid in sids:
+                reply = _recv_msg(self.socks[sid])
+                if reply[0] != 'ok':
+                    from .base import MXNetError
+                    raise MXNetError('kvstore server error: %s'
+                                     % (reply[1],))
+                out[sid] = reply[1] if len(reply) > 1 else None
+            return out
+        finally:
+            for sid in sids:
+                self.locks[sid].release()
+
+    def push_multi(self, pairs):
+        """Push [(key, value), ...] — one frame (one MAC) per server."""
+        by_sid = {}
+        for k, v in pairs:
+            self.push_counts[k] = self.push_counts.get(k, 0) + 1
+            by_sid.setdefault(self._sid(k), []).append(
+                (k, np.asarray(v)))
+        self._multi_rpc('push_multi', by_sid)
+
+    def pull_multi(self, keys):
+        """Pull many keys -> {key: value}, one frame per server; the
+        server answers from its per-round reply-frame cache."""
+        by_sid = {}
+        for k in keys:
+            by_sid.setdefault(self._sid(k), []).append(
+                (k, self.push_counts.get(k, 0)))
+        replies = self._multi_rpc('pull_multi', by_sid)
+        return self._scatter_pull_replies(by_sid, replies)
+
+    def push_pull_multi(self, pairs):
+        """The whole step's round in ONE round trip per server: push
+        [(key, grad), ...], the servers apply completed rounds and
+        reply with the updated weights -> {key: weight}."""
+        by_sid = {}
+        for k, v in pairs:
+            self.push_counts[k] = self.push_counts.get(k, 0) + 1
+            by_sid.setdefault(self._sid(k), []).append(
+                (k, np.asarray(v), self.push_counts[k]))
+        replies = self._multi_rpc('push_pull_multi', by_sid)
+        return self._scatter_pull_replies(by_sid, replies)
+
+    @staticmethod
+    def _scatter_pull_replies(by_sid, replies):
+        out = {}
+        for sid, items in by_sid.items():
+            vals = replies[sid]
+            if len(items) == 1:
+                vals = (vals,)
+            for item, v in zip(items, vals):
+                out[item[0]] = v
+        return out
 
     def barrier(self):
         for sid in range(self.num_servers):

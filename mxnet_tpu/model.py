@@ -52,15 +52,20 @@ def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore,
                               param_names):
-    """Push grad, pull weight per key (reference model.py:106)."""
+    """Push grads, pull weights (reference model.py:106).  The whole
+    step goes through kvstore.push_pull_all so dist stores batch the
+    round into one frame per server instead of 2×#keys round trips;
+    the base store's implementation is the reference's per-key loop."""
+    names, grads, args = [], [], []
     for index, pair in enumerate(zip(param_arrays, grad_arrays)):
         arg_list, grad_list = pair
         if grad_list is None or (isinstance(grad_list, list) and
                                  grad_list[0] is None):
             continue
-        name = param_names[index]
-        kvstore.push(name, grad_list, priority=-index)
-        kvstore.pull(name, arg_list, priority=-index)
+        names.append(param_names[index])
+        grads.append(grad_list)
+        args.append(arg_list)
+    kvstore.push_pull_all(names, grads, args)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,

@@ -270,11 +270,12 @@ def test_frame_hmac_rejects_tampering():
     try:
         srv._send_msg(a, ('ping', 1))
         assert srv._recv_msg(b) == ('ping', 1)
-        # tampered payload under a wrong key
+        # tampered payload under a wrong key (hmac alg slot)
         payload = pickle.dumps(('evil',))
         bad_tag = _hmac.new(b'wrong-key', payload,
                             hashlib.sha256).digest()
-        a.sendall(struct.pack('<Q', len(payload)) + bad_tag + payload)
+        a.sendall(struct.pack('<QB', len(payload), srv._ALG_HMAC) +
+                  b'\x00' * 16 + bad_tag + payload)
         import pytest as _pytest
         with _pytest.raises(ConnectionError):
             srv._recv_msg(b)
@@ -342,7 +343,8 @@ def test_forged_frame_cannot_execute_code(tmp_path):
     tag = _hmac.new(srv._frame_key(), payload, hashlib.sha256).digest()
     a, b = _socket.socketpair()
     try:
-        a.sendall(struct.pack('<Q', len(payload)) + tag + payload)
+        a.sendall(struct.pack('<QB', len(payload), srv._ALG_HMAC) +
+                  b'\x00' * 16 + tag + payload)
         with pytest.raises(ConnectionError):
             srv._recv_msg(b)
     finally:
@@ -359,8 +361,45 @@ def test_oversize_frame_rejected_before_allocation():
     from mxnet_tpu import kvstore_server as srv
     a, b = _socket.socketpair()
     try:
-        a.sendall(struct.pack('<Q', srv._MAX_FRAME_BYTES + 1))
+        a.sendall(struct.pack('<QB', srv._MAX_FRAME_BYTES + 1, 0) +
+                  b'\x00' * 48)
         with pytest.raises(ConnectionError, match='exceeds limit'):
+            srv._recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_poly1305_roundtrip_and_tampering(monkeypatch):
+    """The fast Poly1305 frame MAC (one-time key per nonce, derived
+    through HMAC of the frame key — docs/PERF.md round 5): frames
+    round-trip, and flipping one payload bit or the nonce fails
+    verification."""
+    import socket as _socket
+    from mxnet_tpu import kvstore_server as srv
+    if not srv._poly1305_cls():
+        pytest.skip('cryptography not installed')
+    monkeypatch.setenv('MXNET_TPU_PS_MAC', 'poly')
+    a, b = _socket.socketpair()
+    try:
+        srv._send_msg(a, ('ping', np.arange(4096, dtype=np.float32)))
+        out = srv._recv_msg(b)
+        assert out[0] == 'ping'
+        np.testing.assert_array_equal(
+            out[1], np.arange(4096, dtype=np.float32))
+        # flip a payload bit behind a valid header
+        parts = srv._build_frame(('ping', 7))
+        blob = bytearray(b''.join(bytes(p) for p in parts))
+        blob[-1] ^= 1
+        a.sendall(blob)
+        with pytest.raises(ConnectionError, match='MAC verification'):
+            srv._recv_msg(b)
+        # flip a nonce bit (derives a different one-time key)
+        parts = srv._build_frame(('ping', 8))
+        blob = bytearray(b''.join(bytes(p) for p in parts))
+        blob[9] ^= 1
+        a.sendall(blob)
+        with pytest.raises(ConnectionError, match='MAC verification'):
             srv._recv_msg(b)
     finally:
         a.close()

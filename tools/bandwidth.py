@@ -57,32 +57,51 @@ def measure_mesh(size_mb, iters):
     return gb / dt
 
 
+def _ps_worker_proc(port, size_mb, iters, q):
+    """One worker PROCESS (threads would share the GIL with the server
+    and each other, understating what separate worker hosts achieve).
+    Times its own loop after a server barrier so process startup and
+    import cost stay out of the measurement."""
+    from mxnet_tpu import kvstore_server as ps
+    elems = int(size_mb * 1e6 / 4)
+    grad = np.ones((elems,), np.float32)
+    c = ps.DistServerClient('127.0.0.1', port, 1)
+    c.push('g', grad)   # warm both directions before timing
+    c.pull('g')
+    c.barrier()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        # the fused round the training path uses (push_pull_multi):
+        # grads up, updated weights back, one round trip
+        c.push_pull_multi([('g', grad)])
+    q.put(time.perf_counter() - t0)
+    c.close()
+
+
 def measure_ps(size_mb, iters, num_workers):
+    import multiprocessing as mp
     from mxnet_tpu import kvstore_server as ps
     srv = ps.KVStoreServer(0, num_workers, sync_mode=True)
     t = threading.Thread(target=srv.run, daemon=True)
     t.start()
     elems = int(size_mb * 1e6 / 4)
-    grad = np.ones((elems,), np.float32)
-    clients = [ps.DistServerClient('127.0.0.1', srv.port, 1)
-               for _ in range(num_workers)]
-    clients[0].init('g', np.zeros_like(grad))
+    ctl = ps.DistServerClient('127.0.0.1', srv.port, 1)
+    ctl.init('g', np.zeros((elems,), np.float32))
 
-    times = []
-
-    def worker(c):
-        for _ in range(iters):
-            c.push('g', grad)
-            c.pull('g')
-
-    t0 = time.perf_counter()
-    ths = [threading.Thread(target=worker, args=(c,)) for c in clients]
-    for th in ths:
-        th.start()
-    for th in ths:
-        th.join()
-    dt = (time.perf_counter() - t0) / iters
-    clients[0].stop_servers()
+    ctx = mp.get_context('spawn')
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_ps_worker_proc,
+                         args=(srv.port, size_mb, iters, q))
+             for _ in range(num_workers)]
+    for p in procs:
+        p.start()
+    dts = [q.get(timeout=600) for _ in procs]
+    for p in procs:
+        p.join()
+    if any(p.exitcode != 0 for p in procs):
+        raise RuntimeError('ps worker process failed')
+    dt = max(dts) / iters
+    ctl.stop_servers()
     gb = 2 * size_mb / 1e3      # push + pull
     print('workers=%d payload=%.1fMB time=%.2fms bw=%.2f GB/s/worker'
           % (num_workers, size_mb, dt * 1e3, gb / dt))
