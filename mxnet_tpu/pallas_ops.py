@@ -53,11 +53,14 @@ def _online_softmax_step(q, kblk, vblk, m, l, acc, scale, causal,
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                 acc_ref, *, scale, causal, block_q, block_k, num_kb):
+                 acc_ref, *, scale, causal, block_q, block_k, num_kb,
+                 offset):
     """One (bh, qi, kb) grid step of the streaming schedule.  kb is the
     minor grid dim: scratch (m, l, acc) carries the online softmax
     across kb steps; the last live kb writes o_ref and the per-row
-    logsumexp (saved for the fused backward)."""
+    logsumexp (saved for the fused backward).  `offset` = tk - tq:
+    causal q rows sit suffix-aligned against the keys (KV-decode
+    convention); 0 for square self-attention."""
     qi = pl.program_id(1)
     kb = pl.program_id(2)
 
@@ -65,7 +68,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     last_kb = num_kb - 1
     if causal:
         last_kb = jnp.minimum(
-            (qi * block_q + block_q - 1) // block_k, num_kb - 1)
+            (qi * block_q + block_q - 1 + offset) // block_k, num_kb - 1)
 
     @pl.when(kb == 0)
     def _init():
@@ -77,7 +80,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     def _compute():
         m_new, l_new, acc_new = _online_softmax_step(
             q_ref[0], k_ref[0], v_ref[0], m_ref[...], l_ref[...],
-            acc_ref[...], scale, causal, qi * block_q, kb * block_k)
+            acc_ref[...], scale, causal, qi * block_q + offset,
+            kb * block_k)
         m_ref[...] = m_new
         l_ref[...] = l_new
         acc_ref[...] = acc_new
@@ -89,7 +93,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
 
 
 def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
-                          causal, block_q, block_k, num_kb):
+                          causal, block_q, block_k, num_kb, offset):
     """Resident-K schedule: the whole K/V sequence for one head sits in
     VMEM (fetched once per head); a fori_loop walks k-blocks with the
     online-softmax recurrence, and causal q-tiles stop at the diagonal
@@ -107,11 +111,12 @@ def _attn_kernel_resident(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
         kblk = k_ref[0, pl.ds(kb * block_k, block_k), :]
         vblk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         return _online_softmax_step(q, kblk, vblk, m, l, acc, scale,
-                                    causal, qi * block_q, kb * block_k)
+                                    causal, qi * block_q + offset,
+                                    kb * block_k)
 
     if causal:
         upper = jnp.minimum(
-            (qi * block_q + block_q + block_k - 1) // block_k, num_kb)
+            (qi * block_q + block_q - 1 + offset) // block_k + 1, num_kb)
     else:
         upper = num_kb
     m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
@@ -131,50 +136,69 @@ _VMEM_RESIDENT_BYTES = 6 * 1024 * 1024
 _BWD_BLOCK = 1024
 
 
+def _try_fit(t, cap):
+    """Largest block <= cap dividing t (halving from cap) — the ONE
+    divisibility rule every schedule and the dense-fallback predicate
+    share, so they can never disagree about a shape's viability."""
+    b = min(cap, t)
+    while t % b:
+        b //= 2
+    return b
+
+
 def _fit_block(t, block_q):
-    """Largest power-of-two block <= block_q dividing t.  Sequence
-    lengths with no small power-of-two factor (e.g. prime T) would
-    degenerate to 1-row blocks that Mosaic rejects or runs
-    pathologically — raise with guidance instead."""
-    block_q = min(block_q, t)
-    while t % block_q:
-        block_q //= 2
-    if block_q < 8 and t > 8:
+    """_try_fit, raising on degenerate results.  Sequence lengths with
+    no small power-of-two factor (e.g. prime T) would degenerate to
+    1-row blocks that Mosaic rejects or runs pathologically — raise
+    with guidance instead."""
+    b = _try_fit(t, block_q)
+    if b < 8 and t > 8:
         raise ValueError(
             'flash_attention: sequence length %d has no power-of-two '
             'block factor >= 8; pad the sequence to a multiple of 128 '
             'or use full_attention for unaligned lengths' % t)
-    return block_q
+    return b
+
+
+def _schedule_caps(tq, tk, block_q):
+    """The (q, k) block caps each schedule fits with — forward first,
+    then backward (which prefers larger tiles, _BWD_BLOCK)."""
+    fwd_k = block_q if tq == tk else max(block_q, 256)
+    bwd = max(block_q, _BWD_BLOCK)
+    return ((tq, block_q), (tk, fwd_k), (tq, bwd), (tk, bwd))
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,
                     return_lse=False):
-    b, h, t, d = q.shape
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    offset = tk - tq          # causal rows suffix-align to the keys
     bh = b * h
-    qf = q.reshape(bh, t, d)
-    kf = k.reshape(bh, t, d)
-    vf = v.reshape(bh, t, d)
-    block_q = _fit_block(t, block_q)
-    block_k = block_q
-    num_kb = t // block_k
+    qf = q.reshape(bh, tq, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    block_q = _fit_block(tq, block_q)
+    block_k = _fit_block(tk, block_q if tq == tk else max(block_q, 256))
+    num_kb = tk // block_k
     itemsize = jnp.dtype(q.dtype).itemsize
-    resident = 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES
-    # lse rides along as (bh, t, 1): the trailing singleton keeps the
+    resident = 2 * tk * d * itemsize <= _VMEM_RESIDENT_BYTES
+    # lse rides along as (bh, tq, 1): the trailing singleton keeps the
     # row axis on the sublane dim so (block_q, 1) kernel views
     # broadcast directly against (block_q, block_k) scores
-    out_shapes = [jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-                  jax.ShapeDtypeStruct((bh, t, 1), jnp.float32)]
+    out_shapes = [jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+                  jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32)]
 
     if resident:
         out, lse = pl.pallas_call(
             functools.partial(_attn_kernel_resident, scale=scale,
                               causal=causal, block_q=block_q,
-                              block_k=block_k, num_kb=num_kb),
-            grid=(bh, t // block_q),
+                              block_k=block_k, num_kb=num_kb,
+                              offset=offset),
+            grid=(bh, tq // block_q),
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
-                pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
@@ -183,20 +207,22 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,
             out_shape=out_shapes,
             interpret=interpret,
         )(qf, kf, vf)
-        out = out.reshape(b, h, t, d)
+        out = out.reshape(b, h, tq, d)
         return (out, lse) if return_lse else out
 
-    grid = (bh, t // block_q, num_kb)
+    grid = (bh, tq // block_q, num_kb)
     if causal:
         # clamp masked k-blocks to the diagonal: repeated block indices
         # skip the HBM->VMEM fetch (compute is gated by pl.when)
-        kv_index = lambda i, j, n: (i, jnp.minimum(n, j), 0)
+        kv_index = lambda i, j, n: (
+            i, jnp.minimum(
+                n, (j * block_q + block_q - 1 + offset) // block_k), 0)
     else:
         kv_index = lambda i, j, n: (i, n, 0)
     out, lse = pl.pallas_call(
         functools.partial(_attn_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          num_kb=num_kb),
+                          num_kb=num_kb, offset=offset),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, n: (i, j, 0)),
@@ -215,7 +241,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    out = out.reshape(b, h, t, d)
+    out = out.reshape(b, h, tq, d)
     return (out, lse) if return_lse else out
 
 
@@ -224,6 +250,8 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q, glse=None):
     O(block_q * T) instead of the dense O(T^2).  glse: optional
     logsumexp cotangent, folded into the softmax vjp."""
     bh, t, d = q.shape
+    tk = k.shape[1]
+    offset = tk - t
     block_q = _fit_block(t, block_q)
     nq = t // block_q
     qb = q.reshape(bh, nq, block_q, d)
@@ -235,11 +263,11 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q, glse=None):
         dk, dv = carry
         qi, qblk, gblk, lblk = blk
         s = jnp.einsum('bqd,bkd->bqk', qblk, k).astype(
-            jnp.float32) * scale                       # (bh, bq, T)
+            jnp.float32) * scale                       # (bh, bq, Tk)
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, t), 0)
-            cols = lax.broadcasted_iota(jnp.int32, (block_q, t), 1)
+            rows = qi * block_q + offset + lax.broadcasted_iota(
+                jnp.int32, (block_q, tk), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (block_q, tk), 1)
             s = jnp.where(rows >= cols, s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         pv = p.astype(v.dtype)
@@ -277,7 +305,7 @@ def _blocked_backward(q, k, v, g, causal, scale, block_q, glse=None):
 
 def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
                      dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                     num_qb):
+                     num_qb, offset):
     kb = pl.program_id(1)
     kblk = k_ref[0]                       # (block_k, D)
     vblk = v_ref[0]
@@ -295,7 +323,7 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
+            rows = qi * block_q + offset + lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             cols = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -317,14 +345,15 @@ def _bwd_dkdv_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
         return dk, dv
 
     # causal: the first q-block whose rows reach this k-block's columns
-    lower = (kb * block_k) // block_q if causal else 0
+    lower = jnp.maximum(kb * block_k - offset, 0) // block_q \
+        if causal else 0
     dk, dv = lax.fori_loop(lower, num_qb, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, num_kb):
+                   *, scale, causal, block_q, block_k, num_kb, offset):
     qi = pl.program_id(1)
     qblk = q_ref[0]                       # (block_q, D)
     doblk = do_ref[0]
@@ -340,7 +369,7 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dq_ref,
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
+            rows = qi * block_q + offset + lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             cols = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -356,7 +385,8 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dq_ref,
 
     if causal:
         upper = jnp.minimum(
-            (qi * block_q + block_q + block_k - 1) // block_k, num_kb)
+            (qi * block_q + block_q - 1 + offset) // block_k + 1,
+            num_kb)
     else:
         upper = num_kb
     dq = lax.fori_loop(0, upper, body, dq0)
@@ -365,7 +395,7 @@ def _bwd_dq_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref, dq_ref,
 
 def _bwd_dkdv_stream_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
                             dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
-                            causal, block_q, block_k, num_qb):
+                            causal, block_q, block_k, num_qb, offset):
     """Streaming dK/dV: grid (bh, kb, qi) with the q-block axis
     innermost; q/dO/lse/D arrive one block per grid step (O(block)
     VMEM regardless of T), dk/dv accumulate in f32 scratch and write
@@ -380,7 +410,8 @@ def _bwd_dkdv_stream_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    lower = (kb * block_k) // block_q if causal else 0
+    lower = jnp.maximum(kb * block_k - offset, 0) // block_q \
+        if causal else 0
 
     @pl.when(qi >= lower)
     def _compute():
@@ -394,7 +425,7 @@ def _bwd_dkdv_stream_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
+            rows = qi * block_q + offset + lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             cols = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -419,7 +450,7 @@ def _bwd_dkdv_stream_kernel(q_ref, do_ref, lse_ref, dd_ref, k_ref, v_ref,
 
 def _bwd_dq_stream_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
                           dq_ref, dq_acc, *, scale, causal, block_q,
-                          block_k, num_kb):
+                          block_k, num_kb, offset):
     """Streaming dQ: grid (bh, qi, kb) with the k-block axis innermost;
     k/v stream one block per step, dq accumulates in f32 scratch."""
     qi = pl.program_id(1)
@@ -430,7 +461,7 @@ def _bwd_dq_stream_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     if causal:
-        upper = (qi * block_q + block_q + block_k - 1) // block_k
+        upper = (qi * block_q + block_q - 1 + offset) // block_k + 1
     else:
         upper = num_kb
 
@@ -446,7 +477,7 @@ def _bwd_dq_stream_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, dd_ref,
             qblk, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
+            rows = qi * block_q + offset + lax.broadcasted_iota(
                 jnp.int32, s.shape, 0)
             cols = kb * block_k + lax.broadcasted_iota(
                 jnp.int32, s.shape, 1)
@@ -472,10 +503,13 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
     glse: optional cotangent on the logsumexp output — it folds exactly
     into the D preprocess (ds = p*(dp - (D - glse)))."""
     bh, t, d = q.shape
+    tk = k.shape[1]
+    offset = tk - t
     block_q = _fit_block(t, max(block_q, _BWD_BLOCK))
-    block_k = block_q
+    block_k = block_q if t == tk else _fit_block(
+        tk, max(block_q, _BWD_BLOCK))
     num_qb = t // block_q
-    num_kb = t // block_k
+    num_kb = tk // block_k
     dd = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
                  axis=-1, keepdims=True)
     if glse is not None:
@@ -484,9 +518,11 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
     if causal:
         # fetch-clamp skipped diagonal blocks (compute is pl.when-gated)
         q_index = lambda i, n, j: (
-            i, jnp.maximum(j, (n * block_k) // block_q), 0)
+            i, jnp.maximum(
+                j, jnp.maximum(n * block_k - offset, 0) // block_q), 0)
         k_index_dq = lambda i, j, n: (
-            i, jnp.minimum(n, (j * block_q + block_q - 1) // block_k), 0)
+            i, jnp.minimum(
+                n, (j * block_q + block_q - 1 + offset) // block_k), 0)
     else:
         q_index = lambda i, n, j: (i, j, 0)
         k_index_dq = lambda i, j, n: (i, n, 0)
@@ -494,7 +530,8 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_stream_kernel, scale=scale,
                           causal=causal, block_q=block_q,
-                          block_k=block_k, num_qb=num_qb),
+                          block_k=block_k, num_qb=num_qb,
+                          offset=offset),
         grid=(bh, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_index),            # q
@@ -508,8 +545,8 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda i, n, j: (i, n, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, n, j: (i, n, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -520,7 +557,8 @@ def _flash_bwd_stream_impl(q, k, v, g, o, lse, causal, scale, block_q,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_stream_kernel, scale=scale,
                           causal=causal, block_q=block_q,
-                          block_k=block_k, num_kb=num_kb),
+                          block_k=block_k, num_kb=num_kb,
+                          offset=offset),
         grid=(bh, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_k, d), k_index_dq),         # k
@@ -543,13 +581,16 @@ def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
                     interpret, glse=None):
     """Fused two-kernel backward over flat (bh, t, d) tensors."""
     bh, t, d = q.shape
+    tk = k.shape[1]
+    offset = tk - t
     # the backward wants larger tiles than the forward: its per-tile
     # matmul chain (5 MXU passes) amortizes loop overhead better, and
     # VMEM pressure is lower (no online-softmax scratch)
     block_q = _fit_block(t, max(block_q, _BWD_BLOCK))
-    block_k = block_q
+    block_k = block_q if t == tk else _fit_block(
+        tk, max(block_q, _BWD_BLOCK))
     num_qb = t // block_q
-    num_kb = t // block_k
+    num_kb = tk // block_k
     # pass 0: D_i = dO_i . O_i — one fused elementwise+reduce XLA pass.
     # A logsumexp cotangent folds in here: ds = p*(dp - (D - glse)).
     dd = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
@@ -560,7 +601,7 @@ def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          num_qb=num_qb),
+                          num_qb=num_qb, offset=offset),
         grid=(bh, num_kb),
         in_specs=[
             pl.BlockSpec((1, t, d), lambda i, n: (i, 0, 0)),   # q
@@ -574,19 +615,19 @@ def _flash_bwd_impl(q, k, v, g, o, lse, causal, scale, block_q,
             pl.BlockSpec((1, block_k, d), lambda i, n: (i, n, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, n: (i, n, 0)),
         ],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)],
         interpret=interpret,
     )(q, g, lse, dd, k, v)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          num_kb=num_kb),
+                          num_kb=num_kb, offset=offset),
         grid=(bh, num_qb),
         in_specs=[
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # k
-            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # v
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),  # k
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),  # v
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
@@ -615,29 +656,32 @@ def _flash_bwd_shared(causal, scale, block_q, interpret, res, g,
     """Schedule-selecting backward shared by the plain and with-lse
     custom VJPs; glse is the optional logsumexp cotangent."""
     q, k, v, o, lse = res
-    b, h, t, d = q.shape
-    flat = lambda x: x.reshape(b * h, t, d)
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    flatq = lambda x: x.reshape(b * h, tq, d)
+    flatk = lambda x: x.reshape(b * h, tk, d)
     itemsize = jnp.dtype(q.dtype).itemsize
-    glse_flat = None if glse is None else glse.reshape(b * h, t, 1)
-    args = (flat(q), flat(k), flat(v), flat(g), flat(o),
-            lse.reshape(b * h, t, 1), causal, scale, block_q, interpret)
-    fitted = min(max(block_q, _BWD_BLOCK), t)
-    while t % fitted:
-        fitted //= 2
-    if 2 * t * d * itemsize <= _VMEM_RESIDENT_BYTES:
-        # resident schedule: one head's full sequence (q+dO / k+v) in
-        # VMEM — fewer grid steps, best for short-to-mid T
+    glse_flat = None if glse is None else glse.reshape(b * h, tq, 1)
+    args = (flatq(q), flatk(k), flatk(v), flatq(g), flatq(o),
+            lse.reshape(b * h, tq, 1), causal, scale, block_q,
+            interpret)
+    fitted_q = _try_fit(tq, max(block_q, _BWD_BLOCK))
+    fitted_k = _try_fit(tk, max(block_q, _BWD_BLOCK))
+    if 2 * max(tq, tk) * d * itemsize <= _VMEM_RESIDENT_BYTES:
+        # resident schedule: one head's full sequence (q+dO in the
+        # dK/dV kernel, k+v in the dQ kernel) sits in VMEM — BOTH
+        # sides must fit, hence max(tq, tk)
         dq, dk, dv = _flash_bwd_impl(*args, glse=glse_flat)
-    elif fitted >= 8:
+    elif fitted_q >= 8 and fitted_k >= 8:
         # streaming schedule: O(block) VMEM for any T (the long-context
         # path — T=32k+ stays on the fused Pallas kernels)
         dq, dk, dv = _flash_bwd_stream_impl(*args, glse=glse_flat)
     else:
-        dq, dk, dv = _blocked_backward(flat(q), flat(k), flat(v),
-                                       flat(g), causal, scale, block_q,
+        dq, dk, dv = _blocked_backward(flatq(q), flatk(k), flatk(v),
+                                       flatq(g), causal, scale, block_q,
                                        glse=glse_flat)
-    unflat = lambda x: x.reshape(b, h, t, d)
-    return unflat(dq), unflat(dk), unflat(dv)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 def _flash_bwd_rule(causal, scale, block_q, interpret, res, g):
@@ -669,38 +713,71 @@ def _flash_lse_bwd_rule(causal, scale, block_q, interpret, res, cts):
 _flash_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
+def _validate_attn_shapes(q, k, v, causal, fn):
+    """Rectangular attention contract: same (batch, heads, head_dim),
+    k/v identical, and causal requires tq <= tk (rows suffix-align to
+    the keys — the KV-cache decode convention; tq > tk would leave the
+    leading rows with no visible key)."""
+    if k.shape != v.shape:
+        raise ValueError('%s requires identical k/v shapes; got %s / %s'
+                         % (fn, k.shape, v.shape))
+    if q.ndim != 4 or k.ndim != 4 or \
+            q.shape[:2] != k.shape[:2] or q.shape[-1] != k.shape[-1]:
+        raise ValueError(
+            '%s wants (batch, heads, seq, head_dim) with matching '
+            'batch/heads/head_dim; got q %s vs k %s'
+            % (fn, q.shape, k.shape))
+    if causal and q.shape[2] > k.shape[2]:
+        raise ValueError(
+            '%s: causal masking needs q_len <= kv_len (suffix '
+            'alignment); got q_len=%d kv_len=%d'
+            % (fn, q.shape[2], k.shape[2]))
+
+
+def _needs_dense_fallback(tq, tk, block_q):
+    """No Pallas, or a length no schedule can tile: the check runs
+    _try_fit with exactly the caps the forward AND backward schedules
+    will use (_schedule_caps), so the predicate and the kernels can
+    never disagree."""
+    if not _HAS_PALLAS:
+        return True
+    return any(_try_fit(t, cap) < 8 and t > 8
+               for t, cap in _schedule_caps(tq, tk, block_q))
+
+
+def _dense_attention_lse(q, k, v, causal, scale):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = ((tk - tq) + jnp.arange(tq)[:, None] >=
+                jnp.arange(tk)[None, :])
+        s = jnp.where(mask, s, -jnp.inf)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    out = jnp.einsum('bhqk,bhkd->bhqd',
+                     jnp.exp(s - lse[..., None]), v.astype(
+                         jnp.float32)).astype(q.dtype)
+    return out, lse.reshape(b * h, tq, 1)
+
+
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
                              block_q=None, interpret=None):
     """flash_attention variant that ALSO returns the per-row logsumexp
-    (bh, t, 1) — the merge currency for ring attention / partial
+    (bh, tq, 1) — the merge currency for ring attention / partial
     softmax combination — and is differentiable in BOTH outputs (the
     lse cotangent folds into the backward's D preprocess).  Falls back
     to a dense jnp computation when Pallas is unavailable."""
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError('flash_attention_with_lse requires equal '
-                         'q/k/v shapes; got %s / %s / %s'
-                         % (q.shape, k.shape, v.shape))
-    b, h, t, d = q.shape
+    _validate_attn_shapes(q, k, v, causal, 'flash_attention_with_lse')
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if block_q is None:
-        block_q = max(256, min(1024, t // 32))
+        block_q = max(256, min(1024, tq // 32))
     # dense fallback: no Pallas, or a sequence length with no usable
     # power-of-two block factor (natively differentiable either way)
-    fitted = min(block_q, t)
-    while t % fitted:
-        fitted //= 2
-    if not _HAS_PALLAS or (fitted < 8 and t > 8):
-        s = jnp.einsum('bhqd,bhkd->bhqk', q, k).astype(
-            jnp.float32) * scale
-        if causal:
-            mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
-            s = jnp.where(mask, s, -jnp.inf)
-        lse = jax.scipy.special.logsumexp(s, axis=-1)
-        out = jnp.einsum('bhqk,bhkd->bhqd',
-                         jnp.exp(s - lse[..., None]), v.astype(
-                             jnp.float32)).astype(q.dtype)
-        return out, lse.reshape(b * h, t, 1)
+    if _needs_dense_fallback(tq, tk, block_q):
+        return _dense_attention_lse(q, k, v, causal, scale)
     if interpret is None:
         interpret = jax.devices()[0].platform != 'tpu'
     return _flash_lse(q, k, v, bool(causal), float(scale), int(block_q),
@@ -711,11 +788,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
                     interpret=None):
     """Streaming Pallas attention.
 
-    q, k, v: (batch, heads, seq, head_dim) with equal seq lengths
-    (square self-attention; cross-attention / KV-cache decode take
-    `full_attention` — a documented v1 constraint).  Returns the same
-    shape.  On non-TPU backends runs in Pallas interpret mode (slow but
-    correct) unless `interpret` is passed explicitly.
+    q: (batch, heads, q_len, head_dim); k, v: (batch, heads, kv_len,
+    head_dim).  q_len == kv_len is self-attention; q_len != kv_len
+    covers cross-attention and KV-cache decode, where causal rows are
+    SUFFIX-aligned to the keys (query row i sees keys up to
+    kv_len - q_len + i — the standard decode convention).  Returns
+    q's shape.  On non-TPU backends runs in Pallas interpret mode
+    (slow but correct) unless `interpret` is passed explicitly.
 
     block_q: row-tile edge.  Default (None) auto-scales with the
     sequence — 256 for short T, up to 1024 for long T, where the
@@ -723,17 +802,13 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     explicit value is honored exactly (e.g. to bound VMEM for large
     head_dim).
     """
-    if q.shape != k.shape or q.shape != v.shape:
-        raise ValueError(
-            'flash_attention requires square self-attention (equal '
-            'q/k/v shapes); got %s / %s / %s — use full_attention for '
-            'cross attention or KV-cache decode'
-            % (q.shape, k.shape, v.shape))
+    _validate_attn_shapes(q, k, v, causal, 'flash_attention')
+    tq, tk = q.shape[2], k.shape[2]
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     if block_q is None:
-        block_q = max(256, min(1024, q.shape[2] // 32))
-    if not _HAS_PALLAS:
+        block_q = max(256, min(1024, tq // 32))
+    if _needs_dense_fallback(tq, tk, block_q):
         from .parallel.ring_attention import full_attention
         return full_attention(q, k, v, causal=causal, scale=scale)
     if interpret is None:

@@ -182,20 +182,32 @@ def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False,
 
 
 def full_attention(q, k, v, causal=False, scale=None, use_flash=False):
-    """Single-device attention.  use_flash=True routes (B, H, T, D)
-    inputs through the streaming Pallas kernel (pallas_ops.py) — same
-    numerics, no T^2 HBM scores, ~2x faster at long causal T."""
-    if use_flash and q.ndim == 4 and q.shape == k.shape == v.shape:
+    """Single-device attention; q_len may differ from kv_len
+    (cross-attention / KV-cache decode — causal rows suffix-align to
+    the keys).  use_flash=True routes (B, H, Tq, D) inputs through the
+    streaming Pallas kernel (pallas_ops.py) — same numerics, no T^2
+    HBM scores, ~2x faster at long causal T."""
+    if use_flash and q.ndim == 4 and k.shape == v.shape and \
+            q.shape[:2] == k.shape[:2] and q.shape[-1] == k.shape[-1] \
+            and (not causal or q.shape[2] <= k.shape[2]):
         from .. import pallas_ops
         return pallas_ops.flash_attention(q, k, v, causal=causal,
                                           scale=scale)
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    if causal and q.shape[-2] > k.shape[-2]:
+        raise ValueError(
+            'full_attention: causal masking needs q_len <= kv_len '
+            '(suffix alignment — the leading rows would see no keys); '
+            'got q_len=%d kv_len=%d' % (q.shape[-2], k.shape[-2]))
     s = jnp.einsum('...qd,...kd->...qk', q, k) * scale
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        # suffix alignment: query row i attends keys <= tk - tq + i
+        # (equals the plain lower triangle when tq == tk)
+        mask = (tk - tq) + jnp.arange(tq)[:, None] >= \
+            jnp.arange(tk)[None, :]
         s = jnp.where(mask, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum('...qk,...kd->...qd', p, v)

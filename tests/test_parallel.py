@@ -542,9 +542,75 @@ def test_flash_attention_with_lse():
     assert o2.shape == qq.shape and l2.shape == (1, 30, 1)
 
 
-def test_pallas_flash_rejects_cross_attention():
+def test_pallas_flash_accepts_cross_attention():
+    """Round 5 lifted the v1 square-only constraint: rectangular
+    q/k shapes are first-class (conformance in
+    test_pallas_flash_rectangular; this is the API-level check that
+    the old rejection is gone)."""
     from mxnet_tpu import pallas_ops
     q = jnp.ones((1, 1, 4, 8))
     k = jnp.ones((1, 1, 16, 8))
-    with pytest.raises(ValueError):
-        pallas_ops.flash_attention(q, k, k)
+    out = pallas_ops.flash_attention(q, k, k)
+    assert out.shape == q.shape
+
+
+@pytest.mark.parametrize('tq,tk', [(128, 512), (8, 512), (128, 384),
+                                   (512, 128)])
+def test_pallas_flash_rectangular(tq, tk):
+    """q_len != kv_len (cross-attention / KV-cache decode): forward and
+    all three gradients match the dense oracle under both causal
+    conventions, on every schedule (resident + forced-streaming).
+    Causal rows are SUFFIX-aligned to the keys (docs/PERF.md round 5);
+    full_attention shares the same convention."""
+    from mxnet_tpu import pallas_ops
+    rs = np.random.RandomState(7)
+    B, H, D = 2, 2, 32
+    q = jnp.asarray(rs.randn(B, H, tq, D).astype(np.float32) * 0.3)
+    k = jnp.asarray(rs.randn(B, H, tk, D).astype(np.float32) * 0.3)
+    v = jnp.asarray(rs.randn(B, H, tk, D).astype(np.float32) * 0.3)
+    g = jnp.asarray(rs.randn(B, H, tq, D).astype(np.float32))
+    for causal in (False, True):
+        if causal and tq > tk:
+            continue  # rejected by design (suffix alignment)
+        def loss_flash(q, k, v, causal=causal):
+            return jnp.sum(pallas_ops.flash_attention(
+                q, k, v, causal=causal, block_q=64) * g)
+
+        def loss_ref(q, k, v, causal=causal):
+            return jnp.sum(full_attention(q, k, v, causal=causal) * g)
+
+        out = pallas_ops.flash_attention(q, k, v, causal=causal,
+                                         block_q=64)
+        ref = full_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-4)
+        resident = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        old = pallas_ops._VMEM_RESIDENT_BYTES
+        pallas_ops._VMEM_RESIDENT_BYTES = 1
+        try:
+            streamed = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        finally:
+            pallas_ops._VMEM_RESIDENT_BYTES = old
+        oracle = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for s, r, o in zip(streamed, resident, oracle):
+            np.testing.assert_allclose(np.asarray(s), np.asarray(r),
+                                       rtol=5e-3, atol=5e-4)
+            np.testing.assert_allclose(np.asarray(s), np.asarray(o),
+                                       rtol=5e-3, atol=5e-4)
+
+
+def test_flash_rectangular_validation():
+    from mxnet_tpu import pallas_ops
+    q = jnp.zeros((1, 1, 64, 16))
+    k = jnp.zeros((1, 1, 32, 16))
+    v = jnp.zeros((1, 1, 32, 16))
+    with pytest.raises(ValueError, match='q_len <= kv_len'):
+        pallas_ops.flash_attention(q, k, v, causal=True)
+    with pytest.raises(ValueError, match='identical k/v'):
+        pallas_ops.flash_attention(q, k, jnp.zeros((1, 1, 16, 16)))
+    # the dense fallback enforces the same convention
+    with pytest.raises(ValueError, match='q_len <= kv_len'):
+        full_attention(q, k, v, causal=True)
+    # non-causal tq > tk is legal
+    out = pallas_ops.flash_attention(q, k, v, causal=False)
+    assert out.shape == q.shape
