@@ -49,6 +49,11 @@ def main(quick=False):
     n = 2048 if quick else 8192
     epochs = 15 if quick else 40
     batch_size = 128
+    # deterministic init + shuffle: the assertion threshold is tight,
+    # and without seeding the result depends on how much global RNG
+    # state earlier code consumed (CI runs many examples in one process)
+    mx.random.seed(11)
+    np.random.seed(11)
     X = make_data(n)
     # unsupervised: the reconstruction target IS the input
     train = mx.io.NDArrayIter({'data': X}, {'target': X},

@@ -1,0 +1,121 @@
+"""Fully-convolutional semantic segmentation (the reference's fcn-xs).
+
+Reference: example/fcn-xs/ — FCN-32s/16s on VGG: a conv backbone
+downsamples, a 1x1 conv scores per class, a Deconvolution upsamples the
+score map back to input resolution, Crop aligns it, and a per-pixel
+softmax (multi_output) trains the whole thing end-to-end.  Same
+pipeline here at toy scale on synthetic scenes: grayscale images
+containing filled rectangles (class 1) and disks (class 2) on
+background (class 0); the net must label every pixel.
+
+Exercises the upsampling consumers the op suite otherwise only
+unit-tests: Deconvolution, Crop(crop_like), SoftmaxOutput
+multi_output.  Pixel accuracy must beat 0.9 (background-only scores
+~0.72).
+"""
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+SIZE = 32
+CLASSES = 3
+
+
+def make_scene(rng):
+    img = rng.randn(SIZE, SIZE).astype(np.float32) * 0.15
+    lab = np.zeros((SIZE, SIZE), np.float32)
+    # one rectangle
+    x0, y0 = rng.randint(1, SIZE - 14, 2)
+    w, h = rng.randint(9, 14, 2)
+    img[y0:y0 + h, x0:x0 + w] += 1.0
+    lab[y0:y0 + h, x0:x0 + w] = 1
+    # one disk
+    cx, cy = rng.randint(9, SIZE - 9, 2)
+    r = rng.randint(6, 9)
+    yy, xx = np.mgrid[0:SIZE, 0:SIZE]
+    disk = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+    img[disk] -= 1.0
+    lab[disk] = 2
+    return img, lab
+
+
+def make_data(n, rng):
+    xs = np.zeros((n, 1, SIZE, SIZE), np.float32)
+    ys = np.zeros((n, SIZE, SIZE), np.float32)
+    for i in range(n):
+        xs[i, 0], ys[i] = make_scene(rng)
+    return xs, ys
+
+
+def build_net():
+    data = sym.Variable('data')
+    body = sym.Convolution(data, num_filter=16, kernel=(3, 3),
+                           pad=(1, 1), name='conv1')
+    body = sym.Activation(body, act_type='relu')
+    body = sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                       pool_type='max')
+    body = sym.Convolution(body, num_filter=32, kernel=(3, 3),
+                           pad=(1, 1), name='conv2')
+    body = sym.Activation(body, act_type='relu')
+    body = sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                       pool_type='max')                       # /4
+    score = sym.Convolution(body, num_filter=CLASSES, kernel=(1, 1),
+                            name='score')
+    # FCN upsample: stride-4 deconvolution + crop back to the input
+    # (reference fcn_xs symbol: Deconvolution 'bigscore' + Crop)
+    up = sym.Deconvolution(score, num_filter=CLASSES, kernel=(8, 8),
+                           stride=(4, 4), pad=(2, 2), no_bias=True,
+                           name='bigscore')
+    up = sym.Crop(up, data, num_args=2, name='crop')
+    return sym.SoftmaxOutput(up, multi_output=True, name='softmax')
+
+
+def main(quick=False):
+    # deterministic regardless of how much global RNG state
+    # earlier in-process examples consumed (CI ordering)
+    mx.random.seed(22)
+    np.random.seed(22)
+    rng = np.random.RandomState(1)
+    n_train = 200 if quick else 1000
+    epochs = 16 if quick else 40
+    xtr, ytr = make_data(n_train, rng)
+    xte, yte = make_data(64, rng)
+
+    net = build_net()
+    mod = mx.mod.Module(net, label_names=['softmax_label'])
+    batch = 16
+    train = mx.io.NDArrayIter({'data': xtr}, {'softmax_label': ytr},
+                              batch, shuffle=True)
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(optimizer='adam',
+                       optimizer_params={'learning_rate': 0.005})
+    for epoch in range(epochs):
+        train.reset()
+        for b in train:
+            mod.forward_backward(b)
+            mod.update()
+
+    test = mx.io.NDArrayIter({'data': xte}, {'softmax_label': yte},
+                             batch)
+    correct = seen = 0
+    for b in test:
+        mod.forward(b, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        lab = b.label[0].asnumpy()
+        correct += int((pred == lab).sum())
+        seen += lab.size
+    acc = correct / seen
+    bg = float((yte == 0).mean())
+    print('pixel accuracy %.3f (all-background baseline %.3f)'
+          % (acc, bg))
+    return acc, bg
+
+
+if __name__ == '__main__':
+    acc, bg = main(quick='--quick' in sys.argv)
+    sys.exit(0 if acc > max(0.9, bg + 0.1) else 1)

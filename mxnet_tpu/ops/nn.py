@@ -650,7 +650,7 @@ def _sequence_mask(attrs, data, sequence_length=None):
     steps = jnp.arange(data.shape[0])
     mask = steps[:, None] < sequence_length.astype(jnp.int32)[None, :]
     mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
-    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+    return jnp.where(mask, data, np.dtype(data.dtype).type(value))
 
 
 @register('SequenceReverse', input_names=_seq_names, hint='sequencereverse')

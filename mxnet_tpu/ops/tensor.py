@@ -62,7 +62,13 @@ for _n, _f in [('_equal', jnp.equal), ('_not_equal', jnp.not_equal),
 def _reg_scalar(name, fn):
     @register(name, input_names=('data',), shape_rule='same')
     def _op(attrs, data, _fn=fn):
-        s = jnp.asarray(asfloat(attrs['scalar']), dtype=data.dtype)
+        # a HOST numpy scalar in the data's dtype: it inlines into the
+        # op on the data's device.  jnp.asarray here would COMMIT the
+        # scalar to the default device — with an accelerator attached
+        # and the array on cpu, that drags a cross-device transfer
+        # (~100 ms through the TPU tunnel) into every eager scalar op
+        # (docs/PERF.md round 5).
+        s = np.dtype(data.dtype).type(asfloat(attrs['scalar']))
         return _fn(data, s)
     return _op
 
@@ -778,4 +784,4 @@ def _slice_assign(attrs, lhs, rhs):
 def _crop_assign_scalar(attrs, data):
     idx = _assign_slices(attrs, data.shape)
     val = asfloat(attrs.get('scalar', 0.0))
-    return data.at[idx].set(jnp.asarray(val, dtype=data.dtype))
+    return data.at[idx].set(np.dtype(data.dtype).type(val))

@@ -1,0 +1,422 @@
+/* Perl XS binding over the training C ABI (src/c_api_train.cc).
+ *
+ * Parity role: the reference's perl-package (AI::MXNet) binds the same
+ * C contract through SWIG-generated glue; this is the hand-rolled
+ * equivalent at proof-of-contract scale — enough surface for a Perl
+ * program to compose symbols, bind an executor, run fwd/bwd, and apply
+ * SGD updates with zero Python in the caller (the interpreter is
+ * embedded behind the ABI).  Handles cross the boundary as IVs.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern const char* MXTTrainGetLastError(void);
+extern int MXTNDArrayCreateFromBytes(const uint32_t*, uint32_t,
+                                     const float*, int, int, void**);
+extern int MXTNDArraySyncCopyFromCPU(void*, const float*, size_t);
+extern int MXTNDArraySyncCopyToCPU(void*, float*, size_t);
+extern int MXTNDArrayGetShape(void*, uint32_t*, const uint32_t**);
+extern void MXTNDArrayFree(void*);
+extern int MXTSymbolCreateVariable(const char*, void**);
+extern int MXTSymbolCreate(const char*, const char*, uint32_t,
+                           const char**, const char**, uint32_t,
+                           const char**, void**, void**);
+extern int MXTSymbolListArguments(void*, uint32_t*, const char***);
+extern void MXTSymbolFree(void*);
+extern int MXTExecutorSimpleBind(void*, int, int, const char*, uint32_t,
+                                 const char**, const uint32_t*,
+                                 const uint32_t*, void**);
+extern int MXTExecutorForward(void*, int);
+extern int MXTExecutorBackward(void*);
+extern int MXTExecutorOutput(void*, uint32_t, void**);
+extern int MXTExecutorArgArray(void*, const char*, void**);
+extern int MXTExecutorGradArray(void*, const char*, void**);
+extern void MXTExecutorFree(void*);
+extern int MXTUpdaterCreate(const char*, uint32_t, const char**,
+                            const char**, void**);
+extern int MXTUpdaterStep(void*, int, void*, void*);
+extern void MXTUpdaterFree(void*);
+
+static void croak_on(pTHX_ int rc, const char* what) {
+  if (rc != 0) croak("%s failed: %s", what, MXTTrainGetLastError());
+}
+
+/* Perl arrayref of numbers -> malloc'd float array (caller frees). */
+static float* av_to_floats(pTHX_ SV* ref, size_t* out_n) {
+  AV* av;
+  size_t n, i;
+  float* out;
+  if (!SvROK(ref) || SvTYPE(SvRV(ref)) != SVt_PVAV)
+    croak("expected an array reference");
+  av = (AV*)SvRV(ref);
+  n = av_len(av) + 1;
+  out = (float*)malloc(n * sizeof(float));
+  for (i = 0; i < n; ++i) {
+    SV** elem = av_fetch(av, i, 0);
+    out[i] = elem ? (float)SvNV(*elem) : 0.0f;
+  }
+  *out_n = n;
+  return out;
+}
+
+static uint32_t* av_to_u32(pTHX_ SV* ref, size_t* out_n) {
+  AV* av;
+  size_t n, i;
+  uint32_t* out;
+  if (!SvROK(ref) || SvTYPE(SvRV(ref)) != SVt_PVAV)
+    croak("expected an array reference");
+  av = (AV*)SvRV(ref);
+  n = av_len(av) + 1;
+  out = (uint32_t*)malloc(n * sizeof(uint32_t));
+  for (i = 0; i < n; ++i) {
+    SV** elem = av_fetch(av, i, 0);
+    out[i] = elem ? (uint32_t)SvUV(*elem) : 0;
+  }
+  *out_n = n;
+  return out;
+}
+
+/* arrayref of strings -> argv-style vector (pointers borrow the SVs) */
+static const char** av_to_strs(pTHX_ SV* ref, size_t* out_n) {
+  AV* av;
+  size_t n, i;
+  const char** out;
+  if (!SvROK(ref) || SvTYPE(SvRV(ref)) != SVt_PVAV)
+    croak("expected an array reference");
+  av = (AV*)SvRV(ref);
+  n = av_len(av) + 1;
+  out = (const char**)malloc((n ? n : 1) * sizeof(char*));
+  for (i = 0; i < n; ++i) {
+    SV** elem = av_fetch(av, i, 0);
+    out[i] = elem ? SvPV_nolen(*elem) : "";
+  }
+  *out_n = n;
+  return out;
+}
+
+MODULE = MxTpu  PACKAGE = MxTpu
+
+PROTOTYPES: DISABLE
+
+IV
+nd_create(shape_ref, data_ref)
+    SV* shape_ref
+    SV* data_ref
+  CODE:
+    {
+      size_t ns, nd;
+      uint32_t* shape = av_to_u32(aTHX_ shape_ref, &ns);
+      float* data = av_to_floats(aTHX_ data_ref, &nd);
+      void* h = NULL;
+      int rc = MXTNDArrayCreateFromBytes(shape, (uint32_t)ns, data,
+                                         1, 0, &h);
+      free(shape);
+      free(data);
+      croak_on(aTHX_ rc, "MXTNDArrayCreateFromBytes");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+nd_copy_from(h, data_ref)
+    IV h
+    SV* data_ref
+  CODE:
+    {
+      size_t nd;
+      float* data = av_to_floats(aTHX_ data_ref, &nd);
+      int rc = MXTNDArraySyncCopyFromCPU(INT2PTR(void*, h), data, nd);
+      free(data);
+      croak_on(aTHX_ rc, "MXTNDArraySyncCopyFromCPU");
+    }
+
+SV*
+nd_to_array(h)
+    IV h
+  CODE:
+    {
+      uint32_t ndim = 0;
+      const uint32_t* dims = NULL;
+      size_t n = 1, i;
+      float* buf;
+      AV* av;
+      croak_on(aTHX_ MXTNDArrayGetShape(INT2PTR(void*, h), &ndim, &dims),
+               "MXTNDArrayGetShape");
+      for (i = 0; i < ndim; ++i) n *= dims[i];
+      buf = (float*)malloc(n * sizeof(float));
+      if (MXTNDArraySyncCopyToCPU(INT2PTR(void*, h), buf, n) != 0) {
+        free(buf);   /* croak longjmps; free first */
+        croak("MXTNDArraySyncCopyToCPU failed: %s",
+              MXTTrainGetLastError());
+      }
+      av = newAV();
+      for (i = 0; i < n; ++i) av_push(av, newSVnv(buf[i]));
+      free(buf);
+      RETVAL = newRV_noinc((SV*)av);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+nd_free(h)
+    IV h
+  CODE:
+    MXTNDArrayFree(INT2PTR(void*, h));
+
+IV
+sym_variable(name)
+    const char* name
+  CODE:
+    {
+      void* h = NULL;
+      croak_on(aTHX_ MXTSymbolCreateVariable(name, &h),
+               "MXTSymbolCreateVariable");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+sym_create(op, name, keys_ref, vals_ref, argnames_ref, args_ref)
+    const char* op
+    const char* name
+    SV* keys_ref
+    SV* vals_ref
+    SV* argnames_ref
+    SV* args_ref
+  CODE:
+    {
+      size_t nk, nv, na, nh, i;
+      const char** keys;
+      const char** vals;
+      const char** argnames;
+      void** args;
+      void* h = NULL;
+      int rc;
+      AV* av;
+      /* validate lengths BEFORE any malloc: croak longjmps past
+       * free(), so allocation must follow validation */
+      if (!SvROK(args_ref) || SvTYPE(SvRV(args_ref)) != SVt_PVAV)
+        croak("args must be an array reference");
+      av = (AV*)SvRV(args_ref);
+      nh = av_len(av) + 1;
+      nk = SvROK(keys_ref) ? (size_t)(av_len((AV*)SvRV(keys_ref)) + 1) : 0;
+      nv = SvROK(vals_ref) ? (size_t)(av_len((AV*)SvRV(vals_ref)) + 1) : 0;
+      na = SvROK(argnames_ref)
+          ? (size_t)(av_len((AV*)SvRV(argnames_ref)) + 1) : 0;
+      if (nk != nv) croak("attr keys/vals length mismatch");
+      if (na != nh) croak("arg names/handles length mismatch");
+      keys = av_to_strs(aTHX_ keys_ref, &nk);
+      vals = av_to_strs(aTHX_ vals_ref, &nv);
+      argnames = av_to_strs(aTHX_ argnames_ref, &na);
+      args = (void**)malloc((nh ? nh : 1) * sizeof(void*));
+      for (i = 0; i < nh; ++i) {
+        SV** elem = av_fetch(av, i, 0);
+        args[i] = elem ? INT2PTR(void*, SvIV(*elem)) : NULL;
+      }
+      rc = MXTSymbolCreate(op, name, (uint32_t)nk, keys, vals,
+                           (uint32_t)na, argnames, args, &h);
+      free(keys); free(vals); free(argnames); free(args);
+      croak_on(aTHX_ rc, "MXTSymbolCreate");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+SV*
+sym_list_arguments(h)
+    IV h
+  CODE:
+    {
+      uint32_t n = 0, i;
+      const char** names = NULL;
+      AV* av;
+      croak_on(aTHX_ MXTSymbolListArguments(INT2PTR(void*, h), &n,
+                                            &names),
+               "MXTSymbolListArguments");
+      av = newAV();
+      for (i = 0; i < n; ++i) av_push(av, newSVpv(names[i], 0));
+      RETVAL = newRV_noinc((SV*)av);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+sym_free(h)
+    IV h
+  CODE:
+    MXTSymbolFree(INT2PTR(void*, h));
+
+IV
+executor_bind(sym, grad_req, names_ref, shapes_ref)
+    IV sym
+    const char* grad_req
+    SV* names_ref
+    SV* shapes_ref
+  CODE:
+    {
+      /* shapes arrive as an arrayref of arrayrefs; flatten CSR-style
+       * into (csr, dims) as MXTExecutorSimpleBind expects */
+      size_t nn, i, j;
+      const char** names;
+      AV* shapes;
+      size_t total = 0;
+      uint32_t* csr;
+      uint32_t* dims;
+      void* h = NULL;
+      int rc;
+      if (!SvROK(shapes_ref) || SvTYPE(SvRV(shapes_ref)) != SVt_PVAV)
+        croak("shapes must be an array reference of array references");
+      shapes = (AV*)SvRV(shapes_ref);
+      if (!SvROK(names_ref) || SvTYPE(SvRV(names_ref)) != SVt_PVAV)
+        croak("names must be an array reference");
+      if ((size_t)(av_len((AV*)SvRV(names_ref)) + 1) !=
+          (size_t)(av_len(shapes) + 1))
+        croak("names/shapes length mismatch");
+      for (i = 0; i < (size_t)(av_len(shapes) + 1); ++i) {
+        SV** s = av_fetch(shapes, i, 0);
+        if (s == NULL || !SvROK(*s) || SvTYPE(SvRV(*s)) != SVt_PVAV)
+          croak("shapes[%d] is not an array reference", (int)i);
+      }
+      names = av_to_strs(aTHX_ names_ref, &nn);
+      for (i = 0; i < nn; ++i) {
+        SV** s = av_fetch(shapes, i, 0);
+        total += av_len((AV*)SvRV(*s)) + 1;
+      }
+      csr = (uint32_t*)malloc((nn + 1) * sizeof(uint32_t));
+      dims = (uint32_t*)malloc((total ? total : 1) * sizeof(uint32_t));
+      csr[0] = 0;
+      total = 0;
+      for (i = 0; i < nn; ++i) {
+        SV** s = av_fetch(shapes, i, 0);
+        AV* sh = (AV*)SvRV(*s);
+        size_t nd = av_len(sh) + 1;
+        for (j = 0; j < nd; ++j) {
+          SV** d = av_fetch(sh, j, 0);
+          dims[total++] = (uint32_t)SvUV(*d);
+        }
+        csr[i + 1] = (uint32_t)total;
+      }
+      rc = MXTExecutorSimpleBind(INT2PTR(void*, sym), 1, 0, grad_req,
+                                 (uint32_t)nn, names, csr, dims, &h);
+      free(names); free(csr); free(dims);
+      croak_on(aTHX_ rc, "MXTExecutorSimpleBind");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+executor_forward(h, is_train)
+    IV h
+    IV is_train
+  CODE:
+    croak_on(aTHX_ MXTExecutorForward(INT2PTR(void*, h), (int)is_train),
+             "MXTExecutorForward");
+
+void
+executor_backward(h)
+    IV h
+  CODE:
+    croak_on(aTHX_ MXTExecutorBackward(INT2PTR(void*, h)),
+             "MXTExecutorBackward");
+
+IV
+executor_output(h, i)
+    IV h
+    IV i
+  CODE:
+    {
+      void* out = NULL;
+      croak_on(aTHX_ MXTExecutorOutput(INT2PTR(void*, h), (uint32_t)i,
+                                       &out),
+               "MXTExecutorOutput");
+      RETVAL = PTR2IV(out);
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+executor_arg(h, name)
+    IV h
+    const char* name
+  CODE:
+    {
+      void* out = NULL;
+      croak_on(aTHX_ MXTExecutorArgArray(INT2PTR(void*, h), name, &out),
+               "MXTExecutorArgArray");
+      RETVAL = PTR2IV(out);
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+executor_grad(h, name)
+    IV h
+    const char* name
+  CODE:
+    {
+      void* out = NULL;
+      croak_on(aTHX_ MXTExecutorGradArray(INT2PTR(void*, h), name,
+                                          &out),
+               "MXTExecutorGradArray");
+      RETVAL = PTR2IV(out);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+executor_free(h)
+    IV h
+  CODE:
+    MXTExecutorFree(INT2PTR(void*, h));
+
+IV
+updater_create(opt, keys_ref, vals_ref)
+    const char* opt
+    SV* keys_ref
+    SV* vals_ref
+  CODE:
+    {
+      size_t nk, nv;
+      const char** keys;
+      const char** vals;
+      void* h = NULL;
+      int rc;
+      nk = SvROK(keys_ref) ? (size_t)(av_len((AV*)SvRV(keys_ref)) + 1) : 0;
+      nv = SvROK(vals_ref) ? (size_t)(av_len((AV*)SvRV(vals_ref)) + 1) : 0;
+      if (nk != nv) croak("updater keys/vals length mismatch");
+      keys = av_to_strs(aTHX_ keys_ref, &nk);
+      vals = av_to_strs(aTHX_ vals_ref, &nv);
+      rc = MXTUpdaterCreate(opt, (uint32_t)nk, keys, vals, &h);
+      free(keys); free(vals);
+      croak_on(aTHX_ rc, "MXTUpdaterCreate");
+      RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+updater_step(u, idx, grad, weight)
+    IV u
+    IV idx
+    IV grad
+    IV weight
+  CODE:
+    croak_on(aTHX_ MXTUpdaterStep(INT2PTR(void*, u), (int)idx,
+                                  INT2PTR(void*, grad),
+                                  INT2PTR(void*, weight)),
+             "MXTUpdaterStep");
+
+void
+updater_free(u)
+    IV u
+  CODE:
+    MXTUpdaterFree(INT2PTR(void*, u));

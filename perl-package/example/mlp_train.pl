@@ -1,0 +1,126 @@
+#!/usr/bin/perl
+# Train an MLP classifier end-to-end from PERL — no Python, no C++ in
+# this file.  The reference ships a perl-package (AI::MXNet) over the
+# same C contract; this program is its capability proof at MLP scale:
+# compose symbols, simple_bind with gradients, run minibatch SGD via
+# the Updater, report train accuracy.  Exit 0 iff accuracy > 0.9.
+#
+# Run (after `perl Makefile.PL && make` in perl-package/):
+#   perl -Mblib example/mlp_train.pl
+use strict;
+use warnings;
+use List::Util qw(max);
+use MxTpu;
+
+my $CLASSES  = 10;
+my $FEATURES = 32;
+my $TRAIN    = 1500;
+my $BATCH    = 100;
+my $EPOCHS   = 8;
+
+# deterministic LCG so the data needs no external modules
+my $seed = 123456789;
+sub urand {
+    $seed = (1103515245 * $seed + 12345) % 2147483648;
+    return $seed / 2147483648;
+}
+sub nrand {    # Box-Muller
+    my $u1 = urand() || 1e-9;
+    my $u2 = urand();
+    return sqrt(-2 * log($u1)) * cos(2 * 3.14159265358979 * $u2);
+}
+
+# Gaussian blobs, one center per class
+my @centers;
+for my $c (0 .. $CLASSES - 1) {
+    push @centers, [map { 2.5 * nrand() } 1 .. $FEATURES];
+}
+my (@xs, @ys);
+for my $i (0 .. $TRAIN - 1) {
+    my $c = $i % $CLASSES;
+    push @ys, $c;
+    my $ctr = $centers[$c];
+    push @xs, [map { $ctr->[$_] + nrand() } 0 .. $FEATURES - 1];
+}
+
+# -- symbol composition ------------------------------------------------------
+my $data  = MxTpu::sym_variable('data');
+my $label = MxTpu::sym_variable('softmax_label');
+my $fc1 = MxTpu::sym_create('FullyConnected', 'fc1',
+                            ['num_hidden'], ['64'], ['data'], [$data]);
+my $act = MxTpu::sym_create('Activation', 'relu1',
+                            ['act_type'], ['relu'], ['data'], [$fc1]);
+my $fc2 = MxTpu::sym_create('FullyConnected', 'fc2',
+                            ['num_hidden'], ["$CLASSES"],
+                            ['data'], [$act]);
+my $net = MxTpu::sym_create('SoftmaxOutput', 'softmax', [], [],
+                            ['data', 'softmax_label'], [$fc2, $label]);
+
+my $exec = MxTpu::executor_bind(
+    $net, 'write',
+    ['data', 'softmax_label'],
+    [[$BATCH, $FEATURES], [$BATCH]]);
+
+# -- parameter init (He-ish uniform; biases zero) ---------------------------
+my @params = grep { $_ ne 'data' && $_ ne 'softmax_label' }
+    @{ MxTpu::sym_list_arguments($net) };
+for my $name (@params) {
+    my $arr = MxTpu::executor_arg($exec, $name);
+    my $cur = MxTpu::nd_to_array($arr);
+    my $n = scalar @$cur;
+    my $bound = sqrt(6.0 / ($name =~ /fc1/ ? $FEATURES : 64));
+    my @init = $name =~ /bias/
+        ? (0) x $n
+        : map { (2 * urand() - 1) * $bound } 1 .. $n;
+    MxTpu::nd_copy_from($arr, \@init);
+    MxTpu::nd_free($arr);
+}
+
+my $sgd = MxTpu::updater_create(
+    'sgd', ['learning_rate', 'momentum', 'rescale_grad'],
+    ['0.01', '0.9', 1.0 / $BATCH]);
+
+my $data_arr  = MxTpu::executor_arg($exec, 'data');
+my $label_arr = MxTpu::executor_arg($exec, 'softmax_label');
+my (@weights, @grads);
+for my $name (@params) {
+    push @weights, MxTpu::executor_arg($exec, $name);
+    push @grads,   MxTpu::executor_grad($exec, $name);
+}
+
+my $batches = int($TRAIN / $BATCH);
+my $acc = 0;
+for my $epoch (0 .. $EPOCHS - 1) {
+    my $correct = 0;
+    for my $b (0 .. $batches - 1) {
+        my (@xb, @yb);
+        for my $i ($b * $BATCH .. ($b + 1) * $BATCH - 1) {
+            push @xb, @{ $xs[$i] };
+            push @yb, $ys[$i];
+        }
+        MxTpu::nd_copy_from($data_arr, \@xb);
+        MxTpu::nd_copy_from($label_arr, \@yb);
+        MxTpu::executor_forward($exec, 1);
+        MxTpu::executor_backward($exec);
+        for my $p (0 .. $#params) {
+            MxTpu::updater_step($sgd, $p, $grads[$p], $weights[$p]);
+        }
+        my $out = MxTpu::executor_output($exec, 0);
+        my $probs = MxTpu::nd_to_array($out);
+        MxTpu::nd_free($out);
+        for my $i (0 .. $BATCH - 1) {
+            my ($best, $bestp) = (0, -1);
+            for my $c (0 .. $CLASSES - 1) {
+                my $p = $probs->[$i * $CLASSES + $c];
+                ($best, $bestp) = ($c, $p) if $p > $bestp;
+            }
+            $correct++ if $best == $yb[$i];
+        }
+    }
+    $acc = $correct / ($batches * $BATCH);
+    printf "epoch %d train-accuracy %.4f\n", $epoch, $acc;
+    last if $acc > 0.97;
+}
+printf "final train-accuracy %.4f\n", $acc;
+print "PERL TRAINS OK\n" if $acc > 0.9;
+exit($acc > 0.9 ? 0 : 1);

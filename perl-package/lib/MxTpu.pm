@@ -1,0 +1,33 @@
+# Perl frontend over the training C ABI — the proof that "any language
+# with a C FFI can bind today" (docs/DESIGN.md bindings descope): the
+# XS layer (MxTpu.xs) is this package's only native glue, exactly the
+# role SWIG plays for the reference's perl-package (AI::MXNet).
+package MxTpu;
+
+use strict;
+use warnings;
+
+our $VERSION = '0.1';
+
+require XSLoader;
+XSLoader::load('MxTpu', $VERSION);
+
+1;
+__END__
+
+=head1 NAME
+
+MxTpu - Perl binding over the mxnet_tpu training C ABI
+
+=head1 SYNOPSIS
+
+    use MxTpu;
+    my $data  = MxTpu::sym_variable('data');
+    my $fc    = MxTpu::sym_create('FullyConnected', 'fc1',
+                                  ['num_hidden'], ['64'],
+                                  ['data'], [$data]);
+    ...
+
+See example/mlp_train.pl for a complete training program.
+
+=cut

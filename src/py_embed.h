@@ -9,9 +9,35 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <dlfcn.h>
+
 #include <string>
 
 namespace pyembed {
+
+#define PYEMBED_STR_(x) #x
+#define PYEMBED_STR(x) PYEMBED_STR_(x)
+
+// Python C-extension modules (numpy etc.) resolve Py* symbols from the
+// process's GLOBAL dynamic namespace — they do not link libpython
+// themselves.  When this library is loaded by a plugin host that uses
+// RTLD_LOCAL (perl XS, ruby, lua...), the libpython our embedded
+// interpreter came from is invisible to them and every extension
+// import fails.  Re-open the already-loaded libpython with
+// RTLD_GLOBAL (RTLD_NOLOAD: never load a second copy) to promote its
+// symbols.  No-op in ordinary C programs and inside real Python.
+inline void promote_libpython() {
+  const char* names[] = {
+      "libpython" PYEMBED_STR(PY_MAJOR_VERSION) "."
+      PYEMBED_STR(PY_MINOR_VERSION) ".so.1.0",
+      "libpython" PYEMBED_STR(PY_MAJOR_VERSION) "."
+      PYEMBED_STR(PY_MINOR_VERSION) ".so",
+  };
+  for (const char* n : names) {
+    if (dlopen(n, RTLD_NOW | RTLD_GLOBAL | RTLD_NOLOAD) != nullptr)
+      return;
+  }
+}
 
 inline std::string err_string() {
   PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
@@ -39,6 +65,7 @@ inline std::string err_string() {
 // the reference's implicit init contract.)
 inline bool ensure_interpreter(std::string* err) {
   if (!Py_IsInitialized()) {
+    promote_libpython();
     Py_InitializeEx(0);
     if (!Py_IsInitialized()) {
       if (err != nullptr) *err = "failed to initialize embedded Python";

@@ -88,3 +88,47 @@ def test_profiling_example():
     spans, seen = mod.main(quick=True)
     assert spans > 0, spans
     assert seen, seen
+
+
+def test_lstm_ocr_ctc():
+    """LSTM + CTC (reference example/ctc/lstm_ocr.py role): greedy
+    decode must read >70% of held-out digit sequences exactly."""
+    mod = _load('examples/ctc/lstm_ocr.py', 'ex_ctc')
+    acc = mod.main(quick=True)
+    assert acc > 0.7, acc
+
+
+def test_fcn_segmentation():
+    """FCN upsample pipeline (reference example/fcn-xs role):
+    Deconvolution + Crop + per-pixel softmax must beat the
+    all-background baseline by 10 points and reach 0.9."""
+    mod = _load('examples/fcn_xs/fcn_seg.py', 'ex_fcn')
+    acc, bg = mod.main(quick=True)
+    assert acc > max(0.9, bg + 0.1), (acc, bg)
+
+
+def test_nce_word_vectors():
+    """NCE word vectors (reference example/nce-loss role): same-cluster
+    retrieval precision@5 far above chance."""
+    mod = _load('examples/nce_loss/nce_words.py', 'ex_nce')
+    prec = mod.main(quick=True)
+    assert prec > 0.5, prec
+
+
+def test_cnn_text_classification():
+    """TextCNN (reference example/cnn_text_classification role): the
+    planted-bigram sentiment task needs the conv filters' locality —
+    bag-of-words can't solve it."""
+    mod = _load('examples/cnn_text/text_cnn.py', 'ex_textcnn')
+    acc = mod.main(quick=True)
+    assert acc > 0.9, acc
+
+
+def test_actor_critic_rl():
+    """Policy-gradient actor-critic (reference reinforcement-learning
+    role): the imperative autograd loop must drive the chain MDP to
+    near-optimal return."""
+    mod = _load('examples/reinforcement_learning/actor_critic.py',
+                'ex_rl')
+    first, last = mod.main(quick=True)
+    assert last > 0.7, (first, last)
