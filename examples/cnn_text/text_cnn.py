@@ -42,9 +42,8 @@ def make_data(n, rng):
             # plant the bigram words SEPARATELY (never adjacent in
             # order) so unigram presence carries no signal
             a, b = POS_BIGRAMS[rng.randint(len(POS_BIGRAMS))]
-            p = rng.randint(0, SEQ - 3)
-            q = p + 2 + rng.randint(0, SEQ - p - 3) \
-                if p + 3 < SEQ else p + 2
+            p = rng.randint(0, SEQ - 3)   # p <= SEQ-4, so q <= SEQ-1
+            q = p + 2 + rng.randint(0, SEQ - p - 3)
             xs[i, p], xs[i, q] = b, a
     return xs, ys
 

@@ -29,8 +29,12 @@ FRAMES = 18            # image width = LSTM unroll length
 
 def _glyphs(rng):
     """A fixed random-stroke glyph per digit: binary (HEIGHT, GLYPH_W)
-    patterns, redrawn until pairwise distinct."""
+    patterns.  40 random bits per glyph make collisions vanishingly
+    unlikely, but assert distinctness so a pathological seed fails
+    loudly instead of making sequences unlearnable."""
     g = (rng.rand(NUM_DIGITS, HEIGHT, GLYPH_W) > 0.5).astype(np.float32)
+    flat = {tuple(x.ravel()) for x in g}
+    assert len(flat) == NUM_DIGITS, 'glyph collision; change the seed'
     return g
 
 

@@ -125,6 +125,75 @@ class Predictor(object):
             pass
         return out
 
+    def export_artifact(self, prefix):
+        """Write a SELF-CONTAINED deployment artifact: the forward with
+        all parameters baked in as constants, lowered to StableHLO
+        text, plus a plain-text manifest of the remaining (data)
+        inputs and the outputs — everything a Python-free runner needs
+        (tools/stablehlo_runner/runner.cc executes it through the PJRT
+        CPU client; the reference's amalgamation artifact plays this
+        role, amalgamation/mxnet_predict0.cc).
+
+        Files written: <prefix>.stablehlo, <prefix>.manifest.
+        Returns the manifest lines."""
+        import jax
+        ex = self._executor
+        arg_vals, aux_vals = ex._gather()
+        rng = jax.random.PRNGKey(0)
+        names = list(ex.arg_dict.keys())
+        data_idx = [i for i, n in enumerate(names)
+                    if n in self._input_names]
+
+        def fwd(data_vals):
+            merged = list(arg_vals)
+            for i, v in zip(data_idx, data_vals):
+                merged[i] = v
+            outs, _ = ex.raw_forward(tuple(merged), aux_vals, rng)
+            return outs
+
+        data_vals = tuple(arg_vals[i] for i in data_idx)
+        # classic GSPMD lowering: the shardy (sdy) dialect jax emits by
+        # default is newer than the StableHLO consumers deployment
+        # environments ship (the in-tree runner's XLA parses GSPMD fine)
+        prev = jax.config.jax_use_shardy_partitioner
+        jax.config.update('jax_use_shardy_partitioner', False)
+        try:
+            lowered = jax.jit(fwd).lower(data_vals)
+        finally:
+            jax.config.update('jax_use_shardy_partitioner', prev)
+        # output avals from the lowering we already have — no second
+        # trace; eval_shape remains the fallback for older jax
+        try:
+            outs = [o.aval for o in lowered.out_info]
+        except AttributeError:
+            outs = jax.eval_shape(fwd, data_vals)
+        manifest = []
+        for n, v in zip(self._input_names, data_vals):
+            manifest.append('input %s %s %s' % (
+                n, np.dtype(v.dtype).name,
+                ','.join(str(d) for d in v.shape)))
+        for i, o in enumerate(outs):
+            manifest.append('output %d %s %s' % (
+                i, np.dtype(o.dtype).name,
+                ','.join(str(d) for d in o.shape)))
+        with open(prefix + '.stablehlo', 'w') as f:
+            f.write(lowered.as_text())
+        # ALSO emit the HloModuleProto: the C++ runner consumes this
+        # form because PjRtClient::CompileAndLoad(XlaComputation) needs
+        # no MLIR parser in the deployment process
+        try:
+            from jax._src.lib import xla_client
+            comp = xla_client._xla.mlir.mlir_module_to_xla_computation(
+                lowered.as_text(), use_tuple_args=False,
+                return_tuple=False)
+            with open(prefix + '.hlo.pb', 'wb') as f:
+                f.write(comp.as_serialized_hlo_module_proto())
+        except Exception:  # older jaxlibs: .stablehlo remains usable
+            pass
+        with open(prefix + '.manifest', 'w') as f:
+            f.write('\n'.join(manifest) + '\n')
+        return manifest
+
 
 def _load_param_bytes(blob):
     """Param blob bytes -> dict (reference c_predict accepts an

@@ -110,12 +110,28 @@ nd_create(shape_ref, data_ref)
     SV* data_ref
   CODE:
     {
-      size_t ns, nd;
-      uint32_t* shape = av_to_u32(aTHX_ shape_ref, &ns);
-      float* data = av_to_floats(aTHX_ data_ref, &nd);
+      size_t ns, nd, i, want;
+      uint32_t* shape;
+      float* data;
       void* h = NULL;
-      int rc = MXTNDArrayCreateFromBytes(shape, (uint32_t)ns, data,
-                                         1, 0, &h);
+      int rc;
+      /* validate BEFORE malloc (croak longjmps past free) */
+      if (!SvROK(shape_ref) || SvTYPE(SvRV(shape_ref)) != SVt_PVAV)
+        croak("shape must be an array reference");
+      if (!SvROK(data_ref) || SvTYPE(SvRV(data_ref)) != SVt_PVAV)
+        croak("data must be an array reference");
+      shape = av_to_u32(aTHX_ shape_ref, &ns);
+      want = 1;
+      for (i = 0; i < ns; ++i) want *= shape[i];
+      nd = (size_t)(av_len((AV*)SvRV(data_ref)) + 1);
+      if (nd != want) {
+        free(shape);
+        croak("data has %lu elements; shape wants %lu",
+              (unsigned long)nd, (unsigned long)want);
+      }
+      data = av_to_floats(aTHX_ data_ref, &nd);
+      rc = MXTNDArrayCreateFromBytes(shape, (uint32_t)ns, data,
+                                     1, 0, &h);
       free(shape);
       free(data);
       croak_on(aTHX_ rc, "MXTNDArrayCreateFromBytes");

@@ -583,3 +583,60 @@ def test_perl_binding_trains_mlp(tmp_path):
         capture_output=True, text=True, env=env, timeout=600)
     assert proc.returncode == 0, (proc.stdout, proc.stderr)
     assert 'PERL TRAINS OK' in proc.stdout, proc.stdout
+
+
+@native
+def test_stablehlo_runner_no_python(tmp_path):
+    """The round-5 VERDICT gate: the exported deployment artifact
+    EXECUTES without Python.  Predictor.export_artifact bakes the
+    trained parameters into the lowered module as constants; the C++
+    runner (tools/stablehlo_runner/runner.cc — XLA's PJRT CPU client
+    out of the tensorflow wheel, no interpreter in the process)
+    classifies the same digit as the in-framework predictor — the
+    amalgamation role (reference amalgamation/mxnet_predict0.cc)."""
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tf_dir = None
+    try:
+        import tensorflow
+        tf_dir = os.path.dirname(tensorflow.__file__)
+    except ImportError:
+        pytest.skip('tensorflow wheel (the XLA runtime source) absent')
+
+    prefix, sample, expect = _train_and_save_mlp(tmp_path)
+    from mxnet_tpu.predictor import Predictor
+    pred = Predictor.from_checkpoint(prefix, 1,
+                                     {'data': (1, sample.size)})
+    art = str(tmp_path / 'mlp_art')
+    pred.export_artifact(art)
+    assert os.path.exists(art + '.hlo.pb')
+    ref = pred.predict(sample.reshape(1, -1)).argmax(1)[0]
+    assert int(ref) == int(expect)
+
+    exe = str(tmp_path / 'shlo_runner')
+    src = os.path.join(repo, 'tools', 'stablehlo_runner')
+    build = subprocess.run(
+        ['g++', '-std=c++17', '-O2', '-DNDEBUG',
+         os.path.join(src, 'runner.cc'),
+         '-I' + os.path.join(src, 'mlir_stub'),
+         '-I' + os.path.join(tf_dir, 'include'),
+         '-I' + os.path.join(tf_dir, 'include', 'external',
+                             'highwayhash'),
+         '-I' + os.path.join(tf_dir, 'include', 'external',
+                             'farmhash_archive', 'src'),
+         '-L' + tf_dir, '-l:libtensorflow_cc.so.2',
+         '-l:libtensorflow_framework.so.2',
+         '-Wl,-rpath,' + tf_dir, '-o', exe],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+    inp = str(tmp_path / 'input.raw')
+    np.ascontiguousarray(sample.reshape(1, -1),
+                         dtype='<f4').tofile(inp)
+    proc = subprocess.run(
+        [exe, art + '.hlo.pb', art + '.manifest', inp],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert 'STABLEHLO_RUNNER_OK' in proc.stdout, proc.stdout
+    assert ('predicted=%d' % expect) in proc.stdout, \
+        (expect, proc.stdout)

@@ -1,0 +1,27 @@
+// Minimal mlir::ModuleOp stand-in for the deployment runner build.
+//
+// The TF pip package ships xla/pjrt headers but NOT the llvm-project
+// headers they reach for; the only MLIR name the runner's include set
+// actually needs is the TYPE mlir::ModuleOp, used by-value in two
+// PjRtClient::CompileAndLoad overloads whose inline default bodies
+// ignore the parameter — and which the runner never calls (it compiles
+// from an HloModuleProto / XlaComputation instead).  The real ModuleOp
+// is a one-pointer wrapper over Operation*; this mirrors that layout.
+#ifndef MXNET_TPU_STABLEHLO_RUNNER_MLIR_STUB_BUILTINOPS_H_
+#define MXNET_TPU_STABLEHLO_RUNNER_MLIR_STUB_BUILTINOPS_H_
+
+namespace mlir {
+
+class Operation;
+
+class ModuleOp {
+ public:
+  Operation* getOperation() const { return op_; }
+
+ private:
+  Operation* op_ = nullptr;
+};
+
+}  // namespace mlir
+
+#endif  // MXNET_TPU_STABLEHLO_RUNNER_MLIR_STUB_BUILTINOPS_H_

@@ -1,0 +1,215 @@
+// Execute a Predictor.export_artifact() StableHLO module WITHOUT Python.
+//
+// Role parity: the reference's amalgamation artifact runs anywhere a
+// C++ compiler exists (/root/reference/amalgamation/mxnet_predict0.cc);
+// this runner is that story for the XLA deployment shape — the
+// artifact's parameters are baked in as constants, so the process
+// contains an MLIR parser + the PJRT CPU client and NOTHING else: no
+// interpreter, no framework, no checkpoint loader.
+//
+// Build (CI: tests/test_native.py::test_stablehlo_runner_no_python):
+//   g++ -std=c++17 -O2 -DNDEBUG runner.cc -Imlir_stub -I$TF/include \
+//       -I$TF/include/external/highwayhash \
+//       -I$TF/include/external/farmhash_archive/src \
+//       -L$TF -l:libtensorflow_cc.so.2 -l:libtensorflow_framework.so.2 \
+//       -Wl,-rpath,$TF -o runner
+// where TF = the tensorflow pip package directory (its libtensorflow_cc
+// exports the XLA/PJRT symbols used here).  -DNDEBUG is REQUIRED: the
+// wheel is an NDEBUG build and several inline absl/tsl types change
+// layout without it (debug builds segfault nondeterministically).
+//
+// Usage: runner <m.hlo.pb> <m.manifest> <input0.raw> [input1.raw...]
+// Prints one "predicted=<argmax>" line per row of output 0 (the
+// classification contract shared with examples/c_predict/predict.c)
+// and "output <i> <n> <first..>" summaries for every output.
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "xla/hlo/builder/xla_computation.h"
+#include "xla/literal.h"
+#include "xla/pjrt/pjrt_client.h"
+#include "xla/pjrt/plugin/xla_cpu/cpu_client_options.h"
+#include "xla/service/hlo.pb.h"
+
+namespace xla {
+// Exported by the TF pip package's libtensorflow_cc (declared in
+// xla/pjrt/cpu/cpu_client.h, which needs llvm headers the package
+// doesn't ship; the options struct header above is self-contained).
+absl::StatusOr<std::unique_ptr<PjRtClient>> GetPjRtCpuClient(
+    CpuClientOptions options);
+}  // namespace xla
+
+namespace {
+
+struct TensorSpec {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> dims;
+  int64_t elems() const {
+    int64_t n = 1;
+    for (int64_t d : dims) n *= d;
+    return n;
+  }
+};
+
+std::string ReadFile(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) {
+    std::cerr << "cannot open " << path << "\n";
+    std::exit(2);
+  }
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+// manifest lines: "input <name> <dtype> <d0,d1,...>" /
+//                 "output <i> <dtype> <shape>"
+void ParseManifest(const std::string& text, std::vector<TensorSpec>* ins,
+                   std::vector<TensorSpec>* outs) {
+  std::istringstream lines(text);
+  std::string line;
+  while (std::getline(lines, line)) {
+    std::istringstream ls(line);
+    std::string kind;
+    TensorSpec spec;
+    std::string dims;
+    if (!(ls >> kind >> spec.name >> spec.dtype >> dims)) continue;
+    std::istringstream ds(dims);
+    std::string d;
+    while (std::getline(ds, d, ',')) spec.dims.push_back(std::stoll(d));
+    if (kind == "input") ins->push_back(spec);
+    else if (kind == "output") outs->push_back(spec);
+  }
+}
+
+xla::PrimitiveType DtypeOf(const std::string& name) {
+  if (name == "float32") return xla::F32;
+  if (name == "int32") return xla::S32;
+  if (name == "uint32") return xla::U32;
+  std::cerr << "unsupported manifest dtype " << name << "\n";
+  std::exit(2);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::cerr << "usage: " << argv[0]
+              << " <m.hlo.pb> <m.manifest> [input.raw ...]\n";
+    return 2;
+  }
+  const std::string module_bytes = ReadFile(argv[1]);
+  std::vector<TensorSpec> ins, outs;
+  ParseManifest(ReadFile(argv[2]), &ins, &outs);
+  if (static_cast<size_t>(argc - 3) != ins.size()) {
+    std::cerr << "manifest declares " << ins.size()
+              << " inputs; got " << (argc - 3) << " files\n";
+    return 2;
+  }
+
+  xla::CpuClientOptions options;
+  options.cpu_device_count = 1;
+  options.asynchronous = false;
+  auto client_or = xla::GetPjRtCpuClient(options);
+  if (!client_or.ok()) {
+    std::cerr << "PJRT cpu client: " << client_or.status() << "\n";
+    return 1;
+  }
+  std::unique_ptr<xla::PjRtClient> client = std::move(*client_or);
+
+  xla::HloModuleProto proto;
+  if (!proto.ParseFromString(module_bytes)) {
+    std::cerr << "cannot parse HloModuleProto from " << argv[1] << "\n";
+    return 1;
+  }
+  xla::XlaComputation computation(proto);
+  auto exe_or = client->CompileAndLoad(computation,
+                                       xla::CompileOptions());
+  if (!exe_or.ok()) {
+    std::cerr << "compile: " << exe_or.status() << "\n";
+    return 1;
+  }
+  auto exe = std::move(*exe_or);
+
+  xla::PjRtDevice* device = client->addressable_devices()[0];
+  auto memspace_or = device->default_memory_space();
+  if (!memspace_or.ok()) {
+    std::cerr << "memory space: " << memspace_or.status() << "\n";
+    return 1;
+  }
+  std::vector<std::string> raw;              // keep host data alive
+  std::vector<std::unique_ptr<xla::PjRtBuffer>> buffers;
+  std::vector<xla::PjRtBuffer*> args;
+  for (size_t i = 0; i < ins.size(); ++i) {
+    raw.push_back(ReadFile(argv[3 + i]));
+    const TensorSpec& spec = ins[i];
+    const size_t want = spec.elems() * 4;    // f32/s32/u32 all 4 bytes
+    if (raw.back().size() != want) {
+      std::cerr << "input " << spec.name << ": file has "
+                << raw.back().size() << " bytes, manifest wants "
+                << want << "\n";
+      return 2;
+    }
+    auto buf_or = client->BufferFromHostBuffer(
+        raw.back().data(), DtypeOf(spec.dtype), spec.dims, std::nullopt,
+        xla::PjRtClient::HostBufferSemantics::kImmutableUntilTransferCompletes,
+        nullptr, *memspace_or, nullptr);
+    if (!buf_or.ok()) {
+      std::cerr << "buffer: " << buf_or.status() << "\n";
+      return 1;
+    }
+    buffers.push_back(std::move(*buf_or));
+    args.push_back(buffers.back().get());
+  }
+
+  std::vector<std::vector<xla::PjRtBuffer*>> all_args = {args};
+  auto result_or = exe->Execute(all_args, xla::ExecuteOptions());
+  if (!result_or.ok()) {
+    std::cerr << "execute: " << result_or.status() << "\n";
+    return 1;
+  }
+  auto& results = (*result_or)[0];
+  for (size_t i = 0; i < results.size(); ++i) {
+    // zero-copy fetch: on the CPU client device memory IS host
+    // memory, and AcquireExternalReference is a plain virtual into the
+    // .so — no inline Future/Literal template code crosses the
+    // pip-package ABI boundary (ToLiteralSync/CopyRawToHost both do,
+    // and crash when this TU is built by a different toolchain).
+    // options.asynchronous=false above guarantees the buffer is ready.
+    if (i < outs.size() && outs[i].dtype != "float32") {
+      std::cerr << "output " << i << ": dtype " << outs[i].dtype
+                << " not supported by this runner (float32 only)\n";
+      return 2;
+    }
+    const int64_t n = (i < (int64_t)outs.size()) ? outs[i].elems() : 0;
+    auto ext_or = results[i]->AcquireExternalReference();
+    if (!ext_or.ok()) {
+      std::cerr << "fetch: " << ext_or.status() << "\n";
+      return 1;
+    }
+    const float* vals = static_cast<const float*>(
+        (*ext_or)->OpaqueDeviceMemoryDataPointer());
+    std::cout << "output " << i << " " << n;
+    for (int64_t j = 0; j < n && j < 4; ++j)
+      std::cout << " " << vals[j];
+    std::cout << "\n";
+    if (i == 0 && !outs.empty() && outs[0].dims.size() == 2) {
+      const int64_t rows = outs[0].dims[0], cols = outs[0].dims[1];
+      for (int64_t r = 0; r < rows; ++r) {
+        int64_t best = 0;
+        for (int64_t c = 1; c < cols; ++c)
+          if (vals[r * cols + c] > vals[r * cols + best]) best = c;
+        std::cout << "predicted=" << best << "\n";
+      }
+    }
+  }
+  std::cout << "STABLEHLO_RUNNER_OK\n";
+  return 0;
+}
