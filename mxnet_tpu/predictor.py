@@ -176,20 +176,23 @@ class Predictor(object):
             manifest.append('output %d %s %s' % (
                 i, np.dtype(o.dtype).name,
                 ','.join(str(d) for d in o.shape)))
+        text = lowered.as_text()   # params baked in: serialize ONCE
         with open(prefix + '.stablehlo', 'w') as f:
-            f.write(lowered.as_text())
+            f.write(text)
         # ALSO emit the HloModuleProto: the C++ runner consumes this
         # form because PjRtClient::CompileAndLoad(XlaComputation) needs
-        # no MLIR parser in the deployment process
+        # no MLIR parser in the deployment process.  Only the
+        # conversion API's absence is survivable (older jaxlibs keep
+        # the .stablehlo artifact); I/O failures must surface.
         try:
             from jax._src.lib import xla_client
-            comp = xla_client._xla.mlir.mlir_module_to_xla_computation(
-                lowered.as_text(), use_tuple_args=False,
-                return_tuple=False)
+            convert = xla_client._xla.mlir.mlir_module_to_xla_computation
+        except (ImportError, AttributeError):
+            convert = None
+        if convert is not None:
+            comp = convert(text, use_tuple_args=False, return_tuple=False)
             with open(prefix + '.hlo.pb', 'wb') as f:
                 f.write(comp.as_serialized_hlo_module_proto())
-        except Exception:  # older jaxlibs: .stablehlo remains usable
-            pass
         with open(prefix + '.manifest', 'w') as f:
             f.write('\n'.join(manifest) + '\n')
         return manifest

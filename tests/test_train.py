@@ -296,3 +296,74 @@ def test_optimizer_states_portable_between_update_paths(tmp_path):
     assert isinstance(state, tuple)
     mom, master = state
     assert master.dtype == np.float32
+
+
+def _cifar_like(n, seed):
+    """A CIFAR-class stand-in this rig can generate offline: 6 classes
+    of 3x28x28 color images where the class is a (shape, hue) pair —
+    textured backgrounds, per-image jitter, enough structure that a
+    plain linear model fails but a small resnet separates it."""
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 3, 28, 28).astype(np.float32) * 0.3
+    y = rs.randint(0, 6, n)
+    yy, xx = np.mgrid[0:28, 0:28]
+    for i in range(n):
+        shape = int(y[i]) % 2           # 0: disk, 1: square
+        hue = int(y[i]) // 2            # dominant channel 0/1/2
+        cy, cx = rs.randint(10, 18, 2)
+        r = rs.randint(6, 9)
+        if shape == 0:
+            m = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+        else:
+            m = (np.abs(yy - cy) <= r) & (np.abs(xx - cx) <= r)
+        X[i, hue][m] += 0.8 + 0.2 * rs.rand()
+        X[i, (hue + 1) % 3][m] += 0.2 * rs.rand()
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+@pytest.mark.slow
+def test_resnet_convergence_parity_fp32_vs_bf16():
+    """The convergence-parity proxy for the BASELINE 'identical top-1'
+    gate this rig cannot run (no ImageNet, one chip) — round-5 VERDICT
+    item: the SAME small resnet on the same CIFAR-class data must reach
+    a pinned accuracy under fp32 AND bfloat16 multi_precision, within
+    tolerance of each other (reference role:
+    tests/python/train/test_dtype.py + image-classification
+    test_score.py).  Numbers recorded in docs/PERF.md round 5."""
+    from mxnet_tpu.models import resnet
+
+    Xtr, ytr = _cifar_like(1536, seed=0)
+    Xte, yte = _cifar_like(384, seed=1)
+    accs = {}
+    for dtype in ('float32', 'bfloat16'):
+        mx.random.seed(5)
+        np.random.seed(5)
+        net = resnet.get_symbol(num_classes=6, num_layers=8,
+                                image_shape='3,28,28', dtype=dtype)
+        mod = mx.mod.Module(net, label_names=['softmax_label'])
+        train = mx.io.NDArrayIter(Xtr, ytr, 64, shuffle=True,
+                                  label_name='softmax_label')
+        test = mx.io.NDArrayIter(Xte, yte, 64,
+                                 label_name='softmax_label')
+        # the reference's own recipe shape: lr steps late in training
+        # (--lr-step-epochs).  Without the decay this tiny-data recipe
+        # sits at the edge of stability and bf16 rounding amplifies
+        # batch-stat variance until eval-mode BN moving stats lag the
+        # live activations (train-mode accuracy stays ~1.0 in both
+        # dtypes; fp32 shows the same gap smaller) — docs/PERF.md
+        sched = mx.lr_scheduler.MultiFactorScheduler(
+            step=[24 * 8, 24 * 12], factor=0.1)
+        mod.fit(train, num_epoch=16,
+                optimizer='sgd',
+                optimizer_params={'learning_rate': 0.05, 'momentum': 0.9,
+                                  'wd': 1e-4, 'lr_scheduler': sched,
+                                  'multi_precision': dtype != 'float32'},
+                initializer=mx.init.Xavier(rnd_type='gaussian',
+                                           factor_type='in',
+                                           magnitude=2))
+        accs[dtype] = float(mod.score(test, mx.metric.Accuracy())[0][1])
+    print('convergence parity: fp32 %.3f bf16 %.3f' %
+          (accs['float32'], accs['bfloat16']))
+    assert accs['float32'] > 0.95, accs
+    assert accs['bfloat16'] > 0.95, accs
+    assert abs(accs['float32'] - accs['bfloat16']) < 0.03, accs

@@ -83,7 +83,14 @@ void ParseManifest(const std::string& text, std::vector<TensorSpec>* ins,
     if (!(ls >> kind >> spec.name >> spec.dtype >> dims)) continue;
     std::istringstream ds(dims);
     std::string d;
-    while (std::getline(ds, d, ',')) spec.dims.push_back(std::stoll(d));
+    while (std::getline(ds, d, ',')) {
+      if (d.empty() || d.find_first_not_of("0123456789") !=
+                           std::string::npos) {
+        std::cerr << "bad manifest dim " << d << " in: " << line << "\n";
+        std::exit(2);
+      }
+      spec.dims.push_back(std::stoll(d));
+    }
     if (kind == "input") ins->push_back(spec);
     else if (kind == "output") outs->push_back(spec);
   }
@@ -145,6 +152,8 @@ int main(int argc, char** argv) {
     return 1;
   }
   std::vector<std::string> raw;              // keep host data alive
+  raw.reserve(ins.size());   // push_back must NOT move SSO strings the
+                             // PJRT client may still be reading from
   std::vector<std::unique_ptr<xla::PjRtBuffer>> buffers;
   std::vector<xla::PjRtBuffer*> args;
   for (size_t i = 0; i < ins.size(); ++i) {
