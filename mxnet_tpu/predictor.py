@@ -179,6 +179,11 @@ class Predictor(object):
         text = lowered.as_text()   # params baked in: serialize ONCE
         with open(prefix + '.stablehlo', 'w') as f:
             f.write(text)
+        # the .stablehlo + .manifest pair must be complete even when the
+        # optional HloModuleProto emission below fails, so the manifest
+        # is written before the conversion attempt
+        with open(prefix + '.manifest', 'w') as f:
+            f.write('\n'.join(manifest) + '\n')
         # ALSO emit the HloModuleProto: the C++ runner consumes this
         # form because PjRtClient::CompileAndLoad(XlaComputation) needs
         # no MLIR parser in the deployment process.  Only the
@@ -193,8 +198,6 @@ class Predictor(object):
             comp = convert(text, use_tuple_args=False, return_tuple=False)
             with open(prefix + '.hlo.pb', 'wb') as f:
                 f.write(comp.as_serialized_hlo_module_proto())
-        with open(prefix + '.manifest', 'w') as f:
-            f.write('\n'.join(manifest) + '\n')
         return manifest
 
 

@@ -132,3 +132,13 @@ def test_actor_critic_rl():
                 'ex_rl')
     first, last = mod.main(quick=True)
     assert last > 0.7, (first, last)
+
+
+def test_faster_rcnn():
+    """Two-stage detection (reference example/rcnn/): RPN with
+    IoU-assigned anchor targets, Proposal + ROIPooling + smooth_l1,
+    and the end-to-end backbone->RPN->Proposal->heads test graph."""
+    mod = _load('examples/rcnn/train_faster_rcnn.py', 'ex_rcnn')
+    rpn_recall, det_acc = mod.main(quick=True)
+    assert rpn_recall > 0.8, rpn_recall
+    assert det_acc > 0.7, det_acc

@@ -84,8 +84,8 @@ void ParseManifest(const std::string& text, std::vector<TensorSpec>* ins,
     std::istringstream ds(dims);
     std::string d;
     while (std::getline(ds, d, ',')) {
-      if (d.empty() || d.find_first_not_of("0123456789") !=
-                           std::string::npos) {
+      if (d.empty() || d.size() > 18 ||
+          d.find_first_not_of("0123456789") != std::string::npos) {
         std::cerr << "bad manifest dim " << d << " in: " << line << "\n";
         std::exit(2);
       }
