@@ -47,6 +47,15 @@ void MXTNDListFree(void*);
 const char* MXTPredGetLastError(void);
 
 // training ABI (src/c_api_train.cc)
+//
+// Threading contract (all MXT* entry points): calls may come from any
+// thread — each entry point acquires the embedded interpreter's GIL, so
+// the runtime itself is safe — but a HANDLE is single-caller: pointers
+// returned for a handle (shapes, strings, lists) stay valid only until
+// the next call on the SAME handle, and handles are mutated without a
+// lock, so two threads must not operate on one handle concurrently.
+// Distinct handles can be used from distinct threads freely.  This is
+// the reference's MXAPIThreadLocalEntry discipline restated per-handle.
 const char* MXTTrainGetLastError(void);
 int MXTNDArrayCreate(const uint32_t*, uint32_t, int, int, void**);
 int MXTNDArrayCreateFromBytes(const uint32_t*, uint32_t, const float*,

@@ -202,13 +202,23 @@ def rpn_train_symbol(batch):
     return sym.Group([cls, bb])
 
 
+def rpn_prob(score):
+    """(N, 2A, H, W) logits -> per-anchor bg/fg softmax in the same
+    layout (the reference applies channel softmax on the (N,2,A*H*W)
+    reshape before Proposal; raw fg logits would mis-rank anchors
+    because the bg logit varies per anchor)."""
+    score_r = sym.Reshape(score, shape=(0, 2, -1))
+    prob = sym.SoftmaxActivation(score_r, mode='channel')
+    return sym.Reshape(prob, shape=(0, 2 * A, FMAP, FMAP))
+
+
 def proposal_symbol(post_nms):
     """backbone + RPN heads + Proposal — the ROI generator."""
     data = sym.Variable('data')
     im_info = sym.Variable('im_info')
     score, bbox = rpn_heads(backbone(data))
-    # Proposal ranks by the raw fg logits (monotone in the fg softmax)
-    rois = sym.Proposal(cls_prob=score, bbox_pred=bbox, im_info=im_info,
+    rois = sym.Proposal(cls_prob=rpn_prob(score), bbox_pred=bbox,
+                        im_info=im_info,
                         feature_stride=STRIDE, scales=SCALES, ratios=(1.0,),
                         rpn_pre_nms_top_n=64, rpn_post_nms_top_n=post_nms,
                         threshold=0.7, rpn_min_size=2, name='rois')
@@ -253,7 +263,8 @@ def detect_symbol(post_nms):
     im_info = sym.Variable('im_info')
     feat = backbone(data)
     score, bbox = rpn_heads(feat)
-    rois = sym.Proposal(cls_prob=score, bbox_pred=bbox, im_info=im_info,
+    rois = sym.Proposal(cls_prob=rpn_prob(score), bbox_pred=bbox,
+                        im_info=im_info,
                         feature_stride=STRIDE, scales=SCALES, ratios=(1.0,),
                         rpn_pre_nms_top_n=64, rpn_post_nms_top_n=post_nms,
                         threshold=0.7, rpn_min_size=2, name='rois')

@@ -15,6 +15,12 @@
 // String/shape lists returned to C are cached per-handle with
 // C-pointer lifetime (valid until the next call on the same handle),
 // like the reference's MXAPIThreadLocalEntry scratch space.
+//
+// Threading contract: entry points are callable from any thread (each
+// takes the GIL), but a handle is single-caller — per-handle caches
+// and handle state are mutated without a lock, so concurrent calls on
+// the SAME handle are undefined; use one handle per thread.  The rule
+// is documented at the declaration site (MxTpuCpp.hpp) too.
 #include "py_embed.h"
 
 #include <cstdint>

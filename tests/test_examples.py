@@ -142,3 +142,25 @@ def test_faster_rcnn():
     rpn_recall, det_acc = mod.main(quick=True)
     assert rpn_recall > 0.8, rpn_recall
     assert det_acc > 0.7, det_acc
+
+
+def test_svm_mnist():
+    """SVMOutput consumer (reference example/svm_mnist): both hinge
+    objectives must learn; margins must actually separate."""
+    mod = _load('examples/svm_mnist/svm_mnist.py', 'ex_svm')
+    acc_l2, acc_l1, margin = mod.main(quick=True)
+    assert acc_l2 > 0.9, acc_l2
+    assert acc_l1 > 0.9, acc_l1
+    assert margin > 0.7, margin
+
+
+def test_stochastic_depth():
+    """User-defined BaseModule subclass inside SequentialModule
+    (reference example/stochastic-depth): converges, gate statistics
+    follow the death-rate schedule, expectation inference is
+    deterministic."""
+    mod = _load('examples/stochastic_depth/sd_mnist.py', 'ex_sd')
+    acc, gate_err, determ = mod.main(quick=True)
+    assert acc > 0.9, acc
+    assert gate_err < 0.15, gate_err
+    assert determ == 0.0, determ
