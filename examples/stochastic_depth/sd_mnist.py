@@ -84,10 +84,11 @@ class StochasticDepthModule(BaseModule):
         self.params_initialized = True
 
     def bind(self, *args, **kwargs):
-        # the compute branch must always produce input grads: when the
-        # gate is shut the block's input grad IS the upstream grad, but
-        # when open it needs dx of x + f(x)
-        kwargs['inputs_need_grad'] = True
+        # when training, the compute branch must always produce input
+        # grads: gate shut -> the block's input grad IS the upstream
+        # grad; gate open -> it needs dx of x + f(x)
+        if kwargs.get('for_training', True):
+            kwargs['inputs_need_grad'] = True
         self._mod.bind(*args, **kwargs)
         self.binded = True
 

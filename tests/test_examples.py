@@ -164,3 +164,22 @@ def test_stochastic_depth():
     assert acc > 0.9, acc
     assert gate_err < 0.15, gate_err
     assert determ == 0.0, determ
+
+
+def test_dec_clustering():
+    """Deep Embedded Clustering (reference example/dec): symbolic
+    t-kernel soft assignment + KL refinement must not degrade the
+    k-means init and must exceed 0.9 cluster accuracy."""
+    mod = _load('examples/dec/dec.py', 'ex_dec')
+    init_acc, final_acc = mod.main(quick=True)
+    assert final_acc >= init_acc, (init_acc, final_acc)
+    assert final_acc > 0.9, final_acc
+
+
+def test_captcha_ocr():
+    """Multi-head captcha OCR (reference example/captcha): joint
+    4-head Group training; sequence accuracy is the gate."""
+    mod = _load('examples/captcha/captcha_ocr.py', 'ex_captcha')
+    digit_acc, seq_acc = mod.main(quick=True)
+    assert digit_acc > 0.93, digit_acc
+    assert seq_acc > 0.8, seq_acc
