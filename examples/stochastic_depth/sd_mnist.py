@@ -86,8 +86,11 @@ class StochasticDepthModule(BaseModule):
     def bind(self, *args, **kwargs):
         # when training, the compute branch must always produce input
         # grads: gate shut -> the block's input grad IS the upstream
-        # grad; gate open -> it needs dx of x + f(x)
-        if kwargs.get('for_training', True):
+        # grad; gate open -> it needs dx of x + f(x).  for_training is
+        # the third positional of BaseModule.bind, so check both forms
+        for_training = args[2] if len(args) > 2 else \
+            kwargs.get('for_training', True)
+        if for_training:
             kwargs['inputs_need_grad'] = True
         self._mod.bind(*args, **kwargs)
         self.binded = True

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _maybe_remat(f):
+def _maybe_remat(f, mode=None):
     """Gradient rematerialization for the fused train step
     (MXNET_TPU_REMAT): 'conv' saves only convolution/matmul results as
     forward residuals and recomputes the elementwise chains between
@@ -32,8 +32,10 @@ def _maybe_remat(f):
     trading cheap VPU recompute for whole HBM passes of activation
     traffic.  The jax.checkpoint analog of the reference's
     MXNET_BACKWARD_DO_MIRROR (graph_executor.cc:243).  'none' keeps
-    XLA's default residual choice."""
-    mode = os.environ.get('MXNET_TPU_REMAT', 'none').lower()
+    XLA's default residual choice.  `mode` pins a value captured at
+    bind time (jit traces run later, when the env may have changed)."""
+    if mode is None:
+        mode = os.environ.get('MXNET_TPU_REMAT', 'none').lower()
     if mode in ('none', '0', ''):
         return f
     if mode != 'conv':
@@ -128,6 +130,9 @@ class Executor:
                  grad_req_dict, group2ctx=None):
         self._symbol = symbol
         self._ctx = ctx
+        # capture the remat knob now: jit tracing happens later
+        self._remat_mode = os.environ.get('MXNET_TPU_REMAT',
+                                          'none').lower()
         # ctx_group model parallelism (reference AttrScope ctx_group +
         # PlaceDevice pass, graph_executor.cc:367): nodes whose
         # 'ctx_group' attr maps to a device get their outputs pinned
@@ -433,6 +438,7 @@ class Executor:
                 outs, new_aux = run_graph(tuple(merged), aux_vals, rng, True)
                 return outs, new_aux
 
+            f = _maybe_remat(f, self._remat_mode)   # remat covers this path too
             diff_vals = tuple(arg_vals[i] for i in diff_idx)
             (outs, vjp_fn, new_aux) = jax.vjp(f, diff_vals, has_aux=True)
             grads, = vjp_fn(tuple(head_grads))
@@ -534,7 +540,7 @@ class Executor:
                                               sub, True)
                     return outs, new_aux
 
-                f = _maybe_remat(f)
+                f = _maybe_remat(f, self._remat_mode)
                 outs, vjp_fn, new_aux = jax.vjp(f, tuple(diff_vals),
                                                 has_aux=True)
                 heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
@@ -925,6 +931,46 @@ class Executor:
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
+
+    def memory_cost(self, mode='forward'):
+        """Memory statistics of this executor's compiled XLA module —
+        the reference example/memcost role (there: the NNVM allocation
+        plan's 'Total x MB allocated'; here: the XLA buffer
+        assignment, which IS this runtime's allocation plan).  mode is
+        'forward' (inference program), 'train' (train-mode forward) or
+        'train_backward' (forward+backward, honoring MXNET_TPU_REMAT).
+        Returns a dict of argument/output/temp/peak/code byte counts."""
+        if self._grouped:
+            raise MXNetError('memory_cost: ctx_group executors run '
+                             'eagerly per-op; no single compiled module')
+        arg_vals, aux_vals = self._gather()
+        key = jax.random.PRNGKey(0)
+        if mode == 'forward':
+            lowered = self._fwd_eval.lower(arg_vals, aux_vals, key)
+        elif mode == 'train':
+            lowered = self._fwd_train.lower(arg_vals, aux_vals, key)
+        elif mode == 'train_backward':
+            outs, _ = jax.eval_shape(self.raw_forward_train, arg_vals,
+                                     aux_vals, key)
+            # abstract head grads: .lower() needs only shapes/dtypes
+            heads = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype)
+                          for o in outs)
+            lowered = self._fwd_bwd.lower(arg_vals, aux_vals, key, heads)
+        else:
+            raise ValueError("memory_cost mode must be 'forward', "
+                             "'train' or 'train_backward', got %r" % mode)
+        stats = lowered.compile().memory_analysis()
+        if stats is None:
+            raise MXNetError('memory_cost: this backend reports no '
+                             'compiled-module memory statistics')
+        out = {}
+        for field in ('argument_size_in_bytes', 'output_size_in_bytes',
+                      'temp_size_in_bytes', 'peak_memory_in_bytes',
+                      'generated_code_size_in_bytes'):
+            out[field.replace('_size_in_bytes', '_bytes')
+                .replace('_in_bytes', '_bytes')] = \
+                int(getattr(stats, field, 0) or 0)
+        return out
 
     def debug_str(self):
         """Plan dump: topo-ordered ops, output shapes, and memory

@@ -183,3 +183,34 @@ def test_captcha_ocr():
     digit_acc, seq_acc = mod.main(quick=True)
     assert digit_acc > 0.93, digit_acc
     assert seq_acc > 0.8, seq_acc
+
+
+def test_memcost():
+    """Compiled-module memory census (reference example/memcost):
+    backward temp memory is a multiple of inference temp memory and
+    rematerialization never increases it."""
+    mod = _load('examples/memcost/memcost.py', 'ex_memcost')
+    fwd, bwd, remat = mod.main(quick=True)
+    assert bwd > 2 * fwd, (fwd, bwd)
+    assert remat <= bwd, (remat, bwd)
+
+
+def test_rnn_time_major():
+    """Time-major unroll (reference example/rnn-time-major): layout
+    parity in accuracy and exact cross-layout forward equivalence."""
+    mod = _load('examples/rnn_time_major/rnn_cell_demo.py', 'ex_tnc')
+    acc_nt, acc_tn, max_dev = mod.main(quick=True)
+    assert acc_nt > 0.9, acc_nt
+    assert acc_tn > 0.9, acc_tn
+    assert max_dev < 1e-5, max_dev
+
+
+def test_dsd_training():
+    """Dense-sparse-dense optimizer subclass (reference example/dsd):
+    the pruning mask must actually hold during the sparse phase and
+    accuracy must survive the full D-S-D cycle."""
+    mod = _load('examples/dsd/mlp_dsd.py', 'ex_dsd')
+    dense_acc, sparse_frac, sparse_acc, final_acc = mod.main(quick=True)
+    assert sparse_frac > 0.65, sparse_frac
+    assert sparse_acc > 0.9, sparse_acc
+    assert final_acc > 0.9, final_acc
