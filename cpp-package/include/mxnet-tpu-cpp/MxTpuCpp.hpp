@@ -120,6 +120,9 @@ int MXTCachedOpInvoke(void*, uint32_t, void**, uint32_t*, void**,
                       uint32_t);
 void MXTCachedOpFree(void*);
 int MXTListDataIters(uint32_t*, const char***);
+int MXTListOpNames(uint32_t*, const char***);
+int MXTOpGetInfo(const char*, const char**, const char**, uint32_t*,
+                 const char***);
 int MXTDataIterCreate(const char*, uint32_t, const char**, const char**,
                       void**);
 int MXTDataIterBeforeFirst(void*);

@@ -83,16 +83,15 @@ class StochasticDepthModule(BaseModule):
         self._mod.init_params(*args, **kwargs)
         self.params_initialized = True
 
-    def bind(self, *args, **kwargs):
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, **kwargs):
         # when training, the compute branch must always produce input
         # grads: gate shut -> the block's input grad IS the upstream
-        # grad; gate open -> it needs dx of x + f(x).  for_training is
-        # the third positional of BaseModule.bind, so check both forms
-        for_training = args[2] if len(args) > 2 else \
-            kwargs.get('for_training', True)
+        # grad; gate open -> it needs dx of x + f(x)
         if for_training:
-            kwargs['inputs_need_grad'] = True
-        self._mod.bind(*args, **kwargs)
+            inputs_need_grad = True
+        self._mod.bind(data_shapes, label_shapes, for_training,
+                       inputs_need_grad, **kwargs)
         self.binded = True
 
     def init_optimizer(self, *args, **kwargs):

@@ -203,6 +203,27 @@ def imperative_invoke(op_name, inputs, attr_keys, attr_vals):
     return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
+def list_op_names():
+    """Every invokable registry name, aliases included (reference
+    MXSymbolListAtomicSymbolCreators — the list a binding's codegen
+    walks to build its op namespace)."""
+    return [str(n) for n in _reg.list_ops()]
+
+
+def op_info(name):
+    """-> flat string list [canonical_name, description, in0, in1, ...]
+    (reference MXSymbolGetAtomicSymbolInfo).  Input names for ops whose
+    arity depends on attrs are resolved with empty attrs — the same
+    default composition sees."""
+    op = _reg.get(name)
+    try:
+        inputs = [str(i) for i in op.input_names({})]
+    except Exception:
+        inputs = []
+    doc = (getattr(op.fcompute, '__doc__', None) or '').strip()
+    return [str(op.name), doc] + inputs
+
+
 def autograd_set_recording(flag):
     """-> previous state (reference MXAutogradSetIsRecording)."""
     prev = ag.is_recording()

@@ -744,6 +744,58 @@ int unpack_outputs(PyObject* list, uint32_t max_outputs,
 
 }  // namespace
 
+// Op introspection — the reference's MXSymbolListAtomicSymbolCreators
+// + MXSymbolGetAtomicSymbolInfo pair, which binding codegen walks to
+// build a language's op namespace.  Returned pointers have
+// registry (static) lifetime.
+int MXTListOpNames(uint32_t* out_n, const char*** out_names) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  static Handle* cache = nullptr;
+  if (cache == nullptr) {
+    PyObject* names = call("list_op_names", "()");
+    if (names == nullptr) return -1;
+    Handle* h = wrap(names);
+    uint32_t n = 0;
+    if (store_strings(names, h, &n, nullptr) != 0) {
+      MXTNDArrayFree(h);
+      return -1;
+    }
+    cache = h;
+  }
+  *out_n = static_cast<uint32_t>(cache->str_ptrs.size());
+  *out_names = cache->str_ptrs.data();
+  return 0;
+}
+
+int MXTOpGetInfo(const char* name, const char** canonical_name,
+                 const char** description, uint32_t* num_inputs,
+                 const char*** input_names) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  static std::map<std::string, Handle*>* cache = nullptr;
+  if (cache == nullptr) cache = new std::map<std::string, Handle*>();
+  auto it = cache->find(name);
+  if (it == cache->end()) {
+    // bridge returns [canonical, description, in0, in1, ...]
+    PyObject* info = call("op_info", "(s)", name);
+    if (info == nullptr) return -1;
+    Handle* h = wrap(info);
+    uint32_t n = 0;
+    if (store_strings(info, h, &n, nullptr) != 0 || n < 2) {
+      MXTNDArrayFree(h);
+      return -1;
+    }
+    it = cache->emplace(name, h).first;
+  }
+  Handle* h = it->second;
+  *canonical_name = h->str_ptrs[0];
+  *description = h->str_ptrs[1];
+  *num_inputs = static_cast<uint32_t>(h->str_ptrs.size() - 2);
+  *input_names = *num_inputs ? h->str_ptrs.data() + 2 : nullptr;
+  return 0;
+}
+
 // Run a registered operator imperatively.  `outputs` is a caller array
 // with `max_outputs` slots; on success `*num_outputs` handles are
 // written (each freed with MXTNDArrayFree).

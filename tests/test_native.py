@@ -640,3 +640,48 @@ def test_stablehlo_runner_no_python(tmp_path):
     assert 'STABLEHLO_RUNNER_OK' in proc.stdout, proc.stdout
     assert ('predicted=%d' % expect) in proc.stdout, \
         (expect, proc.stdout)
+
+
+@native
+def test_c_op_introspection():
+    """Op registry introspection from C (reference
+    MXSymbolListAtomicSymbolCreators + MXSymbolGetAtomicSymbolInfo —
+    the pair a binding's codegen walks to build its op namespace):
+    list every invokable name, resolve an op's canonical name and
+    input names, and resolve an alias to its canonical op."""
+    import ctypes
+    lib = ctypes.CDLL(_core._LIB_PATH)
+    lib.MXTTrainGetLastError.restype = ctypes.c_char_p
+
+    n = ctypes.c_uint32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    rc = lib.MXTListOpNames(ctypes.byref(n), ctypes.byref(names))
+    assert rc == 0, lib.MXTTrainGetLastError()
+    all_names = {names[i].decode() for i in range(n.value)}
+    assert len(all_names) > 300, len(all_names)
+    assert {'Convolution', 'FullyConnected', 'stop_gradient'} <= all_names
+
+    canon = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    ni = ctypes.c_uint32()
+    ins = ctypes.POINTER(ctypes.c_char_p)()
+    rc = lib.MXTOpGetInfo(b'FullyConnected', ctypes.byref(canon),
+                          ctypes.byref(desc), ctypes.byref(ni),
+                          ctypes.byref(ins))
+    assert rc == 0, lib.MXTTrainGetLastError()
+    assert canon.value == b'FullyConnected'
+    inputs = [ins[i].decode() for i in range(ni.value)]
+    assert inputs[0] == 'data' and 'weight' in inputs, inputs
+
+    # alias resolves to the canonical registration
+    rc = lib.MXTOpGetInfo(b'stop_gradient', ctypes.byref(canon),
+                          ctypes.byref(desc), ctypes.byref(ni),
+                          ctypes.byref(ins))
+    assert rc == 0, lib.MXTTrainGetLastError()
+    assert canon.value == b'BlockGrad', canon.value
+
+    # unknown op: clean error, not a crash
+    rc = lib.MXTOpGetInfo(b'NoSuchOpEver', ctypes.byref(canon),
+                          ctypes.byref(desc), ctypes.byref(ni),
+                          ctypes.byref(ins))
+    assert rc != 0
