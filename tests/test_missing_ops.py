@@ -164,13 +164,16 @@ def test_correlation1d():
 
 
 def test_convolution_v1_alias():
+    # explicit name: the auto-name counter ('convolution0') is global
+    # per-process state any earlier test may have advanced
     data = sym.Variable('data')
-    c = sym.Convolution_v1(data, kernel=(3, 3), num_filter=2, pad=(1, 1))
+    c = sym.Convolution_v1(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                           name='convv1')
     ex = c.simple_bind(mx.cpu(), grad_req='null', data=(1, 1, 4, 4))
     ex.forward(is_train=False,
                data=np.ones((1, 1, 4, 4), np.float32),
-               convolution0_weight=np.ones((2, 1, 3, 3), np.float32),
-               convolution0_bias=np.zeros((2,), np.float32))
+               convv1_weight=np.ones((2, 1, 3, 3), np.float32),
+               convv1_bias=np.zeros((2,), np.float32))
     assert ex.outputs[0].shape == (1, 2, 4, 4)
 
 
