@@ -12,7 +12,9 @@ The dtype rides in the JSON so the comparison basis is explicit
 (bfloat16 mixed precision with fp32 master weights by default, matching
 the reference's fp16 multi_precision headline mode — NEWS.md:18).
 Env knobs: BENCH_BATCH (default tries 256,128,64), BENCH_STEPS (bulk
-dispatches), BENCH_BULK (steps per dispatch), BENCH_DTYPE, BENCH_MODEL.
+dispatches), BENCH_BULK (steps per dispatch), BENCH_DTYPE, BENCH_MODEL
+(any resnet-{18,34,50,101,152}; tools/bench_family.py sweeps the whole
+BASELINE.md table including inception-bn via this module's harness).
 """
 import json
 import os
@@ -21,16 +23,39 @@ import time
 
 import numpy as np
 
+# per-model 1x K80 fp32 img/s (BASELINE.md / reference
+# example/image-classification/README.md:149-156) — the single source
+# tools/bench_family.py imports
+K80_IMG_S = {
+    'inception-bn': 152.0,
+    'resnet-18': 185.0,
+    'resnet-34': 172.0,
+    'resnet-50': 109.0,
+    'resnet-101': 78.0,
+    'resnet-152': 57.0,
+}
 
-def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
+
+def make_symbol(model, dtype):
+    """BASELINE.md-family symbol by name ('resnet-N' | 'inception-bn')."""
+    if model == 'inception-bn':
+        from mxnet_tpu.models import inception_bn
+        return inception_bn.get_symbol(num_classes=1000, dtype=dtype)
+    from mxnet_tpu.models import resnet
+    depth = int(model.split('-')[1])
+    return resnet.get_symbol(num_classes=1000, num_layers=depth,
+                             dtype=dtype)
+
+
+def run_symbol(sym, batch, steps, warmup, bulk, dtype):
+    """The shared measurement harness: bind, fused bulk_step loop,
+    host-fetch barriers (block_until_ready alone can return before
+    remote execution finishes on tunneled backends)."""
     import jax
     import mxnet_tpu as mx
-    from mxnet_tpu.models import resnet
 
     ctx = mx.tpu() if any(d.platform != 'cpu' for d in jax.devices()) \
         else mx.cpu()
-    sym = resnet.get_symbol(num_classes=1000, num_layers=num_layers,
-                            dtype=dtype)
     mod = mx.mod.Module(sym, context=ctx)
     mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 3, 224, 224))],
              label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
@@ -64,24 +89,31 @@ def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
             mod.forward_backward(batches[0])
             mod.update()
 
+    def block():
+        # force completion with a negligible host fetch of a weight
+        name = next(n for n in mod._exec_group.executor.arg_dict
+                    if n.endswith('weight'))
+        w = mod._exec_group.executor.arg_dict[name]
+        float(w._data.ravel()[0])
+
     for _ in range(warmup):
         step()
-    _block(mod)
+    block()
     tic = time.time()
     for _ in range(steps):
         step()
-    _block(mod)
+    block()
     dt = time.time() - tic
     return batch * bulk * steps / dt
 
 
-def _block(mod):
-    """Force completion with a host fetch — block_until_ready alone can
-    return before remote execution finishes on tunneled backends.  Fetch
-    a single element (device-side slice) so the transfer itself is
-    negligible."""
-    w = mod._exec_group.executor.arg_dict['fc1_weight']
-    float(w._data.ravel()[0])
+def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
+    return run_symbol(make_symbol('resnet-%d' % num_layers, dtype),
+                      batch, steps, warmup, bulk, dtype)
+
+
+def is_oom(text):
+    return 'RESOURCE_EXHAUSTED' in text or 'Out of memory' in text
 
 
 def main():
@@ -94,32 +126,23 @@ def main():
     # measured 2% SLOWER (round 5) — 16 stays the sweet spot
     bulk = int(os.environ.get('BENCH_BULK', 16))
     dtype = os.environ.get('BENCH_DTYPE', 'bfloat16')
-    # BENCH_MODEL=resnet-N picks another family depth (the headline
-    # metric stays resnet-50; tools/bench_family.py sweeps the whole
-    # BASELINE.md table including inception-bn)
     model = os.environ.get('BENCH_MODEL', 'resnet-50')
-    k80_map = {'resnet-18': 185.0, 'resnet-34': 172.0, 'resnet-50': 109.0,
-               'resnet-101': 78.0, 'resnet-152': 57.0}
-    if model not in k80_map:
-        raise SystemExit(
-            'BENCH_MODEL must be one of %s (tools/bench_family.py covers '
-            'inception-bn and the rest of BASELINE.md)'
-            % ', '.join(sorted(k80_map)))
-    depth = int(model.split('-')[1])
-    k80 = k80_map[model]
+    if model not in K80_IMG_S:
+        raise SystemExit('BENCH_MODEL must be one of %s'
+                         % ', '.join(sorted(K80_IMG_S)))
+    k80 = K80_IMG_S[model]
     best = None
     err = None
     for i, b in enumerate(batches):
         try:
-            ips = run(b, steps, warmup, bulk, num_layers=depth,
-                      dtype=dtype)
+            ips = run_symbol(make_symbol(model, dtype), b, steps, warmup,
+                             bulk, dtype)
             if best is None or ips > best:
                 best = ips
             break  # largest fitting batch wins
         except Exception as e:  # OOM at this batch -> retry smaller
             err = e
-            if 'RESOURCE_EXHAUSTED' not in str(e) and \
-                    'Out of memory' not in str(e):
+            if not is_oom(str(e)):
                 raise
             # the in-process TPU client stays poisoned after a
             # ResourceExhausted (smaller retries re-OOM; measured,
@@ -138,12 +161,11 @@ def main():
             break
     if best is None:
         raise err
-    baseline = k80  # per-model 1x K80 fp32 img/s, BASELINE.md
     print(json.dumps({
         'metric': '%s_train_throughput_1chip' % model.replace('-', ''),
         'value': round(best, 2),
         'unit': 'images/sec',
-        'vs_baseline': round(best / baseline, 3),
+        'vs_baseline': round(best / k80, 3),
         'dtype': dtype,
         'steps_per_dispatch': bulk,
         'baseline': 'K80 fp32 %.0f img/s (BASELINE.md)' % k80,

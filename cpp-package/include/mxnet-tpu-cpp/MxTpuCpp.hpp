@@ -120,6 +120,8 @@ int MXTCachedOpInvoke(void*, uint32_t, void**, uint32_t*, void**,
                       uint32_t);
 void MXTCachedOpFree(void*);
 int MXTListDataIters(uint32_t*, const char***);
+int MXTRandomSeed(int);
+int MXTNDArrayWaitAll(void);
 int MXTListOpNames(uint32_t*, const char***);
 int MXTOpGetInfo(const char*, const char**, const char**, uint32_t*,
                  const char***);

@@ -203,6 +203,27 @@ def imperative_invoke(op_name, inputs, attr_keys, attr_vals):
     return list(out) if isinstance(out, (list, tuple)) else [out]
 
 
+def random_seed(seed):
+    """Reference MXRandomSeed: seed the global op RNG stream."""
+    from . import random as _random
+    _random.seed(int(seed))
+
+
+def wait_all():
+    """Reference MXNDArrayWaitAll.  A device's compute stream executes
+    in dispatch order, so enqueueing a trivial computation AFTER the
+    queued work and fetching its result to the host drains the stream —
+    the same enqueue-then-fetch barrier bench.py uses, because
+    block_until_ready on an existing buffer can return before remote
+    execution finishes on tunneled backends.  Failures surface (C
+    callers get -1), they are not swallowed."""
+    import jax
+    import jax.numpy as jnp
+    for d in jax.devices():
+        x = jax.device_put(jnp.zeros((), jnp.int32), d)
+        int(jax.jit(lambda v: v + 1)(x))
+
+
 def list_op_names():
     """Every invokable registry name, aliases included (reference
     MXSymbolListAtomicSymbolCreators — the list a binding's codegen

@@ -54,8 +54,12 @@ def InceptionFactoryB(data, num_3x3red, num_3x3, num_d3x3red, num_d3x3,
                       name='ch_concat_%s_chconcat' % name)
 
 
-def get_symbol(num_classes=1000, **kwargs):
+def get_symbol(num_classes=1000, dtype='float32', **kwargs):
     data = sym.Variable('data')
+    if dtype != 'float32':
+        # mixed precision, same flow as models/resnet.py: cast the
+        # input once; params downstream allocate in the compute dtype
+        data = sym.Cast(data, dtype=dtype, name='cast_data')
     conv1 = ConvFactory(data, 64, kernel=(7, 7), stride=(2, 2), pad=(3, 3),
                         name='conv1')
     pool1 = sym.Pooling(conv1, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
@@ -79,4 +83,6 @@ def get_symbol(num_classes=1000, **kwargs):
                       pool_type='avg')
     flatten = sym.Flatten(avg)
     fc1 = sym.FullyConnected(flatten, num_hidden=num_classes)
+    if dtype != 'float32':
+        fc1 = sym.Cast(fc1, dtype='float32', name='cast_out')
     return sym.SoftmaxOutput(fc1, name='softmax')

@@ -744,6 +744,25 @@ int unpack_outputs(PyObject* list, uint32_t max_outputs,
 
 }  // namespace
 
+// Global runtime controls (reference MXRandomSeed / MXNDArrayWaitAll).
+int MXTRandomSeed(int seed) {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* r = call("random_seed", "(i)", seed);
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXTNDArrayWaitAll() {
+  if (!ensure_python_rt()) return -1;
+  GIL gil;
+  PyObject* r = call("wait_all", "()");
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
 // Op introspection — the reference's MXSymbolListAtomicSymbolCreators
 // + MXSymbolGetAtomicSymbolInfo pair, which binding codegen walks to
 // build a language's op namespace.  Returned pointers have

@@ -685,3 +685,37 @@ def test_c_op_introspection():
                           ctypes.byref(desc), ctypes.byref(ni),
                           ctypes.byref(ins))
     assert rc != 0
+
+
+@native
+def test_c_runtime_controls():
+    """MXTRandomSeed + MXTNDArrayWaitAll (reference MXRandomSeed /
+    MXNDArrayWaitAll): seeding from C makes the op RNG reproducible;
+    WaitAll returns cleanly as a stream barrier."""
+    import ctypes
+    lib = ctypes.CDLL(_core._LIB_PATH)
+    lib.MXTTrainGetLastError.restype = ctypes.c_char_p
+
+    def draw():
+        assert lib.MXTRandomSeed(1234) == 0, lib.MXTTrainGetLastError()
+        out = (ctypes.c_void_p * 1)()
+        n = ctypes.c_uint32()
+        rc = lib.MXTImperativeInvoke(
+            b'_random_uniform', 0, None, 2,
+            (ctypes.c_char_p * 2)(b'shape', b'low'),
+            (ctypes.c_char_p * 2)(b'(4,)', b'0.0'),
+            ctypes.byref(n), out, 1)
+        assert rc == 0, lib.MXTTrainGetLastError()
+        buf = (ctypes.c_float * 4)()
+        # explicit c_void_p/c_size_t: a bare Python int argument is
+        # marshalled as 32-bit c_int, truncating the handle pointer
+        assert lib.MXTNDArraySyncCopyToCPU(ctypes.c_void_p(out[0]), buf,
+                                           ctypes.c_size_t(4)) == 0, \
+            lib.MXTTrainGetLastError()
+        lib.MXTNDArrayFree(ctypes.c_void_p(out[0]))
+        return list(buf)
+
+    a = draw()
+    b = draw()
+    assert a == b, (a, b)               # same seed -> same stream
+    assert lib.MXTNDArrayWaitAll() == 0, lib.MXTTrainGetLastError()

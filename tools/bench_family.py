@@ -2,9 +2,12 @@
 
 The reference publishes single-K80 numbers for six image-classification
 models (example/image-classification/README.md:149-156, reproduced in
-BASELINE.md).  bench.py tracks the ResNet-50 headline; this tool runs
-the WHOLE family on one chip with the same fused bulk_step harness and
-prints one JSON line per model with the per-model K80 baseline ratio.
+BASELINE.md).  bench.py tracks the ResNet-50 headline; this tool drives
+bench.py's shared harness (`run_symbol` + `K80_IMG_S`) over the WHOLE
+family, one subprocess per (model, batch) attempt — after a
+ResourceExhausted the in-process TPU client stays poisoned and smaller
+retries re-OOM (measured; docs/PERF.md round 5) — and prints one JSON
+line per model.
 
   python tools/bench_family.py [--models resnet-50,inception-bn]
                                [--batch N] [--steps N] [--bulk N]
@@ -12,88 +15,18 @@ prints one JSON line per model with the per-model K80 baseline ratio.
 import argparse
 import json
 import os
+import subprocess
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 '..'))
 
-# model -> (symbol factory kwargs, K80 fp32 img/s from BASELINE.md)
-K80 = {
-    'inception-bn': 152.0,
-    'resnet-18': 185.0,
-    'resnet-34': 172.0,
-    'resnet-50': 109.0,
-    'resnet-101': 78.0,
-    'resnet-152': 57.0,
-}
-
-
-def get_net(name, dtype):
-    from mxnet_tpu.models import inception_bn, resnet
-    if name == 'inception-bn':
-        # inception_bn has no dtype knob; bf16 enters via scan_dtype
-        return inception_bn.get_symbol(num_classes=1000)
-    depth = int(name.split('-')[1])
-    return resnet.get_symbol(num_classes=1000, num_layers=depth,
-                             dtype=dtype)
-
-
-def run(name, batch, steps, warmup, bulk, dtype):
-    import jax
-    import mxnet_tpu as mx
-
-    ctx = mx.tpu() if any(d.platform != 'cpu' for d in jax.devices()) \
-        else mx.cpu()
-    mod = mx.mod.Module(get_net(name, dtype), context=ctx)
-    mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 3, 224, 224))],
-             label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
-    mod.init_params(initializer=mx.init.Xavier(rnd_type='gaussian',
-                                               factor_type='in',
-                                               magnitude=2))
-    mod.init_optimizer(optimizer='sgd',
-                       optimizer_params={'learning_rate': 0.1,
-                                         'momentum': 0.9, 'wd': 1e-4,
-                                         'multi_precision':
-                                             dtype != 'float32'})
-    rng = np.random.RandomState(0)
-    batches = [
-        mx.io.DataBatch(
-            data=[mx.nd.array(
-                rng.rand(batch, 3, 224, 224).astype(np.float32),
-                ctx=ctx)],
-            label=[mx.nd.array(
-                (rng.rand(batch) * 1000).astype(np.float32), ctx=ctx)])
-        for _ in range(bulk)]
-    scan_dtype = dtype if dtype != 'float32' else None
-
-    def step():
-        mod.bulk_step(batches=batches, scan_dtype=scan_dtype)
-
-    def block():
-        # force completion with a host fetch (block_until_ready alone
-        # can return early on tunneled backends; see bench.py)
-        name = next(n for n in mod._exec_group.executor.arg_dict
-                    if n.endswith('weight'))
-        w = mod._exec_group.executor.arg_dict[name]
-        float(w._data.ravel()[0])
-
-    for _ in range(warmup):
-        step()
-    block()
-    t0 = time.time()
-    for _ in range(steps):
-        step()
-    block()
-    dt = time.time() - t0
-    return batch * bulk * steps / dt
+import bench  # noqa: E402  (repo-root bench.py: shared harness + table)
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument('--models', default=','.join(K80))
+    p.add_argument('--models', default=','.join(bench.K80_IMG_S))
     p.add_argument('--batch', type=int, default=0,
                    help='0 = try 256,128,64 largest-fitting')
     p.add_argument('--steps', type=int, default=4)
@@ -103,11 +36,6 @@ def main():
     args = p.parse_args()
 
     if not args.batch:
-        # one subprocess per (model, batch) attempt: after a
-        # ResourceExhausted the in-process TPU client stays poisoned
-        # (smaller retries re-OOM), so isolation is the only reliable
-        # retry — measured, not hypothetical
-        import subprocess
         for name in args.models.split(','):
             name = name.strip()
             out = None
@@ -122,7 +50,7 @@ def main():
                 if proc.returncode == 0:
                     out = proc.stdout.strip().splitlines()[-1]
                     break
-                if 'RESOURCE_EXHAUSTED' not in proc.stderr + proc.stdout:
+                if not bench.is_oom(proc.stderr + proc.stdout):
                     sys.stderr.write(proc.stderr)
                     raise RuntimeError('%s failed at batch %d' % (name, b))
             if out is None:
@@ -132,16 +60,18 @@ def main():
 
     for name in args.models.split(','):
         name = name.strip()
-        ips = run(name, args.batch, args.steps, args.warmup, args.bulk,
-                  args.dtype)
+        ips = bench.run_symbol(bench.make_symbol(name, args.dtype),
+                               args.batch, args.steps, args.warmup,
+                               args.bulk, args.dtype)
         print(json.dumps({
             'metric': '%s_train_throughput_1chip' % name.replace('-', ''),
             'value': round(ips, 2),
             'unit': 'images/sec',
-            'vs_baseline': round(ips / K80[name], 3),
+            'vs_baseline': round(ips / bench.K80_IMG_S[name], 3),
             'dtype': args.dtype,
             'batch': args.batch,
-            'baseline': 'K80 fp32 %.0f img/s (BASELINE.md)' % K80[name],
+            'baseline': 'K80 fp32 %.0f img/s (BASELINE.md)'
+                        % bench.K80_IMG_S[name],
         }), flush=True)
 
 
