@@ -13,8 +13,8 @@ The dtype rides in the JSON so the comparison basis is explicit
 the reference's fp16 multi_precision headline mode — NEWS.md:18).
 Env knobs: BENCH_BATCH (default tries 256,128,64), BENCH_STEPS (bulk
 dispatches), BENCH_BULK (steps per dispatch), BENCH_DTYPE, BENCH_MODEL
-(any resnet-{18,34,50,101,152}; tools/bench_family.py sweeps the whole
-BASELINE.md table including inception-bn via this module's harness).
+(any K80_IMG_S key below — resnet-N, inception-bn, inception-v3,
+alexnet; tools/bench_family.py sweeps them all via this harness).
 """
 import json
 import os
@@ -33,21 +33,27 @@ K80_IMG_S = {
     'resnet-50': 109.0,
     'resnet-101': 78.0,
     'resnet-152': 57.0,
+    # from the scaling table's 1-GPU rows (BASELINE.md; batch 512 / 32)
+    'alexnet': 457.07,
+    'inception-v3': 30.4,
 }
+
+# input edge per model (everything else trains at 224)
+IMAGE_EDGE = {'inception-v3': 299}
 
 
 def make_symbol(model, dtype):
-    """BASELINE.md-family symbol by name ('resnet-N' | 'inception-bn')."""
-    if model == 'inception-bn':
-        from mxnet_tpu.models import inception_bn
-        return inception_bn.get_symbol(num_classes=1000, dtype=dtype)
-    from mxnet_tpu.models import resnet
-    depth = int(model.split('-')[1])
-    return resnet.get_symbol(num_classes=1000, num_layers=depth,
-                             dtype=dtype)
+    """BASELINE.md-family symbol by name (resnet-N / inception-bn /
+    inception-v3 / alexnet)."""
+    from mxnet_tpu import models
+    if model.startswith('resnet-'):
+        return models.get_symbol('resnet', num_classes=1000,
+                                 num_layers=int(model.split('-')[1]),
+                                 dtype=dtype)
+    return models.get_symbol(model, num_classes=1000, dtype=dtype)
 
 
-def run_symbol(sym, batch, steps, warmup, bulk, dtype):
+def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224):
     """The shared measurement harness: bind, fused bulk_step loop,
     host-fetch barriers (block_until_ready alone can return before
     remote execution finishes on tunneled backends)."""
@@ -57,7 +63,8 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype):
     ctx = mx.tpu() if any(d.platform != 'cpu' for d in jax.devices()) \
         else mx.cpu()
     mod = mx.mod.Module(sym, context=ctx)
-    mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, 3, 224, 224))],
+    mod.bind(data_shapes=[mx.io.DataDesc('data',
+                                         (batch, 3, edge, edge))],
              label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
     mod.init_params(initializer=mx.init.Xavier(rnd_type='gaussian',
                                                factor_type='in',
@@ -71,7 +78,7 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype):
     batches = [
         mx.io.DataBatch(
             data=[mx.nd.array(
-                rng.rand(batch, 3, 224, 224).astype(np.float32),
+                rng.rand(batch, 3, edge, edge).astype(np.float32),
                 ctx=ctx)],
             label=[mx.nd.array(
                 (rng.rand(batch) * 1000).astype(np.float32), ctx=ctx)])
@@ -136,7 +143,8 @@ def main():
     for i, b in enumerate(batches):
         try:
             ips = run_symbol(make_symbol(model, dtype), b, steps, warmup,
-                             bulk, dtype)
+                             bulk, dtype,
+                             edge=IMAGE_EDGE.get(model, 224))
             if best is None or ips > best:
                 best = ips
             break  # largest fitting batch wins

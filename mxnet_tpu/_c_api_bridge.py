@@ -217,11 +217,17 @@ def wait_all():
     block_until_ready on an existing buffer can return before remote
     execution finishes on tunneled backends.  Failures surface (C
     callers get -1), they are not swallowed."""
+    global _drain
     import jax
     import jax.numpy as jnp
+    if _drain is None:   # one cached jit, not a fresh trace per call
+        _drain = jax.jit(lambda v: v + 1)
     for d in jax.devices():
         x = jax.device_put(jnp.zeros((), jnp.int32), d)
-        int(jax.jit(lambda v: v + 1)(x))
+        int(_drain(x))
+
+
+_drain = None
 
 
 def list_op_names():

@@ -2,8 +2,11 @@
 from .. import symbol as sym
 
 
-def get_symbol(num_classes=1000, **kwargs):
+def get_symbol(num_classes=1000, dtype='float32', **kwargs):
     input_data = sym.Variable('data')
+    if dtype != 'float32':
+        # mixed precision, same flow as models/resnet.py
+        input_data = sym.Cast(input_data, dtype=dtype, name='cast_data')
     # stage 1
     conv1 = sym.Convolution(input_data, kernel=(11, 11), stride=(4, 4),
                             num_filter=96)
@@ -34,4 +37,6 @@ def get_symbol(num_classes=1000, **kwargs):
     dropout2 = sym.Dropout(relu7, p=0.5)
     # stage 6
     fc3 = sym.FullyConnected(dropout2, num_hidden=num_classes)
+    if dtype != 'float32':
+        fc3 = sym.Cast(fc3, dtype='float32', name='cast_out')
     return sym.SoftmaxOutput(fc3, name='softmax')

@@ -106,8 +106,11 @@ def _inception_e(data, n1, n3, n3x3, proj, name, pool_type='avg'):
                       name='ch_concat_%s_chconcat' % name)
 
 
-def get_symbol(num_classes=1000, **kwargs):
+def get_symbol(num_classes=1000, dtype='float32', **kwargs):
     data = sym.Variable('data')
+    if dtype != 'float32':
+        # mixed precision, same flow as models/resnet.py
+        data = sym.Cast(data, dtype=dtype, name='cast_data')
     # stem
     x = _conv(data, 32, kernel=(3, 3), stride=(2, 2), name='conv')
     x = _conv(x, 32, kernel=(3, 3), name='conv_1')
@@ -133,4 +136,6 @@ def get_symbol(num_classes=1000, **kwargs):
                     global_pool=True, name='global_pool')
     x = sym.Flatten(x, name='flatten')
     x = sym.FullyConnected(x, num_hidden=num_classes, name='fc1')
+    if dtype != 'float32':
+        x = sym.Cast(x, dtype='float32', name='cast_out')
     return sym.SoftmaxOutput(x, name='softmax')

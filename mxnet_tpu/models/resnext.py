@@ -33,7 +33,8 @@ def _bottleneck(data, num_filter, stride, dim_match, name, num_group,
 
 
 def get_symbol(num_classes=1000, num_layers=50, num_group=32,
-               bottle_neck_width=4, image_shape='3,224,224', **kwargs):
+               bottle_neck_width=4, image_shape='3,224,224',
+               dtype='float32', **kwargs):
     """ResNeXt-{50,101,152} (num_group x bottle_neck_width d,
     e.g. 32x4d, 64x4d)."""
     stages = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
@@ -41,6 +42,9 @@ def get_symbol(num_classes=1000, num_layers=50, num_group=32,
     filters = [256, 512, 1024, 2048]
 
     data = sym.Variable('data')
+    if dtype != 'float32':
+        # mixed precision, same flow as models/resnet.py
+        data = sym.Cast(data, dtype=dtype, name='cast_data')
     x = sym.Convolution(data, num_filter=64, kernel=(7, 7), stride=(2, 2),
                         pad=(3, 3), no_bias=True, name='conv0')
     x = sym.BatchNorm(x, fix_gamma=False, eps=2e-5, name='bn0')
@@ -60,4 +64,6 @@ def get_symbol(num_classes=1000, num_layers=50, num_group=32,
                     name='pool1')
     x = sym.Flatten(x)
     x = sym.FullyConnected(x, num_hidden=num_classes, name='fc1')
+    if dtype != 'float32':
+        x = sym.Cast(x, dtype='float32', name='cast_out')
     return sym.SoftmaxOutput(x, name='softmax')

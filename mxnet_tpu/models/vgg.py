@@ -9,11 +9,15 @@ VGG_SPEC = {
 }
 
 
-def get_symbol(num_classes=1000, num_layers=16, batch_norm=False, **kwargs):
+def get_symbol(num_classes=1000, num_layers=16, batch_norm=False,
+               dtype='float32', **kwargs):
     if num_layers not in VGG_SPEC:
         raise ValueError('invalid num_layers %d' % num_layers)
     layers, filters = VGG_SPEC[num_layers]
     body = sym.Variable('data')
+    if dtype != 'float32':
+        # mixed precision, same flow as models/resnet.py
+        body = sym.Cast(body, dtype=dtype, name='cast_data')
     for i, num in enumerate(layers):
         for j in range(num):
             body = sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
@@ -33,4 +37,6 @@ def get_symbol(num_classes=1000, num_layers=16, batch_norm=False, **kwargs):
     relu7 = sym.Activation(fc7, act_type='relu', name='relu7')
     drop7 = sym.Dropout(relu7, p=0.5, name='drop7')
     fc8 = sym.FullyConnected(drop7, num_hidden=num_classes, name='fc8')
+    if dtype != 'float32':
+        fc8 = sym.Cast(fc8, dtype='float32', name='cast_out')
     return sym.SoftmaxOutput(fc8, name='softmax')

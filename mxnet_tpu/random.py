@@ -13,16 +13,29 @@ import threading
 import jax
 
 _state = threading.local()
+# last process-wide seed: threads that have not drawn yet derive their
+# stream from it, so seed() is global like the reference MXRandomSeed
+# (per-stream state stays thread-local to keep draws race-free)
+_global_seed = [None]
 
 
 def _get():
     if not hasattr(_state, 'key'):
-        _state.key = jax.random.PRNGKey(0)
+        # a thread drawing for the first time inherits the process
+        # seed, so seed() is global like the reference MXRandomSeed.
+        # Every inheriting thread starts the SAME stream (reproducible
+        # run-to-run; the reference likewise seeds all device RNGs from
+        # one seed) — threads wanting distinct streams call seed()
+        # themselves.
+        _state.key = jax.random.PRNGKey(_global_seed[0] or 0)
     return _state.key
 
 
 def seed(seed_state):
-    """Seed the global PRNG (reference python/mxnet/random.py seed)."""
+    """Seed the global PRNG (reference python/mxnet/random.py seed).
+    Takes effect in every thread: the calling thread's stream resets to
+    the seed, and threads that draw later derive theirs from it."""
+    _global_seed[0] = int(seed_state)
     _state.key = jax.random.PRNGKey(int(seed_state))
 
 

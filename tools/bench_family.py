@@ -62,7 +62,8 @@ def main():
         name = name.strip()
         ips = bench.run_symbol(bench.make_symbol(name, args.dtype),
                                args.batch, args.steps, args.warmup,
-                               args.bulk, args.dtype)
+                               args.bulk, args.dtype,
+                               edge=bench.IMAGE_EDGE.get(name, 224))
         print(json.dumps({
             'metric': '%s_train_throughput_1chip' % name.replace('-', ''),
             'value': round(ips, 2),
