@@ -606,7 +606,11 @@ class KVStoreServer(object):
         if self.updater is not None:
             self.updater(key, merged)     # reads + writes self.store[key]
         else:
-            self.store[key] = merged
+            # copy: `merged` may be a zero-copy view into the recv
+            # frame (async push path) — storing the view would pin the
+            # whole multi-key wire buffer until the key's next push and
+            # alias a writable network buffer
+            self.store[key] = np.array(merged, copy=True)
 
     def _pull_value(self, key, min_version=0):
         """Sync semantics, deadlock-free: the pull carries the calling
@@ -632,29 +636,35 @@ class KVStoreServer(object):
         """Encoded ('ok', values...) reply frame for a pull at a known
         (key, version) snapshot — cached so N workers pulling the same
         round pay ONE encode+MAC (sync rounds always converge on the
-        same versions).  Only the latest snapshot per key set is kept."""
-        cache_key = tuple(keys_versions)
+        same versions).  Only the latest snapshot per key set is kept.
+        The cache is keyed by the ACTUAL snapshot versions, never the
+        client's requested minimums: a client re-requesting the same
+        floor after the store advanced must see the new weights."""
         with self.cv:
             # async mode: versions advance independently of the request,
             # so a version-keyed cache would serve stale weights
             cacheable = self.sync_mode
-            hit = self._frame_cache.get(cache_key) if cacheable else None
-        if hit is not None:
-            return hit
         try:
             # wait for the rounds BEFORE taking the build lock, so a
             # builder never blocks pushes that complete its own wait
-            values = [self._pull_value(k, v)[0] for k, v in keys_versions]
+            pairs = [self._pull_value(k, v) for k, v in keys_versions]
         except KeyError as e:
             return _build_frame(('err',
                                  'key %r not initialized' % (e.args[0],)))
+        values = [p[0] for p in pairs]
         if not cacheable:
             reply = ('ok', values[0]) if len(values) == 1 else \
                 ('ok', tuple(values))
             return _build_frame(reply)
+        snap_key = tuple((k, p[1])
+                         for (k, _), p in zip(keys_versions, pairs))
+        with self.cv:
+            hit = self._frame_cache.get(snap_key)
+        if hit is not None:
+            return hit
         with self._frame_build_lock:
             with self.cv:
-                hit = self._frame_cache.get(cache_key)
+                hit = self._frame_cache.get(snap_key)
             if hit is not None:
                 return hit
             reply = ('ok', values[0]) if len(values) == 1 else \
@@ -666,8 +676,8 @@ class KVStoreServer(object):
                 self._frame_cache = {
                     ck: fr for ck, fr in self._frame_cache.items()
                     if tuple(k for k, _ in ck) != tuple(
-                        k for k, _ in cache_key)}
-                self._frame_cache[cache_key] = frame
+                        k for k, _ in snap_key)}
+                self._frame_cache[snap_key] = frame
         return frame
 
     def _handle_barrier(self):
@@ -740,6 +750,7 @@ class KVStoreServer(object):
                 elif op == 'push_multi':
                     # one frame, many keys: one MAC per round instead
                     # of one per key (reference ZPush batching role)
+                    reply = ('ok',)   # an empty key list is a no-op
                     for k, v in msg[1]:
                         reply = self._handle_push(k, v)
                         if reply[0] != 'ok':

@@ -162,10 +162,17 @@ def _fit_block(t, block_q):
 
 def _schedule_caps(tq, tk, block_q):
     """The (q, k) block caps each schedule fits with — forward first,
-    then backward (which prefers larger tiles, _BWD_BLOCK)."""
-    fwd_k = block_q if tq == tk else max(block_q, 256)
-    bwd = max(block_q, _BWD_BLOCK)
-    return ((tq, block_q), (tk, fwd_k), (tq, bwd), (tk, bwd))
+    then backward (which prefers larger tiles, _BWD_BLOCK).  The k caps
+    derive from the POST-fit q blocks, exactly as the kernel impls
+    compute them — a cap from the user's pre-fit block_q can disagree
+    with the kernels and turn the promised dense fallback into a
+    raise (e.g. tq=8, tk=258, block_q=320)."""
+    fq = _try_fit(tq, block_q)
+    bq = _try_fit(tq, max(block_q, _BWD_BLOCK))
+    fwd_k = fq if tq == tk else max(fq, 256)
+    bwd_k = bq if tq == tk else max(bq, _BWD_BLOCK)
+    return ((tq, block_q), (tk, fwd_k),
+            (tq, max(block_q, _BWD_BLOCK)), (tk, bwd_k))
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, block_q, interpret,

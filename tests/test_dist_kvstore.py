@@ -437,3 +437,26 @@ def test_no_token_refuses_remote_bind(monkeypatch):
     monkeypatch.setenv('DMLC_PS_BIND_URI', '127.0.0.1')
     s = srv.KVStoreServer(0, 1)
     s.listener.close()
+
+
+def test_sync_pull_cache_not_stale():
+    """The sync-mode pull-frame cache must key on the ACTUAL snapshot
+    version: a client re-pulling at the same min_version after the
+    store advanced has to see the new weights (round-5 review repro:
+    the requested-version key served version-0 weights forever)."""
+    import threading
+    from mxnet_tpu import kvstore_server as ps
+    srv = ps.KVStoreServer(0, 1, sync_mode=True)
+    t = threading.Thread(target=srv.run, daemon=True)
+    t.start()
+    c = ps.DistServerClient('127.0.0.1', srv.port, 1)
+    try:
+        c.init('w', np.zeros(4, np.float32))
+        f0 = srv._pull_frame((('w', 0),))
+        srv._handle_push('w', np.ones(4, np.float32))
+        f1 = srv._pull_frame((('w', 0),))
+        assert f0 != f1, 'cache served pre-push weights'
+        v, ver = srv._pull_value('w', 0)
+        assert ver == 1 and v[0] != 0.0
+    finally:
+        c.stop_servers()

@@ -614,3 +614,22 @@ def test_flash_rectangular_validation():
     # non-causal tq > tk is legal
     out = pallas_ops.flash_attention(q, k, v, causal=False)
     assert out.shape == q.shape
+
+
+def test_pallas_flash_fallback_predicate_matches_kernels():
+    """The dense-fallback predicate must derive k-block caps from the
+    POST-fit q block exactly as the kernels do: with a pre-fit cap,
+    (tq=8, tk=258, block_q=320) passed the predicate but the forward
+    kernel raised instead of falling back (round-5 review repro)."""
+    import jax.numpy as jnp
+    from mxnet_tpu import pallas_ops
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(1, 1, 8, 16).astype(np.float32))
+    k = jnp.asarray(rs.randn(1, 1, 258, 16).astype(np.float32))
+    v = jnp.asarray(rs.randn(1, 1, 258, 16).astype(np.float32))
+    assert pallas_ops._needs_dense_fallback(8, 258, 320)
+    out = pallas_ops.flash_attention(q, k, v, block_q=320)
+    s = jnp.einsum('bhqd,bhkd->bhqk', q, k) / np.sqrt(16.0)
+    ref = jnp.einsum('bhqk,bhkd->bhqd', jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

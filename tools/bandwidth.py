@@ -95,7 +95,24 @@ def measure_ps(size_mb, iters, num_workers):
              for _ in range(num_workers)]
     for p in procs:
         p.start()
-    dts = [q.get(timeout=600) for _ in procs]
+    # poll with liveness checks: a worker that dies before q.put()
+    # must surface as an immediate error, not a 600 s queue timeout
+    # that masks its traceback
+    import queue as _queue
+    dts = []
+    deadline = time.time() + 600
+    while len(dts) < len(procs):
+        try:
+            dts.append(q.get(timeout=5))
+        except _queue.Empty:
+            dead = [p for p in procs
+                    if not p.is_alive() and p.exitcode not in (0, None)]
+            if dead:
+                raise RuntimeError(
+                    'ps worker process failed (exitcode %s)'
+                    % dead[0].exitcode)
+            if time.time() > deadline:
+                raise RuntimeError('ps workers timed out')
     for p in procs:
         p.join()
     if any(p.exitcode != 0 for p in procs):
