@@ -606,11 +606,13 @@ class KVStoreServer(object):
         if self.updater is not None:
             self.updater(key, merged)     # reads + writes self.store[key]
         else:
-            # copy: `merged` may be a zero-copy view into the recv
-            # frame (async push path) — storing the view would pin the
-            # whole multi-key wire buffer until the key's next push and
-            # alias a writable network buffer
-            self.store[key] = np.array(merged, copy=True)
+            # `merged` may be a zero-copy view into the recv frame
+            # (async push path) — storing the view would pin the whole
+            # multi-key wire buffer until the key's next push and alias
+            # a writable network buffer.  Owned arrays (the sync path's
+            # merge sum) store as-is; only views pay the copy.
+            self.store[key] = merged if merged.base is None else \
+                np.array(merged, copy=True)
 
     def _pull_value(self, key, min_version=0):
         """Sync semantics, deadlock-free: the pull carries the calling
