@@ -802,6 +802,7 @@ int MXTOpGetInfo(const char* name, const char** canonical_name,
     Handle* h = wrap(info);
     uint32_t n = 0;
     if (store_strings(info, h, &n, nullptr) != 0 || n < 2) {
+      if (n < 2) train_last_error = "op_info: short reply from bridge";
       MXTNDArrayFree(h);
       return -1;
     }

@@ -367,3 +367,30 @@ def test_resnet_convergence_parity_fp32_vs_bf16():
     assert accs['float32'] > 0.95, accs
     assert accs['bfloat16'] > 0.95, accs
     assert abs(accs['float32'] - accs['bfloat16']) < 0.03, accs
+
+
+def test_model_zoo_mixed_precision_binds():
+    """Every imagenet zoo model accepts the dtype knob train_imagenet
+    forwards (round 5: models swallowing it via **kwargs silently
+    computed fp32 under a bf16 label — a 1.77x perf mislabel for
+    inception-bn): params allocate in the compute dtype, BN
+    scale/shift stays fp32, outputs come back fp32."""
+    import jax.numpy as jnp
+    from mxnet_tpu import models
+    cases = [('alexnet', (2, 3, 224, 224), {}),
+             ('vgg', (2, 3, 224, 224), {'num_layers': 11}),
+             ('inception-bn', (2, 3, 128, 128), {}),
+             ('inception-v3', (2, 3, 299, 299), {}),
+             ('resnext', (2, 3, 64, 64), {'num_layers': 50}),
+             ('resnet', (2, 3, 64, 64), {'num_layers': 18})]
+    for name, shape, kw in cases:
+        s = models.get_symbol(name, num_classes=4, dtype='bfloat16', **kw)
+        ex = s.simple_bind(mx.cpu(), data=shape, softmax_label=(2,),
+                           grad_req='null')
+        n_bf16 = sum(1 for a in ex.arg_dict.values()
+                     if a.dtype == jnp.bfloat16)
+        assert n_bf16 > 0, name
+        ex.forward(is_train=False,
+                   data=np.zeros(shape, np.float32),
+                   softmax_label=np.zeros((2,), np.float32))
+        assert ex.outputs[0].dtype == np.float32, name

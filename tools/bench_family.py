@@ -1,19 +1,16 @@
 """Training-throughput sweep across the BASELINE.md model family.
 
-The reference publishes single-K80 numbers for six image-classification
-models (example/image-classification/README.md:149-156, reproduced in
-BASELINE.md).  bench.py tracks the ResNet-50 headline; this tool drives
-bench.py's shared harness (`run_symbol` + `K80_IMG_S`) over the WHOLE
-family, one subprocess per (model, batch) attempt — after a
-ResourceExhausted the in-process TPU client stays poisoned and smaller
-retries re-OOM (measured; docs/PERF.md round 5) — and prints one JSON
-line per model.
+The reference publishes single-K80 numbers for the image-classification
+family (example/image-classification/README.md:149-156 + the scaling
+table's 1-GPU rows, reproduced in BASELINE.md).  bench.py measures ONE
+model per process (BENCH_MODEL, with its own poisoned-client-safe OOM
+fallback); this tool just drives bench.py once per model and relays the
+JSON lines — one emitter, one retry ladder, no duplicated harness.
 
   python tools/bench_family.py [--models resnet-50,inception-bn]
                                [--batch N] [--steps N] [--bulk N]
 """
 import argparse
-import json
 import os
 import subprocess
 import sys
@@ -21,59 +18,36 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 '..'))
 
-import bench  # noqa: E402  (repo-root bench.py: shared harness + table)
+import bench  # noqa: E402  (repo-root bench.py: harness + K80 table)
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument('--models', default=','.join(bench.K80_IMG_S))
     p.add_argument('--batch', type=int, default=0,
-                   help='0 = try 256,128,64 largest-fitting')
+                   help='0 = bench.py default ladder (256,128,64)')
     p.add_argument('--steps', type=int, default=4)
     p.add_argument('--warmup', type=int, default=2)
     p.add_argument('--bulk', type=int, default=16)
     p.add_argument('--dtype', default='bfloat16')
     args = p.parse_args()
 
-    if not args.batch:
-        for name in args.models.split(','):
-            name = name.strip()
-            out = None
-            for b in (256, 128, 64):
-                proc = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     '--models', name, '--batch', str(b),
-                     '--steps', str(args.steps),
-                     '--warmup', str(args.warmup),
-                     '--bulk', str(args.bulk), '--dtype', args.dtype],
-                    capture_output=True, text=True)
-                if proc.returncode == 0:
-                    out = proc.stdout.strip().splitlines()[-1]
-                    break
-                if not bench.is_oom(proc.stderr + proc.stdout):
-                    sys.stderr.write(proc.stderr)
-                    raise RuntimeError('%s failed at batch %d' % (name, b))
-            if out is None:
-                raise RuntimeError('%s OOMs at every batch' % name)
-            print(out, flush=True)
-        return
-
+    bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            '..', 'bench.py')
     for name in args.models.split(','):
         name = name.strip()
-        ips = bench.run_symbol(bench.make_symbol(name, args.dtype),
-                               args.batch, args.steps, args.warmup,
-                               args.bulk, args.dtype,
-                               edge=bench.IMAGE_EDGE.get(name, 224))
-        print(json.dumps({
-            'metric': '%s_train_throughput_1chip' % name.replace('-', ''),
-            'value': round(ips, 2),
-            'unit': 'images/sec',
-            'vs_baseline': round(ips / bench.K80_IMG_S[name], 3),
-            'dtype': args.dtype,
-            'batch': args.batch,
-            'baseline': 'K80 fp32 %.0f img/s (BASELINE.md)'
-                        % bench.K80_IMG_S[name],
-        }), flush=True)
+        env = dict(os.environ, BENCH_MODEL=name,
+                   BENCH_STEPS=str(args.steps),
+                   BENCH_WARMUP=str(args.warmup),
+                   BENCH_BULK=str(args.bulk), BENCH_DTYPE=args.dtype)
+        if args.batch:
+            env['BENCH_BATCH'] = str(args.batch)
+        proc = subprocess.run([sys.executable, bench_py], env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('%s failed' % name)
+        print(proc.stdout.strip().splitlines()[-1], flush=True)
 
 
 if __name__ == '__main__':
