@@ -11,7 +11,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The dtype rides in the JSON so the comparison basis is explicit
 (bfloat16 mixed precision with fp32 master weights by default, matching
 the reference's fp16 multi_precision headline mode — NEWS.md:18).
-Env knobs: BENCH_BATCH (default tries 256,128,64), BENCH_STEPS (bulk
+Env knobs: BENCH_BATCH (default: the per-model BATCH_LADDER, else
+256,128,64), BENCH_STEPS (bulk
 dispatches), BENCH_BULK (steps per dispatch), BENCH_DTYPE, BENCH_MODEL
 (any K80_IMG_S key below — resnet-N, inception-bn, inception-v3,
 alexnet; tools/bench_family.py sweeps them all via this harness).
@@ -40,6 +41,10 @@ K80_IMG_S = {
 
 # input edge per model (everything else trains at 224)
 IMAGE_EDGE = {'inception-v3': 299}
+
+# per-model default batch ladder: alexnet's baseline row was measured
+# at batch 512 and the chip fits it (512 measured faster than 256)
+BATCH_LADDER = {'alexnet': (512, 256, 128)}
 
 
 def make_symbol(model, dtype):
@@ -124,8 +129,9 @@ def is_oom(text):
 
 
 def main():
+    model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \
-        else [256, 128, 64]
+        else list(BATCH_LADDER.get(model_env, (256, 128, 64)))
     steps = int(os.environ.get('BENCH_STEPS', 6))
     warmup = int(os.environ.get('BENCH_WARMUP', 2))
     # 16 steps/dispatch measured +3.2% over 8 (the dependent-dispatch
@@ -133,7 +139,7 @@ def main():
     # measured 2% SLOWER (round 5) — 16 stays the sweet spot
     bulk = int(os.environ.get('BENCH_BULK', 16))
     dtype = os.environ.get('BENCH_DTYPE', 'bfloat16')
-    model = os.environ.get('BENCH_MODEL', 'resnet-50')
+    model = model_env
     if model not in K80_IMG_S:
         raise SystemExit('BENCH_MODEL must be one of %s'
                          % ', '.join(sorted(K80_IMG_S)))
@@ -147,6 +153,7 @@ def main():
                              edge=IMAGE_EDGE.get(model, 224))
             if best is None or ips > best:
                 best = ips
+                best_batch = b
             break  # largest fitting batch wins
         except Exception as e:  # OOM at this batch -> retry smaller
             err = e
@@ -175,6 +182,7 @@ def main():
         'unit': 'images/sec',
         'vs_baseline': round(best / k80, 3),
         'dtype': dtype,
+        'batch': best_batch,
         'steps_per_dispatch': bulk,
         'baseline': 'K80 fp32 %.0f img/s (BASELINE.md)' % k80,
     }))

@@ -801,8 +801,11 @@ int MXTOpGetInfo(const char* name, const char** canonical_name,
     if (info == nullptr) return -1;
     Handle* h = wrap(info);
     uint32_t n = 0;
-    if (store_strings(info, h, &n, nullptr) != 0 || n < 2) {
-      if (n < 2) train_last_error = "op_info: short reply from bridge";
+    int src = store_strings(info, h, &n, nullptr);
+    if (src != 0 || n < 2) {
+      // store_strings failure already carries the real Python error;
+      // only a successful-but-short reply needs its own message
+      if (src == 0) train_last_error = "op_info: short reply from bridge";
       MXTNDArrayFree(h);
       return -1;
     }

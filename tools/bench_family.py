@@ -25,7 +25,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument('--models', default=','.join(bench.K80_IMG_S))
     p.add_argument('--batch', type=int, default=0,
-                   help='0 = bench.py default ladder (256,128,64)')
+                   help='0 = bench.py per-model default ladder '
+                        '(bench.BATCH_LADDER / 256,128,64)')
     p.add_argument('--steps', type=int, default=4)
     p.add_argument('--warmup', type=int, default=2)
     p.add_argument('--bulk', type=int, default=16)
@@ -42,6 +43,10 @@ def main():
                    BENCH_BULK=str(args.bulk), BENCH_DTYPE=args.dtype)
         if args.batch:
             env['BENCH_BATCH'] = str(args.batch)
+        else:
+            # a stray exported BENCH_BATCH must not silently override
+            # the per-model ladder
+            env.pop('BENCH_BATCH', None)
         proc = subprocess.run([sys.executable, bench_py], env=env,
                               capture_output=True, text=True)
         if proc.returncode != 0:
