@@ -11,15 +11,32 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 The dtype rides in the JSON so the comparison basis is explicit
 (bfloat16 mixed precision with fp32 master weights by default, matching
 the reference's fp16 multi_precision headline mode — NEWS.md:18).
+Besides throughput the line reports dispatch-overhead metrics:
+`cold_start_s` (bind -> first completed step, includes XLA compile),
+`warm_start_s` (the same measurement in a SECOND process with the
+persistent compilation cache on — the cross-process warm-start story),
+and `input_stall_ms_per_step` (host time blocked in the input pipeline
+per training step; 0.0 in the default device-resident input mode).
 Env knobs: BENCH_BATCH (default: the per-model BATCH_LADDER, else
 256,128,64), BENCH_STEPS (bulk
 dispatches), BENCH_BULK (steps per dispatch), BENCH_DTYPE, BENCH_MODEL
 (any K80_IMG_S key below — resnet-N, inception-bn, inception-v3,
-alexnet; tools/bench_family.py sweeps them all via this harness).
+alexnet; tools/bench_family.py sweeps them all via this harness),
+BENCH_INPUT=device|host (device: batches pre-staged device-resident,
+the headline configuration; host: batches flow through
+io.prefetch_to_device and the measured stall is reported),
+BENCH_WARM=0 (skip the warm-start child process),
+MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
+cache so warm starts are exercised; set empty to disable).
+CLI: --no-exec-cache disables the in-process compiled-program cache
+(A/B of MXNET_TPU_EXEC_CACHE).
 """
+import argparse
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -58,16 +75,84 @@ def make_symbol(model, dtype):
     return models.get_symbol(model, num_classes=1000, dtype=dtype)
 
 
-def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224):
+def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224,
+               input_mode='device'):
     """The shared measurement harness: bind, fused bulk_step loop,
     host-fetch barriers (block_until_ready alone can return before
-    remote execution finishes on tunneled backends)."""
+    remote execution finishes on tunneled backends).  Returns a dict:
+    images/sec plus cold_start_s and input_stall_ms_per_step."""
     import jax
     import mxnet_tpu as mx
 
     ctx = mx.tpu() if any(d.platform != 'cpu' for d in jax.devices()) \
         else mx.cpu()
     mod = mx.mod.Module(sym, context=ctx)
+    rng = np.random.RandomState(0)
+    # mixed-precision models cast data to the compute dtype as their
+    # first op, so storing the K stacked scan batches in that dtype is
+    # value-preserving (bulk_step casts back before the graph) and
+    # halves their footprint — which is what lets K reach 32
+    scan_dtype = dtype if dtype != 'float32' else None
+
+    prefetch = None
+    if input_mode == 'host':
+        # host input pipeline: a small cycling dataset flows through
+        # io.prefetch_to_device, so the H2D copy of upcoming batches
+        # overlaps device compute and the real stall gets measured
+        nb = max(2, min(4, bulk))
+        Xh = rng.rand(nb * batch, 3, edge, edge).astype(np.float32)
+        yh = (rng.rand(nb * batch) * 1000).astype(np.float32)
+        src = mx.io.NDArrayIter(Xh, yh, batch_size=batch,
+                                label_name='softmax_label')
+        prefetch = mx.io.prefetch_to_device(src, size=2, device=ctx)
+
+        def pull(k):
+            out = []
+            while len(out) < k:
+                try:
+                    out.append(prefetch.next())
+                except StopIteration:
+                    prefetch.reset()
+            return out
+
+        def step():
+            bs = pull(bulk)
+            if bulk > 1:
+                mod.bulk_step(batches=bs, scan_dtype=scan_dtype)
+            else:
+                mod.forward_backward(bs[0])
+                mod.update()
+    else:
+        # headline configuration: batches pre-staged device-resident
+        # (pure compute measurement, zero input stall by construction)
+        batches = [
+            mx.io.DataBatch(
+                data=[mx.nd.array(
+                    rng.rand(batch, 3, edge, edge).astype(np.float32),
+                    ctx=ctx)],
+                label=[mx.nd.array(
+                    (rng.rand(batch) * 1000).astype(np.float32),
+                    ctx=ctx)])
+            for _ in range(bulk)]
+
+        def step():
+            if bulk > 1:
+                mod.bulk_step(batches=batches, scan_dtype=scan_dtype)
+            else:
+                mod.forward_backward(batches[0])
+                mod.update()
+
+    def block():
+        # force completion with a negligible host fetch of a weight
+        name = next(n for n in mod._exec_group.executor.arg_dict
+                    if n.endswith('weight'))
+        w = mod._exec_group.executor.arg_dict[name]
+        float(w._data.ravel()[0])
+
+    # cold start: bind -> first completed training dispatch (includes
+    # trace + XLA compile; with the persistent cache warm, the compile
+    # is fetched from disk and this shrinks — that delta IS warm start)
+    tic = time.time()
     mod.bind(data_shapes=[mx.io.DataDesc('data',
                                          (batch, 3, edge, edge))],
              label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
@@ -79,56 +164,89 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224):
                                          'momentum': 0.9, 'wd': 1e-4,
                                          'multi_precision':
                                              dtype != 'float32'})
-    rng = np.random.RandomState(0)
-    batches = [
-        mx.io.DataBatch(
-            data=[mx.nd.array(
-                rng.rand(batch, 3, edge, edge).astype(np.float32),
-                ctx=ctx)],
-            label=[mx.nd.array(
-                (rng.rand(batch) * 1000).astype(np.float32), ctx=ctx)])
-        for _ in range(bulk)]
-    # mixed-precision models cast data to the compute dtype as their
-    # first op, so storing the K stacked scan batches in that dtype is
-    # value-preserving (bulk_step casts back before the graph) and
-    # halves their footprint — which is what lets K reach 32
-    scan_dtype = dtype if dtype != 'float32' else None
+    step()
+    block()
+    cold_start_s = time.time() - tic
 
-    def step():
-        if bulk > 1:
-            mod.bulk_step(batches=batches, scan_dtype=scan_dtype)
-        else:
-            mod.forward_backward(batches[0])
-            mod.update()
-
-    def block():
-        # force completion with a negligible host fetch of a weight
-        name = next(n for n in mod._exec_group.executor.arg_dict
-                    if n.endswith('weight'))
-        w = mod._exec_group.executor.arg_dict[name]
-        float(w._data.ravel()[0])
-
-    for _ in range(warmup):
+    for _ in range(max(0, warmup - 1)):
         step()
     block()
+    if prefetch is not None:    # count stall over the measured loop only
+        prefetch.input_stall_ms = 0.0
+        prefetch.batches_served = 0
     tic = time.time()
     for _ in range(steps):
         step()
     block()
     dt = time.time() - tic
-    return batch * bulk * steps / dt
+    return {
+        'ips': batch * bulk * steps / dt,
+        'cold_start_s': round(cold_start_s, 3),
+        'input_stall_ms_per_step': round(
+            prefetch.stall_ms_per_batch(), 3) if prefetch is not None
+        else 0.0,
+    }
 
 
 def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
     return run_symbol(make_symbol('resnet-%d' % num_layers, dtype),
-                      batch, steps, warmup, bulk, dtype)
+                      batch, steps, warmup, bulk, dtype)['ips']
 
 
 def is_oom(text):
     return 'RESOURCE_EXHAUSTED' in text or 'Out of memory' in text
 
 
+def measure_warm_start(model, batch, bulk):
+    """Spawn a SECOND process (persistent compilation cache now
+    populated by this one) and read back its cold_start_s — the
+    cross-process warm-start number.  Returns None when disabled."""
+    if os.environ.get('BENCH_WARM', '1') in ('0', ''):
+        return None
+    if not os.environ.get('MXNET_TPU_PERSISTENT_CACHE_DIR'):
+        return None
+    env = dict(os.environ, BENCH_WARM_CHILD='1', BENCH_MODEL=model,
+               BENCH_BATCH=str(batch), BENCH_BULK=str(bulk),
+               BENCH_STEPS='1', BENCH_WARMUP='0', BENCH_WARM='0')
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                          env=env, capture_output=True, text=True)
+    if proc.returncode != 0:
+        return None
+    try:
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        return payload.get('cold_start_s')
+    except (ValueError, IndexError):
+        return None
+
+
 def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument('--no-exec-cache', action='store_true',
+                        help='disable the in-process compiled-program '
+                             'cache (sets MXNET_TPU_EXEC_CACHE=0; '
+                             'A/B the cache overhead/benefit)')
+    args = parser.parse_args()
+    if args.no_exec_cache:
+        os.environ['MXNET_TPU_EXEC_CACHE'] = '0'
+    # warm starts need the on-disk XLA cache.  Default to a FRESH
+    # per-run directory: this run's own compiles stay genuinely cold
+    # (cold_start_s measures a cold start even on repeat invocations)
+    # and only the warm-start child reads the populated cache.  A
+    # user-set MXNET_TPU_PERSISTENT_CACHE_DIR is respected as-is
+    # ('' disables); the per-run default is removed on exit.
+    own_cache_dir = None
+    if 'MXNET_TPU_PERSISTENT_CACHE_DIR' not in os.environ:
+        own_cache_dir = tempfile.mkdtemp(prefix='mxnet_tpu_xla_cache_')
+        os.environ['MXNET_TPU_PERSISTENT_CACHE_DIR'] = own_cache_dir
+    try:
+        _bench_main()
+    finally:
+        if own_cache_dir is not None:
+            import shutil
+            shutil.rmtree(own_cache_dir, ignore_errors=True)
+
+
+def _bench_main():
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \
         else list(BATCH_LADDER.get(model_env, (256, 128, 64)))
@@ -139,6 +257,8 @@ def main():
     # measured 2% SLOWER (round 5) — 16 stays the sweet spot
     bulk = int(os.environ.get('BENCH_BULK', 16))
     dtype = os.environ.get('BENCH_DTYPE', 'bfloat16')
+    input_mode = os.environ.get('BENCH_INPUT', 'device')
+    warm_child = os.environ.get('BENCH_WARM_CHILD', '0') == '1'
     model = model_env
     if model not in K80_IMG_S:
         raise SystemExit('BENCH_MODEL must be one of %s'
@@ -148,11 +268,12 @@ def main():
     err = None
     for i, b in enumerate(batches):
         try:
-            ips = run_symbol(make_symbol(model, dtype), b, steps, warmup,
+            res = run_symbol(make_symbol(model, dtype), b, steps, warmup,
                              bulk, dtype,
-                             edge=IMAGE_EDGE.get(model, 224))
-            if best is None or ips > best:
-                best = ips
+                             edge=IMAGE_EDGE.get(model, 224),
+                             input_mode=input_mode)
+            if best is None or res['ips'] > best['ips']:
+                best = res
                 best_batch = b
             break  # largest fitting batch wins
         except Exception as e:  # OOM at this batch -> retry smaller
@@ -162,7 +283,6 @@ def main():
             # the in-process TPU client stays poisoned after a
             # ResourceExhausted (smaller retries re-OOM; measured,
             # docs/PERF.md round 5) — re-exec each smaller attempt
-            import subprocess
             for nb in batches[i + 1:]:
                 env = dict(os.environ, BENCH_BATCH=str(nb))
                 proc = subprocess.run([sys.executable,
@@ -176,14 +296,28 @@ def main():
             break
     if best is None:
         raise err
+    if warm_child:
+        # minimal payload for the parent: the warm-process start time
+        print(json.dumps({'warm_child': True,
+                          'cold_start_s': best['cold_start_s']}))
+        return
+    from mxnet_tpu import profiler
+    cache_stats = profiler.exec_cache_stats()
     print(json.dumps({
         'metric': '%s_train_throughput_1chip' % model.replace('-', ''),
-        'value': round(best, 2),
+        'value': round(best['ips'], 2),
         'unit': 'images/sec',
-        'vs_baseline': round(best / k80, 3),
+        'vs_baseline': round(best['ips'] / k80, 3),
         'dtype': dtype,
         'batch': best_batch,
         'steps_per_dispatch': bulk,
+        'input': input_mode,
+        'cold_start_s': best['cold_start_s'],
+        'warm_start_s': measure_warm_start(model, best_batch, bulk),
+        'input_stall_ms_per_step': best['input_stall_ms_per_step'],
+        'exec_cache': os.environ.get('MXNET_TPU_EXEC_CACHE', '1')
+        not in ('0', ''),
+        'total_compile_s': round(cache_stats['total_compile_s'], 3),
         'baseline': 'K80 fp32 %.0f img/s (BASELINE.md)' % k80,
     }))
 

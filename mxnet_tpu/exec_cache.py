@@ -1,0 +1,235 @@
+"""Process-wide compiled-program cache for executors.
+
+Every `Executor` bind used to build fresh `jax.jit` closures, so
+rebinding an equivalent graph (batch-ladder sweeps, Module.reshape,
+bucketing, Predictor.reshape, a second simple_bind of the same net)
+re-traced and re-compiled the whole XLA program from scratch.  This
+module keys the jitted step functions on a canonical *graph signature*
+— the topo-sorted op list with attrs, positional arg/aux
+shapes+dtypes+grad_req (names are alpha-renamed away), output wiring,
+ctx-group placement, and the bind-time env knobs that change the traced
+math (remat / layout / stem-split) — so an equivalent rebind reuses the
+already-compiled executable: zero new XLA compilations.
+
+Two layers of reuse:
+
+  * in-process: the jitted callable bundle (fwd_train / fwd_eval /
+    fwd_monitor / fwd_bwd, plus fused multistep programs and AOT
+    memory-analysis compilations) is shared across executors whose
+    signatures match, LRU-bounded by MXNET_TPU_EXEC_CACHE_SIZE.
+  * cross-process: MXNET_TPU_PERSISTENT_CACHE_DIR (opt-in) points
+    JAX's on-disk compilation cache at a directory, so a second
+    process cold-starts warm — the XLA compile is fetched from disk
+    even though Python re-traces.
+
+Env knobs (documented in docs/PERF.md):
+  MXNET_TPU_EXEC_CACHE=1|0         in-process cache (default on)
+  MXNET_TPU_EXEC_CACHE_SIZE=N      LRU entries (default 64)
+  MXNET_TPU_PERSISTENT_CACHE_DIR   on-disk XLA cache dir (default off)
+
+Counters (exposed via profiler.exec_cache_stats / profiler.summary):
+  hits / misses        signature lookups at bind time
+  total_compile_s      wall time spent tracing+compiling XLA programs
+"""
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+_LOCK = threading.RLock()
+_CACHE = OrderedDict()          # signature-scoped key -> cached object
+_STATS = {'hits': 0, 'misses': 0, 'total_compile_s': 0.0}
+_PERSISTENT_DIR = None          # set once by setup_persistent_cache
+
+# Every env knob whose value is baked into the TRACED program must be
+# registered here ((name, default) read at bind time) — a trace-affecting
+# knob missing from this list would let a rebind after flipping it hit a
+# stale executable: wrong numerics with no error.  MXNET_TPU_REMAT is
+# covered separately (the executor passes its captured remat_mode into
+# graph_signature explicitly).
+TRACE_ENV_KNOBS = (
+    ('MXNET_TPU_LAYOUT_OPT', 'auto'),
+    ('MXNET_TPU_STEM_SPLIT', '1'),
+    ('MXNET_TPU_CONV_LAYOUT', ''),
+)
+
+
+def enabled():
+    """In-process executable cache on? (MXNET_TPU_EXEC_CACHE, default 1)"""
+    return os.environ.get('MXNET_TPU_EXEC_CACHE', '1') not in ('0', '')
+
+
+def _max_entries():
+    try:
+        return max(1, int(os.environ.get('MXNET_TPU_EXEC_CACHE_SIZE',
+                                         '64')))
+    except ValueError:
+        return 64
+
+
+def setup_persistent_cache():
+    """Point JAX's on-disk compilation cache at
+    MXNET_TPU_PERSISTENT_CACHE_DIR (idempotent; no-op when unset).
+
+    Must run before the first compilation: jax memoizes cache-usability
+    per backend on first use, so Executor calls this at every bind —
+    only the first call with the env var set does work."""
+    global _PERSISTENT_DIR
+    target = os.environ.get('MXNET_TPU_PERSISTENT_CACHE_DIR') or None
+    if target is None or target == _PERSISTENT_DIR:
+        return _PERSISTENT_DIR
+    import jax
+    jax.config.update('jax_compilation_cache_dir', target)
+    # default thresholds skip small/fast programs; cache everything —
+    # the point is cold-start elimination, not disk economy
+    for knob, val in (('jax_persistent_cache_min_compile_time_secs', 0),
+                      ('jax_persistent_cache_min_entry_size_bytes', -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # older jax without the knob
+            pass
+    # jax memoizes "is the cache used?" at the FIRST compile per task;
+    # environments whose site hooks import jax (and may compile) before
+    # this code runs would silently keep the cache off — drop the memo
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:   # private API moved: stay best-effort
+        pass
+    _PERSISTENT_DIR = target
+    return _PERSISTENT_DIR
+
+
+# ---------------------------------------------------------------------------
+# canonical graph signature
+# ---------------------------------------------------------------------------
+
+def graph_signature(symbol, ctx, arg_dict, aux_dict, grad_req,
+                    group2ctx=None, remat_mode='none'):
+    """Hashable canonical form of everything that determines the traced
+    step program.  Node *names* are deliberately excluded (auto-naming
+    counters differ between two builds of the same net; the compiled
+    math is name-free): variables appear as their positional role in
+    the arg/aux lists with shape+dtype+grad_req, ops as (op, sorted
+    attrs, input wiring by topo index, ctx_group)."""
+    topo = symbol._topo()
+    index = {id(n): i for i, n in enumerate(topo)}
+    arg_pos = {n: i for i, n in enumerate(arg_dict)}
+    aux_pos = {n: i for i, n in enumerate(aux_dict)}
+    nodes = []
+    for n in topo:
+        if n.op is None:
+            if n.name in arg_pos:
+                a = arg_dict[n.name]
+                nodes.append(('arg', arg_pos[n.name], tuple(a.shape),
+                              np.dtype(a.dtype).str,
+                              grad_req.get(n.name, 'null')))
+            elif n.name in aux_pos:
+                a = aux_dict[n.name]
+                nodes.append(('aux', aux_pos[n.name], tuple(a.shape),
+                              np.dtype(a.dtype).str))
+            else:       # unbound variable: name is the only identity
+                nodes.append(('unbound', n.name))
+        else:
+            attrs = tuple(sorted((str(k), repr(v))
+                          for k, v in n.attrs.items()))
+            ins = tuple((index[id(s)], oi) for s, oi in n.inputs)
+            nodes.append(('op', n.op.name, attrs, ins,
+                          n.user_attrs.get('ctx_group')))
+    outs = tuple((index[id(n)], oi) for n, oi in symbol._outputs)
+    groups = tuple(sorted((k, str(v))
+                   for k, v in (group2ctx or {}).items()))
+    # bind-time env knobs baked into the traced program (see
+    # TRACE_ENV_KNOBS — new trace-affecting knobs register there)
+    env = (remat_mode,) + tuple(os.environ.get(k, d)
+                                for k, d in TRACE_ENV_KNOBS)
+    return (str(ctx), tuple(nodes), outs, groups, env)
+
+
+# ---------------------------------------------------------------------------
+# cache proper
+# ---------------------------------------------------------------------------
+
+def get(key, count=False):
+    """Lookup.  count=True records a bind-level hit/miss in the stats
+    (sub-entries like AOT compiles pass count=False)."""
+    with _LOCK:
+        found = key in _CACHE
+        if found:
+            _CACHE.move_to_end(key)
+        if count:
+            _STATS['hits' if found else 'misses'] += 1
+        return _CACHE[key] if found else None
+
+
+def put(key, value):
+    with _LOCK:
+        _CACHE[key] = value
+        _CACHE.move_to_end(key)
+        limit = _max_entries()
+        while len(_CACHE) > limit:
+            _CACHE.popitem(last=False)
+    return value
+
+
+def note_compile(seconds):
+    """Account wall time of one trace+compile (called by TimedJit and
+    the AOT paths)."""
+    with _LOCK:
+        _STATS['total_compile_s'] += float(seconds)
+
+
+def timed_compile(lowered):
+    """`lowered.compile()` with the wall time billed to
+    total_compile_s — the one idiom every AOT path shares."""
+    t0 = time.perf_counter()
+    compiled = lowered.compile()
+    note_compile(time.perf_counter() - t0)
+    return compiled
+
+
+def stats():
+    with _LOCK:
+        return dict(_STATS)
+
+
+def clear(reset_stats=True):
+    """Drop every cached executable (tests / memory pressure)."""
+    with _LOCK:
+        _CACHE.clear()
+        if reset_stats:
+            for k in _STATS:
+                _STATS[k] = 0.0 if k == 'total_compile_s' else 0
+
+
+def size():
+    with _LOCK:
+        return len(_CACHE)
+
+
+class TimedJit:
+    """Thin wrapper over a jax.jit callable that bills trace+compile
+    wall time to the process counters: a call that grows the jit's
+    internal executable cache was a compilation (steady-state calls
+    pay one extra _cache_size() read, negligible next to dispatch)."""
+
+    __slots__ = ('fn',)
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, *args):
+        try:
+            before = self.fn._cache_size()
+        except Exception:     # non-jit callable or future jax
+            return self.fn(*args)
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        if self.fn._cache_size() > before:
+            note_compile(time.perf_counter() - t0)
+        return out
+
+    def lower(self, *args, **kwargs):
+        return self.fn.lower(*args, **kwargs)

@@ -48,6 +48,7 @@ def _maybe_remat(f, mode=None):
 
     return jax.checkpoint(f, policy=save_matmuls)
 
+from . import exec_cache
 from . import ndarray as nd
 from . import random as _random
 from . import profiler
@@ -164,6 +165,9 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _build(self):
+        # on-disk XLA cache (cross-process warm starts) must be
+        # configured before the first compilation; idempotent
+        exec_cache.setup_persistent_cache()
         sym = self._symbol
         topo = sym._topo()
         # only drop to eager per-op dispatch when some node actually
@@ -280,6 +284,13 @@ class Executor:
                 'got %r' % pref)
         self._layout_opt = layout_opt
 
+        # locals for the traced closures: cached jitted functions are
+        # shared across executors, so they must not capture `self`
+        # (that would pin the first executor's whole arg/aux arrays in
+        # the process-wide cache for the entry's lifetime)
+        group2dev = self._group2dev
+        remat_mode = self._remat_mode
+
         def run_graph(arg_vals, aux_vals, rng, is_train, collect_all=False):
             """Evaluate the DAG; returns (outputs, new_aux_tuple), plus
             every node's outputs when collect_all (monitor mode)."""
@@ -339,14 +350,14 @@ class Executor:
                     out_shapes=node_shapes.get(ni)
                     if op.needs_out_shapes else None)
                 group = node.user_attrs.get('ctx_group')
-                if group is not None and group in self._group2dev:
+                if group is not None and group in group2dev:
                     # grouped (model-parallel) execution: inputs
                     # transfer to the group's device and the op
                     # dispatches there — the reference's PlaceDevice +
                     # _CrossDeviceCopy design (graph_executor.cc:367).
                     # (Under jit these device_puts are ignored by
                     # lowering; grouped executors run un-jitted.)
-                    dev = self._group2dev[group]
+                    dev = group2dev[group]
                     args = [jax.device_put(a, dev) for a in args]
                     auxs = [jax.device_put(a, dev) for a in auxs]
                     if op_ctx.rng is not None:
@@ -395,14 +406,6 @@ class Executor:
 
         self._n_outputs = len(out_entries)
 
-        @jax.jit
-        def fwd_train(arg_vals, aux_vals, rng):
-            return run_graph(arg_vals, aux_vals, rng, True)
-
-        @jax.jit
-        def fwd_eval(arg_vals, aux_vals, rng):
-            return run_graph(arg_vals, aux_vals, rng, False)
-
         # monitor mode: also emit every node's outputs (the reference's
         # executor monitor callback, graph_executor.cc:1214 — there it
         # disables bulk segments; here it is a separate jit)
@@ -421,10 +424,6 @@ class Executor:
         def fwd_monitor(arg_vals, aux_vals, rng, is_train):
             return run_graph(arg_vals, aux_vals, rng, is_train,
                              collect_all=True)
-        # grouped executors stay un-jitted everywhere, monitor included
-        # (jit would collapse ctx_group placement onto one device)
-        self._fwd_monitor = fwd_monitor if self._grouped else \
-            jax.jit(fwd_monitor, static_argnums=(3,))
 
         diff_idx = [arg_pos[n] for n in self._diff_names]
 
@@ -438,7 +437,7 @@ class Executor:
                 outs, new_aux = run_graph(tuple(merged), aux_vals, rng, True)
                 return outs, new_aux
 
-            f = _maybe_remat(f, self._remat_mode)   # remat covers this path too
+            f = _maybe_remat(f, remat_mode)   # remat covers this path too
             diff_vals = tuple(arg_vals[i] for i in diff_idx)
             (outs, vjp_fn, new_aux) = jax.vjp(f, diff_vals, has_aux=True)
             grads, = vjp_fn(tuple(head_grads))
@@ -449,13 +448,38 @@ class Executor:
             # dispatches on its group's device with real transfers at
             # the boundaries (per-op dispatch is the reference's own
             # granularity); jit would collapse everything to one device
+            self._sig = None
+            self._fwd_monitor = fwd_monitor
             self._fwd_train = lambda a, x, r: run_graph(a, x, r, True)
             self._fwd_eval = lambda a, x, r: run_graph(a, x, r, False)
             self._fwd_bwd = fwd_bwd_impl
         else:
-            self._fwd_train = fwd_train
-            self._fwd_eval = fwd_eval
-            self._fwd_bwd = jax.jit(fwd_bwd_impl)
+            # compiled-program cache: equivalent graphs (same canonical
+            # signature — see exec_cache.graph_signature) share ONE set
+            # of jitted step functions, so a rebind/reshape back to a
+            # seen configuration re-traces and re-compiles NOTHING
+            self._sig = exec_cache.graph_signature(
+                sym, self._ctx, self.arg_dict, self.aux_dict,
+                self._grad_req, self._group2ctx, self._remat_mode) \
+                if exec_cache.enabled() else None
+            fns = exec_cache.get((self._sig, 'step_fns'), count=True) \
+                if self._sig is not None else None
+            if fns is None:
+                fns = {
+                    'fwd_train': exec_cache.TimedJit(jax.jit(
+                        lambda a, x, r: run_graph(a, x, r, True))),
+                    'fwd_eval': exec_cache.TimedJit(jax.jit(
+                        lambda a, x, r: run_graph(a, x, r, False))),
+                    'fwd_monitor': exec_cache.TimedJit(jax.jit(
+                        fwd_monitor, static_argnums=(3,))),
+                    'fwd_bwd': exec_cache.TimedJit(jax.jit(fwd_bwd_impl)),
+                }
+                if self._sig is not None:
+                    exec_cache.put((self._sig, 'step_fns'), fns)
+            self._fwd_monitor = fns['fwd_monitor']
+            self._fwd_train = fns['fwd_train']
+            self._fwd_eval = fns['fwd_eval']
+            self._fwd_bwd = fns['fwd_bwd']
         self._stash = None
         self._run_graph = run_graph
         self._arg_pos = arg_pos
@@ -466,7 +490,7 @@ class Executor:
             run_graph(arg_vals, aux_vals, rng, True)
 
     # ------------------------------------------------------------------
-    def make_fused_train_step(self, step_math):
+    def make_fused_train_step(self, step_math, step_key=None):
         """Compile forward + backward + optimizer update into ONE donated
         XLA dispatch (the whole training step — no reference
         counterpart; the reference pays per-op dispatch on all three
@@ -482,14 +506,19 @@ class Executor:
         Returns None when this executor cannot fuse (ctx-group eager
         mode).  Caller contract: every differentiable arg is a weight
         updated by step_math (grad_req 'write'), in self._diff_names
-        order.
+        order.  step_key: canonical identity of step_math (e.g.
+        FusedSGD.cache_key()) — when given, the compiled step is shared
+        through the process-wide executable cache across equivalent
+        executors.
 
         Implemented as the K=1 case of make_fused_multistep (no scan
         wrapper, same step body).
         """
-        return self.make_fused_multistep(step_math, (), repeat=1)
+        return self.make_fused_multistep(step_math, (), repeat=1,
+                                         step_key=step_key)
 
-    def make_fused_multistep(self, step_math, scan_names, repeat=None):
+    def make_fused_multistep(self, step_math, scan_names, repeat=None,
+                             step_key=None):
         """K whole training steps (fwd+bwd+update) in ONE donated XLA
         dispatch, looping on-device with lax.scan.
 
@@ -504,10 +533,12 @@ class Executor:
         the caller passes them stacked on a leading K axis; with
         `repeat=K` the currently bound batch is reused K times
         (xs=None scan).  lr/wd are loop-invariant for the K steps.
+        step_key: see make_fused_train_step.
         """
         if self._grouped:
             return None
         run_graph = self._run_graph
+        remat_mode = self._remat_mode   # no self capture: fn is cached
         scan_set = set(scan_names)
         diff_set = set(self._diff_names)
         n_args = len(self._arg_names)
@@ -522,6 +553,13 @@ class Executor:
         # the top of each step so the graph sees its declared inputs
         scan_dt = [self.arg_dict[self._arg_names[i]]._data.dtype
                    for i in scan_idx]
+        cache_key = None
+        if self._sig is not None and step_key is not None:
+            cache_key = (self._sig, 'multistep', tuple(scan_idx), repeat,
+                         tuple(str(d) for d in scan_dt), step_key)
+            fn = exec_cache.get(cache_key)
+            if fn is not None:
+                return fn
 
         def multistep(diff_vals, scan_vals, inv_vals, aux_vals, key,
                       moms, masters, lrs, wds):
@@ -540,7 +578,7 @@ class Executor:
                                               sub, True)
                     return outs, new_aux
 
-                f = _maybe_remat(f, self._remat_mode)
+                f = _maybe_remat(f, remat_mode)
                 outs, vjp_fn, new_aux = jax.vjp(f, tuple(diff_vals),
                                                 has_aux=True)
                 heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
@@ -585,7 +623,11 @@ class Executor:
             new_ws, new_aux, new_moms, new_masters, key, outs = carry
             return outs, new_aux, new_ws, new_moms, new_masters, key
 
-        return jax.jit(multistep, donate_argnums=(0, 3, 4, 5, 6))
+        fn = exec_cache.TimedJit(
+            jax.jit(multistep, donate_argnums=(0, 3, 4, 5, 6)))
+        if cache_key is not None:
+            exec_cache.put(cache_key, fn)
+        return fn
 
     def _align_step_placement(self, diff_vals, moms, masters):
         """A donated jit call requires every committed argument to live
@@ -943,23 +985,43 @@ class Executor:
         if self._grouped:
             raise MXNetError('memory_cost: ctx_group executors run '
                              'eagerly per-op; no single compiled module')
-        arg_vals, aux_vals = self._gather()
-        key = jax.random.PRNGKey(0)
-        if mode == 'forward':
-            lowered = self._fwd_eval.lower(arg_vals, aux_vals, key)
-        elif mode == 'train':
-            lowered = self._fwd_train.lower(arg_vals, aux_vals, key)
-        elif mode == 'train_backward':
-            outs, _ = jax.eval_shape(self.raw_forward_train, arg_vals,
-                                     aux_vals, key)
-            # abstract head grads: .lower() needs only shapes/dtypes
-            heads = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype)
-                          for o in outs)
-            lowered = self._fwd_bwd.lower(arg_vals, aux_vals, key, heads)
-        else:
+        if mode not in ('forward', 'train', 'train_backward'):
             raise ValueError("memory_cost mode must be 'forward', "
                              "'train' or 'train_backward', got %r" % mode)
-        stats = lowered.compile().memory_analysis()
+        # this debug path AOT-compiles outside the jit dispatch cache;
+        # share the compiled module through the process-wide cache so
+        # repeated memory_cost calls (and equivalent executors) pay
+        # ONE compile per mode.  AOT lowering bakes concrete shardings
+        # in (jit would re-trace), so they join the key: a mesh-sharded
+        # rebind must not reuse a single-device compile
+        cache_key = None
+        if self._sig is not None:
+            shard_fp = tuple(
+                str(getattr(a._data, 'sharding', None))
+                for a in list(self.arg_dict.values()) +
+                list(self.aux_dict.values()))
+            cache_key = (self._sig, 'memcost', mode, shard_fp)
+        compiled = exec_cache.get(cache_key) \
+            if cache_key is not None else None
+        if compiled is None:
+            arg_vals, aux_vals = self._gather()
+            key = jax.random.PRNGKey(0)
+            if mode == 'forward':
+                lowered = self._fwd_eval.lower(arg_vals, aux_vals, key)
+            elif mode == 'train':
+                lowered = self._fwd_train.lower(arg_vals, aux_vals, key)
+            else:
+                outs, _ = jax.eval_shape(self.raw_forward_train, arg_vals,
+                                         aux_vals, key)
+                # abstract head grads: .lower() needs only shapes/dtypes
+                heads = tuple(jax.ShapeDtypeStruct(o.shape, o.dtype)
+                              for o in outs)
+                lowered = self._fwd_bwd.lower(arg_vals, aux_vals, key,
+                                              heads)
+            compiled = exec_cache.timed_compile(lowered)
+            if cache_key is not None:
+                exec_cache.put(cache_key, compiled)
+        stats = compiled.memory_analysis()
         if stats is None:
             raise MXNetError('memory_cost: this backend reports no '
                              'compiled-module memory statistics')

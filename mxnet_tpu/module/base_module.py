@@ -165,6 +165,10 @@ class BaseModule:
         validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        # stage upcoming batches device-resident so the H2D copy of
+        # batch N+1 overlaps step N's compute (Module overrides; the
+        # default is identity)
+        train_data = self._wrap_train_iter(train_data)
 
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
@@ -204,6 +208,11 @@ class BaseModule:
                     self.logger.info('Epoch[%d] Validation-%s=%f',
                                      epoch, name, val)
             train_data.reset()
+
+    def _wrap_train_iter(self, train_data):
+        """Hook for subclasses to decorate the training iterator (e.g.
+        device-resident prefetch).  Default: pass through."""
+        return train_data
 
     # -- properties --------------------------------------------------------
     @property

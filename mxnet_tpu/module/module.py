@@ -373,8 +373,11 @@ class Module(BaseModule):
         moms, masters, lrs, wds = fu.host_prep(weights)
         # keyed on executor AND updater: init_optimizer(force_init=True)
         # makes a new FusedSGD whose step_math bakes new hyperparams
+        # (step_key routes the compiled step through the process-wide
+        # executable cache, so a mismatch here rarely means a recompile)
         if self._fused_step_key != (ex, fu):
-            self._fused_step = ex.make_fused_train_step(fu.step_math)
+            self._fused_step = ex.make_fused_train_step(
+                fu.step_math, step_key=fu.cache_key())
             self._fused_step_key = (ex, fu)
         new_moms, new_masters = ex.run_fused_train_step(
             self._fused_step, fnames, moms, masters, lrs, wds)
@@ -471,7 +474,8 @@ class Module(BaseModule):
         if getattr(self, '_bulk_cache_key', None) != cache_key:
             self._bulk_step_fn = ex.make_fused_multistep(
                 fu.step_math, scan_names,
-                repeat=(k if batches is None else None))
+                repeat=(k if batches is None else None),
+                step_key=fu.cache_key())
             self._bulk_cache_key = cache_key
         new_moms, new_masters = ex.run_fused_multistep(
             self._bulk_step_fn, fnames, scan_names, scan_stacks,
@@ -557,6 +561,27 @@ class Module(BaseModule):
     def install_monitor(self, mon):
         assert self.binded
         self._exec_group.install_monitor(mon)
+
+    def _wrap_train_iter(self, train_data):
+        """fit() input pipeline: stage upcoming batches device-resident
+        (io.prefetch_to_device) so the host→device copy of batch N+1
+        overlaps step N's compute.  MXNET_TPU_PREFETCH sets the buffer
+        depth (default 2; 0 disables)."""
+        import os
+        from .. import io as mxio
+        try:
+            depth = int(os.environ.get('MXNET_TPU_PREFETCH', '2'))
+        except ValueError:
+            depth = 2
+        if depth <= 0 or \
+                isinstance(train_data, mxio.PrefetchToDeviceIter) or \
+                not self.binded:
+            return train_data
+        eg = self._exec_group
+        device = None if eg.mesh is not None \
+            else self._context[0].jax_device()
+        return mxio.prefetch_to_device(train_data, size=depth,
+                                       device=device, mesh=eg.mesh)
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded

@@ -598,6 +598,17 @@ class FusedSGD:
         self.step_math = step
         self._jit_step = jax.jit(step, donate_argnums=(0, 2, 3))
 
+    def cache_key(self):
+        """Canonical identity of step_math for the executor's
+        compiled-program cache: exactly the values the step closure
+        bakes in (lr/wd are runtime arguments, not part of the key)."""
+        o = self.optimizer
+        return ('FusedSGD', type(o).__name__, float(o.momentum),
+                float(o.rescale_grad),
+                None if o.clip_gradient is None
+                else float(o.clip_gradient),
+                self.multi_precision)
+
     def host_prep(self, weights):
         """Per-step host-side bookkeeping shared by the standalone
         update and the whole-step fusion (executor.make_fused_train_step):

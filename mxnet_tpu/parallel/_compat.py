@@ -1,0 +1,23 @@
+"""jax version compatibility for the parallel package.
+
+shard_map moved from jax.experimental to the jax namespace, and its
+replication-checking kwarg was renamed check_rep -> check_vma along the
+way; this shim presents the NEW surface (top-level import, check_vma)
+on either jax, so the parallel modules are written once against the
+current API.
+"""
+import inspect
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:                     # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    if 'check_vma' in kwargs and 'check_vma' not in _PARAMS \
+            and 'check_rep' in _PARAMS:
+        kwargs['check_rep'] = kwargs.pop('check_vma')
+    return _shard_map(*args, **kwargs)

@@ -18,7 +18,7 @@ pick M a few multiples of S.
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from ._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 

@@ -171,7 +171,7 @@ def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False,
     use_flash routes each hop through the Pallas kernel (Pallas calls
     carry no vma metadata, so the flash path disables shard_map's vma
     checking for this call)."""
-    from jax import shard_map
+    from ._compat import shard_map
     spec = P(None, None, seq_axis, None)
     kwargs = {'check_vma': False} if use_flash else {}
     fn = shard_map(

@@ -107,11 +107,25 @@ class Predictor(object):
     def export_compiled(self):
         """AOT-lower the forward into a serialized XLA executable
         (StableHLO text + compiled binary when supported) — the
-        amalgamation/mobile-deploy counterpart (SURVEY.md §2.8)."""
+        amalgamation/mobile-deploy counterpart (SURVEY.md §2.8).
+        The compiled module is shared through the process-wide
+        compiled-program cache, so repeated exports (or exports of an
+        equivalently-bound predictor) pay one compile."""
         import jax
+        from . import exec_cache
         ex = self._executor
+        # the export is weight-independent (params are runtime args of
+        # the lowered function), so the whole result — StableHLO text
+        # AND compiled text — is deterministic per graph signature and
+        # a cache hit skips the re-trace/lower, which dominates cost
+        cache_key = (ex._sig, 'export_compiled') \
+            if getattr(ex, '_sig', None) is not None else None
+        if cache_key is not None:
+            cached = exec_cache.get(cache_key)
+            if cached is not None:
+                return dict(cached)
         arg_vals, aux_vals = ex._gather()
-        rng = __import__('jax').random.PRNGKey(0)
+        rng = jax.random.PRNGKey(0)
 
         def fwd(arg_vals, aux_vals, rng):
             outs, _ = ex.raw_forward(arg_vals, aux_vals, rng)
@@ -120,9 +134,11 @@ class Predictor(object):
         lowered = jax.jit(fwd).lower(arg_vals, aux_vals, rng)
         out = {'stablehlo': lowered.as_text()}
         try:
-            out['compiled'] = lowered.compile().as_text()
+            out['compiled'] = exec_cache.timed_compile(lowered).as_text()
         except Exception:
             pass
+        if cache_key is not None:
+            exec_cache.put(cache_key, dict(out))
         return out
 
     def export_artifact(self, prefix):

@@ -71,6 +71,9 @@ def dump_profile():
     story is this profiler's own spans."""
     events = [{'ph': 'M', 'name': 'process_name', 'pid': 0,
                'args': {'name': 'mxnet_tpu host spans'}}]
+    # compiled-program cache counters ride along as trace metadata
+    events.append({'ph': 'M', 'name': 'exec_cache', 'pid': 0,
+                   'args': exec_cache_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -118,6 +121,40 @@ def _collect_xla_lanes():
                     'ts': e.get('ts', 0), 'dur': e.get('dur', 0),
                     'pid': pid_map[e['pid']], 'tid': e.get('tid', 0)})
     return out
+
+
+def exec_cache_stats():
+    """Executor compiled-program cache counters: exec_cache_hits /
+    exec_cache_misses (signature lookups at bind) and total_compile_s
+    (wall time spent tracing+compiling XLA programs this process)."""
+    from . import exec_cache
+    st = exec_cache.stats()
+    return {'exec_cache_hits': st['hits'],
+            'exec_cache_misses': st['misses'],
+            'total_compile_s': st['total_compile_s']}
+
+
+def summary(print_out=True):
+    """Human-readable profile summary: span time by category plus the
+    compiled-program cache counters (reference: the profiler's
+    aggregate stats print, profiler.cc DumpProfile summary mode)."""
+    with _STATE['lock']:
+        records = list(_STATE['records'])
+    by_cat = {}
+    for _name, cat, _ts, dur, _tid in records:
+        by_cat[cat] = by_cat.get(cat, 0) + dur
+    st = exec_cache_stats()
+    lines = ['profile summary: %d spans' % len(records)]
+    for cat in sorted(by_cat):
+        lines.append('  %-16s %10.3f ms' % (cat, by_cat[cat] / 1e3))
+    lines.append('  exec_cache_hits=%d exec_cache_misses=%d '
+                 'total_compile_s=%.3f'
+                 % (st['exec_cache_hits'], st['exec_cache_misses'],
+                    st['total_compile_s']))
+    text = '\n'.join(lines)
+    if print_out:
+        print(text)
+    return text
 
 
 def is_running():

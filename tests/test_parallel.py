@@ -45,6 +45,7 @@ def test_ring_attention_matches_full(causal):
                                rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_transformer_train_step_flash_attention():
     """The dp x tp x sp train step with cfg['use_flash']: identical
     loss to the XLA ring path on the same data/params."""
@@ -66,6 +67,7 @@ def test_transformer_train_step_flash_attention():
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_flash_grad():
     """jax.grad flows through the flash-kernel ring (the with-lse
     custom VJP folds the merge's logsumexp cotangent into the fused
@@ -130,7 +132,7 @@ def test_transformer_train_step_dp_tp_sp():
 
 def test_collectives_api():
     mesh = make_mesh({'data': 8})
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def f(x):
@@ -170,7 +172,7 @@ def test_pipeline_matches_sequential():
     x = rs.randn(M, mb, D).astype(np.float32)
 
     import jax
-    from jax import shard_map
+    from mxnet_tpu.parallel._compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def run(params, micro):
@@ -463,6 +465,7 @@ def test_pallas_flash_streaming_schedule():
         pallas_ops._VMEM_RESIDENT_BYTES = old
 
 
+@pytest.mark.slow
 def test_pallas_flash_streaming_backward():
     """The streaming (non-resident) Pallas backward matches the dense
     oracle's gradients and is bitwise-identical to the resident
@@ -554,8 +557,11 @@ def test_pallas_flash_accepts_cross_attention():
     assert out.shape == q.shape
 
 
-@pytest.mark.parametrize('tq,tk', [(128, 512), (8, 512), (128, 384),
-                                   (512, 128)])
+@pytest.mark.parametrize('tq,tk', [
+    pytest.param(128, 512, marks=pytest.mark.slow),
+    pytest.param(8, 512, marks=pytest.mark.slow),
+    pytest.param(128, 384, marks=pytest.mark.slow),
+    (512, 128)])
 def test_pallas_flash_rectangular(tq, tk):
     """q_len != kv_len (cross-attention / KV-cache decode): forward and
     all three gradients match the dense oracle under both causal
