@@ -27,7 +27,10 @@ the headline configuration; host: batches flow through
 io.prefetch_to_device and the measured stall is reported),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
-cache so warm starts are exercised; set empty to disable).
+cache so warm starts are exercised; set empty to disable),
+MXNET_TPU_ZERO=1 (ZeRO-1 sharded optimizer update on multi-device
+meshes; the JSON's `optimizer_state_bytes_per_device` / `zero` fields
+track the per-device memory win in BENCH_*/MULTICHIP_* trajectories).
 CLI: --no-exec-cache disables the in-process compiled-program cache
 (A/B of MXNET_TPU_EXEC_CACHE).
 """
@@ -179,12 +182,18 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224,
         step()
     block()
     dt = time.time() - tic
+    fu = getattr(mod, '_fused_updater', None)
     return {
         'ips': batch * bulk * steps / dt,
         'cold_start_s': round(cold_start_s, 3),
         'input_stall_ms_per_step': round(
             prefetch.stall_ms_per_batch(), 3) if prefetch is not None
         else 0.0,
+        # ZeRO-1 memory trajectory: momenta + fp32 masters resident per
+        # device (drops ~dp-fold under MXNET_TPU_ZERO=1)
+        'optimizer_state_bytes_per_device':
+            int(fu.state_bytes_per_device()) if fu is not None else None,
+        'zero': int(getattr(fu, 'zero', 0)) if fu is not None else 0,
     }
 
 
@@ -315,6 +324,9 @@ def _bench_main():
         'cold_start_s': best['cold_start_s'],
         'warm_start_s': measure_warm_start(model, best_batch, bulk),
         'input_stall_ms_per_step': best['input_stall_ms_per_step'],
+        'optimizer_state_bytes_per_device':
+            best['optimizer_state_bytes_per_device'],
+        'zero': best['zero'],
         'exec_cache': os.environ.get('MXNET_TPU_EXEC_CACHE', '1')
         not in ('0', ''),
         'total_compile_s': round(cache_stats['total_compile_s'], 3),

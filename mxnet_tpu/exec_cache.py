@@ -48,7 +48,13 @@ _PERSISTENT_DIR = None          # set once by setup_persistent_cache
 # knob missing from this list would let a rebind after flipping it hit a
 # stale executable: wrong numerics with no error.  MXNET_TPU_REMAT is
 # covered separately (the executor passes its captured remat_mode into
-# graph_signature explicitly).
+# graph_signature explicitly).  MXNET_TPU_ZERO / MXNET_TPU_ZERO_BUCKET_MB
+# are ALSO deliberately absent: they alter only the fused train-step
+# update math, which is keyed explicitly — FusedSGD.cache_key() carries
+# (zero stage, bucket layout, mesh) into the executor's 'multistep'
+# cache key, so sharded and replicated step programs never alias, while
+# the zero-independent fwd/eval/bwd programs still share one entry
+# across both modes.
 TRACE_ENV_KNOBS = (
     ('MXNET_TPU_LAYOUT_OPT', 'auto'),
     ('MXNET_TPU_STEM_SPLIT', '1'),

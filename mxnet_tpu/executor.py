@@ -499,6 +499,10 @@ class Executor:
         step_math(ws, gs, moms, masters, lrs, wds) ->
             (new_ws, new_moms, new_masters)
         is the optimizer's whole-model update math (FusedSGD.step).
+        moms/masters are opaque pytrees: per-param arrays in the
+        replicated mode, per-bucket dp-sharded flat buffers under
+        ZeRO-1 (the sharded step_math reduce-scatters gradients and
+        all-gathers updated params inside this same donated dispatch).
         Weights, aux states, momenta, and fp32 masters are donated, so
         params update in place in HBM; the PRNG split happens inside the
         step so the host issues exactly one dispatch per batch.
@@ -629,14 +633,18 @@ class Executor:
             exec_cache.put(cache_key, fn)
         return fn
 
-    def _align_step_placement(self, diff_vals, moms, masters):
+    def _align_step_placement(self, diff_vals, moms, masters,
+                              zero=False):
         """A donated jit call requires every committed argument to live
         on the same device set, and the weights define it: when they are
         sharded over a multi-device mesh, a PRNG key (or optimizer state
         restored before the mesh bind) still committed to one device
         makes jax refuse the dispatch.  Re-commit the key replicated
         over the weights' mesh and any stale moms/masters to their
-        weight's sharding.  moms/masters are aligned with diff_vals."""
+        weight's sharding.  moms/masters are aligned with diff_vals —
+        except under ZeRO (zero=True), where they are per-BUCKET flat
+        shards that own their dp-axis sharding (FusedSGD host_prep
+        committed them); only the key is aligned then."""
         shard = mesh = None
         for v in diff_vals:
             s = getattr(v, 'sharding', None)
@@ -652,6 +660,8 @@ class Executor:
         if key_sh is None or key_sh.device_set != devset:
             self._key = jax.device_put(
                 self._key, NamedSharding(mesh, PartitionSpec()))
+        if zero:
+            return moms, masters
 
         def recommit(state, w):
             if state is None:
@@ -666,11 +676,13 @@ class Executor:
         return moms, masters
 
     def run_fused_multistep(self, step, diff_names, scan_names,
-                            scan_stacks, moms, masters, lrs, wds):
+                            scan_stacks, moms, masters, lrs, wds,
+                            zero=False):
         """Execute a step from make_fused_multistep over the bound
         arrays.  scan_stacks: per-name stacked (K, ...) arrays, or None
-        in repeat mode (the bound batch is reused).  Returns (new_moms,
-        new_masters)."""
+        in repeat mode (the bound batch is reused).  zero=True marks
+        moms/masters as ZeRO bucket shards (see _align_step_placement).
+        Returns (new_moms, new_masters)."""
         diff_set = set(diff_names)
         scan_set = set(scan_names)
         inv_names = [n for n in self._arg_names
@@ -686,7 +698,7 @@ class Executor:
         inv_vals = tuple(self.arg_dict[n]._data for n in inv_names)
         aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
         moms, masters = self._align_step_placement(diff_vals, moms,
-                                                   masters)
+                                                   masters, zero=zero)
         self.fused_dispatches += 1
         with profiler.scope(self._name('fused_multistep')):
             (outs, new_aux, new_ws, new_moms, new_masters,
@@ -702,12 +714,13 @@ class Executor:
         return new_moms, new_masters
 
     def run_fused_train_step(self, step, diff_names, moms, masters,
-                             lrs, wds):
+                             lrs, wds, zero=False):
         """Execute a step from make_fused_train_step over the bound
         arrays and write everything back.  Returns (new_moms,
         new_masters) for the optimizer to reclaim."""
         return self.run_fused_multistep(step, diff_names, (), None,
-                                        moms, masters, lrs, wds)
+                                        moms, masters, lrs, wds,
+                                        zero=zero)
 
     # ------------------------------------------------------------------
     def _gather(self):

@@ -38,13 +38,27 @@ def _ctype_key_value(keys, vals):
 
 
 class KVStore:
-    """Single-controller key-value store over in-XLA collectives."""
+    """Single-controller key-value store over in-XLA collectives.
 
-    def __init__(self, kv_type='local'):
+    ZeRO-1 cross-ref (SURVEY.md §2.4): the reference's server-side
+    updater already shards optimizer STATE — each ps-lite server owns
+    the momenta for its 1/S of the keys, workers never hold them.  The
+    TPU-native mapping of that idea is the `zero_stage=1` sharded
+    update (parallel/zero.py): instead of sharding whole keys across
+    server processes, every bucketed parameter shards by elements over
+    the dp mesh axis — gradients reduce-scatter where ps-lite pushed,
+    the 1/N-shard update runs where the server updater ran, and the
+    all-gather of updated params is the pull.  `dist_sync` without
+    parameter servers maps onto this path (Module folds the update
+    into the compiled SPMD step); only the host-PS store
+    (KVStoreDistPS) keeps the per-key push/pull wire protocol."""
+
+    def __init__(self, kv_type='local', zero=None):
         self.type = kv_type
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._zero = zero
         self._is_dist = 'dist' in kv_type
         if 'async' in kv_type and type(self) is KVStore:
             warnings.warn('dist_async without parameter servers has no '
@@ -70,14 +84,19 @@ class KVStore:
 
     def _push_impl(self, key, value, priority=0):
         import jax
+        import jax.numpy as jnp
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError('key %s not initialized' % str(k))
             merged = vlist[0]
             if len(vlist) > 1:
-                for v in vlist[1:]:
-                    merged = merged + v
+                # one stacked reduction instead of a Python left-fold
+                # of n-1 sequential adds (each a separate dispatch
+                # forming a serial dependency chain)
+                merged = nd.NDArray(
+                    jnp.sum(jnp.stack([v._data for v in vlist]),
+                            axis=0), vlist[0].context)
             if self._updater is not None:
                 # gradients produced by a mesh-sharded step arrive
                 # replicated over the mesh; the stored weight may live
@@ -134,6 +153,14 @@ class KVStore:
             self.pull(k, o)
 
     # -- updater / optimizer ----------------------------------------------
+    @property
+    def zero_stage(self):
+        """ZeRO stage the Module-side fused update should run at: the
+        constructor's explicit value, else the MXNET_TPU_ZERO env knob
+        (see the class docstring's SURVEY §2.4 mapping)."""
+        from .parallel import zero as zero_mod
+        return zero_mod.zero_stage(self._zero)
+
     def _key_index(self, key):
         return key if isinstance(key, int) else key
 
@@ -232,8 +259,8 @@ class KVStoreDistPS(KVStore):
     (kvstore_server.py); without servers, `dist_*` falls back to the
     in-XLA collective design (KVStore)."""
 
-    def __init__(self, kv_type):
-        super().__init__(kv_type)
+    def __init__(self, kv_type, zero=None):
+        super().__init__(kv_type, zero=zero)
         import os
         from . import kvstore_server as ps
         host = os.environ['DMLC_PS_ROOT_URI']
@@ -262,12 +289,15 @@ class KVStoreDistPS(KVStore):
     def _merge_grads(value):
         """Sum a (possibly multi-device) gradient list to one host
         array — the single definition both the per-key and batched
-        paths share."""
+        paths share.  Stacked single-reduction, not a sequential
+        left-fold (same fix as KVStore._push_impl)."""
         vlist = value if isinstance(value, list) else [value]
-        merged = vlist[0]
-        for v in vlist[1:]:
-            merged = merged + v
-        return merged.asnumpy()
+        if len(vlist) == 1:
+            return vlist[0].asnumpy()
+        import numpy as np
+        import jax.numpy as jnp
+        return np.asarray(jnp.sum(jnp.stack([v._data for v in vlist]),
+                                  axis=0))
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
@@ -382,12 +412,14 @@ class KVStoreDistPS(KVStore):
         self._client.close()
 
 
-def create(name='local'):
+def create(name='local', zero=None):
     """Create a KVStore (reference kvstore.py:411 / kvstore.cc:40).
     Types: local, device, local_allreduce_*, dist_sync, dist_device_sync,
     dist_async.  `dist_*` with the DMLC_PS_ROOT_URI env set (the
     tools/launch.py contract) uses parameter-server processes; otherwise
-    dist maps onto jax.distributed in-XLA collectives."""
+    dist maps onto jax.distributed in-XLA collectives.  `zero` pins the
+    store's ZeRO stage (else MXNET_TPU_ZERO decides; see
+    KVStore.zero_stage)."""
     import os
     if not isinstance(name, str):
         raise TypeError('name must be a string')
@@ -395,5 +427,5 @@ def create(name='local'):
             int(os.environ.get('DMLC_NUM_SERVER', '0')) > 0:
         # launch.py -s 0 (SPMD mode) exports the URI for jax.distributed
         # bootstrap reuse — only actual servers select the PS path
-        return KVStoreDistPS(name)
-    return KVStore(name)
+        return KVStoreDistPS(name, zero=zero)
+    return KVStore(name, zero=zero)

@@ -237,8 +237,15 @@ class Module(BaseModule):
     # -- optimizer ---------------------------------------------------------
     def init_optimizer(self, kvstore='local', optimizer='sgd',
                        optimizer_params=(('learning_rate', 0.01),),
-                       force_init=False):
-        """Reference module.py:461."""
+                       force_init=False, zero=None):
+        """Reference module.py:461.
+
+        zero: ZeRO stage for the in-step sharded optimizer update
+        (parallel/zero.py) — 1 reduce-scatters gradients over the data
+        mesh, updates only the local 1/N shard of momenta / fp32
+        masters, and all-gathers the updated params.  None (default)
+        defers to the kvstore's `zero_stage` / the MXNET_TPU_ZERO env
+        knob."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             self.logger.warning('optimizer already initialized, '
@@ -276,16 +283,36 @@ class Module(BaseModule):
                 arg_params=self._arg_params,
                 param_names=self._param_names,
                 update_on_kvstore=update_on_kvstore)
+        from .. import kvstore as kvs_mod
+        from ..parallel import zero as zero_mod
+        if zero is None and kvstore is not None:
+            zero = getattr(kvstore, 'zero_stage', None)
+        zero = zero_mod.zero_stage(zero)
         self._fused_updater = None
-        if kvstore is None or 'dist' not in kvstore.type:
-            # Single-process store (or none): the executor group is one
-            # SPMD program whose gradient all-reduce is already an
-            # in-step psum over the mesh, so the optimizer update can
-            # fold into the same donated dispatch.  The store stays as
-            # the parameter facade; only the multi-process PS keeps the
+        if kvstore is None or \
+                not isinstance(kvstore, kvs_mod.KVStoreDistPS):
+            # In-XLA store (or none): the executor group is one SPMD
+            # program whose gradient all-reduce is already an in-step
+            # psum over the mesh — `dist_sync` without parameter
+            # servers is the SAME program spanning processes — so the
+            # optimizer update folds into the same donated dispatch
+            # (ZeRO-1 sharded when zero=1).  The store stays as the
+            # parameter facade; only the multi-process PS keeps the
             # per-key eager push/pull path.
             self._fused_updater = opt_mod.create_fused_updater(
-                optimizer, self._param_names)
+                optimizer, self._param_names, zero=zero,
+                mesh=self._exec_group.mesh)
+        if zero and self._fused_updater is None:
+            if isinstance(kvstore, kvs_mod.KVStoreDistPS):
+                reason = ('the parameter-server kvstore runs updates '
+                          'server-side (per-key, already state-sharded '
+                          'across servers)')
+            else:
+                reason = ('the %s optimizer has no fused sharded '
+                          'update path' % type(optimizer).__name__)
+            self.logger.warning(
+                'ZeRO stage-1 requested but %s; running without the '
+                'sharded in-step update', reason)
         if self._fused_updater is not None:
             update_on_kvstore = False
             self._update_on_kvstore = False
@@ -371,17 +398,38 @@ class Module(BaseModule):
             fu.param_names = list(fnames)
         weights = [ex.arg_dict[n] for n in fnames]
         moms, masters, lrs, wds = fu.host_prep(weights)
-        # keyed on executor AND updater: init_optimizer(force_init=True)
-        # makes a new FusedSGD whose step_math bakes new hyperparams
-        # (step_key routes the compiled step through the process-wide
-        # executable cache, so a mismatch here rarely means a recompile)
-        if self._fused_step_key != (ex, fu):
+        # keyed on executor AND updater AND the updater's cache_key:
+        # init_optimizer(force_init=True) makes a new FusedSGD whose
+        # step_math bakes new hyperparams, and under ZeRO host_prep may
+        # have just rebuilt the bucket layout (cache_key carries it) —
+        # a stale program would run old-layout buckets against new
+        # state shapes.  (step_key routes the compiled step through the
+        # process-wide executable cache, so a mismatch here rarely
+        # means a recompile.)
+        fkey = fu.cache_key()
+        if self._fused_step_key != (ex, fu, fkey):
             self._fused_step = ex.make_fused_train_step(
-                fu.step_math, step_key=fu.cache_key())
-            self._fused_step_key = (ex, fu)
+                fu.step_math, step_key=fkey)
+            self._fused_step_key = (ex, fu, fkey)
         new_moms, new_masters = ex.run_fused_train_step(
-            self._fused_step, fnames, moms, masters, lrs, wds)
+            self._fused_step, fnames, moms, masters, lrs, wds,
+            zero=bool(fu.zero))
         fu.commit(new_moms, new_masters)
+        self._note_step_counters(1)
+
+    def _note_step_counters(self, k):
+        """Feed the profiler's comm/memory counters after k fused
+        steps: ZeRO reduce-scatter / all-gather payload bytes and the
+        per-device optimizer-state residency."""
+        from .. import profiler
+        fu = self._fused_updater
+        if fu is None:
+            return
+        rs, ag = fu.comm_bytes_per_step()
+        if rs or ag:
+            profiler.add_comm_bytes(reduce_scattered=rs * k,
+                                    all_gathered=ag * k)
+        profiler.set_optimizer_state_bytes(fu.state_bytes_per_device())
 
     def bulk_step(self, batches=None, batch=None, repeat=None,
                   scan_dtype=None):
@@ -468,6 +516,10 @@ class Module(BaseModule):
             cache_key = (ex, fu, 'repeat', k)
         weights = [ex.arg_dict[n] for n in fnames]
         moms, masters, lrs, wds = fu.host_prep(weights)
+        # fu.cache_key() joins AFTER host_prep: under ZeRO it carries
+        # the bucket layout host_prep may have just rebuilt
+        fkey = fu.cache_key()
+        cache_key = cache_key + (fkey,)
         for _ in range(k - 1):  # host_prep bumped counts once
             for n in fnames:
                 self._optimizer._update_count(n)
@@ -475,12 +527,13 @@ class Module(BaseModule):
             self._bulk_step_fn = ex.make_fused_multistep(
                 fu.step_math, scan_names,
                 repeat=(k if batches is None else None),
-                step_key=fu.cache_key())
+                step_key=fkey)
             self._bulk_cache_key = cache_key
         new_moms, new_masters = ex.run_fused_multistep(
             self._bulk_step_fn, fnames, scan_names, scan_stacks,
-            moms, masters, lrs, wds)
+            moms, masters, lrs, wds, zero=bool(fu.zero))
         fu.commit(new_moms, new_masters)
+        self._note_step_counters(k)
         self._params_dirty = True
 
     def _single_step(self, data_batch):
@@ -509,6 +562,7 @@ class Module(BaseModule):
             if self._fused_updater.param_names != fnames:
                 self._fused_updater.param_names = fnames
             self._fused_updater(weights, grads)
+            self._note_step_counters(1)
             return
         if self._update_on_kvstore:
             model_mod._update_params_on_kvstore(

@@ -540,6 +540,27 @@ def get_updater(optimizer):
     return Updater(optimizer)
 
 
+def sgd_update_math(acc, g, m, lr, wd, momentum=0.0, rescale=1.0,
+                    clip=None, nesterov=False):
+    """The SGD/NAG elementwise update core shared by the replicated
+    FusedSGD step (per-param, scalar lr/wd) and the ZeRO-1 sharded
+    step (per-bucket, per-element lr/wd vectors) — ONE definition so
+    the two modes cannot drift.  `g` must already be in `acc`'s dtype;
+    returns (new_acc, new_momentum)."""
+    import jax.numpy as jnp
+    g = g * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    g = g + wd * acc
+    if momentum == 0.0:
+        return acc - lr * g, m
+    if nesterov:
+        nm = momentum * m + g
+        return acc - lr * (g + momentum * nm), nm
+    nm = momentum * m - lr * g
+    return acc + nm, nm
+
+
 class FusedSGD:
     """Whole-model SGD step as ONE jitted XLA call.
 
@@ -547,9 +568,17 @@ class FusedSGD:
     (src/operator/optimizer_op.*) but still dispatches one per key per
     step through the engine; here all parameter updates compile into a
     single XLA executable with buffer donation, so the update adds one
-    device dispatch per step regardless of parameter count."""
+    device dispatch per step regardless of parameter count.
 
-    def __init__(self, optimizer, param_names):
+    ZeRO stage-1 (`zero=1`, parallel/zero.py): the same update math run
+    on flattened-and-bucketed parameters with the momenta and fp32
+    masters permanently SHARDED over the data-parallel mesh axis —
+    gradients reduce-scatter, each device updates its 1/N shard, the
+    updated buckets all-gather back into per-param views.  Per-device
+    optimizer-state memory drops by the dp degree with the same total
+    collective bytes on the wire."""
+
+    def __init__(self, optimizer, param_names, zero=0, mesh=None):
         import jax
         import jax.numpy as jnp
         assert type(optimizer) in (SGD, NAG)
@@ -557,6 +586,27 @@ class FusedSGD:
         self.param_names = list(param_names)
         self.states = {}
         self.masters = {}     # fp32 master copies for low-precision params
+        self.zero = int(zero or 0)
+        self.mesh = mesh
+        # static mesh fingerprint for cache_key (computed once: per-step
+        # key checks must not re-stringify every device on large meshes)
+        self._mesh_fp = None if mesh is None else (
+            tuple(mesh.axis_names),
+            tuple(str(d) for d in mesh.devices.flat))
+        if self.zero and mesh is not None and \
+                'data' not in mesh.axis_names:
+            raise ValueError(
+                "ZeRO-1 shards optimizer state over the 'data' mesh "
+                'axis; mesh axes are %s' % (mesh.axis_names,))
+        # ZeRO bucket state: layout + per-bucket flat shards (momenta /
+        # fp32 masters), plus per-param staged values from set_states
+        # waiting to be re-bucketed at the next host_prep
+        self._layout = None
+        self._layout_inputs = None
+        self._layout_names = None
+        self._zero_moms = None
+        self._zero_masters = None
+        self._staged = None
         momentum = optimizer.momentum
         rescale = optimizer.rescale_grad
         clip = optimizer.clip_gradient
@@ -572,19 +622,10 @@ class FusedSGD:
                 # the low-precision weight is a cast of it (reference
                 # mp_sgd_update, src/operator/optimizer_op-inl.h)
                 acc = mw if mw is not None else w
-                g = g.astype(acc.dtype) * rescale
-                if clip is not None:
-                    g = jnp.clip(g, -clip, clip)
-                g = g + wd * acc
-                if momentum == 0.0:
-                    acc = acc - lr * g
-                    nm = m
-                elif nesterov:
-                    nm = momentum * m + g
-                    acc = acc - lr * (g + momentum * nm)
-                else:
-                    nm = momentum * m - lr * g
-                    acc = acc + nm
+                acc, nm = sgd_update_math(
+                    acc, g.astype(acc.dtype), m, lr, wd,
+                    momentum=momentum, rescale=rescale, clip=clip,
+                    nesterov=nesterov)
                 if mw is not None:
                     new_masters.append(acc)
                     new_ws.append(acc.astype(w.dtype))
@@ -595,19 +636,39 @@ class FusedSGD:
             return new_ws, new_moms, new_masters
 
         self.multi_precision = multi_precision
-        self.step_math = step
-        self._jit_step = jax.jit(step, donate_argnums=(0, 2, 3))
+        if self.zero:
+            from .parallel import zero as zero_mod
+            self._zero_mod = zero_mod
+            self._zero_hyper = {'momentum': momentum, 'rescale': rescale,
+                                'clip': clip, 'nesterov': nesterov}
+            # step_math / _jit_step are (re)bound in _host_prep_zero,
+            # which captures the bucket layout BY VALUE: a step program
+            # cached under one layout's key must never read a layout
+            # this object later rebuilt (host_prep always runs before
+            # step_math is handed to the executor or traced)
+            self.step_math = None
+            self._jit_step = None
+        else:
+            self.step_math = step
+            self._jit_step = jax.jit(step, donate_argnums=(0, 2, 3))
 
     def cache_key(self):
         """Canonical identity of step_math for the executor's
         compiled-program cache: exactly the values the step closure
-        bakes in (lr/wd are runtime arguments, not part of the key)."""
+        bakes in (lr/wd are runtime arguments, not part of the key).
+        The ZeRO stage, bucket layout, and mesh join the key so sharded
+        and replicated step programs never alias in exec_cache."""
         o = self.optimizer
-        return ('FusedSGD', type(o).__name__, float(o.momentum),
-                float(o.rescale_grad),
-                None if o.clip_gradient is None
-                else float(o.clip_gradient),
-                self.multi_precision)
+        key = ('FusedSGD', type(o).__name__, float(o.momentum),
+               float(o.rescale_grad),
+               None if o.clip_gradient is None
+               else float(o.clip_gradient),
+               self.multi_precision)
+        if self.zero:
+            key += (('zero', self.zero,
+                     self._layout.key if self._layout is not None
+                     else None, self._mesh_fp),)
+        return key
 
     def host_prep(self, weights):
         """Per-step host-side bookkeeping shared by the standalone
@@ -618,35 +679,168 @@ class FusedSGD:
         import jax
         import jax.numpy as jnp
         opt = self.optimizer
-        for name, w in zip(self.param_names, weights):
-            mp = self.multi_precision and w.dtype in \
-                (np.dtype(np.float16), jnp.bfloat16)
-            if name not in self.states:
-                mdtype = np.float32 if mp else w.dtype
-                # commit fresh state to the weight's placement: an
-                # uncommitted zeros on call 1 vs a committed donated
-                # output on call 2 changes the jit sharding signature
-                # and forces a full recompile of the fused step
-                sharding = getattr(w._data, 'sharding', None)
-                zeros = jnp.zeros(w.shape, dtype=mdtype)
-                self.states[name] = jax.device_put(zeros, sharding) \
-                    if sharding is not None else zeros
-            if name not in self.masters:
-                # backfill (fresh start or restored checkpoint without
-                # masters): re-derive from the current weight
-                self.masters[name] = w._data.astype(np.float32) if mp \
-                    else None
+        if self.zero:
+            moms, masters = self._host_prep_zero(weights)
+        else:
+            for name, w in zip(self.param_names, weights):
+                mp = self._is_mp(w)
+                if name not in self.states:
+                    mdtype = np.float32 if mp else w.dtype
+                    # commit fresh state to the weight's placement: an
+                    # uncommitted zeros on call 1 vs a committed donated
+                    # output on call 2 changes the jit sharding
+                    # signature and forces a full recompile of the
+                    # fused step
+                    sharding = getattr(w._data, 'sharding', None)
+                    zeros = jnp.zeros(w.shape, dtype=mdtype)
+                    self.states[name] = jax.device_put(zeros, sharding) \
+                        if sharding is not None else zeros
+                if name not in self.masters:
+                    # backfill (fresh start or restored checkpoint
+                    # without masters): re-derive from the current
+                    # weight
+                    self.masters[name] = w._data.astype(np.float32) \
+                        if mp else None
+            moms = [self.states[n] for n in self.param_names]
+            masters = [self.masters[n] for n in self.param_names]
         lrs, wds = [], []
         for name in self.param_names:
             opt._update_count(name)
             lrs.append(opt._get_lr(name))
             wds.append(opt._get_wd(name))
-        moms = [self.states[n] for n in self.param_names]
-        masters = [self.masters[n] for n in self.param_names]
         return moms, masters, lrs, wds
 
+    def _is_mp(self, w):
+        import jax.numpy as jnp
+        return self.multi_precision and w.dtype in \
+            (np.dtype(np.float16), jnp.bfloat16)
+
+    def _host_prep_zero(self, weights):
+        """ZeRO lazy state init: (re)build the bucket layout from the
+        current parameter list and materialize the momentum / fp32
+        master buckets as dp-sharded flat buffers.  Staged per-param
+        values (restored checkpoints, or states carried across a
+        param-list change) fold in here."""
+        import jax
+        import jax.numpy as jnp
+        zm = self._zero_mod
+        names = list(self.param_names)
+        # degree = the 'data' AXIS size, not the whole device count:
+        # the bucket sharding spans only that axis, and padding /
+        # per-device accounting must match it on multi-axis meshes
+        dp = 1 if self.mesh is None else int(self.mesh.shape['data'])
+        # cheap per-step change detection; the full bucket plan is only
+        # rebuilt when an input actually changed (this runs in the
+        # one-dispatch-per-batch host hot path)
+        inputs_key = (tuple(tuple(w.shape) for w in weights),
+                      tuple(str(np.dtype(w.dtype)) for w in weights),
+                      tuple(self._is_mp(w) for w in weights),
+                      dp, zm.bucket_bytes(), tuple(names))
+        if getattr(self, '_layout_inputs', None) != inputs_key:
+            layout = zm.ZeroBucketLayout(
+                [tuple(w.shape) for w in weights],
+                [np.dtype(w.dtype) for w in weights],
+                [self._is_mp(w) for w in weights], dp)
+            if self._zero_moms is not None:
+                # param list changed under us: preserve existing state
+                # by name, re-bucketed below under the new layout
+                self._stage_current()
+            self._layout = layout
+            self._layout_inputs = inputs_key
+            self._layout_names = names
+            self._zero_moms = None
+            self._zero_masters = None
+            # rebind the step math with the NEW layout captured by
+            # value (see __init__: a cached/compiled step must never
+            # observe a later layout through this object)
+            self.step_math = zm.make_sharded_sgd_step(
+                layout, self.mesh, self._zero_hyper)
+            self._jit_step = jax.jit(self.step_math,
+                                     donate_argnums=(0, 2, 3))
+        if self._zero_moms is None:
+            staged_moms, staged_masters = self._staged or ({}, {})
+            self._staged = None
+            sharding = None
+            if self.mesh is not None:
+                from .parallel import mesh as pmesh
+                sharding = pmesh.flat_sharding(self.mesh)
+
+            def build(b, per_name, fallback):
+                # gather per-param initial values, then let the layout
+                # assemble the bucket (single definition of the
+                # cast/pad/concat invariant — zero.py pack)
+                vals = []
+                for i, n in zip(b.param_idx, b.sizes):
+                    v = per_name.get(names[i])
+                    vals.append(fallback(i, n) if v is None
+                                else jnp.asarray(v))
+                buf = self._layout.pack(b, vals)
+                return jax.device_put(buf, sharding) \
+                    if sharding is not None else buf
+
+            self._zero_moms = [
+                build(b, staged_moms,
+                      lambda i, n, b=b: jnp.zeros((n,), b.acc_dtype))
+                for b in self._layout.buckets]
+            self._zero_masters = [
+                build(b, staged_masters,
+                      lambda i, n: weights[i]._data.reshape(-1)
+                      .astype(np.float32))
+                if b.mp else None
+                for b in self._layout.buckets]
+        return self._zero_moms, self._zero_masters
+
+    def _stage_current(self):
+        """Unpack the current ZeRO buckets into per-param staged values
+        (keyed by name) so a layout rebuild re-buckets them.  Each
+        sharded bucket is fetched to host ONCE and sliced there — not
+        one cross-device gather per parameter."""
+        moms, masters = {}, {}
+        for b, mom, mas in zip(self._layout.buckets, self._zero_moms,
+                               self._zero_masters):
+            for i, seg in zip(b.param_idx,
+                              self._layout.unpack(b, np.asarray(mom))):
+                moms[self._layout_names[i]] = seg
+            if b.mp and mas is not None:
+                for i, seg in zip(b.param_idx,
+                                  self._layout.unpack(
+                                      b, np.asarray(mas))):
+                    masters[self._layout_names[i]] = seg
+        self._staged = (moms, masters)
+
+    def state_bytes_per_device(self):
+        """Bytes of optimizer state (momenta + fp32 masters) resident
+        on EACH device — the ZeRO-1 memory metric (profiler/bench).
+        Replicated mode holds the full state everywhere; ZeRO mode
+        holds the 1/dp bucket shards."""
+        if self.zero:
+            return self._layout.state_bytes_per_device() \
+                if self._layout is not None else 0
+        total = 0
+        for n in self.param_names:
+            v = self.states.get(n)
+            if v is not None:
+                total += int(v.size) * np.dtype(v.dtype).itemsize
+            m = self.masters.get(n)
+            if m is not None:
+                total += int(m.size) * 4
+        return total
+
+    def comm_bytes_per_step(self):
+        """Logical (bytes_reduce_scattered, bytes_all_gathered) one
+        training step moves for the sharded update; (0, 0) in
+        replicated mode or when no mesh is active."""
+        if self.zero and self._layout is not None:
+            return self._layout.comm_bytes_per_step()
+        return 0, 0
+
     def commit(self, new_moms, new_masters):
-        """Write back optimizer state returned by a step execution."""
+        """Write back optimizer state returned by a step execution.
+        In ZeRO mode the lists are per-bucket dp-sharded buffers."""
+        if self.zero:
+            self._zero_moms = list(new_moms)
+            self._zero_masters = list(new_masters)
+            return
         for n, nm, nmw in zip(self.param_names, new_moms, new_masters):
             self.states[n] = nm
             self.masters[n] = nmw
@@ -665,6 +859,44 @@ class FusedSGD:
 
     # checkpoint compatibility with Updater.get_states/set_states
     def get_states(self):
+        """Checkpoint format is MODE-INDEPENDENT: ZeRO buckets are
+        unpacked back to per-param arrays (gathering the shards), so a
+        sharded run's checkpoint restores into a replicated run and
+        vice versa — same portability contract as the reference's
+        server-side states."""
+        if self.zero and self._staged is not None:
+            # restored states not yet re-bucketed (no step ran since
+            # set_states): round-trip the staged per-param values —
+            # falling through to the (empty) legacy dicts here would
+            # silently reset all momenta in the written checkpoint
+            staged_moms, staged_masters = self._staged
+            return pickle.dumps(
+                ({n: np.asarray(v) for n, v in staged_moms.items()},
+                 dict(self.optimizer._index_update_count),
+                 {n: np.asarray(v) for n, v in staged_masters.items()}))
+        if self.zero and self._layout is not None and \
+                self._zero_moms is not None:
+            names = self._layout_names
+            states, masters = {}, {}
+            # one host fetch per BUCKET (gathers the dp shards), then
+            # slice on host — not one device round-trip per parameter
+            for b, mom, mas in zip(self._layout.buckets,
+                                   self._zero_moms,
+                                   self._zero_masters):
+                for i, seg in zip(b.param_idx,
+                                  self._layout.unpack(
+                                      b, np.asarray(mom))):
+                    states[names[i]] = seg
+                for i in b.param_idx:
+                    masters[names[i]] = None
+                if b.mp and mas is not None:
+                    for i, seg in zip(b.param_idx,
+                                      self._layout.unpack(
+                                          b, np.asarray(mas))):
+                        masters[names[i]] = seg
+            return pickle.dumps(
+                (states, dict(self.optimizer._index_update_count),
+                 masters))
         states = {n: np.asarray(v) for n, v in self.states.items()}
         masters = {n: (np.asarray(v) if v is not None else None)
                    for n, v in self.masters.items()}
@@ -681,23 +913,36 @@ class FusedSGD:
             states, counts = payload
         else:
             states, counts = payload, None
-        import jax.numpy as jnp
-        self.states = {n: jnp.asarray(v) for n, v in states.items()}
-        # fp32 masters ride along with the momentum states; older/other
-        # checkpoints without them re-derive masters from the weights on
-        # the first update (__call__ backfills missing keys)
-        self.masters = {} if masters is None else {
-            n: (jnp.asarray(v) if v is not None else None)
-            for n, v in masters.items()}
+        if self.zero:
+            # stage per-param values; the next host_prep re-buckets
+            # them into dp-sharded flat buffers (the layout, if already
+            # built, stays valid — only the state buffers rebuild)
+            self._staged = (
+                {n: v for n, v in states.items() if v is not None},
+                {} if masters is None else
+                {n: v for n, v in masters.items() if v is not None})
+            self._zero_moms = None
+            self._zero_masters = None
+        else:
+            import jax.numpy as jnp
+            self.states = {n: jnp.asarray(v) for n, v in states.items()}
+            # fp32 masters ride along with the momentum states;
+            # older/other checkpoints without them re-derive masters
+            # from the weights on the first update (__call__ backfills
+            # missing keys)
+            self.masters = {} if masters is None else {
+                n: (jnp.asarray(v) if v is not None else None)
+                for n, v in masters.items()}
         if counts is not None:
             self.optimizer._index_update_count = dict(counts)
 
 
-def create_fused_updater(optimizer, param_names):
+def create_fused_updater(optimizer, param_names, zero=0, mesh=None):
     """Return a fused whole-model updater when the optimizer supports it,
     else None (caller falls back to the per-key Updater).  FusedSGD
     handles multi_precision natively (fp32 masters inside the jitted
-    step, reference mp_sgd_update)."""
+    step, reference mp_sgd_update).  zero=1 selects the ZeRO stage-1
+    sharded update over `mesh`'s data axis (parallel/zero.py)."""
     if type(optimizer) in (SGD, NAG):
-        return FusedSGD(optimizer, param_names)
+        return FusedSGD(optimizer, param_names, zero=zero, mesh=mesh)
     return None

@@ -8,9 +8,11 @@ step, and XLA collectives riding ICI.  It also provides the parallelism
 modes the reference never had (SURVEY.md §7 step 9): tensor parallelism,
 sequence/context parallelism (ring attention), and pipeline parallelism.
 """
-from .mesh import (make_mesh, data_sharding, replicated, shard_batch,
-                   replicate_params, current_mesh, set_current_mesh)
+from .mesh import (make_mesh, data_sharding, replicated, flat_sharding,
+                   shard_batch, replicate_params, current_mesh,
+                   set_current_mesh)
 from .ring_attention import ring_attention
 from . import collectives
 from . import pipeline
 from . import moe
+from . import zero

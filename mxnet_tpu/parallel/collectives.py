@@ -30,6 +30,34 @@ def reduce_scatter(x, axis_name, scatter_dimension=0, tiled=True):
                             scatter_dimension=scatter_dimension, tiled=tiled)
 
 
+def reduce_scatter_bucket(x, mesh, axis='data'):
+    """GSPMD form of `reduce_scatter` for code compiled under plain
+    `jax.jit` (no shard_map region, so the per-device partial sums are
+    never exposed as named-axis values): constraining the summed array
+    to be SHARDED over the dp axis makes XLA's partitioner lower the
+    cross-replica sum as a psum_scatter instead of a full all-reduce —
+    each device keeps only its 1/N shard.  Identity when no mesh is
+    active (dp==1).  This is the ZeRO-1 gradient-sharding primitive
+    (parallel/zero.py)."""
+    if mesh is None:
+        return x
+    import jax
+    from .mesh import flat_sharding
+    return jax.lax.with_sharding_constraint(x, flat_sharding(mesh, axis))
+
+
+def allgather_bucket(x, mesh):
+    """GSPMD form of `allgather` under plain `jax.jit`: constraining a
+    dp-sharded array back to replicated emits the all-gather.  Identity
+    when no mesh is active.  ZeRO-1 parameter re-materialization
+    (parallel/zero.py)."""
+    if mesh is None:
+        return x
+    import jax
+    from .mesh import replicated
+    return jax.lax.with_sharding_constraint(x, replicated(mesh))
+
+
 def ppermute(x, axis_name, perm):
     return lax.ppermute(x, axis_name, perm)
 

@@ -56,6 +56,14 @@ def replicated(mesh):
     return NamedSharding(mesh, P())
 
 
+def flat_sharding(mesh, axis='data'):
+    """1-D sharding over `axis` — the placement of ZeRO-1 optimizer
+    state buckets (each device holds its 1/N contiguous shard).  Same
+    spec as data_sharding (one definition: leading dim over `axis`);
+    named for the flat-buffer reading."""
+    return data_sharding(mesh, axis=axis)
+
+
 def shard_batch(mesh, array, axis='data', dim=0):
     """Place a jax array sharded over the mesh along dimension `dim`
     (the batch dim; dim=1 for K-stacked bulk batches)."""

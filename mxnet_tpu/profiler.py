@@ -30,6 +30,38 @@ _STATE = {
     'jax_trace_dir': None,
 }
 
+# communication / memory counters for the sharded (ZeRO-1) update:
+# logical collective payload bytes the fused steps moved, and the
+# optimizer-state bytes each device currently holds (Module feeds
+# these after every fused step — see module.py _note_step_counters)
+_COMM = {
+    'bytes_reduce_scattered': 0,
+    'bytes_all_gathered': 0,
+    'optimizer_state_bytes_per_device': 0,
+}
+
+
+def add_comm_bytes(reduce_scattered=0, all_gathered=0):
+    """Accumulate logical collective payload bytes (ZeRO-1 fused
+    steps: gradients reduce-scattered, updated params all-gathered)."""
+    with _STATE['lock']:
+        _COMM['bytes_reduce_scattered'] += int(reduce_scattered)
+        _COMM['bytes_all_gathered'] += int(all_gathered)
+
+
+def set_optimizer_state_bytes(n):
+    """Record the optimizer-state bytes resident PER DEVICE (momenta +
+    fp32 masters; 1/dp of the total under ZeRO-1)."""
+    with _STATE['lock']:
+        _COMM['optimizer_state_bytes_per_device'] = int(n)
+
+
+def comm_stats():
+    """Snapshot of the comm/memory counters (also merged into
+    summary() and dump_profile metadata)."""
+    with _STATE['lock']:
+        return dict(_COMM)
+
 
 def profiler_set_config(mode='symbolic', filename='profile.json',
                         profile_xla=False, xla_trace_dir=None):
@@ -71,9 +103,12 @@ def dump_profile():
     story is this profiler's own spans."""
     events = [{'ph': 'M', 'name': 'process_name', 'pid': 0,
                'args': {'name': 'mxnet_tpu host spans'}}]
-    # compiled-program cache counters ride along as trace metadata
+    # compiled-program cache + ZeRO comm/memory counters ride along
+    # as trace metadata
     events.append({'ph': 'M', 'name': 'exec_cache', 'pid': 0,
                    'args': exec_cache_stats()})
+    events.append({'ph': 'M', 'name': 'comm', 'pid': 0,
+                   'args': comm_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -151,6 +186,12 @@ def summary(print_out=True):
                  'total_compile_s=%.3f'
                  % (st['exec_cache_hits'], st['exec_cache_misses'],
                     st['total_compile_s']))
+    cm = comm_stats()
+    lines.append('  bytes_reduce_scattered=%d bytes_all_gathered=%d '
+                 'optimizer_state_bytes_per_device=%d'
+                 % (cm['bytes_reduce_scattered'],
+                    cm['bytes_all_gathered'],
+                    cm['optimizer_state_bytes_per_device']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -177,6 +218,8 @@ def record(name, category, ts_us, dur_us):
 def clear():
     with _STATE['lock']:
         _STATE['records'].clear()
+        for k in _COMM:
+            _COMM[k] = 0
 
 
 class scope(object):
