@@ -22,9 +22,14 @@ Env knobs: BENCH_BATCH (default: the per-model BATCH_LADDER, else
 dispatches), BENCH_BULK (steps per dispatch), BENCH_DTYPE, BENCH_MODEL
 (any K80_IMG_S key below — resnet-N, inception-bn, inception-v3,
 alexnet; tools/bench_family.py sweeps them all via this harness),
-BENCH_INPUT=device|host (device: batches pre-staged device-resident,
-the headline configuration; host: batches flow through
-io.prefetch_to_device and the measured stall is reported),
+BENCH_INPUT=device|host|rec (device: batches pre-staged
+device-resident, the headline configuration; host: in-memory batches
+flow through io.prefetch_to_device and the measured stall is reported;
+rec: a synthesized JPEG .rec dataset is decoded+augmented end-to-end
+through the parallel host decode pool — BENCH_DECODE_WORKERS /
+MXNET_TPU_DECODE_WORKERS sets the worker count, default 8, and the
+JSON's input_stall_ms_per_step shows whether the pipeline keeps the
+chip fed; BENCH_REC_IMAGES sizes the dataset),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -78,6 +83,44 @@ def make_symbol(model, dtype):
     return models.get_symbol(model, num_classes=1000, dtype=dtype)
 
 
+def _rec_input_source(batch, edge):
+    """BENCH_INPUT=rec: synthesize a JPEG .rec dataset in a tempdir and
+    open it through the parallel host decode pipeline (ImageIter with
+    MXNET_TPU_DECODE_WORKERS / BENCH_DECODE_WORKERS workers, default 8).
+    Returns (iterator, worker_count, cleanup)."""
+    import cv2
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+
+    rec_dir = tempfile.mkdtemp(prefix='bench_rec_')
+    prefix = os.path.join(rec_dir, 'data')
+    n = int(os.environ.get('BENCH_REC_IMAGES', str(max(2 * batch, 512))))
+    rng = np.random.RandomState(7)
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    src_edge = edge + 32   # headroom for the random crop
+    for i in range(n):
+        img = rng.randint(0, 256, (src_edge, src_edge, 3), dtype=np.uint8)
+        ok, buf = cv2.imencode('.jpg', img, [cv2.IMWRITE_JPEG_QUALITY, 90])
+        assert ok, 'jpeg encode failed'
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 1000), i, 0), buf.tobytes()))
+    rec.close()
+    workers = int(os.environ.get(
+        'MXNET_TPU_DECODE_WORKERS',
+        os.environ.get('BENCH_DECODE_WORKERS', '8')))
+    it = mx.image.ImageIter(
+        batch_size=batch, data_shape=(3, edge, edge),
+        path_imgrec=prefix + '.rec', shuffle=False,
+        rand_crop=True, rand_mirror=True,
+        preprocess_threads=workers)
+
+    def cleanup():
+        import shutil
+        it.close()
+        shutil.rmtree(rec_dir, ignore_errors=True)
+    return it, workers, cleanup
+
+
 def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224,
                input_mode='device'):
     """The shared measurement harness: bind, fused bulk_step loop,
@@ -98,15 +141,23 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224,
     scan_dtype = dtype if dtype != 'float32' else None
 
     prefetch = None
-    if input_mode == 'host':
-        # host input pipeline: a small cycling dataset flows through
-        # io.prefetch_to_device, so the H2D copy of upcoming batches
-        # overlaps device compute and the real stall gets measured
-        nb = max(2, min(4, bulk))
-        Xh = rng.rand(nb * batch, 3, edge, edge).astype(np.float32)
-        yh = (rng.rand(nb * batch) * 1000).astype(np.float32)
-        src = mx.io.NDArrayIter(Xh, yh, batch_size=batch,
-                                label_name='softmax_label')
+    cleanup = None
+    decode_workers = None
+    if input_mode in ('host', 'rec'):
+        if input_mode == 'rec':
+            # end-to-end .rec path: JPEG decode + augment in the
+            # parallel worker pool, batches through the device prefetch
+            # — the measured stall is the REAL input-pipeline stall
+            src, decode_workers, cleanup = _rec_input_source(batch, edge)
+        else:
+            # host input pipeline: a small cycling dataset flows through
+            # io.prefetch_to_device, so the H2D copy of upcoming batches
+            # overlaps device compute and the real stall gets measured
+            nb = max(2, min(4, bulk))
+            Xh = rng.rand(nb * batch, 3, edge, edge).astype(np.float32)
+            yh = (rng.rand(nb * batch) * 1000).astype(np.float32)
+            src = mx.io.NDArrayIter(Xh, yh, batch_size=batch,
+                                    label_name='softmax_label')
         prefetch = mx.io.prefetch_to_device(src, size=2, device=ctx)
 
         def pull(k):
@@ -155,46 +206,52 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224,
     # cold start: bind -> first completed training dispatch (includes
     # trace + XLA compile; with the persistent cache warm, the compile
     # is fetched from disk and this shrinks — that delta IS warm start)
-    tic = time.time()
-    mod.bind(data_shapes=[mx.io.DataDesc('data',
-                                         (batch, 3, edge, edge))],
-             label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
-    mod.init_params(initializer=mx.init.Xavier(rnd_type='gaussian',
-                                               factor_type='in',
-                                               magnitude=2))
-    mod.init_optimizer(optimizer='sgd',
-                       optimizer_params={'learning_rate': 0.1,
-                                         'momentum': 0.9, 'wd': 1e-4,
-                                         'multi_precision':
-                                             dtype != 'float32'})
-    step()
-    block()
-    cold_start_s = time.time() - tic
+    try:
+        tic = time.time()
+        mod.bind(data_shapes=[mx.io.DataDesc('data',
+                                             (batch, 3, edge, edge))],
+                 label_shapes=[mx.io.DataDesc('softmax_label', (batch,))])
+        mod.init_params(initializer=mx.init.Xavier(rnd_type='gaussian',
+                                                   factor_type='in',
+                                                   magnitude=2))
+        mod.init_optimizer(optimizer='sgd',
+                           optimizer_params={'learning_rate': 0.1,
+                                             'momentum': 0.9, 'wd': 1e-4,
+                                             'multi_precision':
+                                                 dtype != 'float32'})
+        step()
+        block()
+        cold_start_s = time.time() - tic
 
-    for _ in range(max(0, warmup - 1)):
-        step()
-    block()
-    if prefetch is not None:    # count stall over the measured loop only
-        prefetch.input_stall_ms = 0.0
-        prefetch.batches_served = 0
-    tic = time.time()
-    for _ in range(steps):
-        step()
-    block()
-    dt = time.time() - tic
-    fu = getattr(mod, '_fused_updater', None)
-    return {
-        'ips': batch * bulk * steps / dt,
-        'cold_start_s': round(cold_start_s, 3),
-        'input_stall_ms_per_step': round(
-            prefetch.stall_ms_per_batch(), 3) if prefetch is not None
-        else 0.0,
-        # ZeRO-1 memory trajectory: momenta + fp32 masters resident per
-        # device (drops ~dp-fold under MXNET_TPU_ZERO=1)
-        'optimizer_state_bytes_per_device':
-            int(fu.state_bytes_per_device()) if fu is not None else None,
-        'zero': int(getattr(fu, 'zero', 0)) if fu is not None else 0,
-    }
+        for _ in range(max(0, warmup - 1)):
+            step()
+        block()
+        if prefetch is not None:   # count stall over the measured loop only
+            prefetch.input_stall_ms = 0.0
+            prefetch.batches_served = 0
+        tic = time.time()
+        for _ in range(steps):
+            step()
+        block()
+        dt = time.time() - tic
+        fu = getattr(mod, '_fused_updater', None)
+        return {
+            'ips': batch * bulk * steps / dt,
+            'cold_start_s': round(cold_start_s, 3),
+            'input_stall_ms_per_step': round(
+                prefetch.stall_ms_per_batch(), 3) if prefetch is not None
+            else 0.0,
+            'decode_workers': decode_workers,
+            # ZeRO-1 memory trajectory: momenta + fp32 masters resident
+            # per device (drops ~dp-fold under MXNET_TPU_ZERO=1)
+            'optimizer_state_bytes_per_device':
+                int(fu.state_bytes_per_device()) if fu is not None
+                else None,
+            'zero': int(getattr(fu, 'zero', 0)) if fu is not None else 0,
+        }
+    finally:
+        if cleanup is not None:
+            cleanup()
 
 
 def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
@@ -299,9 +356,29 @@ def _bench_main():
                                       env=env, capture_output=True,
                                       text=True)
                 if proc.returncode == 0:
-                    print(proc.stdout.strip().splitlines()[-1])
-                    return
-                err = RuntimeError(proc.stderr[-2000:])
+                    lines = proc.stdout.strip().splitlines()
+                    if lines:
+                        print(lines[-1])
+                        return
+                    # zero-exit child with no JSON: broken relay, not a
+                    # capacity problem — surface it via the error path
+                    err = RuntimeError(
+                        'bench child (batch %d) exited 0 without '
+                        'output' % nb)
+                    break
+                child_err = proc.stderr or ''
+                if proc.returncode > 0 and not is_oom(child_err):
+                    # TPU-in-use / ImportError / crash: retrying down
+                    # the ladder would only mask the real cause.  A
+                    # NEGATIVE returncode means a signal kill — the
+                    # host OOM-killer leaves no traceback — so that
+                    # case keeps stepping down the ladder
+                    raise RuntimeError(
+                        'bench child (batch %d) failed without OOM:\n%s'
+                        % (nb, child_err[-2000:]))
+                err = RuntimeError('bench child (batch %d) rc=%d: %s'
+                                   % (nb, proc.returncode,
+                                      child_err[-2000:]))
             break
     if best is None:
         raise err
@@ -324,6 +401,7 @@ def _bench_main():
         'cold_start_s': best['cold_start_s'],
         'warm_start_s': measure_warm_start(model, best_batch, bulk),
         'input_stall_ms_per_step': best['input_stall_ms_per_step'],
+        'decode_workers': best['decode_workers'],
         'optimizer_state_bytes_per_device':
             best['optimizer_state_bytes_per_device'],
         'zero': best['zero'],

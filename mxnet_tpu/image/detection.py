@@ -8,16 +8,15 @@ object counts into a fixed (batch, max_objects, width) label tensor
 padded with -1, which is exactly the static-shape input MultiBoxTarget
 (ops/contrib_ops.py) consumes on the chip.
 """
-import random as pyrandom
-
 import numpy as np
 
 from .. import ndarray as nd
 from .. import io as mxio
+from .. import recordio
 from ..base import MXNetError
 from .image import (ImageIter, Augmenter, ResizeAug, ForceResizeAug,
                     CastAug, ColorJitterAug, LightingAug,
-                    ColorNormalizeAug, RandomOrderAug, _asnp)
+                    ColorNormalizeAug, RandomOrderAug, _asnp, _rng)
 
 
 class DetAugmenter(object):
@@ -55,9 +54,9 @@ class DetRandomSelectAug(DetAugmenter):
         self.skip_prob = skip_prob
 
     def __call__(self, src, label):
-        if pyrandom.random() < self.skip_prob or not self.aug_list:
+        if _rng().random() < self.skip_prob or not self.aug_list:
             return src, label
-        return pyrandom.choice(self.aug_list)(src, label)
+        return _rng().choice(self.aug_list)(src, label)
 
 
 class DetHorizontalFlipAug(DetAugmenter):
@@ -69,7 +68,7 @@ class DetHorizontalFlipAug(DetAugmenter):
         self.p = p
 
     def __call__(self, src, label):
-        if pyrandom.random() < self.p:
+        if _rng().random() < self.p:
             src = _asnp(src)[:, ::-1]
             label = label.copy()
             valid = label[:, 0] >= 0
@@ -140,12 +139,12 @@ class DetRandomCropAug(DetAugmenter):
         h, w = img.shape[:2]
         boxes = label[label[:, 0] >= 0][:, 1:5]
         for _ in range(self.max_attempts):
-            area = pyrandom.uniform(*self.area_range)
-            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            area = _rng().uniform(*self.area_range)
+            ratio = _rng().uniform(*self.aspect_ratio_range)
             cw = min(1.0, np.sqrt(area * ratio))
             ch = min(1.0, np.sqrt(area / ratio))
-            cx = pyrandom.uniform(0, 1.0 - cw)
-            cy = pyrandom.uniform(0, 1.0 - ch)
+            cx = _rng().uniform(0, 1.0 - cw)
+            cy = _rng().uniform(0, 1.0 - ch)
             crop = np.array([cx, cy, cx + cw, cy + ch])
             if len(boxes):
                 ious = _box_iou_1(crop, boxes)
@@ -178,15 +177,15 @@ class DetRandomPadAug(DetAugmenter):
     def __call__(self, src, label):
         img = _asnp(src)
         h, w, c = img.shape
-        scale = pyrandom.uniform(*self.area_range)
+        scale = _rng().uniform(*self.area_range)
         if scale <= 1.0:
             return img, label
-        ratio = pyrandom.uniform(*self.aspect_ratio_range)
+        ratio = _rng().uniform(*self.aspect_ratio_range)
         nw = min(int(w * np.sqrt(scale * ratio)), w * 4)
         nh = min(int(h * np.sqrt(scale / ratio)), h * 4)
         nw, nh = max(nw, w), max(nh, h)
-        ox = pyrandom.randint(0, nw - w)
-        oy = pyrandom.randint(0, nh - h)
+        ox = _rng().randint(0, nw - w)
+        oy = _rng().randint(0, nh - h)
         out = np.empty((nh, nw, c), img.dtype)
         out[:] = np.asarray(self.pad_val, img.dtype)[:c]
         out[oy:oy + h, ox:ox + w] = img
@@ -247,15 +246,43 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     return auglist
 
 
+def _parse_det_label(raw, object_width):
+    """Flat label vector -> (num_objects, object_width) array
+    (reference ImageDetIter._parse_label: [header_w, obj_w, header...,
+    obj0..., obj1...]).  Module-level so decode workers can parse
+    without holding the iterator."""
+    raw = np.asarray(raw, np.float32).ravel()
+    if raw.size < 2:
+        raise MXNetError('label must have at least 2 elements')
+    header_width = int(raw[0])
+    obj_width = int(raw[1])
+    if obj_width <= 0 or (raw.size - header_width) % obj_width != 0:
+        # plain flat [cls, x1, y1, x2, y2] * N form
+        if raw.size % object_width == 0:
+            return raw.reshape(-1, object_width)
+        raise MXNetError('invalid detection label of size %d'
+                         % raw.size)
+    out = raw[header_width:].reshape(-1, obj_width)
+    if obj_width < object_width:
+        raise MXNetError(
+            'detection label object width %d < iterator '
+            'object_width %d' % (obj_width, object_width))
+    return out[:, :object_width]
+
+
 class ImageDetIter(ImageIter):
     """Detection iterator: fixed-size (batch, max_objects, width) labels
-    padded with -1 (reference detection.py ImageDetIter)."""
+    padded with -1 (reference detection.py ImageDetIter).  Inherits the
+    parallel decode pipeline (`preprocess_threads` /
+    MXNET_TPU_DECODE_WORKERS) — detection augmentation runs in the
+    workers with the same per-sample seeded streams."""
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
                  path_imglist=None, path_root='.', shuffle=False,
                  part_index=0, num_parts=1, aug_list=None, imglist=None,
                  object_width=5, max_objects=None,
-                 data_name='data', label_name='label', **kwargs):
+                 data_name='data', label_name='label',
+                 preprocess_threads=None, **kwargs):
         if aug_list is None:
             import inspect
             params = set(inspect.signature(
@@ -270,7 +297,8 @@ class ImageDetIter(ImageIter):
             path_imgrec=path_imgrec, path_imglist=path_imglist,
             path_root=path_root, shuffle=shuffle, part_index=part_index,
             num_parts=num_parts, aug_list=[], imglist=imglist,
-            data_name=data_name, label_name=label_name)
+            data_name=data_name, label_name=label_name,
+            preprocess_threads=preprocess_threads)
         self.det_auglist = aug_list
         self.object_width = object_width
         if max_objects is None:
@@ -278,33 +306,23 @@ class ImageDetIter(ImageIter):
         self.max_objects = max_objects
 
     def _parse_label(self, raw):
-        """Flat label vector -> (num_objects, object_width) array
-        (reference ImageDetIter._parse_label: [header_w, obj_w, header...,
-        obj0..., obj1...])."""
-        raw = np.asarray(raw, np.float32).ravel()
-        if raw.size < 2:
-            raise MXNetError('label must have at least 2 elements')
-        header_width = int(raw[0])
-        obj_width = int(raw[1])
-        if obj_width <= 0 or (raw.size - header_width) % obj_width != 0:
-            # plain flat [cls, x1, y1, x2, y2] * N form
-            if raw.size % self.object_width == 0:
-                return raw.reshape(-1, self.object_width)
-            raise MXNetError('invalid detection label of size %d'
-                             % raw.size)
-        out = raw[header_width:].reshape(-1, obj_width)
-        if obj_width < self.object_width:
-            raise MXNetError(
-                'detection label object width %d < iterator '
-                'object_width %d' % (obj_width, self.object_width))
-        return out[:, :self.object_width]
+        return _parse_det_label(raw, self.object_width)
 
     def _scan_max_objects(self):
-        """One pass over labels to size the padded label tensor."""
+        """One pass over labels to size the padded label tensor.
+
+        Scans the FULL dataset — not just this iterator's
+        num_parts/per-host shard — so every partition derives the same
+        max_objects and the SPMD label shapes agree across hosts."""
         max_obj = 1
         if self.imglist:
             for label, _ in self.imglist.values():
                 max_obj = max(max_obj, self._parse_label(label).shape[0])
+        elif getattr(self.imgrec, 'keys', None):
+            for key in self.imgrec.keys:
+                header, _ = recordio.unpack(self.imgrec.read_idx(key))
+                max_obj = max(max_obj,
+                              self._parse_label(header.label).shape[0])
         else:
             self.reset()
             while True:
@@ -322,24 +340,39 @@ class ImageDetIter(ImageIter):
             self._label_name,
             (self.batch_size, self.max_objects, self.object_width))]
 
+    def _make_process(self):
+        """Worker-side closure: parse + pad the detection label and run
+        the (image, boxes) augmentation chain.  Captures config by
+        value; rebuilt every reset so sync_label_shape's max_objects
+        adjustments reach the workers."""
+        det_auglist = list(self.det_auglist)
+        max_objects, object_width = self.max_objects, self.object_width
+
+        def process(raw_label, img):
+            label = _parse_det_label(raw_label, object_width)
+            padded = np.full((max_objects, object_width), -1.0,
+                             np.float32)
+            n = min(len(label), max_objects)
+            padded[:n] = label[:n]
+            data = img
+            for aug in det_auglist:
+                data, padded = aug(data, padded)
+            arr = _asnp(data)
+            if arr.ndim == 3:
+                arr = arr.transpose(2, 0, 1)
+            return arr, padded
+        return process
+
     def next(self):
         bd = np.zeros((self.batch_size,) + self.data_shape, np.float32)
         bl = np.full((self.batch_size, self.max_objects,
                       self.object_width), -1.0, np.float32)
+        pull = self._pull_parallel if self._ensure_pool() is not None \
+            else self._pull_sample
         i = 0
         try:
             while i < self.batch_size:
-                raw_label, data = self.next_sample()
-                label = self._parse_label(raw_label)
-                padded = np.full((self.max_objects, self.object_width),
-                                 -1.0, np.float32)
-                n = min(len(label), self.max_objects)
-                padded[:n] = label[:n]
-                for aug in self.det_auglist:
-                    data, padded = aug(data, padded)
-                arr = _asnp(data)
-                if arr.ndim == 3:
-                    arr = arr.transpose(2, 0, 1)
+                arr, padded = pull()
                 bd[i] = arr
                 bl[i] = padded
                 i += 1
@@ -359,4 +392,12 @@ class ImageDetIter(ImageIter):
         m = max(self.max_objects, it.max_objects)
         self.max_objects = m
         it.max_objects = m
+        # the cached per-sample processors baked the old max_objects —
+        # and so did every staged or in-flight pool sample: discard
+        # them (resubmission re-decodes identically, newly padded)
+        for obj in (self, it):
+            obj._process = None
+            obj._discard_inflight()
+            if obj._source is not None:
+                obj._source.process = obj._processor()
         return it

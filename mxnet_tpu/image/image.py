@@ -8,8 +8,14 @@ numpy); augmented batches land in NDArrays that JAX transfers to the
 chip asynchronously, overlapping with device compute — the same
 producer/consumer split as the reference's prefetching iterators.
 """
+import logging
 import os
+import queue
 import random as pyrandom
+import sys
+import threading
+import time
+from collections import deque
 
 import numpy as np
 
@@ -22,6 +28,55 @@ try:
     import cv2
 except ImportError:  # pragma: no cover - cv2 is present in this image
     cv2 = None
+
+
+# ---------------------------------------------------------------------------
+# Augmenter randomness routing.
+#
+# Augmenters draw through _rng()/_np_rng() instead of the `random` /
+# `np.random` modules directly.  By default these return the process-
+# global modules — bit-compatible with the sequential pre-parallel
+# pipeline.  Inside a decode worker, _seeded_aug_rng routes the calling
+# THREAD's draws through streams seeded per SAMPLE (mx.random
+# stream_seed), so parallel augmentation is reproducible under
+# mx.random.seed() regardless of worker count or scheduling.
+# ---------------------------------------------------------------------------
+
+_AUG_RNG = threading.local()
+
+
+def _rng():
+    """The python-random stream augmenters draw from (thread-local
+    override inside decode workers, the global `random` module else)."""
+    return getattr(_AUG_RNG, 'py', pyrandom)
+
+
+def _np_rng():
+    """Same for numpy draws (LightingAug)."""
+    return getattr(_AUG_RNG, 'np', np.random)
+
+
+class _seeded_aug_rng(object):
+    """Route _rng()/_np_rng() through per-sample seeded streams for the
+    current thread (decode workers wrap each sample's augmentation)."""
+
+    def __init__(self, seed):
+        self._seed = int(seed)
+
+    def __enter__(self):
+        self._prev = (getattr(_AUG_RNG, 'py', None),
+                      getattr(_AUG_RNG, 'np', None))
+        _AUG_RNG.py = pyrandom.Random(self._seed)
+        _AUG_RNG.np = np.random.RandomState(self._seed & 0xffffffff)
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev[0] is None:
+            del _AUG_RNG.py
+            del _AUG_RNG.np
+        else:
+            _AUG_RNG.py, _AUG_RNG.np = self._prev
+        return False
 
 
 def imdecode(buf, flag=1, to_rgb=True, out=None):
@@ -126,8 +181,8 @@ def random_crop(src, size, interp=2):
     """Random crop of `size` (w, h); returns (cropped, (x0,y0,w,h))."""
     h, w = src.shape[:2]
     new_w, new_h = scale_down((w, h), size)
-    x0 = pyrandom.randint(0, w - new_w)
-    y0 = pyrandom.randint(0, h - new_h)
+    x0 = _rng().randint(0, w - new_w)
+    y0 = _rng().randint(0, h - new_h)
     out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
     return out, (x0, y0, new_w, new_h)
 
@@ -146,15 +201,15 @@ def random_size_crop(src, size, min_area, ratio, interp=2):
     h, w = src.shape[:2]
     area = w * h
     for _ in range(10):
-        new_area = pyrandom.uniform(min_area, 1.0) * area
-        new_ratio = pyrandom.uniform(*ratio)
+        new_area = _rng().uniform(min_area, 1.0) * area
+        new_ratio = _rng().uniform(*ratio)
         new_w = int(round(np.sqrt(new_area * new_ratio)))
         new_h = int(round(np.sqrt(new_area / new_ratio)))
-        if pyrandom.random() < 0.5:
+        if _rng().random() < 0.5:
             new_w, new_h = new_h, new_w
         if new_w <= w and new_h <= h:
-            x0 = pyrandom.randint(0, w - new_w)
-            y0 = pyrandom.randint(0, h - new_h)
+            x0 = _rng().randint(0, w - new_w)
+            y0 = _rng().randint(0, h - new_h)
             out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
             return out, (x0, y0, new_w, new_h)
     return center_crop(src, size, interp)
@@ -246,7 +301,7 @@ class RandomOrderAug(Augmenter):
     def __call__(self, src):
         srcs = [src]
         ts = list(self.ts)
-        pyrandom.shuffle(ts)
+        _rng().shuffle(ts)
         for t in ts:
             srcs = [out for s in srcs for out in t(s)]
         return srcs
@@ -258,7 +313,7 @@ class BrightnessJitterAug(Augmenter):
         self.brightness = brightness
 
     def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        alpha = 1.0 + _rng().uniform(-self.brightness, self.brightness)
         return [_like(_asnp(src).astype(np.float32) * alpha, src)]
 
 
@@ -269,7 +324,7 @@ class ContrastJitterAug(Augmenter):
         self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
 
     def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        alpha = 1.0 + _rng().uniform(-self.contrast, self.contrast)
         img = _asnp(src).astype(np.float32)
         gray = (img * self.coef).sum()
         gray = (3.0 * (1.0 - alpha) / img.size) * gray
@@ -283,7 +338,7 @@ class SaturationJitterAug(Augmenter):
         self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
 
     def __call__(self, src):
-        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        alpha = 1.0 + _rng().uniform(-self.saturation, self.saturation)
         img = _asnp(src).astype(np.float32)
         gray = (img * self.coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
         return [_like(img * alpha + gray, src)]
@@ -308,7 +363,7 @@ class LightingAug(Augmenter):
         self.eigvec = np.asarray(eigvec, np.float32)
 
     def __call__(self, src):
-        alpha = np.random.normal(0, self.alphastd, size=(3,)) \
+        alpha = _np_rng().normal(0, self.alphastd, size=(3,)) \
             .astype(np.float32)
         rgb = np.dot(self.eigvec * alpha, self.eigval)
         return [_like(_asnp(src).astype(np.float32) + rgb, src)]
@@ -330,7 +385,7 @@ class HorizontalFlipAug(Augmenter):
         self.p = p
 
     def __call__(self, src):
-        if pyrandom.random() < self.p:
+        if _rng().random() < self.p:
             return [_like(np.ascontiguousarray(_asnp(src)[:, ::-1]), src)]
         return [src]
 
@@ -379,19 +434,213 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
 
 
 # ---------------------------------------------------------------------------
+# Parallel host decode pipeline.
+#
+# The reference's ImageRecordIter (src/io/iter_image_recordio.cc) is a
+# multithreaded C++ pipeline driven by `preprocess_threads`; this is
+# its python counterpart for ImageIter: a worker-thread pool (cv2
+# releases the GIL around decode/resize, so threads scale) pulls record
+# ranges, runs decode+augment per record, and the consumer reassembles
+# batches IN DETERMINISTIC EPOCH ORDER through a bounded chunk queue —
+# so batch N+2 decodes while N+1 stages to device (PrefetchToDeviceIter)
+# and N computes.
+# ---------------------------------------------------------------------------
+
+def decode_workers_from_env(default=0):
+    """The MXNET_TPU_DECODE_WORKERS knob, parsed in ONE place (ImageIter
+    default and Module.fit auto-wiring must always agree)."""
+    try:
+        return max(0, int(os.environ.get('MXNET_TPU_DECODE_WORKERS',
+                                         str(default))))
+    except ValueError:
+        return default
+
+
+def _host_shard(num_parts, part_index):
+    """Compose explicit num_parts/part_index with per-host sharding.
+
+    When a multichip mesh spans hosts (jax.process_count() > 1) each
+    host must decode a disjoint record slice; MXNET_TPU_HOST_SHARD
+    ('index/count') overrides for virtual-host setups (dryrun, launch
+    workers without jax distributed init).  MXNET_TPU_SHARD_BY_HOST=0
+    disables the automatic composition."""
+    spec = os.environ.get('MXNET_TPU_HOST_SHARD', '')
+    if spec:
+        host_index, host_count = (int(x) for x in spec.split('/'))
+    else:
+        if os.environ.get('MXNET_TPU_SHARD_BY_HOST', '1') in ('0', ''):
+            return num_parts, part_index
+        jax = sys.modules.get('jax')
+        if jax is None:
+            return num_parts, part_index
+        try:
+            host_count = jax.process_count()
+            host_index = jax.process_index()
+        except Exception:
+            return num_parts, part_index
+    if host_count <= 1:
+        return num_parts, part_index
+    return num_parts * host_count, part_index * host_count + host_index
+
+
+class _SampleSource(object):
+    """Worker-side view of the dataset: read + decode + augment ONE
+    sample.  Deliberately holds only the readers and the processing
+    closure — never the iterator — so running worker threads don't pin
+    the ImageIter alive (its __del__ must fire to join them)."""
+
+    def __init__(self, imgrec, imglist, path_root, process):
+        self.imgrec = imgrec
+        self.imglist = imglist
+        self.path_root = path_root
+        self.process = process  # (raw_label, img_np) -> (data, label)
+
+    def __call__(self, key, aug_seed):
+        if self.imgrec is not None:
+            header, buf = recordio.unpack(self.imgrec.read_idx(key))
+            raw_label = header.label
+        else:
+            raw_label, fname = self.imglist[key]
+            with open(os.path.join(self.path_root, fname), 'rb') as f:
+                buf = f.read()
+        img = ImageIter._decode_np(buf)
+        with _seeded_aug_rng(aug_seed):
+            return self.process(raw_label, img)
+
+
+def _decode_pool_worker(source, task_q, results, cond, alive, cur_gen):
+    """Decode-pool worker loop (module-level: holds only the shared
+    cells, mirroring io._prefetch_worker's no-owner-pin design).
+    Tasks are (generation, chunk_id, [(key, aug_seed), ...]); results
+    land keyed by (generation, chunk_id), exceptions included — they
+    re-raise at the consumer's next()."""
+    from .. import profiler
+    while True:
+        task = task_q.get()
+        if task is None or not alive[0]:
+            return
+        gen, chunk_id, items = task
+        if gen != cur_gen[0]:
+            continue  # stale epoch: reset() already dropped this chunk
+        t0 = time.perf_counter()
+        try:
+            payload = (True, [source(key, aug_seed)
+                              for key, aug_seed in items])
+        except BaseException as e:  # noqa: B036 - re-raised at next()
+            payload = (False, e)
+        profiler.add_input_stats(
+            decode_ms=(time.perf_counter() - t0) * 1e3,
+            decoded_samples=len(items) if payload[0] else 0)
+        with cond:
+            if alive[0] and gen == cur_gen[0]:
+                results[(gen, chunk_id)] = payload
+                cond.notify_all()
+
+
+class _DecodePool(object):
+    """Bounded multi-worker decode pool with in-order reassembly.
+
+    submit() enqueues chunk k of the current epoch; pop(k) blocks until
+    chunk k's samples are staged and returns them — chunks complete out
+    of order in the workers but are consumed strictly in order, so the
+    epoch stream is deterministic.  advance_epoch() invalidates all
+    outstanding work (generation bump); close() joins the workers."""
+
+    def __init__(self, source, num_workers, name='imageiter'):
+        self._task_q = queue.SimpleQueue()
+        self._cond = threading.Condition()
+        self._results = {}
+        self._alive = [True]
+        self._gen = [0]
+        self.num_workers = num_workers
+        self._threads = []
+        for i in range(num_workers):
+            worker = threading.Thread(
+                target=_decode_pool_worker,
+                args=(source, self._task_q, self._results, self._cond,
+                      self._alive, self._gen),
+                name='%s-decode-%d' % (name, i), daemon=True)
+            worker.start()
+            self._threads.append(worker)
+
+    def advance_epoch(self):
+        with self._cond:
+            self._gen[0] += 1
+            self._results.clear()
+        # drop queued (not yet started) stale tasks eagerly
+        while True:
+            try:
+                self._task_q.get_nowait()
+            except queue.Empty:
+                break
+
+    def submit(self, chunk_id, items):
+        self._task_q.put((self._gen[0], chunk_id, items))
+
+    def ready_depth(self):
+        """Chunks decoded and waiting for the consumer (queue depth)."""
+        with self._cond:
+            return len(self._results)
+
+    def pop(self, chunk_id):
+        """Block until chunk `chunk_id` of the current epoch is staged;
+        re-raises the worker's exception if decoding it failed."""
+        key = (self._gen[0], chunk_id)
+        with self._cond:
+            while key not in self._results:
+                if not self._alive[0]:
+                    raise RuntimeError('decode pool is closed')
+                if not any(t.is_alive() for t in self._threads):
+                    raise MXNetError('all decode workers exited '
+                                     'unexpectedly')
+                self._cond.wait(0.2)
+            ok, payload = self._results.pop(key)
+        if not ok:
+            raise payload
+        return payload
+
+    def close(self):
+        """Stop and join the workers (idempotent)."""
+        self._alive[0] = False
+        for _ in self._threads:
+            self._task_q.put(None)
+        with self._cond:
+            self._cond.notify_all()
+        for worker in self._threads:
+            worker.join(timeout=5)
+        self._threads = [t for t in self._threads if t.is_alive()]
+
+    def alive_workers(self):
+        return sum(t.is_alive() for t in self._threads)
+
+
+# ---------------------------------------------------------------------------
 # ImageIter (reference image.py ImageIter)
 # ---------------------------------------------------------------------------
 
 class ImageIter(mxio.DataIter):
     """Image iterator over .rec files or an image list + root dir, with
-    augmentation, partition sharding (num_parts/part_index), and
-    shuffling — the python analog of ImageRecordIter."""
+    augmentation, partition sharding (num_parts/part_index, composed
+    with per-host sharding on multihost meshes), shuffling, and an
+    optional parallel host decode pipeline — the python analog of
+    ImageRecordIter.
+
+    preprocess_threads (or MXNET_TPU_DECODE_WORKERS when unset): >= 2
+    starts that many decode workers; 0/1 keeps the sequential path
+    (bit-identical to the pre-pipeline iterator, including its legacy
+    global-`random` augmentation draws).  Parallel epochs are
+    deterministic under mx.random.seed() and identical for any worker
+    count >= 2: each sample's augmentation stream is seeded from
+    (process seed, epoch, epoch position), not from whichever worker
+    happened to run it.  The two RNG disciplines differ, so with
+    random augmenters active a parallel epoch is a different (equally
+    distributed) draw than the sequential epoch."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root='.',
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name='data', label_name='softmax_label',
-                 **kwargs):
+                 preprocess_threads=None, **kwargs):
         super(ImageIter, self).__init__()
         assert path_imgrec or path_imglist or isinstance(imglist, list)
         self.batch_size = batch_size
@@ -403,6 +652,11 @@ class ImageIter(mxio.DataIter):
         self.imgrec = None
         self.imglist = {}
         self.seq = None
+        self._workers_explicit = preprocess_threads is not None
+        if preprocess_threads is None:
+            preprocess_threads = decode_workers_from_env()
+        self.preprocess_threads = max(0, int(preprocess_threads))
+        num_parts, part_index = _host_shard(num_parts, part_index)
         if path_imgrec:
             idx_path = os.path.splitext(path_imgrec)[0] + '.idx'
             if os.path.isfile(idx_path):
@@ -445,6 +699,21 @@ class ImageIter(mxio.DataIter):
         self.auglist = (CreateAugmenter(data_shape, **kwargs)
                         if aug_list is None else aug_list)
         self.cur = 0
+        # parallel-pipeline state (pool built lazily at first next() so
+        # subclasses can finish their own setup before process closure
+        # capture); _epoch seeds the per-sample augmentation streams
+        self._pool = None
+        self._source = None
+        self._process = None
+        self._staged = deque()
+        self._epoch = -1
+        self._submit_pos = self._submit_chunk = self._consume_chunk = 0
+        if self.preprocess_threads >= 2 and self.seq is None:
+            logging.warning(
+                'ImageIter: preprocess_threads=%d requested but the '
+                'input is a pure-stream .rec without an .idx sidecar; '
+                'falling back to sequential decode',
+                self.preprocess_threads)
         self.reset()
 
     @property
@@ -458,12 +727,169 @@ class ImageIter(mxio.DataIter):
             else (self.batch_size, self.label_width)
         return [mxio.DataDesc(self._label_name, shape)]
 
+    def _parallel(self):
+        """True when the parallel decode pipeline serves this iterator."""
+        return self.preprocess_threads >= 2 and self.seq is not None
+
     def reset(self):
         if self.shuffle and self.seq is not None:
             pyrandom.shuffle(self.seq)
-        if self.imgrec is not None:
+        if self.imgrec is not None and not self._parallel():
+            # cursor rewind for the sequential/stream path; the parallel
+            # path reads positionally (read_at) and must NOT swap the fp
+            # out from under live workers
             self.imgrec.reset()
         self.cur = 0
+        self._epoch += 1
+        self._staged.clear()
+        self._submit_pos = self._submit_chunk = self._consume_chunk = 0
+        self._next_pos = 0
+        self._chunk_ranges = {}
+        # re-capture processing params a subclass may have changed
+        # since the last epoch (e.g. ImageDetIter sync_label_shape
+        # adjusting max_objects)
+        self._process = None
+        if self._pool is not None:
+            self._pool.advance_epoch()
+            if self._source is not None:
+                self._source.process = self._processor()
+
+    # -- parallel pipeline plumbing ---------------------------------------
+    def _make_process(self):
+        """Build the worker-side processing closure: augment + layout
+        ONE decoded sample.  Captures augmenters/config by value — not
+        `self` — so workers never pin the iterator."""
+        auglist = list(self.auglist)
+
+        def process(raw_label, img):
+            data = img
+            for aug in auglist:
+                data = aug(data)[0]
+            arr = _asnp(data)
+            if arr.ndim == 3:
+                arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+            return arr, np.atleast_1d(np.asarray(raw_label, np.float32))
+        return process
+
+    def _processor(self):
+        """The cached per-sample processing closure — ONE definition
+        serving both the sequential path and the decode workers, so
+        the two can never silently diverge."""
+        if self._process is None:
+            self._process = self._make_process()
+        return self._process
+
+    def _ensure_pool(self):
+        if self._pool is None and self._parallel():
+            self._source = _SampleSource(self.imgrec, self.imglist,
+                                         self.path_root,
+                                         self._processor())
+            self._pool = _DecodePool(self._source,
+                                     self.preprocess_threads,
+                                     name=type(self).__name__.lower())
+            # chunk = the record range one task covers: fine enough to
+            # spread a single batch over the pool, coarse enough to
+            # amortize task/queue overhead
+            self._chunk_records = max(
+                1, min(64, self.batch_size // self.preprocess_threads))
+            # bounded staging: at most this many chunks in flight or
+            # staged (the memory bound of the pipeline)
+            self._max_outstanding = 2 * self.preprocess_threads + 2
+        return self._pool
+
+    def _fill_tasks(self):
+        """Keep the bounded task window full (consumer-driven)."""
+        from .. import random as mxrandom
+        while (self._submit_chunk - self._consume_chunk) < \
+                self._max_outstanding and self._submit_pos < len(self.seq):
+            hi = min(self._submit_pos + self._chunk_records, len(self.seq))
+            items = [(self.seq[p],
+                      mxrandom.stream_seed('image-aug', self._epoch, p))
+                     for p in range(self._submit_pos, hi)]
+            self._pool.submit(self._submit_chunk, items)
+            self._chunk_ranges[self._submit_chunk] = hi
+            self._submit_chunk += 1
+            self._submit_pos = hi
+
+    def _pop_staged(self):
+        self._next_pos += 1   # consumed-sample watermark (see close())
+        return self._staged.popleft()
+
+    def _pull_parallel(self):
+        """Next (data, label) in deterministic epoch order from the
+        decode pool; blocks only when the pool has fallen behind."""
+        from .. import profiler
+        if self._staged:
+            return self._pop_staged()
+        self._fill_tasks()
+        if self._consume_chunk >= self._submit_chunk:
+            raise StopIteration
+        t0 = time.perf_counter()
+        chunk = self._consume_chunk
+        self._consume_chunk += 1   # advance past a poisoned chunk too
+        try:
+            payload = self._pool.pop(chunk)
+        except BaseException:
+            # skip the poisoned chunk's positions so a caller that
+            # keeps iterating (or a close/restart) stays aligned
+            self._next_pos = self._chunk_ranges.pop(chunk, self._next_pos)
+            raise
+        self._chunk_ranges.pop(chunk, None)
+        self._fill_tasks()  # refill before consuming
+        profiler.add_input_stats(
+            decode_wait_ms=(time.perf_counter() - t0) * 1e3,
+            queue_depth=self._pool.ready_depth())
+        self._staged.extend(payload)
+        return self._pop_staged()
+
+    def _pull_sample(self):
+        """Sequential pull: read one sample, then run the SAME process
+        closure the workers use — but on the caller thread with the
+        process-global RNG, i.e. the pre-pipeline code path
+        (bit-identical at preprocess_threads<=1)."""
+        raw_label, data = self.next_sample()
+        return self._processor()(raw_label, data)
+
+    def set_preprocess_threads(self, n):
+        """Change the decode worker count (0/1 = sequential).  Resets
+        the iterator so the new pipeline starts at an epoch boundary."""
+        n = max(0, int(n))
+        self._workers_explicit = True
+        if n == self.preprocess_threads:
+            return self
+        self.close()
+        self.preprocess_threads = n
+        self.reset()
+        return self
+
+    def _discard_inflight(self):
+        """Drop staged + in-flight pool work and rewind submission to
+        the consumed-sample watermark — resubmitted positions re-decode
+        to identical samples (per-sample seeded streams), so this is
+        safe mid-epoch (pool restart, label-shape change)."""
+        self._staged.clear()
+        self._chunk_ranges = {}
+        self._submit_chunk = self._consume_chunk = 0
+        self._submit_pos = self._next_pos
+        if self._pool is not None:
+            self._pool.advance_epoch()
+
+    def close(self):
+        """Join the decode workers (idempotent; __del__ calls it).  The
+        iterator stays usable — the pool restarts at the next next(),
+        resuming from the consumed-sample watermark (per-sample seeded
+        streams make the re-decoded samples identical)."""
+        if getattr(self, '_pool', None) is not None:
+            self._pool.close()
+            self._pool = None
+            self._source = None
+            self._discard_inflight()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # interpreter teardown: attrs may be gone
+            pass
 
     @staticmethod
     def _decode_np(buf, flag=1, to_rgb=True):
@@ -504,17 +930,13 @@ class ImageIter(mxio.DataIter):
         shape = (self.batch_size, self.label_width) \
             if self.label_width > 1 else (self.batch_size,)
         batch_label = np.zeros(shape, np.float32)
+        pull = self._pull_parallel if self._ensure_pool() is not None \
+            else self._pull_sample
         i = 0
         try:
             while i < self.batch_size:
-                label, data = self.next_sample()
-                for aug in self.auglist:
-                    data = aug(data)[0]
-                arr = _asnp(data)
-                if arr.ndim == 3:
-                    arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+                arr, label = pull()
                 batch_data[i] = arr
-                label = np.atleast_1d(np.asarray(label, np.float32))
                 if self.label_width == 1:
                     batch_label[i] = label[0]
                 else:

@@ -617,12 +617,21 @@ class Module(BaseModule):
         self._exec_group.install_monitor(mon)
 
     def _wrap_train_iter(self, train_data):
-        """fit() input pipeline: stage upcoming batches device-resident
-        (io.prefetch_to_device) so the host→device copy of batch N+1
-        overlaps step N's compute.  MXNET_TPU_PREFETCH sets the buffer
-        depth (default 2; 0 disables)."""
+        """fit() input pipeline: turn on the parallel host decode pool
+        for image iterators that were left at their default worker
+        count (MXNET_TPU_DECODE_WORKERS), then stage upcoming batches
+        device-resident (io.prefetch_to_device) so the host→device
+        copy of batch N+1 overlaps step N's compute.  MXNET_TPU_PREFETCH
+        sets the buffer depth (default 2; 0 disables)."""
         import os
         from .. import io as mxio
+        from ..image.image import decode_workers_from_env
+        workers = decode_workers_from_env()
+        if workers >= 2 and \
+                getattr(train_data, '_workers_explicit', None) is False:
+            # an env set after the iterator was constructed still takes
+            # effect; an explicit preprocess_threads=N always wins
+            train_data.set_preprocess_threads(workers)
         try:
             depth = int(os.environ.get('MXNET_TPU_PREFETCH', '2'))
         except ValueError:

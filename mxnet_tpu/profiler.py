@@ -41,6 +41,51 @@ _COMM = {
 }
 
 
+# host input-pipeline counters (parallel decode pool + device prefetch):
+# decode work done by the workers, time the consumer waited on the pool,
+# ready-chunk queue depth observations, and training-loop-visible input
+# stall (PrefetchToDeviceIter.next blocking time)
+_INPUT = {
+    'decode_ms': 0.0,
+    'decoded_samples': 0,
+    'decode_wait_ms': 0.0,
+    'queue_depth_sum': 0,
+    'queue_depth_obs': 0,
+    'input_stall_ms': 0.0,
+    'input_batches': 0,
+}
+
+
+def add_input_stats(decode_ms=0.0, decoded_samples=0, decode_wait_ms=0.0,
+                    queue_depth=None, stall_ms=0.0, batches=0):
+    """Accumulate host input-pipeline counters (decode workers feed
+    decode_ms/decoded_samples; the batch consumer feeds decode_wait_ms
+    + queue_depth; PrefetchToDeviceIter feeds stall_ms/batches)."""
+    with _STATE['lock']:
+        _INPUT['decode_ms'] += decode_ms
+        _INPUT['decoded_samples'] += decoded_samples
+        _INPUT['decode_wait_ms'] += decode_wait_ms
+        if queue_depth is not None:
+            _INPUT['queue_depth_sum'] += int(queue_depth)
+            _INPUT['queue_depth_obs'] += 1
+        _INPUT['input_stall_ms'] += stall_ms
+        _INPUT['input_batches'] += batches
+
+
+def input_stats():
+    """Snapshot of the input-pipeline counters plus derived means
+    (queue_depth_avg, input_stall_ms_per_batch)."""
+    with _STATE['lock']:
+        out = dict(_INPUT)
+    out['queue_depth_avg'] = (out['queue_depth_sum'] /
+                              out['queue_depth_obs']
+                              if out['queue_depth_obs'] else 0.0)
+    out['input_stall_ms_per_batch'] = (out['input_stall_ms'] /
+                                       out['input_batches']
+                                       if out['input_batches'] else 0.0)
+    return out
+
+
 def add_comm_bytes(reduce_scattered=0, all_gathered=0):
     """Accumulate logical collective payload bytes (ZeRO-1 fused
     steps: gradients reduce-scattered, updated params all-gathered)."""
@@ -109,6 +154,8 @@ def dump_profile():
                    'args': exec_cache_stats()})
     events.append({'ph': 'M', 'name': 'comm', 'pid': 0,
                    'args': comm_stats()})
+    events.append({'ph': 'M', 'name': 'input_pipeline', 'pid': 0,
+                   'args': input_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -192,6 +239,13 @@ def summary(print_out=True):
                  % (cm['bytes_reduce_scattered'],
                     cm['bytes_all_gathered'],
                     cm['optimizer_state_bytes_per_device']))
+    ip = input_stats()
+    lines.append('  decode_ms=%.3f decoded_samples=%d '
+                 'decode_wait_ms=%.3f queue_depth_avg=%.2f '
+                 'input_stall_ms_per_batch=%.3f'
+                 % (ip['decode_ms'], ip['decoded_samples'],
+                    ip['decode_wait_ms'], ip['queue_depth_avg'],
+                    ip['input_stall_ms_per_batch']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -220,6 +274,8 @@ def clear():
         _STATE['records'].clear()
         for k in _COMM:
             _COMM[k] = 0
+        for k in _INPUT:
+            _INPUT[k] = type(_INPUT[k])()
 
 
 class scope(object):

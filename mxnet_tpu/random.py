@@ -8,6 +8,7 @@ splitting.  Imperative ops draw fresh subkeys from this module; compiled
 executors fold a per-step key into the XLA module so random ops
 (Dropout, samplers) are reproducible and fusion-friendly.
 """
+import hashlib
 import threading
 
 import jax
@@ -17,26 +18,51 @@ _state = threading.local()
 # stream from it, so seed() is global like the reference MXRandomSeed
 # (per-stream state stays thread-local to keep draws race-free)
 _global_seed = [None]
+# bumped on every seed() call: a thread that already drew under an
+# older seed detects the mismatch at its next draw and re-derives its
+# stream, so seed() reaches long-lived threads (decode workers) too —
+# not just threads that draw for the first time afterwards
+_seed_generation = [0]
 
 
 def _get():
-    if not hasattr(_state, 'key'):
-        # a thread drawing for the first time inherits the process
-        # seed, so seed() is global like the reference MXRandomSeed.
-        # Every inheriting thread starts the SAME stream (reproducible
-        # run-to-run; the reference likewise seeds all device RNGs from
-        # one seed) — threads wanting distinct streams call seed()
-        # themselves.
+    if getattr(_state, 'generation', None) != _seed_generation[0] or \
+            not hasattr(_state, 'key'):
+        # a thread drawing for the first time — or for the first time
+        # since the last seed() — inherits the process seed, so seed()
+        # is global like the reference MXRandomSeed.  Every inheriting
+        # thread starts the SAME stream (reproducible run-to-run; the
+        # reference likewise seeds all device RNGs from one seed) —
+        # threads wanting distinct streams call seed() themselves or
+        # draw through stream_seed().
         _state.key = jax.random.PRNGKey(_global_seed[0] or 0)
+        _state.generation = _seed_generation[0]
     return _state.key
 
 
 def seed(seed_state):
     """Seed the global PRNG (reference python/mxnet/random.py seed).
     Takes effect in every thread: the calling thread's stream resets to
-    the seed, and threads that draw later derive theirs from it."""
+    the seed, and any other thread — whether it has drawn before or
+    not — re-derives its stream at its next draw (generation check)."""
     _global_seed[0] = int(seed_state)
+    _seed_generation[0] += 1
     _state.key = jax.random.PRNGKey(int(seed_state))
+    _state.generation = _seed_generation[0]
+
+
+def stream_seed(*components):
+    """Derive a reproducible integer seed for an auxiliary host-side
+    stream from the process seed (`mx.random.seed`) and `components`
+    (e.g. ('image-aug', epoch, sample_ordinal)).
+
+    Decode workers seed one `random.Random`/`RandomState` per SAMPLE
+    from this, so augmentation randomness depends only on (process
+    seed, epoch, sample position) — identical output no matter how
+    many workers run or which worker drew which sample."""
+    payload = repr((_global_seed[0] or 0, components)).encode()
+    h = hashlib.blake2b(payload, digest_size=8).digest()
+    return int.from_bytes(h, 'little')
 
 
 def next_key():

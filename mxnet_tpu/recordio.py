@@ -127,7 +127,8 @@ class MXIndexedRecordIO(MXRecordIO):
         self.idx = {}
         self.keys = []
         self.key_type = key_type
-        # seek+read must be atomic when shared across loader threads
+        # read_idx goes through positional os.pread and needs no lock;
+        # this guards the seek+read fallback on platforms without pread
         self._lock = threading.Lock()
         super(MXIndexedRecordIO, self).__init__(uri, flag)
 
@@ -166,10 +167,42 @@ class MXIndexedRecordIO(MXRecordIO):
         assert not self.writable
         self.fp.seek(self.idx[idx])
 
+    def read_at(self, pos):
+        """Read the (possibly multi-part) record starting at byte `pos`
+        WITHOUT moving the shared file cursor.  os.pread is positional
+        and atomic per call, so any number of decode-pool workers can
+        read concurrently through this one open fd — no lock, no
+        per-worker reader handles (the thread-safety story behind
+        image.ImageIter's parallel pipeline)."""
+        assert not self.writable
+        if not hasattr(os, 'pread'):  # pragma: no cover - non-POSIX
+            with self._lock:
+                self.fp.seek(pos)
+                return self.read()
+        fd = self.fp.fileno()
+        parts = []
+        while True:
+            head = os.pread(fd, 8, pos)
+            if len(head) < 8:
+                return None if not parts else b''.join(parts)
+            magic, lrec = struct.unpack('<II', head)
+            if magic != _MAGIC:
+                raise IOError('Invalid RecordIO magic in %s' % self.uri)
+            cflag, length = _decode_lrec(lrec)
+            pos += 8
+            data = os.pread(fd, length, pos)
+            while len(data) < length:
+                more = os.pread(fd, length - len(data), pos + len(data))
+                if not more:
+                    raise IOError('Truncated record in %s' % self.uri)
+                data += more
+            pos += length + ((4 - length % 4) % 4)
+            parts.append(data)
+            if cflag in (_CFLAG_WHOLE, _CFLAG_END):
+                return b''.join(parts)
+
     def read_idx(self, idx):
-        with self._lock:
-            self.seek(idx)
-            return self.read()
+        return self.read_at(self.idx[idx])
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

@@ -1,0 +1,482 @@
+"""Parallel host decode pipeline tests (image.ImageIter
+preprocess_threads / MXNET_TPU_DECODE_WORKERS; reference
+src/io/iter_image_recordio.cc semantics): deterministic in-order
+reassembly, per-sample seeded augmentation streams, sharding,
+shutdown, and failure propagation."""
+import random as pyrandom
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, profiler, recordio
+
+
+def _make_img(h, w, seed):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def _encode(img):
+    import cv2
+    ret, buf = cv2.imencode('.png', img)
+    assert ret
+    return buf.tobytes()
+
+
+def _write_rec(tmp_path, n=22, size=33):
+    prefix = str(tmp_path / 'data')
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    for i in range(n):
+        img = _make_img(size, size + 4, seed=i)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        rec.write_idx(i, recordio.pack(header, _encode(img)))
+    rec.close()
+    return prefix
+
+
+def _epoch(it, reset=True):
+    """Materialize one epoch as [(data, label, pad), ...]."""
+    if reset:
+        it.reset()
+    out = []
+    while True:
+        try:
+            b = it.next()
+        except StopIteration:
+            break
+        out.append((b.data[0].asnumpy(), b.label[0].asnumpy(), b.pad))
+    return out
+
+
+def _assert_epochs_equal(a, b):
+    assert len(a) == len(b)
+    for (da, la, pa), (db, lb, pb) in zip(a, b):
+        np.testing.assert_array_equal(da, db)
+        np.testing.assert_array_equal(la, lb)
+        assert pa == pb
+
+
+def _decode_threads():
+    return [t for t in threading.enumerate()
+            if 'decode' in t.name and t.is_alive()]
+
+
+def test_parallel_matches_sequential_deterministic_augs(tmp_path):
+    """No random augs: parallel output is bit-identical to the
+    sequential iterator batch-for-batch, including the padded final
+    partial batch."""
+    prefix = _write_rec(tmp_path, n=22)
+    seq = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                          path_imgrec=prefix + '.rec',
+                          preprocess_threads=0)
+    par = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                          path_imgrec=prefix + '.rec',
+                          preprocess_threads=3)
+    a, b = _epoch(seq), _epoch(par)
+    assert len(a) == 6 and a[-1][2] == 2     # 22 = 5*4 + 2 -> pad 2
+    _assert_epochs_equal(a, b)
+    # a second epoch from the pool matches the sequential one too
+    _assert_epochs_equal(_epoch(seq), _epoch(par))
+    par.close()
+
+
+def test_workers1_is_the_sequential_path(tmp_path):
+    """preprocess_threads=1 takes the pre-pipeline code path: with
+    random augs and the same python-random seed it is bit-identical to
+    preprocess_threads=0 (the acceptance bar for workers=1)."""
+    prefix = _write_rec(tmp_path, n=12)
+
+    def run(workers):
+        pyrandom.seed(11)
+        mx.random.seed(11)
+        it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                             path_imgrec=prefix + '.rec',
+                             rand_crop=True, rand_mirror=True,
+                             preprocess_threads=workers)
+        return _epoch(it)
+
+    _assert_epochs_equal(run(0), run(1))
+
+
+def test_determinism_across_worker_counts(tmp_path):
+    """Random augs: a fixed mx.random.seed gives the SAME epoch for any
+    parallel worker count (per-sample streams are keyed on epoch
+    position, not on worker identity)."""
+    prefix = _write_rec(tmp_path, n=18)
+
+    def run(workers):
+        mx.random.seed(42)
+        it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                             path_imgrec=prefix + '.rec',
+                             rand_crop=True, rand_mirror=True,
+                             preprocess_threads=workers)
+        ep = _epoch(it)
+        it.close()
+        return ep
+
+    e2 = run(2)
+    _assert_epochs_equal(e2, run(5))
+    _assert_epochs_equal(e2, run(8))
+    # and it IS random: a different seed changes the epoch
+    mx.random.seed(43)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec', rand_crop=True,
+                         rand_mirror=True, preprocess_threads=2)
+    other = _epoch(it)
+    it.close()
+    assert not all(np.array_equal(x[0], y[0])
+                   for x, y in zip(e2, other))
+
+
+def test_epochs_advance_augmentation_streams(tmp_path):
+    """Consecutive epochs draw different augmentations (streams are
+    keyed on the epoch counter), and re-seeding reproduces epoch 0."""
+    prefix = _write_rec(tmp_path, n=12)
+    mx.random.seed(7)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec', rand_crop=True,
+                         rand_mirror=True, preprocess_threads=3)
+    e0, e1 = _epoch(it), _epoch(it)
+    assert not all(np.array_equal(x[0], y[0]) for x, y in zip(e0, e1))
+    it.close()
+    mx.random.seed(7)
+    it2 = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                          path_imgrec=prefix + '.rec', rand_crop=True,
+                          rand_mirror=True, preprocess_threads=4)
+    _assert_epochs_equal(e0, _epoch(it2))
+    it2.close()
+
+
+def test_worker_exception_propagates(tmp_path):
+    """A record the workers cannot decode re-raises at next()."""
+    prefix = str(tmp_path / 'bad')
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    for i in range(8):
+        if i == 5:
+            payload = b'this is not an image'
+        else:
+            payload = _encode(_make_img(16, 16, i))
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), payload))
+    rec.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec',
+                         preprocess_threads=3)
+    with pytest.raises(Exception) as excinfo:
+        _epoch(it)
+    assert 'decode' in str(excinfo.value).lower()
+    it.close()
+    assert not _decode_threads()
+
+
+def test_shutdown_leaves_no_live_threads(tmp_path):
+    prefix = _write_rec(tmp_path, n=12)
+    before = set(_decode_threads())
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec',
+                         preprocess_threads=4)
+    it.next()
+    assert len(set(_decode_threads()) - before) == 4
+    it.close()
+    assert not set(_decode_threads()) - before
+    # close() is not terminal: the pool restarts on demand
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 16, 16)
+    it.close()
+    assert not set(_decode_threads()) - before
+
+
+def test_del_joins_workers(tmp_path):
+    """Dropping the iterator (no explicit close) must still reap the
+    pool: workers hold the sample source, never the iterator."""
+    import gc
+    prefix = _write_rec(tmp_path, n=12)
+    before = set(_decode_threads())
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec',
+                         preprocess_threads=3)
+    it.next()
+    del it
+    gc.collect()
+    deadline = [t for t in set(_decode_threads()) - before]
+    for t in deadline:
+        t.join(timeout=5)
+    assert not set(_decode_threads()) - before
+
+
+def test_num_parts_sharding_disjoint(tmp_path):
+    """num_parts partitions stay disjoint under the parallel pool and
+    cover the same records as the sequential shards."""
+    prefix = _write_rec(tmp_path, n=20)
+    labels = {}
+    for part in (0, 1):
+        it = image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                             path_imgrec=prefix + '.rec', num_parts=2,
+                             part_index=part, preprocess_threads=3)
+        labels[part] = np.concatenate(
+            [lab[:2 - pad if pad else 2] for _, lab, pad in _epoch(it)])
+        it.close()
+    assert len(labels[0]) == len(labels[1]) == 10
+    assert not set(labels[0]) & set(labels[1])
+    assert sorted(set(labels[0]) | set(labels[1])) == list(range(20))
+
+
+def test_host_sharding_env(tmp_path, monkeypatch):
+    """MXNET_TPU_HOST_SHARD composes with num_parts: each virtual host
+    decodes a disjoint slice; the union matches the full dataset."""
+    prefix = _write_rec(tmp_path, n=16)
+    full = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                           path_imgrec=prefix + '.rec',
+                           preprocess_threads=0)
+    ref = {}
+    for data, lab, pad in _epoch(full):
+        for row, y in zip(data, lab):
+            ref[float(y)] = row
+    shards = {}
+    for host in (0, 1):
+        monkeypatch.setenv('MXNET_TPU_HOST_SHARD', '%d/2' % host)
+        it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                             path_imgrec=prefix + '.rec',
+                             preprocess_threads=2)
+        shards[host] = {}
+        for data, lab, pad in _epoch(it):
+            for row, y in zip(data, lab):
+                shards[host][float(y)] = row
+        it.close()
+    assert len(shards[0]) == len(shards[1]) == 8
+    assert not set(shards[0]) & set(shards[1])
+    merged = dict(shards[0])
+    merged.update(shards[1])
+    assert set(merged) == set(ref)
+    for y, row in merged.items():
+        np.testing.assert_array_equal(row, ref[y])     # batch parity
+
+
+def test_image_det_iter_parallel(tmp_path):
+    """ImageDetIter runs through the pool: parity with the sequential
+    detection pipeline (deterministic augs) incl. padded label rows."""
+    import cv2
+    prefix = str(tmp_path / 'det')
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = rng.randint(0, 255, (48, 48, 3)).astype(np.uint8)
+        ret, buf = cv2.imencode('.png', img)
+        nobj = 1 + i % 3
+        label = [2, 5]
+        for j in range(nobj):
+            label += [float(j % 4), 0.1, 0.1, 0.6, 0.6]
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, np.array(label, np.float32), i, 0),
+            buf.tobytes()))
+    rec.close()
+    seq = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                                path_imgrec=prefix + '.rec',
+                                preprocess_threads=0)
+    par = mx.image.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                                path_imgrec=prefix + '.rec',
+                                preprocess_threads=3)
+    assert par.max_objects == seq.max_objects == 3
+    _assert_epochs_equal(_epoch(seq), _epoch(par))
+    par.close()
+
+
+def test_det_iter_max_objects_agrees_across_shards(tmp_path):
+    """max_objects derives from the FULL dataset, not the local shard,
+    so partitioned/per-host iterators bind identical label shapes."""
+    import cv2
+    prefix = str(tmp_path / 'detshard')
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (24, 24, 3)).astype(np.uint8)
+        ret, buf = cv2.imencode('.png', img)
+        nobj = 4 if i >= 4 else 1   # big labels live in one half only
+        label = [2, 5]
+        for j in range(nobj):
+            label += [float(j), 0.1, 0.1, 0.6, 0.6]
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, np.array(label, np.float32), i, 0),
+            buf.tobytes()))
+    rec.close()
+    its = [mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                                 path_imgrec=prefix + '.rec',
+                                 num_parts=2, part_index=p)
+           for p in (0, 1)]
+    assert its[0].max_objects == its[1].max_objects == 4
+    assert its[0].provide_label[0].shape == its[1].provide_label[0].shape
+
+
+def _write_det_rec_n(prefix, n, nobj_fn):
+    import cv2
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (24, 24, 3)).astype(np.uint8)
+        ret, buf = cv2.imencode('.png', img)
+        label = [2, 5]
+        for j in range(nobj_fn(i)):
+            label += [float(j), 0.1, 0.1, 0.6, 0.6]
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, np.array(label, np.float32), i, 0),
+            buf.tobytes()))
+    rec.close()
+
+
+def test_det_sync_label_shape_mid_pool(tmp_path):
+    """Growing max_objects after the pool has staged samples discards
+    the old-shape staging and re-decodes with the new padding."""
+    pa = str(tmp_path / 'a')
+    pb = str(tmp_path / 'b')
+    _write_det_rec_n(pa, 12, lambda i: 2)
+    _write_det_rec_n(pb, 4, lambda i: 5)
+    ita = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                                path_imgrec=pa + '.rec',
+                                preprocess_threads=3)
+    itb = mx.image.ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                                path_imgrec=pb + '.rec')
+    first = ita.next()           # pool stages chunks padded to 2
+    assert first.label[0].shape == (2, 2, 5)
+    ita.sync_label_shape(itb)
+    assert ita.max_objects == 5
+    nxt = ita.next()             # staged old-shape samples discarded
+    assert nxt.label[0].shape == (2, 5, 5)
+    ita.close()
+
+
+def test_image_record_iter_python_pipeline(tmp_path):
+    """ImageRecordIter's python fallback threads preprocess_threads
+    through to the decode pool (stacked under PrefetchingIter)."""
+    prefix = _write_rec(tmp_path, n=12, size=30)
+    it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + '.rec', data_shape=(3, 24, 24),
+        batch_size=3, shuffle=False, use_native=False,
+        preprocess_threads=3)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 3, 24, 24)
+    it._inner.close()
+
+
+def test_profiler_input_counters(tmp_path):
+    prefix = _write_rec(tmp_path, n=12)
+    profiler.clear()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec',
+                         preprocess_threads=3)
+    _epoch(it)
+    it.close()
+    st = profiler.input_stats()
+    assert st['decoded_samples'] >= 12
+    assert st['decode_ms'] > 0
+    assert st['queue_depth_obs'] > 0
+    text = profiler.summary(print_out=False)
+    assert 'decode_ms' in text and 'queue_depth_avg' in text
+
+
+def test_prefetch_to_device_feeds_stall_counter():
+    profiler.clear()
+    X = np.random.RandomState(0).rand(8, 3).astype(np.float32)
+    y = np.arange(8, dtype=np.float32)
+    src = mx.io.NDArrayIter(X, y, batch_size=4)
+    pf = mx.io.prefetch_to_device(src, size=2)
+    list(pf)
+    st = profiler.input_stats()
+    assert st['input_batches'] == 2
+    assert st['input_stall_ms'] >= 0
+
+
+def test_fit_auto_wires_decode_workers(tmp_path, monkeypatch):
+    """Module._wrap_train_iter upgrades a default-constructed ImageIter
+    to the env's worker count (explicit preprocess_threads wins)."""
+    prefix = _write_rec(tmp_path, n=12)
+    monkeypatch.delenv('MXNET_TPU_DECODE_WORKERS', raising=False)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                         path_imgrec=prefix + '.rec')
+    assert it.preprocess_threads == 0 and it._workers_explicit is False
+    monkeypatch.setenv('MXNET_TPU_DECODE_WORKERS', '3')
+    from mxnet_tpu import sym as S
+    net = S.SoftmaxOutput(S.FullyConnected(S.Variable('data'),
+                                           num_hidden=4), name='softmax')
+    mod = mx.mod.Module(net)
+    wrapped = mod._wrap_train_iter(it)
+    assert it.preprocess_threads == 3
+    # explicit worker counts are left alone
+    it2 = image.ImageIter(batch_size=4, data_shape=(3, 16, 16),
+                          path_imgrec=prefix + '.rec',
+                          preprocess_threads=0)
+    it2._workers_explicit = True
+    mod._wrap_train_iter(it2)
+    assert it2.preprocess_threads == 0
+    del wrapped
+    it.close()
+
+
+def test_seed_generation_counter_reaches_running_threads():
+    """random.seed() re-derives streams in threads that already drew
+    (the generation-counter satellite)."""
+    from mxnet_tpu import random as mxrandom
+    import jax
+    results = {}
+    gate_drawn = threading.Event()
+    gate_reseeded = threading.Event()
+
+    def worker():
+        results['first'] = np.asarray(mxrandom.next_key())
+        gate_drawn.set()
+        assert gate_reseeded.wait(10)
+        # after the main thread reseeded, this thread's NEXT draw must
+        # restart from the new seed, not continue its old stream
+        results['second'] = np.asarray(mxrandom.next_key())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    assert gate_drawn.wait(10)
+    mxrandom.seed(12345)
+    expected = np.asarray(jax.random.split(jax.random.PRNGKey(12345))[1])
+    gate_reseeded.set()
+    t.join(10)
+    np.testing.assert_array_equal(results['second'], expected)
+
+
+def test_stream_seed_reproducible():
+    from mxnet_tpu import random as mxrandom
+    mxrandom.seed(5)
+    a = mxrandom.stream_seed('image-aug', 0, 3)
+    assert a == mxrandom.stream_seed('image-aug', 0, 3)
+    assert a != mxrandom.stream_seed('image-aug', 0, 4)
+    assert a != mxrandom.stream_seed('image-aug', 1, 3)
+    mxrandom.seed(6)
+    assert a != mxrandom.stream_seed('image-aug', 0, 3)
+    mxrandom.seed(5)
+    assert a == mxrandom.stream_seed('image-aug', 0, 3)
+
+
+def test_recordio_read_at_concurrent(tmp_path):
+    """read_idx is positional (os.pread): concurrent readers through
+    ONE handle see correct records and the cursor never moves."""
+    prefix = _write_rec(tmp_path, n=16)
+    rec = recordio.MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'r')
+    errors = []
+
+    def hammer(worker_seed):
+        order = list(rec.keys)
+        pyrandom.Random(worker_seed).shuffle(order)
+        try:
+            for k in order * 4:
+                header, _ = recordio.unpack(rec.read_idx(k))
+                if float(header.label) != float(k):
+                    errors.append((k, float(header.label)))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert not errors
+    rec.close()

@@ -52,7 +52,13 @@ def main():
         if proc.returncode != 0:
             sys.stderr.write(proc.stderr)
             raise RuntimeError('%s failed' % name)
-        print(proc.stdout.strip().splitlines()[-1], flush=True)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            # a zero-exit child that printed nothing has no JSON to
+            # relay — treat it as a failure, not an IndexError
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('%s produced no output' % name)
+        print(lines[-1], flush=True)
 
 
 if __name__ == '__main__':
