@@ -30,6 +30,10 @@ through the parallel host decode pool — BENCH_DECODE_WORKERS /
 MXNET_TPU_DECODE_WORKERS sets the worker count, default 8, and the
 JSON's input_stall_ms_per_step shows whether the pipeline keeps the
 chip fed; BENCH_REC_IMAGES sizes the dataset),
+BENCH_INFER=serve (serving mode: measure the dynamic-batching
+InferenceEngine against serial per-request Predictor.forward and emit
+a throughput + latency-percentile JSON line instead of the training
+bench — see serve_bench() / tools/serve_bench.py for the knobs),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -259,6 +263,214 @@ def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
                       batch, steps, warmup, bulk, dtype)['ips']
 
 
+# ---------------------------------------------------------------------------
+# BENCH_INFER=serve: dynamic-batching inference engine vs serial predict
+# ---------------------------------------------------------------------------
+
+def _serve_symbol(hidden, classes, dim):
+    """CPU-sized serving workload: a small MLP (the serving engine's
+    mechanics — coalescing, padding, slicing, staging — are model-size
+    independent; the rig has no TPU, so the smoke must stay tiny)."""
+    from mxnet_tpu import sym
+    data = sym.Variable('data')
+    x = sym.Activation(sym.FullyConnected(data, num_hidden=hidden,
+                                          name='fc1'), act_type='relu')
+    x = sym.Activation(sym.FullyConnected(x, num_hidden=hidden,
+                                          name='fc2'), act_type='relu')
+    x = sym.FullyConnected(x, num_hidden=classes, name='fc3')
+    return sym.SoftmaxOutput(x, name='softmax')
+
+
+def serve_bench():
+    """BENCH_INFER=serve: measure the dynamic-batching InferenceEngine
+    (mxnet_tpu/serving.py) against serial per-request Predictor.forward
+    on the same request stream, and emit ONE JSON line with request
+    throughput, latency percentiles, fill/pad-waste, and the
+    zero-compile steady-state check (exec_cache misses after warmup).
+
+    Closed loop: BENCH_SERVE_CLIENTS client threads (default 8) each
+    issue BENCH_SERVE_REQS single-row requests back-to-back (a new
+    request the moment the previous answer lands).  The serial
+    baseline runs the IDENTICAL client loop against the pre-engine
+    serving story: per-request Predictor.forward behind one lock
+    (forward is set-input-then-run on shared executor state, so
+    concurrent callers must serialize — that lock is what the engine
+    replaces).  A 1-thread serial pass is also timed and reported
+    (serial_rps_1thread) so the client-contention cost is visible.
+    Parity: engine answers must match the serial answers
+    (same-bucket co-batching is bit-exact; across gemm shapes XLA
+    differs at float rounding, so the gate is atol 1e-5 with the
+    measured max reported).
+
+    Knobs: BENCH_SERVE_CLIENTS (8), BENCH_SERVE_REQS (per client, 100),
+    BENCH_SERVE_PASSES (best-of passes per arm, 7),
+    BENCH_SERVE_MAX_BATCH (= clients), BENCH_SERVE_WAIT_US (2000),
+    BENCH_SERVE_DIM (256), BENCH_SERVE_HIDDEN (256 — enough
+    per-request compute that dispatch amortization dominates noise;
+    the whole smoke stays a few seconds per pass),
+    BENCH_SERVE_MIXED=1 (alternate two request widths; the narrow one
+    zero-pads up the free-dim bucket — the shape-bucket story under
+    mixed traffic).
+    """
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import profiler
+    from mxnet_tpu.predictor import Predictor
+
+    # both arms are thread-ping-pong-bound on a CPU rig; the default
+    # 5ms GIL switch interval adds multi-ms scheduling bubbles to
+    # every client wakeup, swamping the sub-ms dispatch being measured
+    sys.setswitchinterval(0.001)
+
+    clients = int(os.environ.get('BENCH_SERVE_CLIENTS', 8))
+    reqs_per_client = int(os.environ.get('BENCH_SERVE_REQS', 100))
+    max_batch = int(os.environ.get('BENCH_SERVE_MAX_BATCH', clients))
+    wait_us = int(os.environ.get('BENCH_SERVE_WAIT_US', 2000))
+    dim = int(os.environ.get('BENCH_SERVE_DIM', 256))
+    hidden = int(os.environ.get('BENCH_SERVE_HIDDEN', 256))
+    classes = 16
+    mixed = os.environ.get('BENCH_SERVE_MIXED', '0') == '1'
+
+    rng = np.random.RandomState(11)
+    net = _serve_symbol(hidden, classes, dim)
+    probe = net.simple_bind(mx.cpu(), grad_req='null', data=(1, dim))
+    args = {k: mx.nd.array(rng.randn(*v.shape).astype(np.float32) * 0.1)
+            for k, v in probe.arg_dict.items() if k != 'data'}
+    pred = Predictor(symbol=net, arg_params=args,
+                     input_shapes={'data': (1, dim)})
+
+    n_total = clients * reqs_per_client
+    dims = [dim] * n_total
+    if mixed:
+        # two free-dim rungs; the narrow one zero-pads up to `dim`,
+        # which this MLP treats as extra zero features (value-neutral)
+        dims = [dim if i % 2 == 0 else dim // 2 for i in range(n_total)]
+    requests = [rng.randn(1, d).astype(np.float32) for d in dims]
+
+    def run_clients(serve_one):
+        """The closed loop both arms share: `clients` threads, each
+        issuing its requests back-to-back.  Returns elapsed seconds."""
+        errors = []
+
+        def client(c):
+            try:
+                for j in range(reqs_per_client):
+                    serve_one(c * reqs_per_client + j)
+            except Exception as e:   # surface, don't hang the join
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(clients)]
+        tic = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.time() - tic
+        if errors:
+            raise errors[0]
+        return elapsed
+
+    # -- serial baseline: per-request forward behind one lock -----------
+    # (run FIRST so its own first-shape compiles don't pollute the
+    # engine's post-warmup zero-compile accounting)
+    serial_out = [None] * n_total
+    serial_lock = threading.Lock()
+
+    def serial_one(i):
+        a = requests[i]
+        if a.shape[1] != dim:
+            # narrow request: the model's input width is fixed, so the
+            # serial server zero-pads too (value-neutral for this MLP —
+            # exactly what the engine's free-dim bucket does)
+            buf = np.zeros((1, dim), np.float32)
+            buf[:, :a.shape[1]] = a
+            a = buf
+        with serial_lock:
+            serial_out[i] = pred.forward(data=a)[0].asnumpy()
+
+    serial_one(0)                     # warmup outside the clock
+    tic = time.time()
+    for i in range(n_total):
+        serial_one(i)
+    serial_1thread_rps = n_total / (time.time() - tic)
+
+    # -- engine: the same closed loop, coalesced dispatches -------------
+    # (mixed mode opts into free-dim zero-padding with ONE rung at
+    # the model's bound width — value-neutral for an MLP, padded
+    # features multiply zero weights; a narrower graph rung would be
+    # a different model, fc1_weight binds at the rung width.  The
+    # default engine keeps the serial exact-shape contract and would
+    # reject the narrow requests.)
+    eng = pred.serve(max_batch=max_batch, max_wait_us=wait_us,
+                     **({'free_dim_buckets': [((dim,),)]} if mixed
+                        else {}))
+    stats0 = profiler.exec_cache_stats()
+    engine_out = [None] * n_total
+
+    def engine_one(i):
+        engine_out[i] = eng.predict(requests[i])
+
+    # the rig runs under cpu-shares throttling whose multi-second
+    # bursts swing any single pass by ~2x, so the arms run
+    # BENCH_SERVE_PASSES times INTERLEAVED (serial, engine, serial,
+    # ...) and each reports its best pass — peak vs peak sampled from
+    # the same throttle climate compares the serving mechanisms, not
+    # the throttle phase.  (Serial passes after the engine exists
+    # compile nothing — the predictor's executor is long bound — so
+    # the zero-compile accounting from stats0 is undisturbed.)
+    passes = max(1, int(os.environ.get('BENCH_SERVE_PASSES', 7)))
+    serial_rps = engine_rps = 0.0
+    best_sv = None
+    for _ in range(passes):
+        serial_rps = max(serial_rps,
+                         n_total / run_clients(serial_one))
+        # the latency percentiles must be measured on the SAME pass as
+        # the throughput they sit beside: reset the profiler's serving
+        # window before each engine pass and keep the best pass's
+        # snapshot (a cumulative ring would pair best-of throughput
+        # with latencies dominated by the throttled passes;
+        # exec_cache_stats reads through to exec_cache, so the
+        # zero-compile accounting is untouched by clear())
+        profiler.clear()
+        rps = n_total / run_clients(engine_one)
+        if rps > engine_rps:
+            engine_rps = rps
+            best_sv = profiler.serving_stats()
+    stats1 = profiler.exec_cache_stats()
+    est = eng.stats()
+    eng.close()
+
+    max_diff = max(float(np.abs(engine_out[i] - serial_out[i]).max())
+                   for i in range(n_total))
+    print(json.dumps({
+        'metric': 'serve_throughput',
+        'value': round(engine_rps, 2),
+        'unit': 'requests/sec',
+        'serial_rps': round(serial_rps, 2),
+        'serial_rps_1thread': round(serial_1thread_rps, 2),
+        'speedup_vs_serial': round(engine_rps / serial_rps, 3),
+        'speedup_vs_1thread': round(engine_rps / serial_1thread_rps, 3),
+        'clients': clients,
+        'requests': n_total,
+        'max_batch': max_batch,
+        'max_wait_us': wait_us,
+        'mixed_shapes': mixed,
+        'batch_buckets': list(eng.batch_buckets),
+        'p50_ms': round(best_sv['serve_latency_p50_ms'], 3),
+        'p99_ms': round(best_sv['serve_latency_p99_ms'], 3),
+        'batch_fill_avg': round(est['batch_fill_avg'], 3),
+        'pad_waste_frac': round(est['pad_waste_frac'], 3),
+        'queue_depth_avg': round(best_sv['serve_queue_depth_avg'], 2),
+        'exec_cache_misses_after_warmup':
+            stats1['exec_cache_misses'] - stats0['exec_cache_misses'],
+        'compiles_after_warmup': est['compiles_after_warmup'],
+        'parity_max_abs_diff': max_diff,
+        'parity_ok': bool(max_diff < 1e-5),
+    }))
+
+
 def is_oom(text):
     return 'RESOURCE_EXHAUSTED' in text or 'Out of memory' in text
 
@@ -313,6 +525,9 @@ def main():
 
 
 def _bench_main():
+    if os.environ.get('BENCH_INFER', '') == 'serve':
+        serve_bench()   # dynamic-batching inference engine bench
+        return
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \
         else list(BATCH_LADDER.get(model_env, (256, 128, 64)))

@@ -57,6 +57,7 @@ from . import executor_manager
 from . import torch_bridge
 from . import torch_bridge as th
 from . import predictor
+from . import serving
 from . import pallas_ops
 from .model import FeedForward
 from . import recordio

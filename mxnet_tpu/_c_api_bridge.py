@@ -237,6 +237,16 @@ def list_op_names():
     return [str(n) for n in _reg.list_ops()]
 
 
+def op_registry_generation():
+    """Live registry generation stamp.  The C introspection caches
+    (MXTListOpNames / MXTOpGetInfo) poll this and rebuild when it
+    changes, so runtime-registered ops appear instead of a stale
+    first-call snapshot.  A mutation counter, not a cardinality:
+    RE-registering an existing name (same dict sizes, new inputs)
+    also invalidates."""
+    return _reg.generation()
+
+
 def op_info(name):
     """-> flat string list [canonical_name, description, in0, in1, ...]
     (reference MXSymbolGetAtomicSymbolInfo).  Input names for ops whose

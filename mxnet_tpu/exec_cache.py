@@ -201,6 +201,46 @@ def stats():
         return dict(_STATS)
 
 
+# ---------------------------------------------------------------------------
+# serving bucket ladder
+# ---------------------------------------------------------------------------
+# The serving engine (serving.py) pads requests up to a ladder of
+# bucket shapes; each rung binds its own executor, whose graph
+# signature (shape included) is its cache identity — warming the
+# ladder populates this cache, and steady-state traffic then reuses
+# the rungs with ZERO new compilations.  The helpers below are the
+# ladder's shared vocabulary so predictor.export_compiled and
+# serving.InferenceEngine key identically.
+
+def batch_ladder(max_batch, min_batch=1):
+    """Default batch-dim bucket ladder: powers of two from min_batch
+    up to and including max_batch (always included even when not a
+    power of two)."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise ValueError('max_batch must be >= 1')
+    out = []
+    b = max(1, int(min_batch))
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+def serve_step_key(sig, input_names=()):
+    """Cache key of one bucket rung's donated serve program (the
+    forward-only jit serving.py dispatches).  `sig` is the bucket
+    executor's graph signature — shape-distinct per rung, so rungs
+    never alias and an equivalent engine re-creation hits every
+    entry.  `input_names` is the engine's input ORDER: the signature
+    deliberately alpha-renames variable names away, but the serve
+    closure bakes the data_vals->argument mapping in, so engines over
+    the same graph with differently-ordered data_names must not share
+    a program (they'd silently swap inputs)."""
+    return (sig, 'serve_step', tuple(input_names))
+
+
 def clear(reset_stats=True):
     """Drop every cached executable (tests / memory pressure)."""
     with _LOCK:

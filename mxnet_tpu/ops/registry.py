@@ -233,6 +233,19 @@ class OpDef:
 
 _OP_REGISTRY = {}
 _OP_ALIASES = {}
+# bumped on every register() call (including RE-registration of an
+# existing name, which leaves the dict sizes unchanged) — consumers
+# caching registry-derived data key on generation(), not on len()
+_GENERATION = [0]
+
+
+def generation():
+    """Monotonic registry mutation stamp: changes whenever register()
+    runs.  The dict sizes are folded in only as a weak tripwire for
+    direct del/pop edits (tests) — a size-compensating direct
+    mutation (pop one name, insert another) is NOT detected; mutate
+    through register() for the stamp to advance."""
+    return (_GENERATION[0] << 20) + len(_OP_REGISTRY) + len(_OP_ALIASES)
 
 
 def register(name, input_names=('data',), num_aux=0, num_outputs=1,
@@ -271,6 +284,7 @@ def register(name, input_names=('data',), num_aux=0, num_outputs=1,
         _OP_REGISTRY[name] = op
         for alias in aliases:
             _OP_ALIASES[alias] = name
+        _GENERATION[0] += 1
         fn.op = op
         return fn
     return do_register

@@ -92,7 +92,10 @@ class Predictor(object):
         return out[0].asnumpy()
 
     def reshape(self, input_shapes):
-        """MXPredReshape: rebind for new input shapes sharing weights."""
+        """MXPredReshape: rebind for new input shapes sharing weights.
+        Rebinding makes a live InferenceEngine over this predictor
+        stale (its rung executors keep the pre-reshape arrays):
+        close() and re-create the engine afterwards."""
         arg_params = {k: v for k, v in self._executor.arg_dict.items()
                       if k not in self._input_names}
         aux_params = dict(self._executor.aux_dict)
@@ -103,17 +106,53 @@ class Predictor(object):
                              if n in dict(input_shapes)]
         return self
 
-    # -- TPU-native deployment extra ---------------------------------------
-    def export_compiled(self):
+    # -- TPU-native serving / deployment extras ----------------------------
+    def serve(self, **engine_kwargs):
+        """Wrap this predictor in a `serving.InferenceEngine`: a
+        dynamic batcher over a shape-bucket ladder that coalesces
+        concurrent `infer()` calls into padded device dispatches with
+        zero steady-state XLA compiles (the serving counterpart of the
+        reference's one-request-at-a-time MXPredForward).  Keyword
+        args forward to InferenceEngine (max_batch, max_wait_us,
+        batch_buckets, free_dim_buckets, ...); the ladder is AOT-warmed
+        before this returns unless warmup=False."""
+        from .serving import InferenceEngine
+        return InferenceEngine(self, **engine_kwargs)
+
+    def export_compiled(self, batch_buckets=None):
         """AOT-lower the forward into a serialized XLA executable
         (StableHLO text + compiled binary when supported) — the
         amalgamation/mobile-deploy counterpart (SURVEY.md §2.8).
         The compiled module is shared through the process-wide
         compiled-program cache, so repeated exports (or exports of an
-        equivalently-bound predictor) pay one compile."""
+        equivalently-bound predictor) pay one compile.
+
+        With `batch_buckets` (a sequence of batch sizes, e.g. the
+        serving engine's ladder) the export is bucket-aware: one
+        artifact per rung, each cached in exec_cache under that
+        rung's graph signature (the same shape-distinct identity the
+        serving engine derives its program keys from, with an
+        export-specific tag — repeated exports of a rung are free,
+        but an export does NOT pre-warm an engine's serve programs) —
+        returns {batch: artifact_dict}.  Rung executors share this
+        predictor's weight arrays (no parameter copies)."""
+        if batch_buckets is not None:
+            out = {}
+            for b in sorted(set(int(x) for x in batch_buckets)):
+                shapes = {
+                    n: (b,) + tuple(self._executor.arg_dict[n].shape[1:])
+                    for n in self._input_names}
+                ex = self._symbol.simple_bind(
+                    self._ctx, grad_req='null',
+                    shared_exec=self._executor, **shapes)
+                out[b] = self._export_one(ex)
+            return out
+        return self._export_one(self._executor)
+
+    @staticmethod
+    def _export_one(ex):
         import jax
         from . import exec_cache
-        ex = self._executor
         # the export is weight-independent (params are runtime args of
         # the lowered function), so the whole result — StableHLO text
         # AND compiled text — is deterministic per graph signature and

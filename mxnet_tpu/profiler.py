@@ -20,6 +20,8 @@ import os
 import threading
 import time
 
+import numpy as np
+
 _STATE = {
     'mode': 'symbolic',        # 'symbolic' | 'all'
     'filename': 'profile.json',
@@ -83,6 +85,76 @@ def input_stats():
     out['input_stall_ms_per_batch'] = (out['input_stall_ms'] /
                                        out['input_batches']
                                        if out['input_batches'] else 0.0)
+    return out
+
+
+# serving-engine counters (serving.InferenceEngine's dynamic batcher):
+# coalesced dispatches, batch fill / pad waste, batcher queue depth
+# observations, and a bounded ring of request latencies for p50/p99
+_SERVING = {
+    'serve_requests': 0,
+    'serve_batches': 0,
+    'serve_rows': 0,
+    'serve_padded_rows': 0,
+    'serve_fill_sum': 0.0,
+    'serve_pad_elem_frac_sum': 0.0,
+    'serve_queue_depth_sum': 0,
+    'serve_queue_depth_obs': 0,
+}
+_SERVE_LAT_CAP = 8192
+_SERVE_LAT = []                 # ring buffer of request latencies (ms)
+_SERVE_LAT_POS = [0]
+
+
+def add_serving_stats(requests=0, batches=0, rows=0, padded_rows=0,
+                      fill=None, pad_elem_frac=None, queue_depth=None,
+                      latencies_ms=()):
+    """Accumulate serving counters (the engine's completion thread
+    feeds one call per coalesced dispatch)."""
+    with _STATE['lock']:
+        _SERVING['serve_requests'] += requests
+        _SERVING['serve_batches'] += batches
+        _SERVING['serve_rows'] += rows
+        _SERVING['serve_padded_rows'] += padded_rows
+        if fill is not None:
+            _SERVING['serve_fill_sum'] += float(fill)
+        if pad_elem_frac is not None:
+            _SERVING['serve_pad_elem_frac_sum'] += float(pad_elem_frac)
+        if queue_depth is not None:
+            _SERVING['serve_queue_depth_sum'] += int(queue_depth)
+            _SERVING['serve_queue_depth_obs'] += 1
+        for lat in latencies_ms:
+            if len(_SERVE_LAT) < _SERVE_LAT_CAP:
+                _SERVE_LAT.append(float(lat))
+            else:   # overwrite oldest: percentiles track recent traffic
+                _SERVE_LAT[_SERVE_LAT_POS[0]] = float(lat)
+                _SERVE_LAT_POS[0] = (_SERVE_LAT_POS[0] + 1) \
+                    % _SERVE_LAT_CAP
+
+
+def serving_stats():
+    """Snapshot of the serving counters plus derived means and request
+    latency percentiles (serve_latency_p50_ms / p99; 0.0 when no
+    requests were served)."""
+    with _STATE['lock']:
+        out = dict(_SERVING)
+        lats = list(_SERVE_LAT)
+    b = out.pop('serve_fill_sum'), out.pop('serve_pad_elem_frac_sum')
+    nb = out['serve_batches']
+    out['serve_batch_fill_avg'] = b[0] / nb if nb else 0.0
+    out['serve_pad_elem_frac_avg'] = b[1] / nb if nb else 0.0
+    qs = out.pop('serve_queue_depth_sum')
+    qo = out.pop('serve_queue_depth_obs')
+    out['serve_queue_depth_avg'] = qs / qo if qo else 0.0
+    total = out['serve_rows'] + out['serve_padded_rows']
+    out['serve_pad_waste_frac'] = \
+        out['serve_padded_rows'] / total if total else 0.0
+    if lats:
+        out['serve_latency_p50_ms'] = float(np.percentile(lats, 50))
+        out['serve_latency_p99_ms'] = float(np.percentile(lats, 99))
+    else:
+        out['serve_latency_p50_ms'] = 0.0
+        out['serve_latency_p99_ms'] = 0.0
     return out
 
 
@@ -156,6 +228,8 @@ def dump_profile():
                    'args': comm_stats()})
     events.append({'ph': 'M', 'name': 'input_pipeline', 'pid': 0,
                    'args': input_stats()})
+    events.append({'ph': 'M', 'name': 'serving', 'pid': 0,
+                   'args': serving_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -246,6 +320,17 @@ def summary(print_out=True):
                  % (ip['decode_ms'], ip['decoded_samples'],
                     ip['decode_wait_ms'], ip['queue_depth_avg'],
                     ip['input_stall_ms_per_batch']))
+    sv = serving_stats()
+    lines.append('  serve_requests=%d serve_batches=%d '
+                 'serve_queue_depth_avg=%.2f serve_batch_fill_avg=%.2f '
+                 'serve_pad_waste_frac=%.3f serve_latency_p50_ms=%.3f '
+                 'serve_latency_p99_ms=%.3f'
+                 % (sv['serve_requests'], sv['serve_batches'],
+                    sv['serve_queue_depth_avg'],
+                    sv['serve_batch_fill_avg'],
+                    sv['serve_pad_waste_frac'],
+                    sv['serve_latency_p50_ms'],
+                    sv['serve_latency_p99_ms']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -276,6 +361,10 @@ def clear():
             _COMM[k] = 0
         for k in _INPUT:
             _INPUT[k] = type(_INPUT[k])()
+        for k in _SERVING:
+            _SERVING[k] = type(_SERVING[k])()
+        del _SERVE_LAT[:]
+        _SERVE_LAT_POS[0] = 0
 
 
 class scope(object):

@@ -1,0 +1,858 @@
+"""Dynamic-batching inference engine: shape-bucketed, zero-recompile
+serving on top of Predictor/Module.
+
+The reference's predict API (src/c_predict_api.cc, SURVEY.md §2.6)
+serves one request per MXPredForward: every caller pays full dispatch
+latency and any new input shape recompiles.  `InferenceEngine` makes
+that contract fast under concurrent load with three mechanisms:
+
+  * **shape-bucket ladder** — requests are padded up to the nearest
+    configured bucket on the batch dim (and optionally on free dims),
+    so steady-state traffic only ever runs shapes that were AOT-warmed
+    through the process-wide compiled-program cache (exec_cache):
+    ZERO new XLA compilations after `warmup()`.
+  * **dynamic batcher** — a thread-safe queue coalesces concurrent
+    `infer()` calls into one padded device dispatch under a
+    `max_batch` / `max_wait_us` policy, then slices each request's
+    rows back out.  Within one bucket shape the slicing is BIT-exact:
+    a request's rows do not depend on what it was co-batched with
+    (row independence of the forward ops; verified by tests).  Across
+    *different* shapes XLA may pick different gemm strategies, so an
+    engine answer can differ from a serial `Predictor.forward` at the
+    request's own shape by float rounding (~1e-9 relative — measured;
+    docs/PERF.md round 9).
+  * **double-buffered device staging** — the dispatcher thread stages
+    batch N+1's H2D copy (io.stage_to_device, the same machinery as
+    io.prefetch_to_device) and enqueues its dispatch while the
+    completion thread is still draining batch N; the bounded in-flight
+    queue (depth 2) gives backpressure.  The per-bucket serve program
+    *donates* its input staging buffers, so XLA may reuse them for
+    scratch/output memory.
+
+Weights are shared by reference across every bucket executor (one copy
+in device memory, `simple_bind(shared_exec=...)`), so a ladder of B
+buckets costs B compiled programs but ~1x parameter memory.
+
+Serving counters (queue depth, batch fill, pad waste, request latency
+p50/p99) feed `profiler.serving_stats()` / `profiler.summary()` /
+`dump_profile` metadata.
+
+Typical use::
+
+    pred = Predictor.from_checkpoint('model', 42, {'data': (1, 128)})
+    eng = pred.serve(max_batch=8, max_wait_us=2000)   # warms the ladder
+    out = eng.predict(x)                              # thread-safe
+    eng.close()
+
+Env knobs (docs/PERF.md round 9):
+  MXNET_TPU_SERVE_MAX_BATCH     default max_batch (8)
+  MXNET_TPU_SERVE_WAIT_US       default max_wait_us (2000)
+"""
+import contextlib
+import os
+import threading
+import time
+import warnings
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from . import exec_cache
+from . import profiler
+from .base import MXNetError
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+class _Request(object):
+    """One infer() call in flight: host inputs, result slot, a done
+    event the caller blocks on."""
+    __slots__ = ('inputs', 'rows', 'free_shapes', 't_enq', 'event',
+                 'outputs', 'error')
+
+    def __init__(self, inputs, rows, free_shapes):
+        self.inputs = inputs            # list of np arrays, one per input
+        self.rows = rows
+        self.free_shapes = free_shapes  # tuple of shape[1:] per input
+        self.t_enq = time.perf_counter()
+        self.event = threading.Event()
+        self.outputs = None
+        self.error = None
+
+
+class _Program(object):
+    """One warmed (batch bucket x free bucket) rung: a forward-only
+    executor sharing the base weights plus its donated serve step."""
+    __slots__ = ('executor', 'serve_fn', 'weight_names', 'batch',
+                 'free_shapes', 'warmed')
+
+    def __init__(self, executor, serve_fn, weight_names, batch,
+                 free_shapes):
+        self.executor = executor
+        self.serve_fn = serve_fn
+        self.weight_names = weight_names
+        self.batch = batch
+        self.free_shapes = free_shapes
+        # flipped after the rung's first (compiling) call, under the
+        # engine's _prog_lock: a warmup() called on a live
+        # warmup=False engine runs concurrently with the dispatcher
+        self.warmed = False
+
+
+class InferenceEngine(object):
+    """Dynamic-batching, shape-bucketed server over a bound
+    Predictor or Module (forward only).
+
+    Parameters
+    ----------
+    source : Predictor or Module
+        Bound, parameter-initialized model.  The engine shares its
+        weight arrays by reference (no copy; later set_params calls
+        that write INTO the same NDArrays are picked up).  Anything
+        that REBINDS the source to new arrays — Predictor.reshape(),
+        Module.bind(force_rebind=True) — is invisible to the engine's
+        rung executors: close() and re-create the engine after such
+        calls (re-creation warms entirely from exec_cache).
+    max_batch : int
+        Largest coalesced dispatch (default MXNET_TPU_SERVE_MAX_BATCH
+        or 8).  Also the top rung of the default bucket ladder.
+    batch_buckets : sequence of int, optional
+        Explicit batch-dim ladder (sorted ascending).  Default:
+        powers of two up to max_batch (exec_cache.batch_ladder).
+    max_wait_us : int
+        How long the batcher holds an underfull batch open for more
+        requests before flushing (default MXNET_TPU_SERVE_WAIT_US or
+        2000).  0 flushes immediately (latency-optimal, fill-poor).
+    free_dim_buckets : sequence of tuple-of-tuples, optional
+        Ladder for the non-batch dims, each entry one free shape per
+        input, e.g. [((64,),), ((128,),)] for a single (N, L) input.
+        Requests are padded up to the smallest covering entry.
+        Default: requests must arrive at EXACTLY the source's bound
+        free shapes — the serial Predictor.forward contract, which
+        rejects other shapes; only the batch dim buckets (parity
+        unconditional).  Free-dim padding is model-dependent (fine
+        for per-position models; wrong for e.g. softmax or BatchNorm
+        over the padded axis), so it is strictly an opt-in via this
+        parameter — a single entry at the bound shapes opts
+        zero-padding in without adding rungs.  A MULTI-rung ladder
+        also opts outputs into free-dim slicing: output axes that
+        vary with the rung (settled by shape inference at
+        construction) mirror the padded input and are cut back to
+        the request's extent, while fixed model dims that merely
+        equal a bucket extent (num_classes == padded input width)
+        stay whole.  A single-entry ladder never slices outputs.
+    pad_value : float
+        Fill for padding rows/elements (default 0).
+    warmup : bool
+        AOT-compile every ladder rung at construction (default True)
+        so steady-state traffic compiles nothing.
+    depth : int
+        In-flight dispatch queue bound (default 2: double-buffered).
+    """
+
+    def __init__(self, source, max_batch=None, batch_buckets=None,
+                 max_wait_us=None, free_dim_buckets=None, pad_value=0.0,
+                 warmup=True, depth=2):
+        ex, symbol, ctx, input_names = _source_parts(source)
+        if not input_names:
+            raise MXNetError('InferenceEngine: source has no data inputs')
+        if getattr(ex, '_grouped', False):
+            # rung executors rebind WITHOUT group2ctx and the serve
+            # program jits the whole graph onto one device — silently
+            # collapsing a model-parallel placement (and its memory
+            # budget) is worse than refusing
+            raise MXNetError('InferenceEngine does not support ctx_group '
+                             '(model-parallel) sources: rung executors '
+                             'would collapse the placement onto one '
+                             'device')
+        self._symbol = symbol
+        self._ctx = ctx
+        self._base_ex = ex
+        self._input_names = list(input_names)
+        self.max_batch = int(max_batch if max_batch is not None else
+                             _env_int('MXNET_TPU_SERVE_MAX_BATCH', 8))
+        self.max_wait_us = int(max_wait_us if max_wait_us is not None else
+                               _env_int('MXNET_TPU_SERVE_WAIT_US', 2000))
+        self.pad_value = pad_value
+        self.batch_buckets = tuple(sorted(set(
+            int(b) for b in (batch_buckets or
+                             exec_cache.batch_ladder(self.max_batch)))))
+        if self.batch_buckets[-1] != self.max_batch:
+            raise MXNetError('largest batch bucket (%d) must equal '
+                             'max_batch (%d)'
+                             % (self.batch_buckets[-1], self.max_batch))
+        base_free = tuple(tuple(ex.arg_dict[n].shape[1:])
+                          for n in self._input_names)
+        self._input_dtypes = [np.dtype(ex.arg_dict[n].dtype)
+                              for n in self._input_names]
+        # output free-dim slicing is tied to an EXPLICIT free ladder:
+        # passing free_dim_buckets asserts a per-position model whose
+        # output axes mirror the padded input axes; without it a
+        # trailing output dim that merely equals the bucket extent
+        # (e.g. a classifier with num_classes == input width) must
+        # not be truncated
+        self._slice_free = free_dim_buckets is not None
+        free = [tuple(tuple(int(d) for d in shp) for shp in entry)
+                for entry in (free_dim_buckets or [base_free])]
+        for entry in free:
+            if len(entry) != len(self._input_names):
+                raise MXNetError('free_dim_buckets entries need one free '
+                                 'shape per input (%d)'
+                                 % len(self._input_names))
+        # dedupe, keep deterministic (sorted by total padded volume)
+        self._free_buckets = sorted(set(free), key=lambda e: (
+            tuple(int(np.prod(s)) if s else 1 for s in e), e))
+        # free-dim output slicing decides per OUTPUT AXIS whether the
+        # axis genuinely mirrors the padded input (slice back to the
+        # request's extent) or is a fixed model dimension that merely
+        # EQUALS the bucket extent (num_classes == padded input
+        # width: never slice).  Shape inference across rungs settles
+        # it without compiling: a mirroring axis varies with the free
+        # entry, a fixed one doesn't.  A single-entry ladder has
+        # nothing to compare against -> no output slicing (it is the
+        # pure zero-pad opt-in; outputs keep bucket extents).
+        self._mirror_masks = {}
+        if self._slice_free and len(self._free_buckets) > 1:
+            b = self.max_batch
+            outs = {}
+            for e in self._free_buckets:
+                shapes = {n: (b,) + f
+                          for n, f in zip(self._input_names, e)}
+                outs[e] = self._symbol.infer_shape(**shapes)[1]
+            ref = self._free_buckets[-1]
+            alt = self._free_buckets[0]
+            for e in self._free_buckets:
+                other = outs[alt if e == ref else ref]
+                self._mirror_masks[e] = [
+                    tuple(d1 != d2 for d1, d2 in zip(s1[1:], s2[1:]))
+                    for s1, s2 in zip(outs[e], other)]
+        self._programs = {}             # (batch, free_entry) -> _Program
+        # serializes rung creation and cold (compiling) serve calls:
+        # warmup() on a live warmup=False engine runs concurrently
+        # with the dispatcher, and both may reach the same rung
+        self._prog_lock = threading.Lock()
+        self._queues = OrderedDict()    # free_entry -> deque of _Request
+        self._qrows = {}                # free_entry -> queued row count
+        self._n_queued = 0              # total queued requests (O(1)
+                                        # queue-depth stat at dispatch)
+        self._cond = threading.Condition()
+        self._inflight = deque()        # (program, outs, reqs, offs,
+                                        #  rows, depth, pad_elem_frac)
+        self._inflight_cond = threading.Condition()
+        self._depth = max(1, int(depth))
+        self._closed = False
+        self._started = False
+        # lifetime counters (engine-local; profiler gets them too)
+        self._lock = threading.Lock()
+        self._n_requests = 0
+        self._n_batches = 0
+        self._n_rows = 0
+        self._n_padded_rows = 0
+        self._fill_sum = 0.0
+        self._warm_snapshot = None
+        if warmup:
+            self.warmup()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name='mxtpu-serve-dispatch',
+            daemon=True)
+        self._completer = threading.Thread(
+            target=self._complete_loop, name='mxtpu-serve-complete',
+            daemon=True)
+        self._dispatcher.start()
+        self._completer.start()
+        self._started = True
+
+    # ------------------------------------------------------------------
+    # bucket ladder
+    # ------------------------------------------------------------------
+    def _pick_free_bucket(self, free_shapes):
+        """Smallest configured free-dim entry covering the request's
+        free shapes elementwise (rank must match).  Without an
+        explicit free ladder only exact matches are accepted:
+        zero-padding free dims is model-dependent, and the serial
+        forward this engine replaces rejects mismatched shapes."""
+        if not self._slice_free:
+            if free_shapes == self._free_buckets[0]:
+                return free_shapes
+            raise MXNetError('request free dims %r != bound %r — '
+                             'free-dim padding is model-dependent and '
+                             'needs an explicit free_dim_buckets '
+                             'opt-in (a single entry at the bound '
+                             'shape suffices)'
+                             % (free_shapes, self._free_buckets[0]))
+        for entry in self._free_buckets:
+            ok = True
+            for want, have in zip(free_shapes, entry):
+                if len(want) != len(have) or \
+                        any(w > h for w, h in zip(want, have)):
+                    ok = False
+                    break
+            if ok:
+                return entry
+        raise MXNetError('no free-dim bucket covers request shapes %r '
+                         '(ladder: %r)'
+                         % (free_shapes, self._free_buckets))
+
+    def _pick_batch_bucket(self, rows):
+        for b in self.batch_buckets:
+            if rows <= b:
+                return b
+        return self.batch_buckets[-1]
+
+    def _program(self, batch, free_entry):
+        """The (batch x free) rung's executor + donated serve step,
+        built on first use and AOT-warmed by warmup().  Rebuilding an
+        equivalent engine hits exec_cache: zero new compilations."""
+        key = (batch, free_entry)
+        with self._prog_lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                return prog
+            shapes = {n: (batch,) + f
+                      for n, f in zip(self._input_names, free_entry)}
+            ex = self._symbol.simple_bind(self._ctx, grad_req='null',
+                                          shared_exec=self._base_ex,
+                                          **shapes)
+            prog = _Program(ex, _make_serve_fn(ex, self._input_names),
+                            [n for n in ex.arg_dict
+                             if n not in self._input_names],
+                            batch, free_entry)
+            self._programs[key] = prog
+            return prog
+
+    def warmup(self):
+        """AOT-compile every ladder rung (batch buckets x free-dim
+        buckets) through exec_cache, then snapshot the cache stats —
+        steady-state traffic after this performs zero XLA compiles
+        (stats()['compiles_after_warmup'] stays 0)."""
+        import jax
+        rng = jax.random.PRNGKey(0)
+        for free_entry in self._free_buckets:
+            for b in self.batch_buckets:
+                prog = self._program(b, free_entry)
+                dvals = tuple(
+                    jax.device_put(
+                        np.full((b,) + f, self.pad_value, dt),
+                        self._ctx.jax_device())
+                    for f, dt in zip(free_entry, self._input_dtypes))
+                outs = self._run(prog, dvals, rng)
+                jax.block_until_ready(outs)
+        self._warm_snapshot = exec_cache.stats()
+        return self
+
+    def _run(self, prog, dvals, rng):
+        ex = prog.executor
+        weights = tuple(ex.arg_dict[n]._data for n in prog.weight_names)
+        aux = tuple(ex.aux_dict[n]._data for n in ex.aux_dict)
+        if prog.warmed:
+            return prog.serve_fn(dvals, weights, aux, rng)
+        # the donation warning only fires at COMPILE time, and
+        # warnings.catch_warnings mutates process-global state (not
+        # thread-safe) — so the silencer wraps at most the one cold
+        # call per rung, never the steady-state dispatch path, and
+        # _prog_lock keeps a live-engine warmup() and the dispatcher
+        # from taking this branch for the same rung concurrently
+        with self._prog_lock:
+            if prog.warmed:
+                return prog.serve_fn(dvals, weights, aux, rng)
+            with _quiet_donation():
+                out = prog.serve_fn(dvals, weights, aux, rng)
+            # slicing assumes axis 0 of every output is the request
+            # batch; a batch-reducing model (sum/mean over rows)
+            # would silently hand each caller the co-batched
+            # aggregate — refuse at the rung's first (warmup) call,
+            # same policy as the ctx_group guard
+            for i, o in enumerate(out):
+                if o.ndim == 0 or o.shape[0] != prog.batch:
+                    raise MXNetError(
+                        'InferenceEngine requires row-independent '
+                        'outputs with a leading batch dim: output %d '
+                        'has shape %r at bucket batch %d — a '
+                        'batch-reducing model would mix co-batched '
+                        'requests' % (i, tuple(o.shape), prog.batch))
+            prog.warmed = True
+        return out
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def infer(self, *pos_inputs, **named_inputs):
+        """Submit one request (thread-safe) and block until its
+        outputs are ready.  Inputs: positional in input-name order, or
+        named.  Each is an np.ndarray/NDArray with a leading batch dim
+        (rows may exceed max_batch: the request is split and results
+        re-concatenated).  Returns a list of np.ndarrays, one per
+        model output, with the request's own batch size."""
+        if self._closed:
+            raise MXNetError('InferenceEngine is closed')
+        arrays = self._canonical_inputs(pos_inputs, named_inputs)
+        rows = arrays[0].shape[0]
+        if any(a.shape[0] != rows for a in arrays):
+            raise MXNetError('inputs disagree on batch size')
+        if rows == 0:
+            raise MXNetError('empty request')
+        # oversized requests split into max_batch chunks, ALL enqueued
+        # before the first wait so the chunks pipeline through the
+        # double-buffered dispatch queue instead of paying one full
+        # round trip each
+        reqs = self._submit_all(
+            [[a[i:i + self.max_batch] for a in arrays]
+             for i in range(0, rows, self.max_batch)])
+        for r in reqs:
+            r.event.wait()
+        for r in reqs:
+            if r.error is not None:
+                raise r.error
+        if len(reqs) == 1:
+            return reqs[0].outputs
+        return [np.concatenate([r.outputs[k] for r in reqs], axis=0)
+                for k in range(len(reqs[0].outputs))]
+
+    def _submit_all(self, chunks):
+        """Enqueue a request's bucket-sized chunks atomically — one
+        lock hold, with every bucket pick (which can raise) done
+        BEFORE the first enqueue — so a concurrent close() either
+        sees the whole request (served before shutdown) or none of it
+        (raise), never a half-submitted request whose early chunks
+        compute answers the caller can't receive."""
+        staged = []
+        for arrays in chunks:
+            free_shapes = tuple(tuple(a.shape[1:]) for a in arrays)
+            entry = self._pick_free_bucket(free_shapes)
+            staged.append(
+                (entry, _Request(arrays, arrays[0].shape[0],
+                                 free_shapes)))
+        with self._cond:
+            if self._closed:
+                raise MXNetError('InferenceEngine is closed')
+            wake = False
+            self._n_queued += len(staged)
+            for entry, req in staged:
+                q = self._queues.setdefault(entry, deque())
+                q.append(req)
+                # running per-group row count: every enqueue/flush/
+                # wakeup decision is O(1), not an O(queue) rescan
+                # under the lock (a backlogged engine would otherwise
+                # go quadratic right when throughput matters)
+                rows = self._qrows.get(entry, 0) + req.rows
+                self._qrows[entry] = rows
+                # wake the dispatcher only when its decision can
+                # change — a group just became non-empty (arm the
+                # deadline) or can now flush full; intermediate
+                # enqueues would only bounce it through a futile
+                # recheck (GIL churn that measurably costs throughput
+                # on CPU rigs)
+                if len(q) == 1 or rows >= self.max_batch:
+                    wake = True
+            if wake:
+                self._cond.notify_all()
+        return [req for _, req in staged]
+
+    def predict(self, *pos_inputs, **named_inputs):
+        """Convenience: first model output as np.ndarray (same input
+        conventions as infer() — positional in input-name order, or
+        every input by name)."""
+        return self.infer(*pos_inputs, **named_inputs)[0]
+
+    def _canonical_inputs(self, pos_inputs, named_inputs):
+        if pos_inputs and named_inputs:
+            raise MXNetError('pass inputs positionally or by name, '
+                             'not both')
+        if pos_inputs:
+            if len(pos_inputs) != len(self._input_names):
+                raise MXNetError('expected %d inputs, got %d'
+                                 % (len(self._input_names),
+                                    len(pos_inputs)))
+            vals = list(pos_inputs)
+        else:
+            extra = set(named_inputs) - set(self._input_names)
+            if extra:
+                # parity with Predictor.forward, which raises on an
+                # unrecognized name — silently dropping an input the
+                # caller believes is consumed is wrong-answers territory
+                raise MXNetError('unknown input(s) %s (model inputs: %s)'
+                                 % (sorted(extra), self._input_names))
+            try:
+                vals = [named_inputs[n] for n in self._input_names]
+            except KeyError as e:
+                raise MXNetError('missing input %s' % e)
+        out = []
+        for v, dt in zip(vals, self._input_dtypes):
+            a = v.asnumpy() if hasattr(v, 'asnumpy') else np.asarray(v)
+            out.append(np.ascontiguousarray(a, dtype=dt))
+        return out
+
+    def stats(self):
+        """Engine-lifetime serving counters + the zero-compile check:
+        compiles_after_warmup / compile_s_after_warmup are the
+        PROCESS-WIDE exec_cache miss / compile-time deltas since this
+        engine's warmup() — a conservative gate: 0 proves this engine
+        compiled nothing after warmup (bucketed steady state); in a
+        multi-engine or serve-while-training process another
+        component's compiles bill here too, so >0 means *something*
+        compiled, not necessarily this engine.  The merged serve_*
+        keys (latency percentiles, queue depth, ...) likewise come
+        from the PROCESS-global profiler and span every engine in the
+        process; requests/batches/rows/fill/pad are this engine's
+        own."""
+        with self._lock:
+            out = {
+                'requests': self._n_requests,
+                'batches': self._n_batches,
+                'rows': self._n_rows,
+                'padded_rows': self._n_padded_rows,
+                'batch_fill_avg': (self._fill_sum / self._n_batches
+                                   if self._n_batches else 0.0),
+                'pad_waste_frac': (self._n_padded_rows /
+                                   (self._n_rows + self._n_padded_rows)
+                                   if self._n_rows else 0.0),
+            }
+        snap = self._warm_snapshot
+        if snap is not None:
+            now = exec_cache.stats()
+            out['compiles_after_warmup'] = now['misses'] - snap['misses']
+            out['compile_s_after_warmup'] = round(
+                now['total_compile_s'] - snap['total_compile_s'], 6)
+        out.update(profiler.serving_stats())
+        return out
+
+    # ------------------------------------------------------------------
+    # batcher (dispatcher thread)
+    # ------------------------------------------------------------------
+    def _oldest_group(self):
+        """Free-dim group whose head request has waited longest."""
+        best, best_t = None, None
+        for entry, q in self._queues.items():
+            if q and (best_t is None or q[0].t_enq < best_t):
+                best, best_t = entry, q[0].t_enq
+        return best
+
+    def _coalesce_locked(self, entry):
+        """Pop requests from one group up to max_batch rows."""
+        q = self._queues[entry]
+        reqs, rows = [], 0
+        while q and rows + q[0].rows <= self.max_batch:
+            r = q.popleft()
+            reqs.append(r)
+            rows += r.rows
+        self._qrows[entry] = self._qrows.get(entry, 0) - rows
+        self._n_queued -= len(reqs)
+        return reqs, rows
+
+    def _dispatch_loop(self):
+        import jax
+        rng = jax.random.PRNGKey(0)
+        while True:
+            with self._cond:
+                while not self._closed and not any(
+                        self._queues.values()):
+                    self._cond.wait()
+                if self._closed and not any(self._queues.values()):
+                    break
+                entry = self._oldest_group()
+                # hold the batch open for up to max_wait_us while
+                # underfull and more traffic may coalesce
+                deadline = self._queues[entry][0].t_enq + \
+                    self.max_wait_us / 1e6
+                while not self._closed:
+                    rows = self._qrows.get(entry, 0)
+                    left = deadline - time.perf_counter()
+                    if rows >= self.max_batch or left <= 0:
+                        break
+                    # a DIFFERENT free-dim group filling to max_batch
+                    # is dispatch-ready now — serve it instead of
+                    # idling on this group's deadline (the held group
+                    # stays oldest, so it's picked right back up)
+                    full = next(
+                        (e for e, q in self._queues.items()
+                         if e != entry and
+                         self._qrows.get(e, 0) >= self.max_batch),
+                        None)
+                    if full is not None:
+                        entry = full
+                        break
+                    self._cond.wait(timeout=left)
+                # this loop is the ONLY consumer of _queues, so the
+                # held group cannot drain out from under it — no
+                # emptiness re-check needed here
+                # backlog at dispatch time, the coalesced batch
+                # included — the running counter keeps this O(1)
+                # under the lock (a per-dispatch scan of every queue
+                # would go quadratic under exactly the backlog the
+                # batcher exists to absorb)
+                depth = self._n_queued
+                reqs, rows = self._coalesce_locked(entry)
+            if not reqs:
+                continue
+            try:
+                self._launch(entry, reqs, rows, depth, rng)
+            except Exception as e:               # surface per-request
+                for r in reqs:
+                    r.error = e
+                    r.event.set()
+        # drain: wake the completer with a sentinel
+        with self._inflight_cond:
+            self._inflight.append(None)
+            self._inflight_cond.notify_all()
+
+    def _launch(self, entry, reqs, rows, depth, rng):
+        """Assemble the padded host batch, stage H2D, enqueue the
+        dispatch.  Runs in the dispatcher thread; the bounded in-flight
+        queue means batch N+1 stages/dispatches while the completion
+        thread drains batch N (double buffering)."""
+        from . import io as mxio
+        bucket = self._pick_batch_bucket(rows)
+        prog = self._program(bucket, entry)
+        # exact fill (rows == bucket AND every request already at the
+        # bucket's free shapes) is the measured steady state (bench
+        # fill 0.96+): every element gets written by a request row, so
+        # skip the pad memset — and with a single such request its
+        # canonicalized (contiguous) arrays ARE the batch: stage them
+        # directly, no assembly copy at all
+        exact = rows == bucket and all(r.free_shapes == entry
+                                       for r in reqs)
+        if exact and len(reqs) == 1:
+            host = reqs[0].inputs
+        else:
+            host = []
+            for k, (f, dt) in enumerate(zip(entry, self._input_dtypes)):
+                if exact:
+                    buf = np.empty((bucket,) + f, dtype=dt)
+                else:
+                    buf = np.full((bucket,) + f, self.pad_value,
+                                  dtype=dt)
+                off = 0
+                for r in reqs:
+                    a = r.inputs[k]
+                    sl = (slice(off, off + r.rows),) + tuple(
+                        slice(0, d) for d in a.shape[1:])
+                    buf[sl] = a
+                    off += r.rows
+                host.append(buf)
+        with profiler.scope('serve_stage', 'serving'):
+            dvals = tuple(mxio.stage_to_device(host,
+                                               device=self._ctx))
+            outs = self._run(prog, dvals, rng)   # async dispatch
+        offs = []
+        off = 0
+        for r in reqs:
+            offs.append(off)
+            off += r.rows
+        pad_elems_frac = _pad_elem_frac(reqs, entry)
+        with self._inflight_cond:
+            while len(self._inflight) >= self._depth and \
+                    not self._closed:
+                self._inflight_cond.wait()
+            self._inflight.append(
+                (prog, outs, reqs, offs, rows, depth, pad_elems_frac))
+            self._inflight_cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # completion thread
+    # ------------------------------------------------------------------
+    def _complete_loop(self):
+        import jax
+        while True:
+            with self._inflight_cond:
+                while not self._inflight:
+                    self._inflight_cond.wait()
+                item = self._inflight.popleft()
+                self._inflight_cond.notify_all()
+            if item is None:
+                break
+            prog, outs, reqs, offs, rows, depth, pad_frac = item
+            try:
+                with profiler.scope('serve_complete', 'serving'):
+                    jax.block_until_ready(outs)
+                np_outs = [np.asarray(o) for o in outs]
+                now = time.perf_counter()
+                masks = self._mirror_masks.get(prog.free_shapes)
+                lats = []
+                for r, off in zip(reqs, offs):
+                    r.outputs = [_slice_out(o, off, r, prog,
+                                            masks[k] if masks else None)
+                                 for k, o in enumerate(np_outs)]
+                    lats.append((now - r.t_enq) * 1e3)
+                fill = rows / float(prog.batch)
+                # commit the batch's counters BEFORE waking the
+                # callers: a client calling stats() the moment its
+                # infer() returns must see its own batch counted
+                with self._lock:
+                    self._n_requests += len(reqs)
+                    self._n_batches += 1
+                    self._n_rows += rows
+                    self._n_padded_rows += prog.batch - rows
+                    self._fill_sum += fill
+                profiler.add_serving_stats(
+                    requests=len(reqs), batches=1, rows=rows,
+                    padded_rows=prog.batch - rows, fill=fill,
+                    pad_elem_frac=pad_frac, queue_depth=depth,
+                    latencies_ms=lats)
+                for r in reqs:
+                    r.event.set()
+            except Exception as e:
+                for r in reqs:
+                    if not r.event.is_set():
+                        r.error = e
+                        r.event.set()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def close(self, timeout=30):
+        """Flush queued work, stop and join both worker threads
+        (idempotent).  Requests still queued are served before
+        shutdown; infer() after close raises."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        with self._inflight_cond:
+            self._inflight_cond.notify_all()
+        if self._started:
+            self._dispatcher.join(timeout=timeout)
+            self._completer.join(timeout=timeout)
+            if self._dispatcher.is_alive() or self._completer.is_alive():
+                # a wedged dispatch outlived the join timeout: keep
+                # _started so a later close() retries the join instead
+                # of silently reporting a drained shutdown
+                warnings.warn('InferenceEngine.close(): worker threads '
+                              'still running after %ss (dispatch '
+                              'wedged?); call close() again to re-join'
+                              % timeout)
+            else:
+                self._started = False
+        return self
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=5)
+        except Exception:       # interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+# warnings.catch_warnings mutates process-global filter state:
+# concurrent cold calls from DIFFERENT engines (each under its own
+# _prog_lock) must not nest it across threads
+_DONATION_WARN_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """XLA:CPU usually can't alias the donated input staging buffers
+    and jax warns once per bucket at compile; the donation is a device
+    (HBM) optimization — the CPU warning is expected noise, silenced
+    only around the serve-program call."""
+    with _DONATION_WARN_LOCK:
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                'ignore', message='Some donated buffers were not usable')
+            yield
+
+
+def _source_parts(source):
+    """(executor, symbol, ctx, input_names) from a Predictor or a
+    bound Module."""
+    if hasattr(source, '_executor') and hasattr(source, '_input_names'):
+        ex = source._executor
+        return ex, source._symbol, source._ctx, list(source._input_names)
+    if hasattr(source, '_exec_group') and source._exec_group is not None:
+        ex = source._exec_group.executor
+        return ex, source._symbol, ex._ctx, list(source.data_names)
+    raise MXNetError('InferenceEngine needs a Predictor or a bound '
+                     'Module, got %r' % (source,))
+
+
+def _make_serve_fn(ex, input_names):
+    """The bucket's serve program: forward-only jit over (data_vals,
+    weight_vals, aux_vals, rng) with the data staging buffers DONATED
+    (input memory becomes XLA scratch).  Shared process-wide through
+    exec_cache under the bucket's graph signature, so an equivalent
+    engine (or a re-created one) compiles nothing."""
+    import jax
+    input_set = set(input_names)
+    names = list(ex.arg_dict)
+    # data_vals arrive in input_names order, which need not be graph
+    # argument order (a Module's data_names is caller-chosen): map
+    # each input NAME to its argument position, not position-by-rank
+    data_pos = [names.index(n) for n in input_names]
+    other_pos = [i for i, n in enumerate(names) if n not in input_set]
+    key = exec_cache.serve_step_key(ex._sig, input_names) \
+        if ex._sig is not None else None
+    if key is not None:
+        fn = exec_cache.get(key)
+        if fn is not None:
+            return fn
+    raw = ex.raw_forward
+    n_args = len(names)
+
+    def serve(data_vals, weight_vals, aux_vals, rng):
+        merged = [None] * n_args
+        for i, v in zip(data_pos, data_vals):
+            merged[i] = v
+        for i, v in zip(other_pos, weight_vals):
+            merged[i] = v
+        outs, _ = raw(tuple(merged), aux_vals, rng)
+        return outs
+
+    fn = exec_cache.TimedJit(jax.jit(serve, donate_argnums=(0,)))
+    if key is not None:
+        exec_cache.put(key, fn)
+    return fn
+
+
+def _pad_elem_frac(reqs, entry):
+    """Fraction of free-dim elements that are padding across the
+    coalesced requests (0.0 when every request already had bucket
+    free shapes)."""
+    total = real = 0
+    for r in reqs:
+        for f, want in zip(entry, r.free_shapes):
+            n = int(np.prod(f)) if f else 1
+            total += n * r.rows
+            real += (int(np.prod(want)) if want else 1) * r.rows
+    return (total - real) / total if total else 0.0
+
+
+def _slice_out(out, off, req, prog, mirror):
+    """One request's rows out of the padded batch output.  `mirror`
+    (present only for engines with an explicit multi-rung free
+    ladder) marks, per trailing output axis, whether the axis varies
+    with the free-dim rung — i.e. genuinely mirrors a padded input
+    axis (shape-inferred at construction): those are sliced back to
+    the request's own extent on the matching axis of input 0.  A
+    fixed model dimension that merely EQUALS the bucket extent (a
+    classifier with num_classes == the padded input width) is never
+    truncated.  Outputs are guaranteed a leading batch dim by the
+    rung warmup guard in _run."""
+    sl = [slice(off, off + req.rows)]
+    if mirror:
+        # align trailing output dims with the first input's padding
+        want = req.free_shapes[0]
+        have = prog.free_shapes[0]
+        for i, (d, (w, h)) in enumerate(zip(out.shape[1:],
+                                            zip(want, have))):
+            sl.append(slice(0, w)
+                      if (i < len(mirror) and mirror[i] and
+                          d == h and w < h)
+                      else slice(None))
+    return out[tuple(sl)].copy()

@@ -765,13 +765,44 @@ int MXTNDArrayWaitAll() {
 
 // Op introspection — the reference's MXSymbolListAtomicSymbolCreators
 // + MXSymbolGetAtomicSymbolInfo pair, which binding codegen walks to
-// build a language's op namespace.  Returned pointers have
-// registry (static) lifetime.
+// build a language's op namespace.  The caches below rebuild whenever
+// the Python registry's generation stamp changes, so ops registered at
+// runtime (CustomOp) appear instead of a stale first-call snapshot
+// silently diverging from the live registry imperative_invoke
+// consults.  Returned pointers keep the original static-lifetime
+// contract: superseded cache entries are retired, not freed, so a
+// caller holding a pre-refresh list never dereferences freed memory
+// (it just sees a stale snapshot).
+
+// Live registry generation stamp (bumped on every registration,
+// including re-registration of an existing name); -1 on bridge
+// failure.  Caller holds the GIL.
+static long op_registry_generation_now() {
+  PyObject* r = call("op_registry_generation", "()");
+  if (r == nullptr) return -1;
+  long n = PyLong_AsLong(r);
+  Py_DECREF(r);
+  return n;
+}
+
+// Superseded cache entries are retired, never freed: the pre-refresh
+// contract gave returned pointers registry (static) lifetime, and a
+// caller iterating a name list while another thread registers an op
+// must not land on freed memory.  Growth is bounded by the number of
+// runtime registrations observed by the introspection calls.
+static void retire_handle(void* h) {
+  static std::vector<void*>* retired = new std::vector<void*>();
+  if (h != nullptr) retired->push_back(h);
+}
+
 int MXTListOpNames(uint32_t* out_n, const char*** out_names) {
   if (!ensure_python_rt()) return -1;
   GIL gil;
   static Handle* cache = nullptr;
-  if (cache == nullptr) {
+  static long cache_gen = -1;
+  long gen = op_registry_generation_now();
+  if (gen < 0) return -1;
+  if (cache == nullptr || gen != cache_gen) {
     PyObject* names = call("list_op_names", "()");
     if (names == nullptr) return -1;
     Handle* h = wrap(names);
@@ -780,7 +811,9 @@ int MXTListOpNames(uint32_t* out_n, const char*** out_names) {
       MXTNDArrayFree(h);
       return -1;
     }
+    retire_handle(cache);   // old pointers stay valid (never freed)
     cache = h;
+    cache_gen = gen;
   }
   *out_n = static_cast<uint32_t>(cache->str_ptrs.size());
   *out_names = cache->str_ptrs.data();
@@ -793,13 +826,27 @@ int MXTOpGetInfo(const char* name, const char** canonical_name,
   if (!ensure_python_rt()) return -1;
   GIL gil;
   static std::map<std::string, Handle*>* cache = nullptr;
+  static long cache_gen = -1;
   if (cache == nullptr) cache = new std::map<std::string, Handle*>();
+  long gen = op_registry_generation_now();
+  if (gen < 0) return -1;
+  if (gen != cache_gen) {
+    // registry changed: a cached name may now resolve differently
+    // (e.g. a CustomOp re-registered with new inputs) — retire it
+    // all (old pointers stay valid, see retire_handle)
+    for (auto& kv : *cache) retire_handle(kv.second);
+    cache->clear();
+    cache_gen = gen;
+  }
+  Handle* h;
   auto it = cache->find(name);
-  if (it == cache->end()) {
+  if (it != cache->end()) {
+    h = it->second;
+  } else {
     // bridge returns [canonical, description, in0, in1, ...]
     PyObject* info = call("op_info", "(s)", name);
     if (info == nullptr) return -1;
-    Handle* h = wrap(info);
+    h = wrap(info);
     uint32_t n = 0;
     int src = store_strings(info, h, &n, nullptr);
     if (src != 0 || n < 2) {
@@ -809,9 +856,19 @@ int MXTOpGetInfo(const char* name, const char** canonical_name,
       MXTNDArrayFree(h);
       return -1;
     }
-    it = cache->emplace(name, h).first;
+    // call() may release the GIL: the registry can mutate (and
+    // another caller advance cache_gen) while op_info ran, so only
+    // insert if the generation still matches the one observed at
+    // ENTRY (not cache_gen, which a concurrent refresher may already
+    // have advanced past our pre-mutation info) — a stale insert
+    // under the new generation would be served until the NEXT bump.
+    // The answer itself is still returned (retired, never freed).
+    if (op_registry_generation_now() == gen) {
+      cache->emplace(name, h);
+    } else {
+      retire_handle(h);
+    }
   }
-  Handle* h = it->second;
   *canonical_name = h->str_ptrs[0];
   *description = h->str_ptrs[1];
   *num_inputs = static_cast<uint32_t>(h->str_ptrs.size() - 2);

@@ -6,6 +6,8 @@ import importlib.util
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -45,7 +47,11 @@ def test_finetune():
     assert head > 0.5, head
 
 
+@pytest.mark.slow
 def test_bi_lstm_sort():
+    # slow (~29s): bidirectional-LSTM training itself is tier-1
+    # covered by test_gluon_rnn/test_rnn; this end-to-end example
+    # regression runs in full CI
     mod = _load('examples/bi_lstm_sort/sort.py', 'ex_sort')
     acc = mod.main(quick=True)
     assert acc > 0.8, acc
@@ -134,10 +140,15 @@ def test_actor_critic_rl():
     assert last > 0.7, (first, last)
 
 
+@pytest.mark.slow
 def test_faster_rcnn():
     """Two-stage detection (reference example/rcnn/): RPN with
     IoU-assigned anchor targets, Proposal + ROIPooling + smooth_l1,
-    and the end-to-end backbone->RPN->Proposal->heads test graph."""
+    and the end-to-end backbone->RPN->Proposal->heads test graph.
+
+    slow (~38s): Proposal/ROIPooling/multibox op behavior stays
+    tier-1 in test_contrib/test_ssd/test_image_io; this end-to-end
+    training regression runs in full CI."""
     mod = _load('examples/rcnn/train_faster_rcnn.py', 'ex_rcnn')
     rpn_recall, det_acc = mod.main(quick=True)
     assert rpn_recall > 0.8, rpn_recall
@@ -176,9 +187,14 @@ def test_dec_clustering():
     assert final_acc > 0.9, final_acc
 
 
+@pytest.mark.slow
 def test_captcha_ocr():
     """Multi-head captcha OCR (reference example/captcha): joint
-    4-head Group training; sequence accuracy is the gate."""
+    4-head Group training; sequence accuracy is the gate.
+
+    slow (~27s): multi-output Group training stays tier-1 via
+    test_multi_task and the CTC OCR path via test_lstm_ocr_ctc; this
+    end-to-end example regression runs in full CI."""
     mod = _load('examples/captcha/captcha_ocr.py', 'ex_captcha')
     digit_acc, seq_acc = mod.main(quick=True)
     assert digit_acc > 0.93, digit_acc

@@ -2,6 +2,7 @@
 Modeled on reference tests/cpp/engine/threaded_engine_test.cc stress
 coverage, run from Python through the ctypes ABI."""
 import os
+import tempfile
 import threading
 import time
 
@@ -613,22 +614,56 @@ def test_stablehlo_runner_no_python(tmp_path):
     ref = pred.predict(sample.reshape(1, -1)).argmax(1)[0]
     assert int(ref) == int(expect)
 
-    exe = str(tmp_path / 'shlo_runner')
+    # The g++ compile against the TF headers dominates this test
+    # (formerly ~85s of its runtime at -O2), so the binary is cached
+    # across runs keyed by the runner sources + the full compile
+    # command (flags, include paths, TF install) + TF version, and
+    # built at -O0 (the runner executes ONE inference; compile time is
+    # what matters).  A source, flag, or toolkit change rebuilds; the
+    # executed coverage — artifact runs without Python — is unchanged.
+    import getpass
+    import hashlib
+    try:
+        user = getpass.getuser()
+    except (KeyError, OSError):
+        # containers often run as a UID with no passwd entry
+        user = str(os.getuid())
     src = os.path.join(repo, 'tools', 'stablehlo_runner')
-    build = subprocess.run(
-        ['g++', '-std=c++17', '-O2', '-DNDEBUG',
-         os.path.join(src, 'runner.cc'),
-         '-I' + os.path.join(src, 'mlir_stub'),
-         '-I' + os.path.join(tf_dir, 'include'),
-         '-I' + os.path.join(tf_dir, 'include', 'external',
-                             'highwayhash'),
-         '-I' + os.path.join(tf_dir, 'include', 'external',
-                             'farmhash_archive', 'src'),
-         '-L' + tf_dir, '-l:libtensorflow_cc.so.2',
-         '-l:libtensorflow_framework.so.2',
-         '-Wl,-rpath,' + tf_dir, '-o', exe],
-        capture_output=True, text=True)
-    assert build.returncode == 0, build.stderr[-2000:]
+    cmd = ['g++', '-std=c++17', '-O0', '-DNDEBUG',
+           os.path.join(src, 'runner.cc'),
+           '-I' + os.path.join(src, 'mlir_stub'),
+           '-I' + os.path.join(tf_dir, 'include'),
+           '-I' + os.path.join(tf_dir, 'include', 'external',
+                               'highwayhash'),
+           '-I' + os.path.join(tf_dir, 'include', 'external',
+                               'farmhash_archive', 'src'),
+           '-L' + tf_dir, '-l:libtensorflow_cc.so.2',
+           '-l:libtensorflow_framework.so.2',
+           '-Wl,-rpath,' + tf_dir]
+    h = hashlib.sha256(tensorflow.__version__.encode())
+    h.update('\0'.join(cmd).encode())
+    for root, _, files in sorted(os.walk(src)):
+        for f in sorted(files):
+            with open(os.path.join(root, f), 'rb') as fh:
+                h.update(fh.read())
+    # per-user 0700 cache dir: /tmp is world-writable, so a bare
+    # predictable file name could be pre-planted by another local
+    # user and executed below — own the directory or don't trust it
+    # (fresh mkdtemp: cache lost, safety kept)
+    cache_dir = os.path.join(tempfile.gettempdir(),
+                             'mxtpu_shlo_cache_%s' % user)
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    dstat = os.stat(cache_dir)
+    if dstat.st_uid != os.getuid() or (dstat.st_mode & 0o077):
+        cache_dir = tempfile.mkdtemp(prefix='mxtpu_shlo_cache_')
+    exe = os.path.join(cache_dir,
+                       'runner_%s' % h.hexdigest()[:16])
+    if not os.path.exists(exe):
+        tmp_exe = '%s.tmp.%d' % (exe, os.getpid())
+        build = subprocess.run(cmd + ['-o', tmp_exe],
+                               capture_output=True, text=True)
+        assert build.returncode == 0, build.stderr[-2000:]
+        os.replace(tmp_exe, exe)       # atomic: racing runs both win
 
     inp = str(tmp_path / 'input.raw')
     np.ascontiguousarray(sample.reshape(1, -1),
@@ -685,6 +720,41 @@ def test_c_op_introspection():
                           ctypes.byref(desc), ctypes.byref(ni),
                           ctypes.byref(ins))
     assert rc != 0
+
+    # runtime registration: the C caches rebuild when the Python
+    # registry grows, so an op registered AFTER the first list call
+    # still appears (ADVICE round-5; previously a first-call snapshot)
+    from mxnet_tpu.ops import registry as _reg
+    assert '_test_runtime_op' not in all_names
+
+    @_reg.register('_test_runtime_op', input_names=('data',))
+    def _rt_op(attrs, data):            # pragma: no cover - never run
+        return data
+    try:
+        rc = lib.MXTListOpNames(ctypes.byref(n), ctypes.byref(names))
+        assert rc == 0, lib.MXTTrainGetLastError()
+        fresh = {names[i].decode() for i in range(n.value)}
+        assert '_test_runtime_op' in fresh
+        rc = lib.MXTOpGetInfo(b'_test_runtime_op', ctypes.byref(canon),
+                              ctypes.byref(desc), ctypes.byref(ni),
+                              ctypes.byref(ins))
+        assert rc == 0, lib.MXTTrainGetLastError()
+        assert canon.value == b'_test_runtime_op'
+        assert [ins[i].decode() for i in range(ni.value)] == ['data']
+
+        # RE-registering the same name keeps the dict sizes unchanged
+        # but must still invalidate (generation stamp, not cardinality)
+        @_reg.register('_test_runtime_op', input_names=('lhs', 'rhs'))
+        def _rt_op2(attrs, lhs, rhs):   # pragma: no cover - never run
+            return lhs
+        rc = lib.MXTOpGetInfo(b'_test_runtime_op', ctypes.byref(canon),
+                              ctypes.byref(desc), ctypes.byref(ni),
+                              ctypes.byref(ins))
+        assert rc == 0, lib.MXTTrainGetLastError()
+        assert [ins[i].decode() for i in range(ni.value)] == \
+            ['lhs', 'rhs']
+    finally:
+        _reg._OP_REGISTRY.pop('_test_runtime_op', None)
 
 
 @native

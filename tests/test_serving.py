@@ -1,0 +1,482 @@
+"""Dynamic-batching inference engine tests (serving.InferenceEngine).
+
+Covers the ISSUE-4 serving contract: batcher coalescing under
+concurrency, bucket padding/slicing bit-parity against serial
+Predictor.forward, zero-compile steady state (exec_cache counters),
+timeout flush of underfull batches, shutdown joining the worker
+threads, and the new profiler serving counters surfacing in
+summary() / dump_profile metadata.  All models are CPU-sized.
+"""
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import exec_cache, nd, profiler, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import InferenceEngine
+
+DIM = 6
+HID = 8
+OUT = 3
+
+
+def _mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, num_hidden=HID, name='fc1')
+    act = sym.Activation(fc1, act_type='relu')
+    return sym.FullyConnected(act, num_hidden=OUT, name='fc2')
+
+
+def _params(seed=7):
+    rs = np.random.RandomState(seed)
+    return {
+        'fc1_weight': nd.array(rs.randn(HID, DIM).astype(np.float32) * .5),
+        'fc1_bias': nd.array(rs.randn(HID).astype(np.float32) * .1),
+        'fc2_weight': nd.array(rs.randn(OUT, HID).astype(np.float32) * .5),
+        'fc2_bias': nd.array(rs.randn(OUT).astype(np.float32) * .1),
+    }
+
+
+def _predictor(batch=1):
+    return Predictor(symbol=_mlp(), arg_params=_params(),
+                     input_shapes={'data': (batch, DIM)})
+
+
+def _x(rows, seed=0, dim=DIM):
+    return np.random.RandomState(seed).randn(rows, dim).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# coalescing
+# ---------------------------------------------------------------------------
+
+def test_coalesces_concurrent_requests():
+    # 8 single-row clients behind a barrier, batcher holding batches
+    # open 300ms: they must merge into far fewer dispatches than 8
+    with _predictor().serve(max_batch=8, max_wait_us=300000) as eng:
+        barrier = threading.Barrier(8)
+        outs = [None] * 8
+        xs = [_x(1, seed=i) for i in range(8)]
+
+        def client(i):
+            barrier.wait()
+            outs[i] = eng.infer(xs[i])
+
+        ts = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        st = eng.stats()
+    assert st['requests'] == 8
+    assert st['batches'] <= 3          # coalescing actually happened
+    assert st['batch_fill_avg'] > 0.5
+    for i in range(8):                  # everyone got *their* answer
+        solo = _predictor(batch=1).forward(data=xs[i])[0].asnumpy()
+        np.testing.assert_allclose(outs[i][0], solo, rtol=2e-6, atol=1e-6)
+
+
+def test_oversized_request_splits():
+    # rows > max_batch: split into max_batch chunks, re-concatenated
+    with _predictor().serve(max_batch=4, max_wait_us=0) as eng:
+        x = _x(11)
+        out = eng.infer(x)[0]
+    assert out.shape == (11, OUT)
+    ref = _predictor(batch=11).forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# padding / slicing parity
+# ---------------------------------------------------------------------------
+
+def test_full_bucket_bit_parity_vs_serial_forward():
+    # a request that exactly fills its bucket runs the identical graph
+    # as a serial Predictor.forward at that shape: bit-identical
+    x = _x(8, seed=3)
+    with _predictor().serve(max_batch=8, batch_buckets=(8,),
+                            max_wait_us=0) as eng:
+        got = eng.infer(x)[0]
+    ref = _predictor(batch=8).forward(data=x)[0].asnumpy()
+    assert np.array_equal(got, ref)
+
+
+def test_padded_request_bit_parity_vs_padded_serial():
+    # rows=3 padded up to the 4-bucket must equal manually padding to
+    # 4, serial forward at (4, DIM), slicing 3 rows — bit-identical
+    x = _x(3, seed=5)
+    with _predictor().serve(max_batch=4, batch_buckets=(4,),
+                            max_wait_us=0, pad_value=0.0) as eng:
+        got = eng.infer(x)[0]
+    assert got.shape == (3, OUT)
+    xp = np.zeros((4, DIM), np.float32)
+    xp[:3] = x
+    ref = _predictor(batch=4).forward(data=xp)[0].asnumpy()[:3]
+    assert np.array_equal(got, ref)
+
+
+def test_cobatch_slicing_is_row_independent():
+    # a request's rows must not depend on what it was co-batched with:
+    # same request solo vs coalesced with another gives identical bits
+    x_a = _x(2, seed=11)
+    x_b = _x(2, seed=12)
+
+    def run_pair(first, second):
+        with _predictor().serve(max_batch=4, batch_buckets=(4,),
+                                max_wait_us=300000) as eng:
+            res = {}
+            barrier = threading.Barrier(2)
+
+            def client(name, arr):
+                barrier.wait()
+                res[name] = eng.infer(arr)[0]
+
+            ts = [threading.Thread(target=client, args=('a', first)),
+                  threading.Thread(target=client, args=('b', second))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return res
+
+    together = run_pair(x_a, x_b)
+    with _predictor().serve(max_batch=4, batch_buckets=(4,),
+                            max_wait_us=0) as eng:
+        solo = eng.infer(x_a)[0]
+    assert np.array_equal(together['a'], solo)
+
+
+def test_default_engine_requires_exact_free_dims():
+    # without an explicit free_dim_buckets opt-in the engine keeps
+    # the serial forward contract: a request narrower than the bound
+    # width is REJECTED, not silently zero-padded (free-dim padding
+    # parity is model-dependent — wrong for e.g. BatchNorm/softmax
+    # over the padded axis); exact-width requests serve with full,
+    # untruncated output dims even when a trailing output dim
+    # coincidentally equals the input width
+    data = sym.Variable('data')
+    net = sym.FullyConnected(data, num_hidden=8, name='fc')
+    rs = np.random.RandomState(2)
+    params = {'fc_weight': nd.array(rs.randn(8, 8).astype(np.float32)),
+              'fc_bias': nd.array(np.zeros(8, np.float32))}
+    pred = Predictor(symbol=net, arg_params=params,
+                     input_shapes={'data': (1, 8)})
+    x = rs.randn(2, 8).astype(np.float32)
+    with InferenceEngine(pred, max_batch=4, max_wait_us=0) as eng:
+        with pytest.raises(MXNetError, match='free-dim padding'):
+            eng.infer(rs.randn(2, 5).astype(np.float32))
+        out = eng.infer(x)[0]
+    assert out.shape == (2, 8)          # all 8 class scores survive
+    ref = Predictor(symbol=net, arg_params=params,
+                    input_shapes={'data': (2, 8)}).forward(
+                        data=x)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-6)
+
+
+def test_free_dim_bucket_padding_and_slicing():
+    # per-position model: free-dim padding must slice back to the
+    # request's own extent with untouched real elements
+    data = sym.Variable('data')
+    net = sym.Activation(data, act_type='relu')
+    pred = Predictor(symbol=net, arg_params={},
+                     input_shapes={'data': (1, 8)})
+    x = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+    with InferenceEngine(pred, max_batch=4,
+                         free_dim_buckets=[((8,),), ((16,),)],
+                         max_wait_us=0) as eng:
+        out = eng.infer(x)[0]
+    assert out.shape == (2, 5)
+    assert np.array_equal(out, np.maximum(x, 0))
+    with pytest.raises(MXNetError):
+        # nothing on the ladder covers a 32-wide request
+        with InferenceEngine(pred, max_batch=4,
+                             free_dim_buckets=[((8,),), ((16,),)],
+                             max_wait_us=0) as eng:
+            eng.infer(np.zeros((1, 32), np.float32))
+
+
+def test_free_dim_slicing_spares_fixed_output_dims():
+    # two outputs: relu mirrors the padded input (slice back), while
+    # slice_axis(0:8) is a FIXED 8-wide head that coincidentally
+    # equals the 8-rung's bucket extent — the mirror mask (axes that
+    # vary across rungs, shape-inferred) must slice the first and
+    # spare the second
+    data = sym.Variable('data')
+    net = sym.Group([sym.Activation(data, act_type='relu'),
+                     sym.slice_axis(data, axis=1, begin=0, end=8)])
+    pred = Predictor(symbol=net, arg_params={},
+                     input_shapes={'data': (1, 8)})
+    x = np.random.RandomState(1).randn(2, 5).astype(np.float32)
+    with InferenceEngine(pred, max_batch=4,
+                         free_dim_buckets=[((8,),), ((16,),)],
+                         max_wait_us=0) as eng:
+        relu_out, head_out = eng.infer(x)
+    assert relu_out.shape == (2, 5)
+    assert np.array_equal(relu_out, np.maximum(x, 0))
+    # the fixed head keeps its full 8 columns: 5 real + 3 pad zeros,
+    # exactly what a serial forward on the padded input returns
+    assert head_out.shape == (2, 8)
+    xp = np.zeros((2, 8), np.float32)
+    xp[:, :5] = x
+    assert np.array_equal(head_out, xp)
+
+
+def test_full_batch_in_other_group_preempts_held_deadline():
+    # two free-dim rungs: a lone rung-A request holds the batcher on
+    # a LONG deadline while rung B fills to max_batch — B must
+    # dispatch promptly instead of idling out A's deadline
+    data = sym.Variable('data')
+    net = sym.Activation(data, act_type='relu')
+    pred = Predictor(symbol=net, arg_params={},
+                     input_shapes={'data': (1, 8)})
+    with InferenceEngine(pred, max_batch=4,
+                         free_dim_buckets=[((8,),), ((16,),)],
+                         max_wait_us=30000000) as eng:
+        t_a = threading.Thread(
+            target=lambda: eng.infer(np.zeros((1, 8), np.float32)))
+        t_a.start()
+        deadline = time.time() + 10      # wait until A is queued/held
+        while time.time() < deadline and \
+                not any(eng._queues.values()):
+            time.sleep(0.005)
+        tic = time.perf_counter()
+        done = []
+
+        def b_client():
+            done.append(eng.infer(np.zeros((1, 16), np.float32)))
+
+        t_bs = [threading.Thread(target=b_client) for _ in range(4)]
+        for t in t_bs:
+            t.start()
+        for t in t_bs:
+            t.join(timeout=30)
+        elapsed = time.perf_counter() - tic
+        assert len(done) == 4
+        # far below A's 30s deadline, with enough margin that this
+        # rig's documented multi-second cpu-shares throttle bursts
+        # cannot flake a correct preemption
+        assert elapsed < 10, elapsed
+        # close() drains the held rung-A request without its deadline
+    t_a.join(timeout=30)
+    assert not t_a.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# zero-compile steady state
+# ---------------------------------------------------------------------------
+
+def test_zero_compiles_after_warmup():
+    with _predictor().serve(max_batch=8, max_wait_us=0) as eng:
+        for rows in (1, 2, 3, 5, 7, 8, 4, 6, 1, 8):
+            eng.infer(_x(rows, seed=rows))
+        st = eng.stats()
+    assert st['compiles_after_warmup'] == 0
+    assert st['compile_s_after_warmup'] == 0
+    assert st['requests'] == 10
+
+
+def test_recreated_engine_reuses_cached_programs():
+    # an equivalent engine hits exec_cache for every ladder rung: its
+    # construction (warmup included) triggers zero cache misses
+    with _predictor().serve(max_batch=4, max_wait_us=0) as eng:
+        eng.infer(_x(2))
+    before = exec_cache.stats()['misses']
+    with _predictor().serve(max_batch=4, max_wait_us=0) as eng:
+        eng.infer(_x(2))
+    assert exec_cache.stats()['misses'] == before
+
+
+def test_late_warmup_on_live_engine():
+    # warmup=False starts the workers immediately; a later warmup()
+    # runs concurrently with live traffic — rung builds and cold
+    # serve calls serialize on _prog_lock, so neither thread races
+    # the other and the zero-compile snapshot still lands
+    eng = _predictor().serve(max_batch=4, max_wait_us=0, warmup=False)
+    try:
+        errs = []
+
+        def traffic():
+            try:
+                for i in range(10):
+                    eng.infer(_x(1 + i % 4, seed=i))
+            except Exception as e:      # surface in the main thread
+                errs.append(e)
+
+        t = threading.Thread(target=traffic)
+        t.start()
+        eng.warmup()
+        t.join(timeout=60)
+        assert not t.is_alive() and not errs, errs
+        out = eng.infer(_x(2, seed=42))[0]
+        assert eng.stats()['compiles_after_warmup'] == 0
+    finally:
+        eng.close()
+    ref = _predictor(batch=2).forward(data=_x(2, seed=42))[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# timeout flush
+# ---------------------------------------------------------------------------
+
+def test_timeout_flushes_underfull_batch():
+    # one lone request against max_batch=8 must still complete (after
+    # ~max_wait_us), padded up to its bucket
+    with _predictor().serve(max_batch=8, max_wait_us=2000) as eng:
+        out = eng.infer(_x(1))
+        st = eng.stats()
+    assert out[0].shape == (1, OUT)
+    assert st['batches'] == 1
+    assert st['padded_rows'] == 0      # bucket ladder: 1 -> bucket 1
+    with _predictor().serve(max_batch=8, batch_buckets=(8,),
+                            max_wait_us=2000) as eng:
+        eng.infer(_x(3))
+        st = eng.stats()
+    assert st['padded_rows'] == 5      # 3 rows padded to the 8-bucket
+    assert st['pad_waste_frac'] == pytest.approx(5 / 8)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def test_close_joins_workers_and_rejects_new_work():
+    eng = _predictor().serve(max_batch=4, max_wait_us=0)
+    eng.infer(_x(2))
+    workers = [eng._dispatcher, eng._completer]
+    eng.close()
+    for t in workers:
+        assert not t.is_alive()
+    with pytest.raises(MXNetError):
+        eng.infer(_x(1))
+    eng.close()                        # idempotent
+
+
+def test_close_drains_queued_requests():
+    # requests enqueued before close() are answered, not dropped
+    with _predictor().serve(max_batch=8, max_wait_us=100000) as eng:
+        res = {}
+
+        def client():
+            res['out'] = eng.infer(_x(2))[0]
+
+        t = threading.Thread(target=client)
+        t.start()
+        # wait until the request is actually enqueued (or already
+        # answered) before close() flushes the held-open batch
+        deadline = time.time() + 10
+        while time.time() < deadline and 'out' not in res and \
+                not any(eng._queues.values()):
+            time.sleep(0.005)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert res['out'].shape == (2, OUT)
+
+
+def test_multi_input_names_out_of_graph_order():
+    # a Module's data_names order is caller-chosen and need not match
+    # graph argument order: the serve program must bind each input by
+    # NAME (regression: position-by-rank silently swapped a-b to b-a)
+    av = np.full((1, 4), 5.0, np.float32)
+    bv = np.full((1, 4), 2.0, np.float32)
+
+    def engine(order):
+        a = sym.Variable('a')
+        b = sym.Variable('b')
+        mod = mx.mod.Module(a - b, data_names=order, label_names=[])
+        mod.bind(data_shapes=[(n, (1, 4)) for n in order],
+                 for_training=False)
+        mod.init_params()
+        return InferenceEngine(mod, max_batch=2, max_wait_us=0)
+
+    with engine(('b', 'a')) as eng:
+        named = eng.infer(a=av, b=bv)[0]
+        pos = eng.infer(bv, av)[0]      # positional = data_names order
+    np.testing.assert_array_equal(named, av - bv)
+    np.testing.assert_array_equal(pos, av - bv)
+    # graph signatures alpha-rename names away: a SECOND engine over
+    # the same graph with the other data_names order must not hit the
+    # first engine's cached serve closure (input order is part of the
+    # serve program's cache key)
+    with engine(('a', 'b')) as eng:
+        np.testing.assert_array_equal(eng.infer(a=av, b=bv)[0], av - bv)
+        np.testing.assert_array_equal(eng.infer(av, bv)[0], av - bv)
+
+
+def test_batch_reducing_model_rejected():
+    # sum over all axes: each caller would receive the co-batched
+    # (and pad-row) aggregate — warmup checks every output keeps the
+    # bucket batch dim and refuses (same policy as the ctx_group
+    # guard: silent wrong answers are worse than an error)
+    data = sym.Variable('data')
+    net = sym.sum(data)
+    pred = Predictor(symbol=net, arg_params={},
+                     input_shapes={'data': (1, 4)})
+    with pytest.raises(MXNetError, match='row-independent'):
+        InferenceEngine(pred, max_batch=4, max_wait_us=0)
+
+
+def test_model_parallel_source_rejected():
+    # rung executors rebind WITHOUT group2ctx, so a ctx_group
+    # (model-parallel) source would silently collapse its placement
+    # onto one device — the engine must refuse instead
+    with mx.AttrScope(ctx_group='dev1'):
+        data = sym.Variable('data')
+        fc1 = sym.FullyConnected(data, num_hidden=4, name='fc1')
+    with mx.AttrScope(ctx_group='dev2'):
+        net = sym.FullyConnected(fc1, num_hidden=2, name='fc2')
+    ex = net.simple_bind(mx.cpu(0), grad_req='null', data=(2, 3),
+                         group2ctx={'dev1': mx.cpu(0),
+                                    'dev2': mx.cpu(1)})
+    assert ex._grouped
+    src = types.SimpleNamespace(_executor=ex, _symbol=net,
+                                _ctx=mx.cpu(0), _input_names=['data'])
+    with pytest.raises(MXNetError, match='ctx_group'):
+        InferenceEngine(src, max_batch=2, max_wait_us=0)
+
+
+def test_engine_over_module_source():
+    # the engine also wraps a bound Module (forward only)
+    mod = mx.mod.Module(_mlp(), label_names=[])
+    mod.bind(data_shapes=[('data', (1, DIM))], for_training=False)
+    mod.init_params()
+    mod.set_params(_params(), {})
+    x = _x(2, seed=9)
+    with InferenceEngine(mod, max_batch=4, max_wait_us=0) as eng:
+        out = eng.infer(x)[0]
+    ref = _predictor(batch=2).forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# profiler counters
+# ---------------------------------------------------------------------------
+
+def test_serving_counters_in_summary_and_dump(tmp_path):
+    profiler.clear()
+    with _predictor().serve(max_batch=4, max_wait_us=0) as eng:
+        eng.infer(_x(3))
+        eng.infer(_x(1))
+    sv = profiler.serving_stats()
+    assert sv['serve_requests'] >= 2
+    assert sv['serve_batches'] >= 2
+    assert sv['serve_latency_p50_ms'] > 0
+    assert sv['serve_latency_p99_ms'] >= sv['serve_latency_p50_ms']
+    assert 0 <= sv['serve_pad_waste_frac'] < 1
+    text = profiler.summary(print_out=False)
+    for key in ('serve_requests', 'serve_queue_depth_avg',
+                'serve_batch_fill_avg', 'serve_pad_waste_frac',
+                'serve_latency_p50_ms', 'serve_latency_p99_ms'):
+        assert key in text
+    out = tmp_path / 'serve_profile.json'
+    profiler.profiler_set_config(filename=str(out))
+    profiler.dump_profile()
+    events = json.loads(out.read_text())['traceEvents']
+    meta = [e for e in events if e.get('name') == 'serving']
+    assert meta and meta[0]['args']['serve_requests'] >= 2

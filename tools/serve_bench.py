@@ -1,0 +1,87 @@
+"""Closed-loop serving load driver over the bench.py JSON relay.
+
+Drives bench.py's BENCH_INFER=serve mode (the dynamic-batching
+InferenceEngine vs serial per-request Predictor.forward) once per
+client-count rung, each in its own process — the same one-emitter /
+one-relay pattern as tools/bench_family.py, with the same guards:
+a zero-exit child with empty stdout is a broken relay (error, not an
+IndexError), a non-OOM child failure raises immediately, and an OOM
+ends the client ladder cleanly (larger rungs only build larger
+buckets) with the rungs already measured kept.
+
+  python tools/serve_bench.py [--clients 1,2,4,8] [--requests 100]
+                              [--passes 7] [--max-batch N]
+                              [--wait-us 2000] [--mixed]
+                              [--dim 256] [--hidden 256]
+
+Each rung prints bench.py's JSON line (throughput, speedup vs serial,
+p50/p99 latency, batch fill, pad waste, exec-cache misses after
+warmup).  CPU-sized by default: safe on a no-TPU rig.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+import_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), '..')
+sys.path.insert(0, import_path)
+
+from bench import is_oom  # noqa: E402  (one OOM definition, bench.py's)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--clients', default='1,2,4,8',
+                   help='comma-separated client-thread rungs')
+    p.add_argument('--requests', type=int, default=100,
+                   help='requests per client (closed loop)')
+    p.add_argument('--passes', type=int, default=7,
+                   help='best-of passes per arm (throttle de-noising)')
+    p.add_argument('--max-batch', type=int, default=0,
+                   help='0 = one dispatch per client count')
+    p.add_argument('--wait-us', type=int, default=2000)
+    p.add_argument('--mixed', action='store_true',
+                   help='mixed free-dim shapes across the bucket ladder')
+    p.add_argument('--dim', type=int, default=256)
+    p.add_argument('--hidden', type=int, default=256)
+    args = p.parse_args()
+
+    bench_py = os.path.join(import_path, 'bench.py')
+    for rung in args.clients.split(','):
+        clients = int(rung.strip())
+        env = dict(os.environ, BENCH_INFER='serve',
+                   BENCH_SERVE_CLIENTS=str(clients),
+                   BENCH_SERVE_REQS=str(args.requests),
+                   BENCH_SERVE_PASSES=str(args.passes),
+                   BENCH_SERVE_WAIT_US=str(args.wait_us),
+                   BENCH_SERVE_DIM=str(args.dim),
+                   BENCH_SERVE_HIDDEN=str(args.hidden),
+                   BENCH_SERVE_MIXED='1' if args.mixed else '0')
+        if args.max_batch:
+            env['BENCH_SERVE_MAX_BATCH'] = str(args.max_batch)
+        else:
+            env.pop('BENCH_SERVE_MAX_BATCH', None)
+        proc = subprocess.run([sys.executable, bench_py], env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            if is_oom(proc.stderr or ''):
+                # larger client counts only build larger buckets:
+                # stop the ladder cleanly, keep the rungs measured
+                sys.stderr.write('serve bench: OOM at %d clients; '
+                                 'stopping the ladder\n' % clients)
+                break
+            raise RuntimeError('serve bench (%d clients) rc=%d, '
+                               'failed without OOM'
+                               % (clients, proc.returncode))
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            # zero-exit child with no JSON: broken relay, not a result
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('serve bench (%d clients) produced no '
+                               'output' % clients)
+        print(lines[-1], flush=True)
+
+
+if __name__ == '__main__':
+    main()
