@@ -34,6 +34,9 @@ BENCH_INFER=serve (serving mode: measure the dynamic-batching
 InferenceEngine against serial per-request Predictor.forward and emit
 a throughput + latency-percentile JSON line instead of the training
 bench — see serve_bench() / tools/serve_bench.py for the knobs),
+BENCH_GLUON=1 (fused Gluon training mode: whole-step-compiled
+imperative training vs the per-dispatch early-Gluon loop — see
+gluon_bench() for the BENCH_GLUON_* knobs),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -261,6 +264,157 @@ def run_symbol(sym, batch, steps, warmup, bulk, dtype, edge=224,
 def run(batch, steps, warmup, bulk, num_layers=50, dtype='float32'):
     return run_symbol(make_symbol('resnet-%d' % num_layers, dtype),
                       batch, steps, warmup, bulk, dtype)['ips']
+
+
+# ---------------------------------------------------------------------------
+# BENCH_GLUON=1: fused whole-step Gluon training vs the imperative loop
+# ---------------------------------------------------------------------------
+
+def gluon_bench():
+    """BENCH_GLUON=1: measure the fused Gluon training step
+    (gluon/fused.py: forward+loss+backward+update as ONE donated XLA
+    dispatch) against the imperative early-Gluon loop (per-tape-node
+    autograd.backward + Trainer.step) on the same MLP workload, and
+    emit ONE JSON line with steps/s for three arms — imperative,
+    fused, fused-bulk (lax.scan, BENCH_GLUON_BULK steps/dispatch) —
+    plus total_compile_s, the gluon_fused_* counters, and a parity
+    check (both arms trained from identical init; the gate reflects
+    the float32-ulp agreement of the two program partitions).
+
+    Arms run best-of-BENCH_GLUON_PASSES interleaved (the rig's
+    cpu-shares throttle swings single passes ~2x).  Knobs:
+    BENCH_GLUON_BATCH (64), BENCH_GLUON_DIM (64), BENCH_GLUON_HIDDEN
+    (128), BENCH_GLUON_LAYERS (4), BENCH_GLUON_STEPS (20 per pass),
+    BENCH_GLUON_PASSES (5), BENCH_GLUON_BULK (8),
+    BENCH_GLUON_HYBRID=1 (hybridize the imperative arm: forward
+    becomes one CachedOp jit, backward one whole-graph vjp — isolates
+    the Trainer.step + per-step dispatch overhead the fusion removes)."""
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd, profiler
+    from mxnet_tpu.gluon import nn
+
+    batch = int(os.environ.get('BENCH_GLUON_BATCH', 64))
+    dim = int(os.environ.get('BENCH_GLUON_DIM', 64))
+    hidden = int(os.environ.get('BENCH_GLUON_HIDDEN', 128))
+    layers = int(os.environ.get('BENCH_GLUON_LAYERS', 4))
+    steps = int(os.environ.get('BENCH_GLUON_STEPS', 20))
+    passes = max(1, int(os.environ.get('BENCH_GLUON_PASSES', 5)))
+    bulk = int(os.environ.get('BENCH_GLUON_BULK', 8))
+    hybrid = os.environ.get('BENCH_GLUON_HYBRID', '0') == '1'
+    classes = 10
+    opt_params = {'learning_rate': 0.05, 'momentum': 0.9, 'wd': 1e-4}
+
+    def make_net(seed):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(layers):
+                net.add(nn.Dense(hidden, activation='relu'))
+            net.add(nn.Dense(classes))
+        net.initialize()
+        net(mx.nd.zeros((batch, dim)))   # complete deferred shapes
+        rs = np.random.RandomState(seed)
+        for _, p in sorted(net.collect_params().items()):
+            p.set_data(mx.nd.array(
+                (rs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2))
+        return net
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, dim).astype(np.float32))
+    y = mx.nd.array((rs.rand(batch) * classes).astype(np.float32))
+    xs = mx.nd.NDArray(jnp.stack([x._data] * bulk))
+    ys = mx.nd.NDArray(jnp.stack([y._data] * bulk))
+
+    # -- arms (shared nets/trainers; measurement loops below) ----------
+    net_i = make_net(1)
+    if hybrid:
+        net_i.hybridize()
+    tr_i = gluon.Trainer(net_i.collect_params(), 'sgd', dict(opt_params))
+
+    def imperative_steps(n):
+        for _ in range(n):
+            with autograd.record():
+                l = loss_fn(net_i(x), y)
+            l.backward()
+            tr_i.step(batch)
+        l.asnumpy()          # host-fetch barrier
+
+    net_f = make_net(1)
+    tr_f = gluon.Trainer(net_f.collect_params(), 'sgd', dict(opt_params))
+    fused = gluon.fuse_step(net_f, loss_fn, tr_f)
+
+    def fused_steps(n):
+        for _ in range(n):
+            l = fused(x, y)
+        l.asnumpy()
+
+    def bulk_steps(n):
+        for _ in range(max(1, n // bulk)):
+            l = fused.bulk(xs, ys)
+        l.asnumpy()
+
+    # warmup (compiles) outside the clock
+    imperative_steps(2)
+    fused_steps(2)
+    bulk_steps(bulk)
+
+    best = {'imperative': 0.0, 'fused': 0.0, 'bulk': 0.0}
+    for _ in range(passes):
+        for name, fn, n in (('imperative', imperative_steps, steps),
+                            ('fused', fused_steps, steps),
+                            ('bulk', bulk_steps,
+                             max(bulk, (steps // bulk) * bulk))):
+            tic = time.time()
+            fn(n)
+            sps = n / (time.time() - tic)
+            best[name] = max(best[name], sps)
+
+    # parity from identical init (fresh nets: the measured ones drifted
+    # apart over different step counts)
+    net_pi = make_net(7)
+    tr_pi = gluon.Trainer(net_pi.collect_params(), 'sgd',
+                          dict(opt_params))
+    net_pf = make_net(7)
+    tr_pf = gluon.Trainer(net_pf.collect_params(), 'sgd',
+                          dict(opt_params))
+    pf = gluon.fuse_step(net_pf, loss_fn, tr_pf)
+    for _ in range(3):
+        with autograd.record():
+            l = loss_fn(net_pi(x), y)
+        l.backward()
+        tr_pi.step(batch)
+        pf(x, y)
+    max_diff = max(
+        float(np.abs(a.list_data()[0].asnumpy() -
+                     b.list_data()[0].asnumpy()).max())
+        for (_, a), (_, b) in zip(
+            sorted(net_pi.collect_params().items()),
+            sorted(net_pf.collect_params().items())))
+
+    gf = profiler.gluon_fused_stats()
+    cache = profiler.exec_cache_stats()
+    print(json.dumps({
+        'metric': 'gluon_fused_train',
+        'value': round(best['fused'], 2),
+        'unit': 'steps/sec',
+        'imperative_sps': round(best['imperative'], 2),
+        'bulk_sps': round(best['bulk'], 2),
+        'speedup_vs_imperative': round(
+            best['fused'] / best['imperative'], 3),
+        'speedup_bulk_vs_imperative': round(
+            best['bulk'] / best['imperative'], 3),
+        'batch': batch, 'dim': dim, 'hidden': hidden, 'layers': layers,
+        'steps_per_pass': steps, 'passes': passes, 'bulk': bulk,
+        'imperative_hybridized': hybrid,
+        'gluon_fused_steps': gf['gluon_fused_steps'],
+        'gluon_fused_dispatches': gf['gluon_fused_dispatches'],
+        'total_compile_s': round(cache['total_compile_s'], 3),
+        'exec_cache_misses': cache['exec_cache_misses'],
+        'parity_max_abs_diff': max_diff,
+        'parity_ok': bool(max_diff < 1e-5),
+    }))
 
 
 # ---------------------------------------------------------------------------
@@ -527,6 +681,9 @@ def main():
 def _bench_main():
     if os.environ.get('BENCH_INFER', '') == 'serve':
         serve_bench()   # dynamic-batching inference engine bench
+        return
+    if os.environ.get('BENCH_GLUON', '') == '1':
+        gluon_bench()   # fused vs imperative Gluon training
         return
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \

@@ -3,6 +3,11 @@
 autograd.record + Trainer on a Sequential net, synthetic digits.
 
   python examples/gluon/mnist_gluon.py --epochs 5
+
+--fused compiles the whole train step (forward + loss + backward +
+optimizer update) into ONE donated XLA dispatch via gluon.fuse_step —
+same math, no per-op dispatch (docs/PERF.md round 10); accuracy is
+then evaluated once per epoch instead of per batch.
 """
 import argparse
 import os
@@ -31,6 +36,9 @@ def main():
     p.add_argument('--batch-size', type=int, default=64)
     p.add_argument('--lr', type=float, default=0.1)
     p.add_argument('--hybridize', action='store_true')
+    p.add_argument('--fused', action='store_true',
+                   help='whole-step compilation (gluon.fuse_step): '
+                        'fwd+loss+bwd+update as one XLA dispatch')
     args = p.parse_args()
 
     net = gluon.nn.Sequential()
@@ -48,16 +56,24 @@ def main():
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = gluon.Trainer(net.collect_params(), 'sgd',
                             {'learning_rate': args.lr})
+    fused = gluon.fuse_step(net, loss_fn, trainer) if args.fused \
+        else None
     metric = mx.metric.Accuracy()
     for epoch in range(args.epochs):
         metric.reset()
         for data, label in loader:
-            with autograd.record():
-                out = net(data)
-                loss = loss_fn(out, label)
-            loss.backward()
-            trainer.step(data.shape[0])
-            metric.update([label], [out])
+            if fused is not None:
+                fused(data, label)
+            else:
+                with autograd.record():
+                    out = net(data)
+                    loss = loss_fn(out, label)
+                loss.backward()
+                trainer.step(data.shape[0])
+                metric.update([label], [out])
+        if fused is not None:
+            out = net(nd.array(X.reshape(len(X), -1)))
+            metric.update([nd.array(y)], [out])
         print('epoch %d acc %.4f' % (epoch, metric.get()[1]))
     return metric.get()[1]
 

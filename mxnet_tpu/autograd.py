@@ -199,7 +199,16 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             arr._grad._data = g
 
     if not retain_graph:
-        st.tape = []
+        # free the graph AT THE STEP BOUNDARY: clear the tape IN PLACE
+        # and drop every node's NDArray references, so activation
+        # memory is released now even if something still holds the
+        # tape list or a node (a debugger, a monitor, the `tape` local
+        # of a re-entrant caller) — not at the next record()
+        for node in tape:
+            node.inputs = ()
+            node.auxs = ()
+            node.outputs = ()
+        del tape[:]
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None,

@@ -241,6 +241,28 @@ def serve_step_key(sig, input_names=()):
     return (sig, 'serve_step', tuple(input_names))
 
 
+def gluon_step_key(fingerprint, step_key, mode, k, placement):
+    """Cache key of one fused Gluon whole-train-step program
+    (gluon/fused.py).  `fingerprint` is the blake2b hash of the step
+    function's abstract jaxpr — a canonical, name-free identity of the
+    ENTIRE traced computation (net forward + loss + backward + grad
+    reduce + optimizer update, with every input shape/dtype and any
+    mesh sharding constraints baked in), so a re-created net/Trainer of
+    the same architecture hits the same entry regardless of parameter
+    names/prefixes.  `step_key` is FusedSGD.cache_key() — already part
+    of the traced math, but joined explicitly so optimizer-state layout
+    changes (ZeRO bucket relayout, rescale/clip/momentum) can never
+    alias even if a jaxpr printing subtlety collided.  `mode`/`k`
+    distinguish single-step from K-step lax.scan bulk programs.
+    `placement` is the device/mesh fingerprint: the cached object is an
+    AOT-COMPILED executable (holds no Python closure, so cache entries
+    never pin a discarded net's weights) and AOT bakes concrete device
+    placements in — same-architecture steps on different devices must
+    not alias."""
+    return ('gluon_fused', fingerprint, step_key, mode, int(k),
+            placement)
+
+
 def clear(reset_stats=True):
     """Drop every cached executable (tests / memory pressure)."""
     with _LOCK:

@@ -4,6 +4,7 @@ from .parameter import Parameter, Constant, ParameterDict, \
     DeferredInitializationError
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
+from .fused import FusedStep, fuse_step
 from . import nn
 from . import rnn
 from . import loss

@@ -10,12 +10,15 @@ the "replay" is an XLA executable, so per-op Python overhead vanishes
 and XLA fuses the entire block.  Backward through a hybridized block is
 one jax.vjp over the same jitted function (one tape node).
 """
+from contextlib import contextmanager
+
 import jax
 
 from .. import ndarray as nd
 from .. import autograd
 from ..base import _pretty_name
 from ..context import current_context
+from . import parameter as _parameter_mod
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 
@@ -338,7 +341,6 @@ class HybridBlock(Block):
         cached = _CachedFn(None, aux_params)
 
         def pure_fn(flat):
-            from .. import random as _random
             ps = flat[n_in:-1]
             rng = flat[-1]
             leaves = [None] * n_leaves
@@ -348,17 +350,8 @@ class HybridBlock(Block):
                 leaves[pos] = val
             call_args = jtu.tree_unflatten(treedef, leaves)
             sub = {p: nd.NDArray(v) for (_, p), v in zip(plist, ps)}
-            token = _push_param_substitution(sub)
-            _random.push_key_override(rng)
-            old_tracing = _TRACING
-            _set_tracing(True)
-            try:
-                with autograd.pause(train_mode=is_train):
-                    out = self.forward(*call_args)
-            finally:
-                _set_tracing(old_tracing)
-                _random.pop_key_override()
-                _pop_param_substitution(token)
+            with param_trace(sub, rng, train_mode=is_train):
+                out = self.forward(*call_args)
             aux_updates = tuple(sub[p]._data for _, p in aux_params)
             out_leaves, out_treedef = jtu.tree_flatten(
                 out, is_leaf=lambda a: isinstance(a, nd.NDArray))
@@ -400,6 +393,37 @@ def _lookup_param_substitution(param):
         if param in sub:
             return sub[param]
     return None
+
+
+# parameter.py consults the substitution stack from Parameter.data() so
+# blocks that read their weights directly (SymbolBlock, custom Blocks)
+# trace correctly too; bound here to avoid a circular import
+_parameter_mod._lookup_param_substitution = _lookup_param_substitution
+
+
+@contextmanager
+def param_trace(sub, rng, train_mode=True):
+    """Trace imperative block code as a PURE function of its arrays:
+    Parameters resolve to the traced values in `sub` (a dict Parameter
+    -> NDArray), RNG draws split from the traced `rng` key, hybridized
+    blocks take their imperative path (their ops inline into the
+    enclosing trace instead of nesting a cached jit), and the autograd
+    tape pauses.  Mutable aux updates land back in `sub` (read
+    sub[param]._data after the block ran).  Shared by
+    HybridBlock._build_cache and gluon.fused (whole-step compilation).
+    """
+    from .. import random as _random
+    token = _push_param_substitution(sub)
+    _random.push_key_override(rng)
+    old_tracing = _TRACING
+    _set_tracing(True)
+    try:
+        with autograd.pause(train_mode=train_mode):
+            yield
+    finally:
+        _set_tracing(old_tracing)
+        _random.pop_key_override()
+        _pop_param_substitution(token)
 
 
 class SymbolBlock(HybridBlock):

@@ -1,0 +1,470 @@
+"""Fused Gluon training: whole-step compilation for imperative loops.
+
+The early-Gluon imperative path trains op-by-op: `autograd.backward`
+replays the tape with one `jax.vjp` dispatch per node, and
+`Trainer.step` runs a Python loop doing per-parameter reduce + updater
+calls — the dispatch-bound regime this project exists to eliminate.
+The Module path already escaped it (executor.make_fused_train_step:
+fwd+bwd+update as ONE donated XLA dispatch, exec_cache'd, ZeRO-1
+sharded).  This module brings the same whole-program compilation to
+hybrid nets trained imperatively:
+
+    net = nn.HybridSequential(); ...; net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), 'sgd', {...})
+    fused = gluon.fuse_step(net, loss_fn, trainer)
+    for x, y in batches:
+        loss = fused(x, y)          # ONE donated XLA dispatch
+
+`fused(x, y)` compiles `forward -> loss -> backward -> grad-reduce ->
+optimizer update` into one jitted program: the block's imperative
+forward is lifted into a pure function of the flattened parameter
+pytree (block.param_trace — the same substitution machinery
+hybridize's cached forward uses), `jax.value_and_grad` runs the
+backward with the ones-head semantics of `loss.backward()`, gradients
+reduce across the device mesh with GSPMD collectives
+(parallel/collectives.py) instead of per-param kvstore.push/pull —
+composing with ZeRO-1 bucketed reduce-scatter when zero=1 /
+MXNET_TPU_ZERO=1 — and the FusedSGD update math runs on the results
+with parameter/momentum/fp32-master buffers donated.  `fused.bulk(xs,
+ys)` loops K steps on-device via lax.scan (the Module bulk_step
+analog).
+
+Programs go through the process-wide exec_cache keyed on a canonical
+signature (abstract-jaxpr fingerprint of the whole step + input
+shapes/dtypes + FusedSGD.cache_key() carrying optimizer hypers and the
+ZeRO bucket layout/mesh), so re-creating the net and Trainer — same
+architecture, fresh Parameter objects, different auto-prefixes —
+performs ZERO new XLA compilations.
+
+Observability: profiler.gluon_fused_stats() (gluon_fused_steps /
+gluon_fused_dispatches), the 'gluon_fused' span category, and the
+ZeRO comm/state counters Module feeds.  Bench: BENCH_GLUON=1 in
+bench.py.  Docs: docs/PERF.md round 10.
+"""
+import hashlib
+import re
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+
+from .. import exec_cache
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from .. import profiler
+from .. import random as _random
+from ..parallel import collectives
+from ..parallel import mesh as pmesh
+from ..parallel import zero as zero_mod
+from . import block as block_mod
+
+
+def fuse_step(net, loss, trainer, mesh=None, zero=None):
+    """Build (and register on `trainer`) a FusedStep compiling the
+    whole train step for `net` into one donated XLA dispatch.
+
+    net: a Block whose forward is pure NDArray math (HybridBlocks
+    always qualify; hybridize() is not required — tracing takes the
+    imperative path either way).  loss: a gluon loss (or any callable
+    of (out, label) -> per-sample loss), or None when the net's output
+    IS the loss.  trainer: the gluon.Trainer owning the parameters;
+    its optimizer must have a fused update (SGD / NAG — see
+    optimizer.create_fused_updater).
+
+    mesh: optional jax Mesh for data-parallel execution; defaults to a
+    1-D 'data' mesh over the trainer's contexts when there are several
+    (batches shard over it, parameters replicate, gradients reduce
+    in-step).  zero: ZeRO stage for the sharded optimizer update
+    (None defers to MXNET_TPU_ZERO).
+
+    After this call `trainer.step_fused(batch_size, *args)` also runs
+    the fused step."""
+    return FusedStep(net, loss, trainer, mesh=mesh, zero=zero)
+
+
+class FusedStep:
+    """One whole training step as a single compiled, donated XLA
+    program (see module docstring).  Instances are callable:
+    `loss = fused(x, y)` runs one step; `losses = fused.bulk(xs, ys)`
+    runs K steps on-device (leading axis of the stacked inputs)."""
+
+    def __init__(self, net, loss, trainer, mesh=None, zero=None):
+        self._net = net
+        self._loss = loss
+        self._trainer = trainer
+        if type(trainer._optimizer) not in (opt_mod.SGD, opt_mod.NAG):
+            # fail at build time, not deep inside the training loop
+            raise ValueError(
+                'fuse_step: optimizer %s has no fused whole-model '
+                'update (SGD and NAG fuse); use trainer.step instead'
+                % type(trainer._optimizer).__name__)
+        ctxs = list(trainer._contexts) or [None]
+        self._ctxs = ctxs
+        if mesh is None and len(ctxs) > 1:
+            devices = [c.jax_device() for c in ctxs]
+            if len(set(devices)) != len(devices):
+                raise ValueError('duplicate devices in the trainer '
+                                 'contexts: %s' % (ctxs,))
+            mesh = pmesh.make_mesh(devices=devices)
+        self._mesh = mesh
+        self._zero = zero_mod.zero_stage(zero)
+        self._params = None          # trainable, trainer order
+        self._aux_params = None      # grad_req='null' (BatchNorm stats)
+        self._frozen_params = None   # in the net but not the trainer
+        self._programs = {}          # local key -> compiled step fn
+        self._loss_treedef = None
+        self._rng = None
+        self._placed = False
+        self._deferred_done = False
+        # mesh mode: id(param) -> (replicated parent, ctx0 shard view).
+        # The parent is the fused step's truth; the per-context slots
+        # hold per-device shard VIEWS of it so eager/imperative code
+        # (eval forwards, metrics) keeps seeing single-device arrays.
+        # The view identity doubles as the staleness check: a user
+        # set_data() replaces the slot array, and the next step
+        # re-replicates from it.
+        self._repl = {}
+        trainer._fused_step = self
+
+    # -- parameter partition ---------------------------------------------
+    def _collect_params(self):
+        if self._params is not None:
+            return
+        allp = dict(self._net.collect_params().items())
+        if hasattr(self._loss, 'collect_params'):
+            for name, p in self._loss.collect_params().items():
+                allp.setdefault(name, p)
+        trainable = {id(p) for p in self._trainer._params}
+        aux, frozen = [], []
+        for name in sorted(allp):
+            p = allp[name]
+            if id(p) in trainable:
+                continue
+            (aux if p.grad_req == 'null' else frozen).append(p)
+        # trainable params keep the TRAINER's order: FusedSGD state is
+        # keyed by the trainer's integer indices, so fused checkpoints
+        # are byte-compatible with the per-key Updater's (Trainer
+        # save_states/load_states round-trips across both paths)
+        self._params = list(self._trainer._params)
+        self._aux_params = aux
+        self._frozen_params = frozen
+
+    def _finish_deferred(self, arrays, bulk):
+        """Deferred-shape params complete on a real (eager, paused)
+        forward — run one with the first batch before compiling.
+        One-time: once nothing is pending it never can be again, so
+        the per-step hot path skips the block-tree walk."""
+        if self._deferred_done:
+            return
+        pending = any(p._deferred_init for p in
+                      self._net.collect_params().values())
+        if not pending:
+            self._deferred_done = True
+            return
+        n_data = len(arrays) if self._loss is None else len(arrays) - 1
+        from .. import autograd
+        with autograd.pause(train_mode=False):
+            ins = [nd.NDArray(a[0] if bulk else a) for a in
+                   arrays[:n_data]]
+            self._net(*ins)
+        self._deferred_done = True
+
+    def _place(self):
+        """Commit parameters/PRNG to the step's placement once:
+        replicated over the mesh (batches arrive sharded; XLA partitions
+        the one program — SPMD), or the single context's device."""
+        if self._mesh is not None:
+            for p in (self._params + self._aux_params +
+                      self._frozen_params):
+                self._gather_param(p)
+            self._rng = jax.device_put(_random.next_key(),
+                                       pmesh.replicated(self._mesh))
+        else:
+            dev = self._ctxs[0].jax_device() if self._ctxs[0] is not None \
+                else None
+            key = _random.next_key()
+            self._rng = jax.device_put(key, dev) if dev is not None \
+                else key
+        self._placed = True
+
+    def _gather_param(self, p):
+        """The parameter's value as the step program sees it: the
+        mesh-replicated parent when current, re-replicated from the
+        ctx0 slot when user code replaced it (set_data, load_params)."""
+        cur = p.list_data()[0]._data
+        if self._mesh is None:
+            return cur
+        ent = self._repl.get(id(p))
+        if ent is not None and ent[1] is cur:
+            return ent[0]
+        repl = jax.device_put(cur, pmesh.replicated(self._mesh))
+        self._writeback_param(p, repl)
+        return repl
+
+    def _writeback_param(self, p, value):
+        """Write a step result (or fresh replication) back into the
+        parameter: single-device mode rebinds all slots to `value`;
+        mesh mode keeps `value` as the replicated parent and gives
+        each context its device's shard view (no copy)."""
+        if self._mesh is None:
+            p._rebind_all_ctx(value)
+            return
+        p._rebind_all_ctx({s.device: s.data
+                           for s in value.addressable_shards})
+        self._repl[id(p)] = (value, p.list_data()[0]._data)
+
+    # -- program construction ---------------------------------------------
+    def _forward_loss(self, ws, auxs, frozen, ins, rng):
+        """The pure forward+loss body: substitute every parameter,
+        route RNG through the traced key, return (scalar_total,
+        (loss_leaves, new_aux)).  The scalar is the SUM of all loss
+        elements (each leaf summed in its own dtype) — exactly the
+        ones-head cotangent `loss.backward()` uses, so gradients match
+        the imperative path."""
+        tps, aps, fps = self._params, self._aux_params, \
+            self._frozen_params
+        sub = {p: nd.NDArray(v) for p, v in zip(tps, ws)}
+        sub.update({p: nd.NDArray(v) for p, v in zip(aps, auxs)})
+        sub.update({p: nd.NDArray(v) for p, v in zip(fps, frozen)})
+        with block_mod.param_trace(sub, rng, train_mode=True):
+            in_nd = [nd.NDArray(v) for v in ins]
+            if self._loss is not None:
+                out = self._net(*in_nd[:-1])
+                if isinstance(out, (list, tuple)):
+                    l = self._loss(*out, in_nd[-1])
+                else:
+                    l = self._loss(out, in_nd[-1])
+            else:
+                l = self._net(*in_nd)
+        leaves, treedef = jtu.tree_flatten(
+            l, is_leaf=lambda a: isinstance(a, nd.NDArray))
+        self._loss_treedef = treedef     # static; fixed at trace time
+        loss_leaves = tuple(x._data for x in leaves)
+        total = None
+        for x in loss_leaves:
+            s = jnp.sum(x).astype(jnp.float32)
+            total = s if total is None else total + s
+        new_aux = tuple(sub[p]._data for p in aps)
+        return total, (loss_leaves, new_aux)
+
+    def _make_step_fn(self, fu, bulk, k):
+        mesh, zero = self._mesh, self._zero
+        step_math = fu.step_math
+        forward_loss = self._forward_loss
+
+        def one_step(ws, auxs, moms, masters, rng, frozen, ins, lrs,
+                     wds):
+            rng, sub = jax.random.split(rng)
+            f = lambda w: forward_loss(w, auxs, frozen, ins, sub)
+            ((_, (loss_leaves, new_aux)), grads) = jax.value_and_grad(
+                f, has_aux=True)(tuple(ws))
+            grads = list(grads)
+            if mesh is not None and not zero:
+                # pin gradients replicated: the partitioner lowers the
+                # cross-replica sum as an all-reduce INSIDE this same
+                # program (the kvstore push/pull role; under ZeRO the
+                # sharded step_math reduce-scatters instead)
+                grads = [collectives.allreduce_bucket(g, mesh)
+                         for g in grads]
+            new_ws, new_moms, new_masters = step_math(
+                list(ws), grads, moms, masters, lrs, wds)
+            return (loss_leaves, tuple(new_ws), new_aux, new_moms,
+                    new_masters, rng)
+
+        if not bulk:
+            def step_fn(ws, auxs, moms, masters, rng, frozen, ins, lrs,
+                        wds):
+                return one_step(ws, auxs, moms, masters, rng, frozen,
+                                ins, lrs, wds)
+            return step_fn
+
+        def step_fn(ws, auxs, moms, masters, rng, frozen, ins, lrs,
+                    wds):
+            def body(carry, xs):
+                ws, auxs, moms, masters, rng = carry
+                (loss_leaves, ws, auxs, moms, masters,
+                 rng) = one_step(ws, auxs, moms, masters, rng, frozen,
+                                 xs, lrs, wds)
+                return (ws, auxs, moms, masters, rng), loss_leaves
+
+            init = (tuple(ws), tuple(auxs), moms, masters, rng)
+            (ws, auxs, moms, masters, rng), losses = jax.lax.scan(
+                body, init, tuple(ins))
+            return losses, ws, auxs, moms, masters, rng
+
+        return step_fn
+
+    def _placement_fp(self):
+        """Device identity for the program cache: AOT compilation
+        bakes concrete placements, so same-architecture steps on
+        different devices/meshes must key apart."""
+        if self._mesh is not None:
+            return ('mesh', tuple(self._mesh.axis_names),
+                    tuple(str(d) for d in self._mesh.devices.flat))
+        if self._ctxs[0] is not None:
+            return ('dev', str(self._ctxs[0].jax_device()))
+        return ('dev', 'default')
+
+    def _get_program(self, fu, fkey, bulk, k, args):
+        """Resolve the compiled step through the process-wide
+        exec_cache: the key is the blake2b fingerprint of the step
+        function's ABSTRACT jaxpr (name-free: auto-prefixes and
+        Parameter identities trace away) + FusedSGD.cache_key +
+        device placement, so an equivalent re-created net/Trainer
+        reuses the executable with zero new XLA compilations (the
+        fingerprint trace itself compiles nothing).  The cached value
+        is the AOT-COMPILED executable: it holds no Python closure,
+        so a cache entry never pins a discarded net's weights."""
+        step_fn = self._make_step_fn(fu, bulk, k)
+        sds = jtu.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, 'shape') else a, args)
+        jaxpr = jax.make_jaxpr(step_fn)(*sds)
+        # the pretty-printer leaks object identities into some eqn
+        # params (custom_jvp thunks print as '<function ... at 0x...>');
+        # scrub addresses so equal programs fingerprint equally
+        canon = re.sub(r'0x[0-9a-f]+', '0x', str(jaxpr))
+        fp = hashlib.blake2b(canon.encode(), digest_size=16).hexdigest()
+        key = exec_cache.gluon_step_key(fp, fkey,
+                                        'bulk' if bulk else 'step', k,
+                                        self._placement_fp())
+        if exec_cache.enabled():
+            fn = exec_cache.get(key, count=True)
+            if fn is not None:
+                return fn
+        lowered = jax.jit(step_fn,
+                          donate_argnums=(0, 1, 2, 3, 4)).lower(*args)
+        fn = exec_cache.timed_compile(lowered)
+        if exec_cache.enabled():
+            exec_cache.put(key, fn)
+        return fn
+
+    # -- optimizer plumbing -----------------------------------------------
+    def _ensure_updater(self, batch_size):
+        """The trainer-owned FusedSGD, rebuilt when rescale_grad
+        changes (Trainer.step semantics: rescale = scale/batch_size is
+        baked into the step closure and its cache key; optimizer state
+        transfers through the mode-portable checkpoint format)."""
+        tr = self._trainer
+        rescale = tr._scale / batch_size
+        fu = tr._fused_updater
+        # compare the BAKED rescale, not the live optimizer attribute:
+        # an interleaved trainer.step(other_batch) mutates
+        # optimizer.rescale_grad without touching fu's captured value
+        if fu is not None and fu.optimizer is tr._optimizer and \
+                fu._baked['rescale'] == float(rescale):
+            return fu
+        tr._optimizer.rescale_grad = rescale
+        new = opt_mod.create_fused_updater(
+            tr._optimizer, list(range(len(self._params))),
+            zero=self._zero, mesh=self._mesh)
+        if new is None:
+            raise ValueError(
+                'fuse_step: optimizer %s has no fused whole-model '
+                'update (SGD and NAG fuse); use trainer.step instead'
+                % type(tr._optimizer).__name__)
+        if fu is not None:
+            new.transfer_states_from(fu)
+        elif tr._pending_fused_states is not None:
+            new.set_states(tr._pending_fused_states)
+            tr._pending_fused_states = None
+        tr._fused_updater = new
+        return new
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args, batch_size=None):
+        """One fused training step.  args: the net inputs followed by
+        the loss label (no label when loss is None).  batch_size
+        defaults to the first input's leading dim (Trainer.step's
+        1/batch_size gradient scaling).  Returns the per-sample
+        loss (net output structure preserved)."""
+        return self._run(args, bulk=False, batch_size=batch_size)
+
+    def bulk(self, *args, batch_size=None):
+        """K fused steps in ONE dispatch, looping on-device via
+        lax.scan (Module.bulk_step analog).  Each arg carries a leading
+        K axis ((K, batch, ...) stacks); lr/wd are loop-invariant for
+        the K steps.  Returns the per-step losses stacked on a leading
+        K axis."""
+        return self._run(args, bulk=True, batch_size=batch_size)
+
+    def _run(self, args, bulk, batch_size):
+        if self._loss is not None and len(args) < 2:
+            raise ValueError('fused step needs (inputs..., label); '
+                             'got %d argument(s)' % len(args))
+        arrays = tuple(a._data if isinstance(a, nd.NDArray)
+                       else jnp.asarray(a) for a in args)
+        k = int(arrays[0].shape[0]) if bulk else 1
+        if bulk and k == 0:
+            raise ValueError('bulk: stacked inputs have K=0 steps')
+        if batch_size is None:
+            batch_size = int(arrays[0].shape[1 if bulk else 0])
+        self._collect_params()
+        self._finish_deferred(arrays, bulk)
+        fu = self._ensure_updater(batch_size)
+        tr = self._trainer
+        if tr._last_update_mode == 'unfused' and tr._updaters and \
+                tr._updaters[0].states:
+            # the per-key path trained since the last fused step: adopt
+            # its momenta/update-counts so the two paths share ONE
+            # optimizer-state history (mode switches only — one host
+            # round-trip per switch, not per step)
+            fu.set_states(tr._updaters[0].get_states())
+        if not self._placed:
+            self._place()
+        ws = [self._gather_param(p) for p in self._params]
+        # host_prep reads shape/dtype/_data (momenta adopt the weight's
+        # sharding) — hand it the replicated parents, not the views
+        weights = [nd.NDArray(w, self._ctxs[0]) for w in ws]
+        moms, masters, lrs, wds = fu.host_prep(weights)
+        # plain floats: the AOT program baked weak-f32 scalar avals (an
+        # np scalar from an lr scheduler would mismatch them)
+        lrs = [float(v) for v in lrs]
+        wds = [float(v) for v in wds]
+        for _ in range(k - 1):       # host_prep bumped counts once
+            for i in fu.param_names:
+                self._trainer._optimizer._update_count(i)
+        if self._mesh is not None:
+            arrays = tuple(pmesh.shard_batch(self._mesh, a,
+                                             dim=1 if bulk else 0)
+                           for a in arrays)
+        elif self._ctxs[0] is not None:
+            # inputs often arrive committed to the default device; the
+            # donated dispatch needs them on the weights' device
+            dev = self._ctxs[0].jax_device()
+            arrays = tuple(jax.device_put(a, dev) for a in arrays)
+        fkey = fu.cache_key()
+        shapes = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        local = ('bulk' if bulk else 'step', k, shapes, fkey)
+        auxs = [self._gather_param(p) for p in self._aux_params]
+        frozen = [self._gather_param(p) for p in self._frozen_params]
+        prog = self._programs.get(local)
+        if prog is None:
+            prog = self._get_program(
+                fu, fkey, bulk, k,
+                (ws, auxs, moms, masters, self._rng, frozen, arrays,
+                 lrs, wds))
+            self._programs[local] = prog
+        with profiler.scope('gluon_fused_%s' % ('bulk' if bulk
+                                                else 'step'),
+                            'gluon_fused'):
+            (loss_out, new_ws, new_aux, new_moms, new_masters,
+             self._rng) = prog(ws, auxs, moms, masters, self._rng,
+                               frozen, arrays, lrs, wds)
+            if profiler.is_running():
+                jax.block_until_ready(loss_out)
+        for p, w in zip(self._params, new_ws):
+            self._writeback_param(p, w)
+        for p, a in zip(self._aux_params, new_aux):
+            self._writeback_param(p, a)
+        fu.commit(new_moms, new_masters)
+        self._trainer._last_update_mode = 'fused'
+        profiler.add_gluon_fused_stats(steps=k, dispatches=1)
+        rs, ag = fu.comm_bytes_per_step()
+        if rs or ag:
+            profiler.add_comm_bytes(reduce_scattered=rs * k,
+                                    all_gathered=ag * k)
+        profiler.set_optimizer_state_bytes(fu.state_bytes_per_device())
+        ctx = self._ctxs[0]
+        out = [nd.NDArray(v, ctx) for v in loss_out]
+        return jtu.tree_unflatten(self._loss_treedef, out)

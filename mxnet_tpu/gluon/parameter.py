@@ -20,6 +20,13 @@ class DeferredInitializationError(MXNetError):
     """Raised when parameter data is requested before shapes are known."""
 
 
+# Bound by block.py at import time (avoids a circular import): during
+# jit tracing of a block (hybridize cache / gluon.fused whole-step
+# compilation) parameters resolve to traced substitutes, so even blocks
+# that read weights via Parameter.data() directly trace purely.
+_lookup_param_substitution = None
+
+
 class Parameter(object):
     """A trainable parameter: holds data (per context) and gradient.
 
@@ -155,6 +162,10 @@ class Parameter(object):
             "initialized on %s." % (self.name, ctx, list(store)))
 
     def data(self, ctx=None):
+        if _lookup_param_substitution is not None:
+            sub = _lookup_param_substitution(self)
+            if sub is not None:
+                return sub
         return self._check_and_get(self._data, ctx)
 
     def list_data(self):
@@ -191,6 +202,23 @@ class Parameter(object):
             new.grad_req = old.grad_req
             new._grad = old._grad
             self._data[c] = new
+
+    def _rebind_all_ctx(self, value):
+        """Rebind every context copy's device buffer without a copy —
+        the fused train step's write-back path.  `value` is either one
+        jax array shared by all contexts (single-device training) or a
+        dict jax.Device -> array of per-device shard VIEWS (mesh
+        training: each context gets its own device's view of the
+        replicated parent, so eager/imperative code keeps operating on
+        single-device arrays).  Grad attachment stays live (the
+        NDArray holders are reused, only their buffers rebind)."""
+        self._finish_lazy()
+        if isinstance(value, dict):
+            for c, arr in self._data.items():
+                arr._data = value[c.jax_device()]
+        else:
+            for arr in self._data.values():
+                arr._data = value
 
     def zero_grad(self):
         if self._grad is None:

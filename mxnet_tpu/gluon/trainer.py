@@ -3,11 +3,21 @@
 Applies an Optimizer to a set of Parameters.  Where the reference routes
 gradients through KVStore push/pull (trainer.py _init_kvstore:95
 reusing model._create_kvstore), the TPU build reduces across devices
-with the KVStore facade (XLA collectives / explicit device reduce) and
-runs the updater locally.
+in ONE batched dispatch (all parameters' gradients flattened,
+concatenated per device, summed in a single stacked reduction — the
+PR 2 `_push_impl` fix applied across the whole parameter list) and runs
+the updater locally.
+
+The fused path (`gluon.fuse_step(net, loss, trainer)` →
+`trainer.step_fused(batch_size, x, y)`) goes further: forward, loss,
+backward, gradient reduce, and the optimizer update compile into one
+donated XLA program — see gluon/fused.py.
 """
+import numpy as np
+
 from .. import optimizer as opt
 from .. import kvstore as kvs
+from .. import profiler
 from .parameter import ParameterDict, Parameter
 
 
@@ -35,6 +45,13 @@ class Trainer(object):
         self._kv_type = kvstore
         self._kvstore = None
         self._kv_initialized = False
+        # fused whole-step training (gluon/fused.py): the FusedStep
+        # registers itself here; its FusedSGD holds the optimizer state
+        # of the fused path (checkpoint-compatible with _updaters)
+        self._fused_step = None
+        self._fused_updater = None
+        self._pending_fused_states = None
+        self._last_update_mode = None   # 'fused' | 'unfused' | None
 
     def _check_contexts(self):
         contexts = None
@@ -67,9 +84,10 @@ class Trainer(object):
 
     def _init_kvstore(self):
         if self._kv_type and len(self._contexts) > 1:
+            # the store is kept as the distribution facade (rank/size/
+            # barrier); the per-step gradient reduce no longer routes
+            # through per-key push/pull — see _batched_reduce_grads
             self._kvstore = kvs.create(self._kv_type)
-            for i, param in enumerate(self._params):
-                self._kvstore.init(i, param.data(self._contexts[0]))
         self._kv_initialized = True
 
     @property
@@ -79,6 +97,51 @@ class Trainer(object):
     def set_learning_rate(self, lr):
         self._optimizer.lr = lr
 
+    def _batched_reduce_grads(self):
+        """Sum every parameter's per-device gradients in ONE stacked
+        reduction per dtype group (flatten + concat per device, stack,
+        sum, slice back), replacing the per-parameter kvstore
+        push/pull Python loop — the fallback path stops dispatching
+        per param.  The summed gradient is written back to every
+        device copy (pull semantics)."""
+        import jax
+        import jax.numpy as jnp
+        work = [p for p in self._params
+                if p.grad_req != 'null' and len(p.list_grad()) > 1]
+        if not work:
+            return
+        groups = {}
+        for p in work:
+            g0 = p.list_grad()[0]
+            groups.setdefault(np.dtype(g0.dtype).str, []).append(p)
+        with profiler.scope('trainer_batched_reduce', 'kvstore'):
+            for params in groups.values():
+                glists = [p.list_grad() for p in params]
+                ndev = len(glists[0])
+                dev0 = glists[0][0].context.jax_device()
+                flats = []
+                for d in range(ndev):
+                    # ONE device_put per device moves the whole grad
+                    # pytree (not one transfer per param)
+                    parts = jax.device_put(
+                        [gl[d]._data for gl in glists], dev0)
+                    parts = [v.reshape(-1) for v in parts]
+                    flats.append(parts[0] if len(parts) == 1
+                                 else jnp.concatenate(parts))
+                total = jnp.sum(jnp.stack(flats), axis=0)
+                for d in range(ndev):
+                    # one summed-vector transfer per device; the
+                    # per-param views slice locally on that device
+                    dev = glists[0][d].context.jax_device()
+                    tot_d = total if dev == dev0 else \
+                        jax.device_put(total, dev)
+                    off = 0
+                    for gl in glists:
+                        n = gl[0].size
+                        gl[d]._data = tot_d[off:off + n].reshape(
+                            gl[0].shape)
+                        off += n
+
     def step(self, batch_size, ignore_stale_grad=False):
         """Apply one optimization step using recorded gradients, scaled
         by 1/batch_size (reference trainer.py step:116)."""
@@ -86,25 +149,58 @@ class Trainer(object):
             self._init_kvstore()
         self._optimizer.rescale_grad = self._scale / batch_size
 
+        if self._last_update_mode == 'fused' and \
+                self._fused_updater is not None:
+            # the fused path trained since the last per-key step: adopt
+            # its momenta/update-counts so the two paths share ONE
+            # optimizer-state history (mode switches only)
+            states = self._fused_updater.get_states()
+            for updater in self._updaters:
+                updater.set_states(states)
+        if self._kvstore is not None:
+            self._batched_reduce_grads()
         for i, param in enumerate(self._params):
             if param.grad_req == 'null':
                 continue
-            grads = param.list_grad()
-            datas = param.list_data()
-            if self._kvstore is not None and len(grads) > 1:
-                # sum gradients across devices, broadcast back
-                self._kvstore.push(i, grads)
-                self._kvstore.pull(i, out=grads)
-                for upd, d, g in zip(self._updaters, datas, grads):
-                    upd(i, g, d)
-            else:
-                for upd, d, g in zip(self._updaters, datas, grads):
-                    upd(i, g, d)
+            for upd, d, g in zip(self._updaters, param.list_data(),
+                                 param.list_grad()):
+                upd(i, g, d)
+        self._last_update_mode = 'unfused'
+
+    def step_fused(self, batch_size, *args):
+        """One whole-step-compiled training step: forward → loss →
+        backward → grad-reduce → optimizer update in ONE donated XLA
+        dispatch.  Requires `gluon.fuse_step(net, loss, trainer)` to
+        have been called on this trainer first (it supplies the net
+        and loss this trainer cannot know).  args are the fused step's
+        inputs (net inputs..., label).  Returns the per-sample loss."""
+        if self._fused_step is None:
+            raise ValueError(
+                'step_fused: no fused step attached to this Trainer; '
+                'build one with gluon.fuse_step(net, loss, trainer)')
+        return self._fused_step(*args, batch_size=batch_size)
 
     def save_states(self, fname):
+        """Checkpoint the optimizer states.  The fused and per-key
+        paths share one mode-portable format (per-param arrays +
+        update counts; ZeRO bucket shards are gathered and unpacked),
+        so a fused run's states restore into an un-fused trainer and
+        vice versa — including a save before the first step."""
         assert self._optimizer is not None
+        updater = self._checkpoint_updater()
         with open(fname, 'wb') as f:
-            f.write(self._updaters[0].get_states())
+            f.write(updater.get_states())
+
+    def _checkpoint_updater(self):
+        """The updater holding the current optimizer-state truth: the
+        path that ran last wins; before any step, the fused updater
+        (if built) and the per-key updaters are equally (and
+        trivially) current."""
+        if self._last_update_mode == 'fused' or (
+                self._last_update_mode is None and
+                self._fused_updater is not None):
+            return self._fused_updater
+        return self._updaters[0]
 
     def load_states(self, fname):
         if not self._kv_initialized:
@@ -113,3 +209,10 @@ class Trainer(object):
             states = f.read()
         for updater in self._updaters:
             updater.set_states(states)
+        if self._fused_updater is not None:
+            self._fused_updater.set_states(states)
+        else:
+            # applied when fuse_step builds the fused updater (a load
+            # before the first fused step must not be lost)
+            self._pending_fused_states = states
+        self._last_update_mode = None

@@ -35,7 +35,8 @@ _DTYPE_ALIASES = {'float32': np.float32, 'float64': np.float64,
 
 class NDArray:
     """An n-dimensional array on a device (CPU or TPU)."""
-    __slots__ = ('_data', '_ctx', 'grad_req', '_grad', '_fresh_grad')
+    __slots__ = ('_data', '_ctx', 'grad_req', '_grad', '_fresh_grad',
+                 '__weakref__')
 
     def __init__(self, data, ctx=None):
         self._data = data

@@ -510,10 +510,9 @@ class Updater:
 
     def set_states(self, states):
         payload = pickle.loads(states)
+        masters = None
         if isinstance(payload, tuple) and len(payload) == 3:
-            # fused-updater checkpoints carry fp32 masters as a third
-            # member; the per-key path re-derives masters lazily
-            states, counts, _ = payload
+            states, counts, masters = payload
         elif isinstance(payload, tuple):
             states, counts = payload
         else:
@@ -523,6 +522,17 @@ class Updater:
                 if isinstance(v, (list, tuple)) else
                 (nd.array(v) if v is not None else None))
             for k, v in states.items()}
+        if masters:
+            # fused-updater checkpoints carry the fp32 masters as a
+            # third member: rebuild the per-key (momentum, master)
+            # pair states, because the mp update path cannot re-derive
+            # a lost master (create_state never re-runs once the index
+            # has a state) — dropping it would silently promote the
+            # low-precision weight to fp32 on the next update
+            for k, m in masters.items():
+                if m is None or isinstance(self.states.get(k), list):
+                    continue
+                self.states[k] = [self.states.get(k), nd.array(m)]
         if counts is not None:
             self.optimizer._index_update_count = dict(counts)
 
@@ -613,6 +623,15 @@ class FusedSGD:
         nesterov = isinstance(optimizer, NAG)
         multi_precision = bool(getattr(optimizer, 'multi_precision',
                                        False))
+        # hypers are captured BY VALUE here (the step closures bake
+        # them in); cache_key must report these captured values, not
+        # live optimizer attributes — the gluon Trainer mutates
+        # rescale_grad per step() call, and a key that tracked the
+        # mutation would relabel this object's unchanged math
+        self._baked = {'momentum': float(momentum),
+                       'rescale': float(rescale),
+                       'clip': None if clip is None else float(clip),
+                       'nesterov': nesterov}
 
         def step(ws, gs, moms, masters, lrs, wds):
             new_ws, new_moms, new_masters = [], [], []
@@ -658,11 +677,9 @@ class FusedSGD:
         bakes in (lr/wd are runtime arguments, not part of the key).
         The ZeRO stage, bucket layout, and mesh join the key so sharded
         and replicated step programs never alias in exec_cache."""
-        o = self.optimizer
-        key = ('FusedSGD', type(o).__name__, float(o.momentum),
-               float(o.rescale_grad),
-               None if o.clip_gradient is None
-               else float(o.clip_gradient),
+        b = self._baked
+        key = ('FusedSGD', type(self.optimizer).__name__,
+               b['momentum'], b['rescale'], b['clip'],
                self.multi_precision)
         if self.zero:
             key += (('zero', self.zero,
@@ -857,6 +874,46 @@ class FusedSGD:
             w._data = nw
         self.commit(new_moms, new_masters)
 
+    def transfer_states_from(self, other):
+        """Adopt another FusedSGD's optimizer state (same param_names):
+        the gluon fused path rebuilds its updater when rescale_grad
+        changes (the step closure bakes it in), and the momenta / fp32
+        masters must survive.  Replicated->replicated transfers share
+        the device buffers by reference (no host round-trip — the old
+        updater is discarded, so nothing else aliases them); ZeRO
+        sources/targets go through the mode-portable checkpoint
+        format."""
+        if not self.zero and not other.zero:
+            self.states = dict(other.states)
+            self.masters = dict(other.masters)
+            if other.optimizer is not self.optimizer:
+                self.optimizer._index_update_count = \
+                    dict(other.optimizer._index_update_count)
+            return
+        self.set_states(other.get_states())
+
+    @staticmethod
+    def _split_updater_states(states, masters):
+        """Normalize checkpoint state values into (momenta, masters)
+        dicts: the per-key Updater stores None for momentum-free SGD
+        and [momentum, fp32_master] pairs for multi-precision params,
+        while FusedSGD checkpoints carry momenta and masters
+        separately.  Missing entries re-materialize lazily in
+        host_prep (zeros momenta / masters re-derived from weights) —
+        the same backfill a fresh start uses."""
+        moms = {}
+        out_masters = {n: v for n, v in (masters or {}).items()
+                       if v is not None}
+        for n, v in states.items():
+            if isinstance(v, (list, tuple)):
+                if len(v) > 0 and v[0] is not None:
+                    moms[n] = v[0]
+                if len(v) > 1 and v[1] is not None:
+                    out_masters.setdefault(n, v[1])
+            elif v is not None:
+                moms[n] = v
+        return moms, out_masters
+
     # checkpoint compatibility with Updater.get_states/set_states
     def get_states(self):
         """Checkpoint format is MODE-INDEPENDENT: ZeRO buckets are
@@ -913,26 +970,26 @@ class FusedSGD:
             states, counts = payload
         else:
             states, counts = payload, None
+        # normalize: per-key Updater checkpoints carry None (no
+        # momentum) and [mom, master] pair values — a fused updater
+        # must restore from those too (Trainer.load_states feeds both
+        # formats to both paths)
+        moms, masters = self._split_updater_states(states, masters)
         if self.zero:
             # stage per-param values; the next host_prep re-buckets
             # them into dp-sharded flat buffers (the layout, if already
             # built, stays valid — only the state buffers rebuild)
-            self._staged = (
-                {n: v for n, v in states.items() if v is not None},
-                {} if masters is None else
-                {n: v for n, v in masters.items() if v is not None})
+            self._staged = (moms, masters)
             self._zero_moms = None
             self._zero_masters = None
         else:
             import jax.numpy as jnp
-            self.states = {n: jnp.asarray(v) for n, v in states.items()}
+            self.states = {n: jnp.asarray(v) for n, v in moms.items()}
             # fp32 masters ride along with the momentum states;
-            # older/other checkpoints without them re-derive masters
-            # from the weights on the first update (__call__ backfills
-            # missing keys)
-            self.masters = {} if masters is None else {
-                n: (jnp.asarray(v) if v is not None else None)
-                for n, v in masters.items()}
+            # checkpoints without them re-derive masters from the
+            # weights at the next host_prep (backfills missing keys)
+            self.masters = {n: jnp.asarray(v)
+                            for n, v in masters.items()}
         if counts is not None:
             self.optimizer._index_update_count = dict(counts)
 

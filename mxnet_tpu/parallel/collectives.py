@@ -58,6 +58,17 @@ def allgather_bucket(x, mesh):
     return jax.lax.with_sharding_constraint(x, replicated(mesh))
 
 
+def allreduce_bucket(x, mesh):
+    """GSPMD all-reduce under plain `jax.jit`: constraining a value
+    whose partial sums live per-device (a gradient of replicated
+    params w.r.t. a dp-sharded batch) to be REPLICATED makes XLA's
+    partitioner lower the cross-replica sum as an all-reduce.  This is
+    the fused Gluon step's gradient aggregation — the role of
+    Trainer.step's per-parameter kvstore.push/pull, collapsed into the
+    compiled step (identity when no mesh is active)."""
+    return allgather_bucket(x, mesh)
+
+
 def ppermute(x, axis_name, perm):
     return lax.ppermute(x, axis_name, perm)
 

@@ -88,6 +88,35 @@ def input_stats():
     return out
 
 
+# fused Gluon training counters (gluon/fused.py): optimizer steps that
+# ran whole-step-compiled and the host dispatches that carried them
+# (bulk lax.scan programs run K steps per dispatch)
+_GLUON_FUSED = {
+    'gluon_fused_steps': 0,
+    'gluon_fused_dispatches': 0,
+}
+
+
+def add_gluon_fused_stats(steps=0, dispatches=0):
+    """Accumulate fused-Gluon counters (FusedStep feeds one call per
+    compiled dispatch; bulk dispatches carry steps=K)."""
+    with _STATE['lock']:
+        _GLUON_FUSED['gluon_fused_steps'] += int(steps)
+        _GLUON_FUSED['gluon_fused_dispatches'] += int(dispatches)
+
+
+def gluon_fused_stats():
+    """Snapshot of the fused-Gluon counters plus the derived mean
+    steps-per-dispatch (the on-device bulking factor actually
+    achieved)."""
+    with _STATE['lock']:
+        out = dict(_GLUON_FUSED)
+    out['gluon_fused_steps_per_dispatch'] = (
+        out['gluon_fused_steps'] / out['gluon_fused_dispatches']
+        if out['gluon_fused_dispatches'] else 0.0)
+    return out
+
+
 # serving-engine counters (serving.InferenceEngine's dynamic batcher):
 # coalesced dispatches, batch fill / pad waste, batcher queue depth
 # observations, and a bounded ring of request latencies for p50/p99
@@ -230,6 +259,8 @@ def dump_profile():
                    'args': input_stats()})
     events.append({'ph': 'M', 'name': 'serving', 'pid': 0,
                    'args': serving_stats()})
+    events.append({'ph': 'M', 'name': 'gluon_fused', 'pid': 0,
+                   'args': gluon_fused_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -331,6 +362,12 @@ def summary(print_out=True):
                     sv['serve_pad_waste_frac'],
                     sv['serve_latency_p50_ms'],
                     sv['serve_latency_p99_ms']))
+    gf = gluon_fused_stats()
+    lines.append('  gluon_fused_steps=%d gluon_fused_dispatches=%d '
+                 'gluon_fused_steps_per_dispatch=%.2f'
+                 % (gf['gluon_fused_steps'],
+                    gf['gluon_fused_dispatches'],
+                    gf['gluon_fused_steps_per_dispatch']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -363,6 +400,8 @@ def clear():
             _INPUT[k] = type(_INPUT[k])()
         for k in _SERVING:
             _SERVING[k] = type(_SERVING[k])()
+        for k in _GLUON_FUSED:
+            _GLUON_FUSED[k] = 0
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0
 

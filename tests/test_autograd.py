@@ -102,6 +102,48 @@ def test_softmax_output_backward_semantics():
     np.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
 
 
+def test_backward_releases_tape_refs():
+    """backward(retain_graph=False) must clear the tape IN PLACE and
+    drop node->NDArray references, so a step's activations free at the
+    step boundary even while something else still holds the tape list
+    or a node — not at the next record()."""
+    import gc
+    import weakref
+
+    del autograd._st().tape[:]   # residue from recorded-only tests
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = y + 1
+    tape = autograd._st().tape
+    assert len(tape) == 2
+    node = tape[0]
+    wr = weakref.ref(y)
+    z.backward()
+    del y, z
+    gc.collect()
+    # in-place clear: the captured list emptied, the captured node
+    # dropped its array references, the intermediate activation died
+    assert tape is autograd._st().tape and len(tape) == 0
+    assert node.inputs == () and node.outputs == ()
+    assert wr() is None
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+
+def test_backward_retain_graph_keeps_tape():
+    del autograd._st().tape[:]   # residue from recorded-only tests
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    assert len(autograd._st().tape) == 1
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+    y.backward()   # second replay, then the graph frees
+    assert len(autograd._st().tape) == 0
+
+
 def test_custom_function():
     class Sigmoid(autograd.Function):
         def forward(self, x):

@@ -31,10 +31,28 @@ def main():
     p.add_argument('--warmup', type=int, default=2)
     p.add_argument('--bulk', type=int, default=16)
     p.add_argument('--dtype', default='bfloat16')
+    p.add_argument('--gluon', action='store_true',
+                   help='run the BENCH_GLUON fused-Gluon training '
+                        'smoke (one bench.py child) instead of the '
+                        'model-family sweep')
     args = p.parse_args()
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
+    if args.gluon:
+        env = dict(os.environ, BENCH_GLUON='1')
+        proc = subprocess.run([sys.executable, bench_py], env=env,
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('gluon bench failed')
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            # zero-exit child with no JSON: broken relay, not success
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('gluon bench produced no output')
+        print(lines[-1], flush=True)
+        return
     for name in args.models.split(','):
         name = name.strip()
         env = dict(os.environ, BENCH_MODEL=name,
