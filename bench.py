@@ -35,8 +35,14 @@ InferenceEngine against serial per-request Predictor.forward and emit
 a throughput + latency-percentile JSON line instead of the training
 bench — see serve_bench() / tools/serve_bench.py for the knobs),
 BENCH_GLUON=1 (fused Gluon training mode: whole-step-compiled
-imperative training vs the per-dispatch early-Gluon loop — see
-gluon_bench() for the BENCH_GLUON_* knobs),
+imperative training vs the per-dispatch early-Gluon loop, plus the
+scan-fused-metrics arm — see gluon_bench() for the BENCH_GLUON_*
+knobs),
+BENCH_OVERLAP=1 (gradient-reduction schedule A/B: backward-interleaved
+bucket-by-bucket all-reduce vs the end-of-backward baseline on a
+data-parallel mesh — see overlap_bench() for the BENCH_OVERLAP_*
+knobs; re-execs onto a virtual CPU mesh when the process has too few
+devices),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -281,6 +287,14 @@ def gluon_bench():
     check (both arms trained from identical init; the gate reflects
     the float32-ulp agreement of the two program partitions).
 
+    Round 11 adds two metric arms: `metric_scan` (accuracy folded
+    INTO the bulk lax.scan — device-resident carry, one queued delta
+    pair per dispatch, no host sync) vs `metric_host` (per-step fused
+    dispatch + eager metric forward + host update — the pre-round-11
+    way to see per-batch train accuracy, which breaks the bulk at
+    every metric boundary).  Their ratio is the epoch-fusion win;
+    the JSON also carries scan_fused_metric_steps.
+
     Arms run best-of-BENCH_GLUON_PASSES interleaved (the rig's
     cpu-shares throttle swings single passes ~2x).  Knobs:
     BENCH_GLUON_BATCH (64), BENCH_GLUON_DIM (64), BENCH_GLUON_HIDDEN
@@ -355,21 +369,54 @@ def gluon_bench():
             l = fused.bulk(xs, ys)
         l.asnumpy()
 
+    # scan-fused-metrics arm (round 11): accuracy accumulates INSIDE
+    # the bulk scan (device-resident carry, deltas queued without a
+    # sync) vs the pre-round-11 way to get per-batch train accuracy —
+    # a per-step fused dispatch plus an eager metric forward + host
+    # update, which breaks the bulk at every metric boundary
+    from mxnet_tpu import metric as metric_mod
+    acc_scan = metric_mod.Accuracy()
+    net_m = make_net(1)
+    tr_m = gluon.Trainer(net_m.collect_params(), 'sgd', dict(opt_params))
+    fused_m = gluon.fuse_step(net_m, loss_fn, tr_m, metric=acc_scan)
+    acc_host = metric_mod.Accuracy()
+    net_h = make_net(1)
+    tr_h = gluon.Trainer(net_h.collect_params(), 'sgd', dict(opt_params))
+    fused_h = gluon.fuse_step(net_h, loss_fn, tr_h)
+
+    def metric_scan_steps(n):
+        for _ in range(max(1, n // bulk)):
+            l = fused_m.bulk(xs, ys)
+        l.asnumpy()
+
+    def metric_host_steps(n):
+        for _ in range(n):
+            l = fused_h(x, y)
+            acc_host.update([y], [net_h(x)])
+        l.asnumpy()
+
     # warmup (compiles) outside the clock
     imperative_steps(2)
     fused_steps(2)
     bulk_steps(bulk)
+    metric_scan_steps(bulk)
+    metric_host_steps(2)
 
-    best = {'imperative': 0.0, 'fused': 0.0, 'bulk': 0.0}
+    best = {'imperative': 0.0, 'fused': 0.0, 'bulk': 0.0,
+            'metric_scan': 0.0, 'metric_host': 0.0}
     for _ in range(passes):
         for name, fn, n in (('imperative', imperative_steps, steps),
                             ('fused', fused_steps, steps),
                             ('bulk', bulk_steps,
-                             max(bulk, (steps // bulk) * bulk))):
+                             max(bulk, (steps // bulk) * bulk)),
+                            ('metric_scan', metric_scan_steps,
+                             max(bulk, (steps // bulk) * bulk)),
+                            ('metric_host', metric_host_steps, steps)):
             tic = time.time()
             fn(n)
             sps = n / (time.time() - tic)
             best[name] = max(best[name], sps)
+    assert 0.0 <= acc_scan.get()[1] <= 1.0   # deltas drained cleanly
 
     # parity from identical init (fresh nets: the measured ones drifted
     # apart over different step counts)
@@ -405,6 +452,12 @@ def gluon_bench():
             best['fused'] / best['imperative'], 3),
         'speedup_bulk_vs_imperative': round(
             best['bulk'] / best['imperative'], 3),
+        'metric_scan_sps': round(best['metric_scan'], 2),
+        'metric_host_sps': round(best['metric_host'], 2),
+        'speedup_metric_scan_vs_host': round(
+            best['metric_scan'] / max(best['metric_host'], 1e-9), 3),
+        'scan_fused_metric_steps':
+            profiler.comm_stats()['scan_fused_metric_steps'],
         'batch': batch, 'dim': dim, 'hidden': hidden, 'layers': layers,
         'steps_per_pass': steps, 'passes': passes, 'bulk': bulk,
         'imperative_hybridized': hybrid,
@@ -412,6 +465,164 @@ def gluon_bench():
         'gluon_fused_dispatches': gf['gluon_fused_dispatches'],
         'total_compile_s': round(cache['total_compile_s'], 3),
         'exec_cache_misses': cache['exec_cache_misses'],
+        'parity_max_abs_diff': max_diff,
+        'parity_ok': bool(max_diff < 1e-5),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# BENCH_OVERLAP=1: interleaved vs end-of-backward gradient reduction
+# ---------------------------------------------------------------------------
+
+def overlap_bench():
+    """BENCH_OVERLAP=1: A/B the gradient-reduction schedule on a
+    data-parallel mesh — backward-interleaved bucket-by-bucket
+    all-reduce (each bucket's collective issues as soon as its wgrads
+    exist, overlapping the remaining backward) vs the end-of-backward
+    baseline (optimization_barrier: all wgrads complete before any
+    reduce).  Values are identical across schedules (the barrier is
+    identity and the packed bucket psum is elementwise the per-param
+    psum), so the measured delta is schedule-only; a parity gate
+    asserts it.  Emits ONE JSON line with best-of-N steps/s per arm
+    (the rig's cpu-shares throttle swings single passes ~2x), the
+    reduce_buckets_issued / overlap_window_ms counters, and the
+    parity max-abs-diff.
+
+    Needs >= BENCH_OVERLAP_DEVICES devices: when the process has
+    fewer (no TPU pod on this rig), re-execs itself on a virtual CPU
+    mesh (same technique as dryrun_multichip).  NOTE on reading CPU
+    numbers: virtual host devices share the same cores, so collective
+    overlap cannot shorten wall-clock the way a real ICI fabric does —
+    expect parity there and treat the arm as a schedule-correctness +
+    counter smoke; the speedup story needs real chips (PERF round 11).
+
+    Knobs: BENCH_OVERLAP_DEVICES (4), BENCH_OVERLAP_BATCH (64),
+    BENCH_OVERLAP_DIM (64), BENCH_OVERLAP_HIDDEN (256),
+    BENCH_OVERLAP_LAYERS (4), BENCH_OVERLAP_STEPS (20 per pass),
+    BENCH_OVERLAP_PASSES (5), BENCH_OVERLAP_ZERO (0: plain all-reduce;
+    1: compose with the ZeRO-1 reduce-scatter),
+    MXNET_TPU_REDUCE_BUCKETS (defaulted to 4 here so the schedule has
+    buckets to interleave)."""
+    ndev = int(os.environ.get('BENCH_OVERLAP_DEVICES', 4))
+    import jax
+    try:
+        have = jax.device_count()
+    except Exception:
+        have = 0
+    if have < ndev:
+        if os.environ.get('BENCH_OVERLAP_SPAWNED') == '1':
+            raise RuntimeError('spawned overlap bench still has %d < '
+                               '%d devices' % (have, ndev))
+        env = dict(os.environ, BENCH_OVERLAP='1',
+                   BENCH_OVERLAP_SPAWNED='1', JAX_PLATFORMS='cpu')
+        flags = [f for f in env.get('XLA_FLAGS', '').split()
+                 if 'xla_force_host_platform_device_count' not in f]
+        flags.append('--xla_force_host_platform_device_count=%d'
+                     % ndev)
+        env['XLA_FLAGS'] = ' '.join(flags)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('overlap bench child failed (rc=%d)'
+                               % proc.returncode)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('overlap bench child produced no '
+                               'output')
+        print(lines[-1], flush=True)
+        return
+    os.environ.setdefault('MXNET_TPU_REDUCE_BUCKETS', '4')
+
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, profiler
+    from mxnet_tpu.gluon import nn
+
+    batch = int(os.environ.get('BENCH_OVERLAP_BATCH', 64))
+    dim = int(os.environ.get('BENCH_OVERLAP_DIM', 64))
+    hidden = int(os.environ.get('BENCH_OVERLAP_HIDDEN', 256))
+    layers = int(os.environ.get('BENCH_OVERLAP_LAYERS', 4))
+    steps = int(os.environ.get('BENCH_OVERLAP_STEPS', 20))
+    passes = max(1, int(os.environ.get('BENCH_OVERLAP_PASSES', 5)))
+    zero = int(os.environ.get('BENCH_OVERLAP_ZERO', 0))
+    classes = 10
+    ctxs = [mx.cpu(i) for i in range(ndev)]
+    opt_params = {'learning_rate': 0.05, 'momentum': 0.9, 'wd': 1e-4}
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, dim).astype(np.float32))
+    y = mx.nd.array((rs.rand(batch) * classes).astype(np.float32))
+
+    def make_fused(seed, interleave):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(layers):
+                net.add(nn.Dense(hidden, activation='relu'))
+            net.add(nn.Dense(classes))
+        net.initialize(ctx=ctxs)
+        net(mx.nd.zeros((batch, dim)))
+        prs = np.random.RandomState(seed)
+        for _, p in sorted(net.collect_params().items()):
+            p.set_data(mx.nd.array(
+                (prs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2))
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           dict(opt_params))
+        return net, gluon.fuse_step(net, loss_fn, tr, zero=zero,
+                                    interleave=interleave)
+
+    net_i, fs_i = make_fused(1, True)
+    net_e, fs_e = make_fused(1, False)
+
+    def run_steps(fs, n):
+        for _ in range(n):
+            l = fs(x, y)
+        l.asnumpy()
+
+    run_steps(fs_i, 2)
+    run_steps(fs_e, 2)
+    # the reduce plan materializes on the first step
+    buckets = fs_i._reduce_plan.n_buckets if not zero else None
+    best = {'interleaved': 0.0, 'end': 0.0}
+    # measure with the profiler ON: dispatches then synchronize, so
+    # per-dispatch wall time (and the overlap_window_ms estimate it
+    # feeds) reflects execution, not async enqueue — both arms pay
+    # the same sync
+    profiler.clear()
+    profiler.profiler_set_state('run')
+    try:
+        for _ in range(passes):
+            for name, fs in (('interleaved', fs_i), ('end', fs_e)):
+                tic = time.time()
+                run_steps(fs, steps)
+                best[name] = max(best[name],
+                                 steps / (time.time() - tic))
+    finally:
+        profiler.profiler_set_state('stop')
+
+    # parity: same step counts on both arms -> identical weights
+    max_diff = max(
+        float(np.abs(a.list_data()[0].asnumpy() -
+                     b.list_data()[0].asnumpy()).max())
+        for (_, a), (_, b) in zip(
+            sorted(net_i.collect_params().items()),
+            sorted(net_e.collect_params().items())))
+    cm = profiler.comm_stats()
+    print(json.dumps({
+        'metric': 'overlap_reduce',
+        'value': round(best['interleaved'], 2),
+        'unit': 'steps/sec',
+        'end_of_backward_sps': round(best['end'], 2),
+        'speedup_vs_end': round(best['interleaved'] /
+                                max(best['end'], 1e-9), 3),
+        'devices': ndev, 'batch': batch, 'dim': dim,
+        'hidden': hidden, 'layers': layers, 'zero': zero,
+        'reduce_buckets': buckets,
+        'reduce_buckets_issued': cm['reduce_buckets_issued'],
+        'overlap_window_ms': round(cm['overlap_window_ms'], 3),
+        'steps_per_pass': steps, 'passes': passes,
         'parity_max_abs_diff': max_diff,
         'parity_ok': bool(max_diff < 1e-5),
     }))
@@ -684,6 +895,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_GLUON', '') == '1':
         gluon_bench()   # fused vs imperative Gluon training
+        return
+    if os.environ.get('BENCH_OVERLAP', '') == '1':
+        overlap_bench()   # interleaved vs end-of-backward reduce
         return
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \

@@ -249,10 +249,13 @@ def gluon_step_key(fingerprint, step_key, mode, k, placement):
     reduce + optimizer update, with every input shape/dtype and any
     mesh sharding constraints baked in), so a re-created net/Trainer of
     the same architecture hits the same entry regardless of parameter
-    names/prefixes.  `step_key` is FusedSGD.cache_key() — already part
-    of the traced math, but joined explicitly so optimizer-state layout
-    changes (ZeRO bucket relayout, rescale/clip/momentum) can never
-    alias even if a jaxpr printing subtlety collided.  `mode`/`k`
+    names/prefixes.  `step_key` is FusedSGD.cache_key() extended with
+    the epoch-fusion carry signature and gradient-reduce plan
+    (FusedStep._full_step_key: EMA decay, metric fold identity, bucket
+    layout + schedule) — all already part of the traced math, but
+    joined explicitly so optimizer-state layout changes (ZeRO bucket
+    relayout, rescale/clip/momentum) or carry changes can never alias
+    even if a jaxpr printing subtlety collided.  `mode`/`k`
     distinguish single-step from K-step lax.scan bulk programs.
     `placement` is the device/mesh fingerprint: the cached object is an
     AOT-COMPILED executable (holds no Python closure, so cache entries

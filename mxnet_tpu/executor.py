@@ -490,7 +490,8 @@ class Executor:
             run_graph(arg_vals, aux_vals, rng, True)
 
     # ------------------------------------------------------------------
-    def make_fused_train_step(self, step_math, step_key=None):
+    def make_fused_train_step(self, step_math, step_key=None,
+                              grad_reduce=None):
         """Compile forward + backward + optimizer update into ONE donated
         XLA dispatch (the whole training step — no reference
         counterpart; the reference pays per-op dispatch on all three
@@ -519,10 +520,12 @@ class Executor:
         wrapper, same step body).
         """
         return self.make_fused_multistep(step_math, (), repeat=1,
-                                         step_key=step_key)
+                                         step_key=step_key,
+                                         grad_reduce=grad_reduce)
 
     def make_fused_multistep(self, step_math, scan_names, repeat=None,
-                             step_key=None):
+                             step_key=None, grad_reduce=None,
+                             metric=None, lr_stacked=False):
         """K whole training steps (fwd+bwd+update) in ONE donated XLA
         dispatch, looping on-device with lax.scan.
 
@@ -536,8 +539,28 @@ class Executor:
         scan_names: args fed per-step (data/label).  In stacked mode
         the caller passes them stacked on a leading K axis; with
         `repeat=K` the currently bound batch is reused K times
-        (xs=None scan).  lr/wd are loop-invariant for the K steps.
-        step_key: see make_fused_train_step.
+        (xs=None scan).  step_key: see make_fused_train_step; it MUST
+        also identify grad_reduce/metric (both bake into the traced
+        program but are opaque callables here).
+
+        grad_reduce: optional callable list->list applied to the
+        gradients before step_math — the backward-interleaved bucketed
+        all-reduce (collectives.GradReducePlan.apply) or its
+        end-of-backward barrier baseline.
+
+        metric: optional (init, update) pair folding metric
+        accumulation into the scan carry — `init()` returns the zero
+        carry, `update(carry, outs, scan_step_vals)` is pure jnp.  The
+        final carry comes back from run_fused_multistep so per-batch
+        metric host syncs stop breaking the bulk.
+
+        lr_stacked: lrs/wds arrive as ONE (K, n_params) schedule
+        array each, scanned alongside the batches so each step sees
+        ITS row (FactorScheduler boundaries crossed mid-dispatch
+        decay at the right step) instead of loop-invariant scalars —
+        one host->device transfer per dispatch regardless of
+        parameter count; the per-param split happens inside the
+        trace.
         """
         if self._grouped:
             return None
@@ -559,15 +582,23 @@ class Executor:
                    for i in scan_idx]
         cache_key = None
         if self._sig is not None and step_key is not None:
+            # step_key stays the LAST component (tests and tools key
+            # off it positionally)
             cache_key = (self._sig, 'multistep', tuple(scan_idx), repeat,
-                         tuple(str(d) for d in scan_dt), step_key)
+                         tuple(str(d) for d in scan_dt),
+                         bool(lr_stacked), step_key)
             fn = exec_cache.get(cache_key)
             if fn is not None:
                 return fn
 
         def multistep(diff_vals, scan_vals, inv_vals, aux_vals, key,
                       moms, masters, lrs, wds):
-            def run_one(diff_vals, aux_vals, moms, masters, key, sv):
+            def run_one(diff_vals, aux_vals, moms, masters, key, sv,
+                        lr_t, wd_t, mc):
+                if lr_stacked:
+                    # (n,) schedule row -> per-param traced scalars
+                    lr_t = [lr_t[j] for j in range(len(diff_idx))]
+                    wd_t = [wd_t[j] for j in range(len(diff_idx))]
                 key, sub = jax.random.split(key)
 
                 def f(dv):
@@ -587,45 +618,72 @@ class Executor:
                                                 has_aux=True)
                 heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
                 grads, = vjp_fn(heads)
+                grads = list(grads)
+                if grad_reduce is not None:
+                    grads = grad_reduce(grads)
                 new_ws, new_moms, new_masters = step_math(
-                    list(diff_vals), list(grads), moms, masters, lrs,
-                    wds)
+                    list(diff_vals), grads, moms, masters, lr_t, wd_t)
+                if metric is not None:
+                    mc = metric[1](mc, outs, sv)
                 return (tuple(new_ws), new_aux, new_moms, new_masters,
-                        key, outs)
+                        key, outs, mc)
 
+            mc0 = metric[0]() if metric is not None else ()
             if repeat == 1:
                 # single step: no scan wrapper (keeps the whole body in
                 # one fusion scope and avoids a trip-count-1 while loop)
-                (new_ws, new_aux, new_moms, new_masters, key,
-                 outs) = run_one(tuple(diff_vals), aux_vals, moms,
-                                 masters, key, scan_vals)
-                return outs, new_aux, new_ws, new_moms, new_masters, key
+                lr1 = lrs[0] if lr_stacked else lrs
+                wd1 = wds[0] if lr_stacked else wds
+                (new_ws, new_aux, new_moms, new_masters, key, outs,
+                 mc) = run_one(tuple(diff_vals), aux_vals, moms,
+                               masters, key, scan_vals, lr1, wd1, mc0)
+                return (outs, new_aux, new_ws, new_moms, new_masters,
+                        key, mc)
 
+            lr0 = lrs[0] if lr_stacked else lrs
+            wd0 = wds[0] if lr_stacked else wds
             out_shapes = jax.eval_shape(
                 lambda dv: run_one(dv, aux_vals, moms, masters, key,
                                    jax.tree_util.tree_map(
                                        lambda x: x[0], scan_vals)
-                                   if repeat is None else scan_vals)[5],
+                                   if repeat is None else scan_vals,
+                                   lr0, wd0, mc0)[5],
                 tuple(diff_vals))
             outs0 = tuple(jnp.zeros(o.shape, o.dtype) for o in out_shapes)
 
             def body(carry, xs):
-                diff_vals, aux_vals, moms, masters, key, _ = carry
-                sv = scan_vals if xs is None else xs
-                (new_ws, new_aux, new_moms, new_masters, key,
-                 outs) = run_one(diff_vals, aux_vals, moms, masters,
-                                 key, sv)
+                diff_vals, aux_vals, moms, masters, key, _, mc = carry
+                if lr_stacked:
+                    if repeat is None:
+                        sv, lr_t, wd_t = xs
+                    else:
+                        (lr_t, wd_t), sv = xs, scan_vals
+                else:
+                    sv = scan_vals if xs is None else xs
+                    lr_t, wd_t = lrs, wds
+                (new_ws, new_aux, new_moms, new_masters, key, outs,
+                 mc) = run_one(diff_vals, aux_vals, moms, masters,
+                               key, sv, lr_t, wd_t, mc)
                 return (new_ws, new_aux, new_moms, new_masters, key,
-                        outs), None
+                        outs, mc), None
 
             init = (tuple(diff_vals), aux_vals, moms, masters, key,
-                    outs0)
+                    outs0, mc0)
             if repeat is not None:
-                carry, _ = jax.lax.scan(body, init, None, length=repeat)
+                if lr_stacked:
+                    carry, _ = jax.lax.scan(body, init, (lrs, wds))
+                else:
+                    carry, _ = jax.lax.scan(body, init, None,
+                                            length=repeat)
+            elif lr_stacked:
+                carry, _ = jax.lax.scan(body, init,
+                                        (tuple(scan_vals), lrs, wds))
             else:
                 carry, _ = jax.lax.scan(body, init, tuple(scan_vals))
-            new_ws, new_aux, new_moms, new_masters, key, outs = carry
-            return outs, new_aux, new_ws, new_moms, new_masters, key
+            (new_ws, new_aux, new_moms, new_masters, key, outs,
+             mc) = carry
+            return (outs, new_aux, new_ws, new_moms, new_masters, key,
+                    mc)
 
         fn = exec_cache.TimedJit(
             jax.jit(multistep, donate_argnums=(0, 3, 4, 5, 6)))
@@ -682,7 +740,9 @@ class Executor:
         arrays.  scan_stacks: per-name stacked (K, ...) arrays, or None
         in repeat mode (the bound batch is reused).  zero=True marks
         moms/masters as ZeRO bucket shards (see _align_step_placement).
-        Returns (new_moms, new_masters)."""
+        Returns (new_moms, new_masters, metric_carry) — metric_carry
+        is the device-resident metric fold's final carry (() when the
+        program has no metric fold)."""
         diff_set = set(diff_names)
         scan_set = set(scan_names)
         inv_names = [n for n in self._arg_names
@@ -701,9 +761,9 @@ class Executor:
                                                    masters, zero=zero)
         self.fused_dispatches += 1
         with profiler.scope(self._name('fused_multistep')):
-            (outs, new_aux, new_ws, new_moms, new_masters,
-             self._key) = step(diff_vals, scan_vals, inv_vals, aux_vals,
-                               self._key, moms, masters, lrs, wds)
+            (outs, new_aux, new_ws, new_moms, new_masters, self._key,
+             mcarry) = step(diff_vals, scan_vals, inv_vals, aux_vals,
+                            self._key, moms, masters, lrs, wds)
             self._maybe_block(outs)
         for n, w in zip(diff_names, new_ws):
             self.arg_dict[n]._data = w
@@ -711,7 +771,7 @@ class Executor:
             self.aux_dict[n]._data = v
         self._stash = None
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
-        return new_moms, new_masters
+        return new_moms, new_masters, mcarry
 
     def run_fused_train_step(self, step, diff_names, moms, masters,
                              lrs, wds, zero=False):
@@ -720,7 +780,7 @@ class Executor:
         new_masters) for the optimizer to reclaim."""
         return self.run_fused_multistep(step, diff_names, (), None,
                                         moms, masters, lrs, wds,
-                                        zero=zero)
+                                        zero=zero)[:2]
 
     # ------------------------------------------------------------------
     def _gather(self):

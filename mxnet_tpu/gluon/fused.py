@@ -36,19 +36,35 @@ ZeRO bucket layout/mesh), so re-creating the net and Trainer — same
 architecture, fresh Parameter objects, different auto-prefixes —
 performs ZERO new XLA compilations.
 
+Round 11 (backward-interleaved reduction + epoch-level fusion):
+gradients all-reduce bucket-by-bucket in backward-availability order
+(parallel/collectives.GradReducePlan — each bucket's collective
+issues as soon as its wgrads exist and overlaps the remaining
+backward; MXNET_TPU_INTERLEAVE_REDUCE=0 restores the end-of-backward
+baseline), and `bulk` carries metric running sums
+(metric.device_fold), per-step lr/wd schedule columns
+(FusedSGD.host_prep_steps — schedules no longer advance in bulk-size
+units), and an optional weight-EMA arm (ema_decay=...; read with
+FusedStep.ema()) as pure lax.scan carry state, so steps_per_dispatch
+stretches across what used to be per-batch metric/LR host syncs.
+
 Observability: profiler.gluon_fused_stats() (gluon_fused_steps /
-gluon_fused_dispatches), the 'gluon_fused' span category, and the
-ZeRO comm/state counters Module feeds.  Bench: BENCH_GLUON=1 in
-bench.py.  Docs: docs/PERF.md round 10.
+gluon_fused_dispatches), the 'gluon_fused' span category, the
+reduce_buckets_issued / overlap_window_ms / scan_fused_metric_steps
+comm counters, and the ZeRO comm/state counters Module feeds.
+Bench: BENCH_GLUON=1 and BENCH_OVERLAP=1 in bench.py.  Docs:
+docs/PERF.md rounds 10-11.
 """
 import hashlib
 import re
+import time
 
 import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
 
 from .. import exec_cache
+from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import optimizer as opt_mod
 from .. import profiler
@@ -59,7 +75,8 @@ from ..parallel import zero as zero_mod
 from . import block as block_mod
 
 
-def fuse_step(net, loss, trainer, mesh=None, zero=None):
+def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
+              ema_decay=None, interleave=None):
     """Build (and register on `trainer`) a FusedStep compiling the
     whole train step for `net` into one donated XLA dispatch.
 
@@ -77,9 +94,24 @@ def fuse_step(net, loss, trainer, mesh=None, zero=None):
     in-step).  zero: ZeRO stage for the sharded optimizer update
     (None defers to MXNET_TPU_ZERO).
 
+    metric: optional EvalMetric with a device fold
+    (metric.device_fold) — its accumulation then runs INSIDE the
+    compiled step from (net output, label): `bulk` carries the running
+    sums through the lax.scan and one queued device-scalar pair per
+    dispatch reaches the host metric, so metric logging no longer
+    breaks the bulk (steps_per_dispatch stretches across it; the first
+    metric.get() syncs).  ema_decay: optional float in (0, 1) adding a
+    weight-EMA arm as pure carry state of the same dispatch
+    (ema <- d*ema + (1-d)*w after each update; read with
+    FusedStep.ema()).  interleave: override for the gradient-reduction
+    schedule (None = MXNET_TPU_INTERLEAVE_REDUCE; see
+    parallel/collectives.GradReducePlan).
+
     After this call `trainer.step_fused(batch_size, *args)` also runs
     the fused step."""
-    return FusedStep(net, loss, trainer, mesh=mesh, zero=zero)
+    return FusedStep(net, loss, trainer, mesh=mesh, zero=zero,
+                     metric=metric, ema_decay=ema_decay,
+                     interleave=interleave)
 
 
 class FusedStep:
@@ -88,10 +120,44 @@ class FusedStep:
     `loss = fused(x, y)` runs one step; `losses = fused.bulk(xs, ys)`
     runs K steps on-device (leading axis of the stacked inputs)."""
 
-    def __init__(self, net, loss, trainer, mesh=None, zero=None):
+    def __init__(self, net, loss, trainer, mesh=None, zero=None,
+                 metric=None, ema_decay=None, interleave=None):
         self._net = net
         self._loss = loss
         self._trainer = trainer
+        self._metric = metric
+        self._metric_fold = None
+        if metric is not None:
+            if loss is None:
+                raise ValueError(
+                    'fuse_step: device-resident metrics need the net '
+                    'output and a label (loss=None nets expose '
+                    'neither)')
+            self._metric_fold = metric_mod.device_fold(metric)
+            if self._metric_fold is None:
+                raise ValueError(
+                    'fuse_step: metric %r has no device fold (see '
+                    'metric.device_fold); update it on the host loop '
+                    'instead' % (getattr(metric, 'name', metric),))
+            for leaf in self._metric_fold.leaves:
+                if leaf.output_names is not None or \
+                        leaf.label_names is not None:
+                    # the gluon step routes under synthetic names
+                    # ('output%d'/'label'); a metric's own name filter
+                    # cannot resolve against them — fail here, not
+                    # with a KeyError inside the trace
+                    raise ValueError(
+                        'fuse_step: metric %r declares output_names/'
+                        'label_names; name routing only applies on '
+                        'the Module path (bulk_step/fit)' % leaf.name)
+        if ema_decay is not None and not 0.0 < float(ema_decay) < 1.0:
+            raise ValueError('ema_decay must be in (0, 1), got %r'
+                             % (ema_decay,))
+        self._ema_decay = None if ema_decay is None else float(ema_decay)
+        self._ema_state = None       # list aligned with self._params
+        self._interleave = collectives.interleave_reduce_enabled(
+            interleave)
+        self._reduce_plan = None     # built once shapes are known
         if type(trainer._optimizer) not in (opt_mod.SGD, opt_mod.NAG):
             # fail at build time, not deep inside the training loop
             raise ValueError(
@@ -217,23 +283,30 @@ class FusedStep:
     def _forward_loss(self, ws, auxs, frozen, ins, rng):
         """The pure forward+loss body: substitute every parameter,
         route RNG through the traced key, return (scalar_total,
-        (loss_leaves, new_aux)).  The scalar is the SUM of all loss
-        elements (each leaf summed in its own dtype) — exactly the
-        ones-head cotangent `loss.backward()` uses, so gradients match
-        the imperative path."""
+        (loss_leaves, new_aux, metric_outs)).  The scalar is the SUM
+        of all loss elements (each leaf summed in its own dtype) —
+        exactly the ones-head cotangent `loss.backward()` uses, so
+        gradients match the imperative path.  metric_outs carries the
+        net outputs only when a device-resident metric consumes them
+        (empty otherwise — the backward never sees extra residuals)."""
         tps, aps, fps = self._params, self._aux_params, \
             self._frozen_params
         sub = {p: nd.NDArray(v) for p, v in zip(tps, ws)}
         sub.update({p: nd.NDArray(v) for p, v in zip(aps, auxs)})
         sub.update({p: nd.NDArray(v) for p, v in zip(fps, frozen)})
+        mouts = ()
         with block_mod.param_trace(sub, rng, train_mode=True):
             in_nd = [nd.NDArray(v) for v in ins]
             if self._loss is not None:
                 out = self._net(*in_nd[:-1])
                 if isinstance(out, (list, tuple)):
                     l = self._loss(*out, in_nd[-1])
+                    if self._metric_fold is not None:
+                        mouts = tuple(o._data for o in out)
                 else:
                     l = self._loss(out, in_nd[-1])
+                    if self._metric_fold is not None:
+                        mouts = (out._data,)
             else:
                 l = self._net(*in_nd)
         leaves, treedef = jtu.tree_flatten(
@@ -245,62 +318,110 @@ class FusedStep:
             s = jnp.sum(x).astype(jnp.float32)
             total = s if total is None else total + s
         new_aux = tuple(sub[p]._data for p in aps)
-        return total, (loss_leaves, new_aux)
+        return total, (loss_leaves, new_aux, mouts)
 
     def _make_step_fn(self, fu, bulk, k):
         mesh, zero = self._mesh, self._zero
         step_math = fu.step_math
         forward_loss = self._forward_loss
+        plan = self._reduce_plan
+        fold = self._metric_fold
+        decay = self._ema_decay
 
-        def one_step(ws, auxs, moms, masters, rng, frozen, ins, lrs,
-                     wds):
+        def one_step(ws, auxs, moms, masters, emas, rng, mcarry,
+                     frozen, ins, lrs, wds):
+            if hasattr(lrs, 'ndim'):
+                # bulk mode: (n,) schedule row -> per-param scalars
+                lrs = [lrs[j] for j in range(len(ws))]
+                wds = [wds[j] for j in range(len(ws))]
             rng, sub = jax.random.split(rng)
             f = lambda w: forward_loss(w, auxs, frozen, ins, sub)
-            ((_, (loss_leaves, new_aux)), grads) = jax.value_and_grad(
-                f, has_aux=True)(tuple(ws))
+            ((_, (loss_leaves, new_aux, mouts)),
+             grads) = jax.value_and_grad(f, has_aux=True)(tuple(ws))
             grads = list(grads)
             if mesh is not None and not zero:
-                # pin gradients replicated: the partitioner lowers the
-                # cross-replica sum as an all-reduce INSIDE this same
-                # program (the kvstore push/pull role; under ZeRO the
-                # sharded step_math reduce-scatters instead)
-                grads = [collectives.allreduce_bucket(g, mesh)
-                         for g in grads]
+                # bucket-by-bucket all-reduce in backward-availability
+                # order — each bucket's collective issues as soon as
+                # its wgrads exist, overlapping the remaining backward
+                # (the kvstore push/pull role; end-of-backward mode
+                # barriers first; under ZeRO the sharded step_math
+                # reduce-scatters its own buckets instead)
+                grads = plan.apply(grads, mesh)
             new_ws, new_moms, new_masters = step_math(
                 list(ws), grads, moms, masters, lrs, wds)
+            if decay is not None:
+                # weight-EMA arm: pure carry math on the POST-update
+                # weights, in the weight's dtype (decay is weak-typed)
+                emas = tuple(decay * e + (1.0 - decay) * w
+                             for e, w in zip(emas, new_ws))
+            if fold is not None:
+                mcarry = fold.update(
+                    mcarry, {'label': ins[-1]},
+                    {'output%d' % i: o for i, o in enumerate(mouts)})
             return (loss_leaves, tuple(new_ws), new_aux, new_moms,
-                    new_masters, rng)
+                    new_masters, emas, mcarry, rng)
+
+        def init_mcarry():
+            return fold.init() if fold is not None else ()
 
         if not bulk:
-            def step_fn(ws, auxs, moms, masters, rng, frozen, ins, lrs,
-                        wds):
-                return one_step(ws, auxs, moms, masters, rng, frozen,
-                                ins, lrs, wds)
+            def step_fn(ws, auxs, moms, masters, emas, rng, frozen,
+                        ins, lrs, wds):
+                return one_step(ws, auxs, moms, masters, emas, rng,
+                                init_mcarry(), frozen, ins, lrs, wds)
             return step_fn
 
-        def step_fn(ws, auxs, moms, masters, rng, frozen, ins, lrs,
-                    wds):
+        def step_fn(ws, auxs, moms, masters, emas, rng, frozen, ins,
+                    lrs, wds):
             def body(carry, xs):
-                ws, auxs, moms, masters, rng = carry
-                (loss_leaves, ws, auxs, moms, masters,
-                 rng) = one_step(ws, auxs, moms, masters, rng, frozen,
-                                 xs, lrs, wds)
-                return (ws, auxs, moms, masters, rng), loss_leaves
+                ws, auxs, moms, masters, emas, rng, mc = carry
+                sv, lr_t, wd_t = xs
+                (loss_leaves, ws, auxs, moms, masters, emas, mc,
+                 rng) = one_step(ws, auxs, moms, masters, emas, rng,
+                                 mc, frozen, sv, lr_t, wd_t)
+                return (ws, auxs, moms, masters, emas, rng, mc), \
+                    loss_leaves
 
-            init = (tuple(ws), tuple(auxs), moms, masters, rng)
-            (ws, auxs, moms, masters, rng), losses = jax.lax.scan(
-                body, init, tuple(ins))
-            return losses, ws, auxs, moms, masters, rng
+            init = (tuple(ws), tuple(auxs), moms, masters, emas, rng,
+                    init_mcarry())
+            (ws, auxs, moms, masters, emas, rng, mc), losses = \
+                jax.lax.scan(body, init, (tuple(ins), lrs, wds))
+            if mesh is not None:
+                # pin the carry OUTPUTS replicated: GSPMD may choose a
+                # dp-sharded layout for the scan carry (observed under
+                # ZeRO — the in-body all-gather constraint doesn't bind
+                # the carry), and the writeback hands each context its
+                # device's shard view, which must be the FULL value
+                ws = tuple(collectives.allgather_bucket(w, mesh)
+                           for w in ws)
+                auxs = tuple(collectives.allgather_bucket(a, mesh)
+                             for a in auxs)
+                emas = tuple(collectives.allgather_bucket(e, mesh)
+                             for e in emas)
+            return (losses, ws, auxs, moms, masters, emas, mc, rng)
 
         return step_fn
+
+    def _full_step_key(self, fkey):
+        """FusedSGD.cache_key extended with the epoch-fusion carry
+        signature and reduction plan: EMA decay, the metric fold's
+        identity, and the gradient-bucket layout/schedule all bake
+        into the traced program, so they join the cache key (the jaxpr
+        fingerprint reflects them too — this makes aliasing impossible
+        even across a printing subtlety)."""
+        return (fkey,
+                ('ema', self._ema_decay),
+                ('metric', self._metric_fold.key
+                 if self._metric_fold is not None else None),
+                ('reduce', self._reduce_plan.key
+                 if self._reduce_plan is not None else None))
 
     def _placement_fp(self):
         """Device identity for the program cache: AOT compilation
         bakes concrete placements, so same-architecture steps on
         different devices/meshes must key apart."""
         if self._mesh is not None:
-            return ('mesh', tuple(self._mesh.axis_names),
-                    tuple(str(d) for d in self._mesh.devices.flat))
+            return ('mesh',) + pmesh.mesh_fingerprint(self._mesh)
         if self._ctxs[0] is not None:
             return ('dev', str(self._ctxs[0].jax_device()))
         return ('dev', 'default')
@@ -325,7 +446,7 @@ class FusedStep:
         # scrub addresses so equal programs fingerprint equally
         canon = re.sub(r'0x[0-9a-f]+', '0x', str(jaxpr))
         fp = hashlib.blake2b(canon.encode(), digest_size=16).hexdigest()
-        key = exec_cache.gluon_step_key(fp, fkey,
+        key = exec_cache.gluon_step_key(fp, self._full_step_key(fkey),
                                         'bulk' if bulk else 'step', k,
                                         self._placement_fp())
         if exec_cache.enabled():
@@ -333,7 +454,7 @@ class FusedStep:
             if fn is not None:
                 return fn
         lowered = jax.jit(step_fn,
-                          donate_argnums=(0, 1, 2, 3, 4)).lower(*args)
+                          donate_argnums=(0, 1, 2, 3, 4, 5)).lower(*args)
         fn = exec_cache.timed_compile(lowered)
         if exec_cache.enabled():
             exec_cache.put(key, fn)
@@ -357,7 +478,8 @@ class FusedStep:
         tr._optimizer.rescale_grad = rescale
         new = opt_mod.create_fused_updater(
             tr._optimizer, list(range(len(self._params))),
-            zero=self._zero, mesh=self._mesh)
+            zero=self._zero, mesh=self._mesh,
+            interleave=self._interleave)
         if new is None:
             raise ValueError(
                 'fuse_step: optimizer %s has no fused whole-model '
@@ -382,10 +504,11 @@ class FusedStep:
 
     def bulk(self, *args, batch_size=None):
         """K fused steps in ONE dispatch, looping on-device via
-        lax.scan (Module.bulk_step analog).  Each arg carries a leading
-        K axis ((K, batch, ...) stacks); lr/wd are loop-invariant for
-        the K steps.  Returns the per-step losses stacked on a leading
-        K axis."""
+        lax.scan (Module.bulk_step analog).  Each arg carries a
+        leading K axis ((K, batch, ...) stacks); lr/wd schedules
+        evaluate at EVERY step index (per-step schedule rows scanned
+        alongside the batches — bit-identical to the per-step loop).
+        Returns the per-step losses stacked on a leading K axis."""
         return self._run(args, bulk=True, batch_size=batch_size)
 
     def _run(self, args, bulk, batch_size):
@@ -413,17 +536,41 @@ class FusedStep:
         if not self._placed:
             self._place()
         ws = [self._gather_param(p) for p in self._params]
+        if self._reduce_plan is None:
+            # reverse-availability bucketing over the trainable grads
+            # (static: shapes/dtypes are fixed once params are known)
+            self._reduce_plan = collectives.GradReducePlan(
+                [w.shape for w in ws], [w.dtype for w in ws],
+                interleave=self._interleave)
+        if self._ema_decay is not None and self._ema_state is None:
+            # EMA starts as a COPY of the current weights (jnp.add
+            # allocates fresh buffers with the weights' placement —
+            # the dispatch donates both lists, so they must not alias)
+            self._ema_state = [jnp.add(w, 0) for w in ws]
+        emas = tuple(self._ema_state) if self._ema_decay is not None \
+            else ()
         # host_prep reads shape/dtype/_data (momenta adopt the weight's
         # sharding) — hand it the replicated parents, not the views
         weights = [nd.NDArray(w, self._ctxs[0]) for w in ws]
-        moms, masters, lrs, wds = fu.host_prep(weights)
-        # plain floats: the AOT program baked weak-f32 scalar avals (an
-        # np scalar from an lr scheduler would mismatch them)
-        lrs = [float(v) for v in lrs]
-        wds = [float(v) for v in wds]
-        for _ in range(k - 1):       # host_prep bumped counts once
-            for i in fu.param_names:
-                self._trainer._optimizer._update_count(i)
+        # per-step schedule stacks: counts bump and lr/wd schedules
+        # evaluate at EVERY step index of the dispatch (host scheduler
+        # semantics, bit-identical to the per-step loop)
+        moms, masters, lr_stack, wd_stack = fu.host_prep_steps(
+            weights, k)
+        if bulk:
+            # ONE (K, n) schedule array each, scanned row-per-step —
+            # a single transfer per dispatch regardless of parameter
+            # count (the per-param split happens in the trace)
+            lrs, wds = jnp.asarray(lr_stack), jnp.asarray(wd_stack)
+            if self._mesh is not None:
+                repl = pmesh.replicated(self._mesh)
+                lrs = jax.device_put(lrs, repl)
+                wds = jax.device_put(wds, repl)
+        else:
+            # plain floats: the AOT program baked weak-f32 scalar avals
+            # (an np scalar from an lr scheduler would mismatch them)
+            lrs = [float(v) for v in lr_stack[0]]
+            wds = [float(v) for v in wd_stack[0]]
         if self._mesh is not None:
             arrays = tuple(pmesh.shard_batch(self._mesh, a,
                                              dim=1 if bulk else 0)
@@ -435,31 +582,45 @@ class FusedStep:
             arrays = tuple(jax.device_put(a, dev) for a in arrays)
         fkey = fu.cache_key()
         shapes = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
-        local = ('bulk' if bulk else 'step', k, shapes, fkey)
+        local = ('bulk' if bulk else 'step', k, shapes,
+                 self._full_step_key(fkey))
         auxs = [self._gather_param(p) for p in self._aux_params]
         frozen = [self._gather_param(p) for p in self._frozen_params]
         prog = self._programs.get(local)
         if prog is None:
             prog = self._get_program(
                 fu, fkey, bulk, k,
-                (ws, auxs, moms, masters, self._rng, frozen, arrays,
-                 lrs, wds))
+                (ws, auxs, moms, masters, emas, self._rng, frozen,
+                 arrays, lrs, wds))
             self._programs[local] = prog
+        t0 = time.perf_counter()
+        synced = profiler.is_running()
         with profiler.scope('gluon_fused_%s' % ('bulk' if bulk
                                                 else 'step'),
                             'gluon_fused'):
             (loss_out, new_ws, new_aux, new_moms, new_masters,
-             self._rng) = prog(ws, auxs, moms, masters, self._rng,
-                               frozen, arrays, lrs, wds)
-            if profiler.is_running():
+             new_emas, mdeltas, self._rng) = prog(
+                ws, auxs, moms, masters, emas, self._rng, frozen,
+                arrays, lrs, wds)
+            if synced:
                 jax.block_until_ready(loss_out)
+        # only a synchronized dispatch's wall time says anything about
+        # device execution (async enqueue returns immediately)
+        dt_ms = (time.perf_counter() - t0) * 1e3 if synced else 0.0
         for p, w in zip(self._params, new_ws):
             self._writeback_param(p, w)
         for p, a in zip(self._aux_params, new_aux):
             self._writeback_param(p, a)
         fu.commit(new_moms, new_masters)
+        if self._ema_decay is not None:
+            self._ema_state = list(new_emas)
+        if self._metric_fold is not None:
+            # device scalars queue on the host metric WITHOUT a sync;
+            # the first metric.get() (epoch end / logging) drains them
+            self._metric_fold.commit(mdeltas)
         self._trainer._last_update_mode = 'fused'
         profiler.add_gluon_fused_stats(steps=k, dispatches=1)
+        self._note_reduce_counters(fu, k, dt_ms)
         rs, ag = fu.comm_bytes_per_step()
         if rs or ag:
             profiler.add_comm_bytes(reduce_scattered=rs * k,
@@ -468,3 +629,36 @@ class FusedStep:
         ctx = self._ctxs[0]
         out = [nd.NDArray(v, ctx) for v in loss_out]
         return jtu.tree_unflatten(self._loss_treedef, out)
+
+    def _note_reduce_counters(self, fu, k, dt_ms):
+        """Feed the round-11 profiler counters after a dispatch of k
+        steps: gradient-bucket collectives issued (reduce plan
+        buckets, or the ZeRO layout's) and device-folded metric steps
+        (one model, profiler.note_reduce_dispatch; dt_ms is 0.0 for
+        async dispatches — no overlap window is estimated then)."""
+        buckets = 0
+        if self._mesh is not None:
+            if self._zero and fu._layout is not None:
+                buckets = len(fu._layout.buckets)
+            elif not self._zero and self._reduce_plan is not None:
+                buckets = self._reduce_plan.n_buckets
+        profiler.note_reduce_dispatch(
+            buckets, self._interleave, k, dt_ms=dt_ms,
+            metric_steps=k if self._metric_fold is not None else 0)
+
+    def ema(self):
+        """Snapshot of the weight-EMA arm as {parameter name:
+        NDArray}, aligned with the trainable parameters.  Before the
+        first step the EMA equals the current weights."""
+        if self._ema_decay is None:
+            raise ValueError('fuse_step was built without ema_decay')
+        self._collect_params()
+        if self._ema_state is None:
+            if not self._placed:
+                self._place()
+            vals = [self._gather_param(p) for p in self._params]
+        else:
+            vals = self._ema_state
+        ctx = self._ctxs[0]
+        return {p.name: nd.NDArray(v, ctx)
+                for p, v in zip(self._params, vals)}

@@ -1,4 +1,15 @@
-"""Learning-rate schedulers (reference python/mxnet/lr_scheduler.py:1-173)."""
+"""Learning-rate schedulers (reference python/mxnet/lr_scheduler.py:1-173).
+
+Each scheduler is stateful (`__call__` mutates base_lr, reference
+semantics).  Epoch-level fusion (docs/PERF.md round 11) feeds K-step
+fused dispatches by replaying that stateful loop on the host
+(FusedSGD.host_prep_steps), so per-step schedule columns are
+bit-identical to the per-step training loop BY CONSTRUCTION.  Each
+scheduler additionally exposes a STATELESS `lr_at(num_update)` — the
+schedule as a pure function of the step index, bit-equal to the
+replay under the monotone per-step evaluation pattern the training
+loops use — for callers that need the value without mutating the
+live schedule (and as the parity guard on the stateful form)."""
 import logging
 import math
 
@@ -9,6 +20,19 @@ class LRScheduler:
 
     def __call__(self, num_update):
         raise NotImplementedError
+
+    def lr_at(self, num_update):
+        """Pure value of the schedule at `num_update` (no state
+        mutation); subclasses override."""
+        raise NotImplementedError
+
+    def _orig(self):
+        """The base lr as first assigned (the optimizer sets base_lr
+        right after construction; __call__ mutates it afterwards, so
+        the original is snapshotted at first evaluation)."""
+        if getattr(self, '_base_lr_orig', None) is None:
+            self._base_lr_orig = self.base_lr
+        return self._base_lr_orig
 
 
 class FactorScheduler(LRScheduler):
@@ -24,7 +48,25 @@ class FactorScheduler(LRScheduler):
         self.stop_factor_lr = stop_factor_lr
         self.count = 0
 
+    def lr_at(self, num_update):
+        """Stateless FactorScheduler: the number of crossed step
+        boundaries determines the decay count; the decays replay
+        ITERATIVELY (lr *= factor, not factor**d) so the value is
+        bit-identical to the stateful loop's repeated multiplication,
+        including the stop_factor_lr pin."""
+        d = 0
+        if num_update > self.step:
+            d = (num_update - self.step - 1) // self.step + 1
+        lr = self._orig()
+        for _ in range(d):
+            decayed = lr * self.factor
+            if decayed < self.stop_factor_lr:
+                return self.stop_factor_lr
+            lr = decayed
+        return lr
+
     def __call__(self, num_update):
+        self._orig()
         # Catch up: every crossed step boundary decays the rate once.
         while num_update > self.count + self.step:
             self.count += self.step
@@ -60,7 +102,19 @@ class MultiFactorScheduler(LRScheduler):
         self.factor = factor
         self.count = 0
 
+    def lr_at(self, num_update):
+        """Stateless MultiFactorScheduler: one iterative decay per
+        milestone strictly below `num_update`."""
+        lr = self._orig()
+        for s in self.step:
+            if num_update > s:
+                lr *= self.factor
+            else:
+                break
+        return lr
+
     def __call__(self, num_update):
+        self._orig()
         while self.cur_step_ind <= len(self.step) - 1:
             if num_update > self.step[self.cur_step_ind]:
                 self.count = self.step[self.cur_step_ind]
@@ -82,6 +136,11 @@ class PolyScheduler(LRScheduler):
         self.base_lr_orig = base_lr
         self.power = pwr
 
+    def lr_at(self, num_update):
+        n = min(num_update, self.max_update)
+        return self.base_lr_orig * pow(
+            1.0 - float(n) / self.max_update, self.power)
+
     def __call__(self, num_update):
         if num_update <= self.max_update:
             self.base_lr = self.base_lr_orig * pow(
@@ -100,6 +159,17 @@ class CosineScheduler(LRScheduler):
         self.warmup_steps = warmup_steps
         self.warmup_begin_lr = warmup_begin_lr
         self.base_lr_orig = base_lr
+
+    def lr_at(self, num_update):
+        if num_update < self.warmup_steps:
+            return self.warmup_begin_lr + \
+                (self.base_lr_orig - self.warmup_begin_lr) * \
+                num_update / max(self.warmup_steps, 1)
+        n = min(num_update, self.max_update)
+        frac = (n - self.warmup_steps) / \
+            max(self.max_update - self.warmup_steps, 1)
+        return self.final_lr + (self.base_lr_orig - self.final_lr) * \
+            (1 + math.cos(math.pi * frac)) / 2
 
     def __call__(self, num_update):
         if num_update < self.warmup_steps:

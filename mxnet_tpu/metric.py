@@ -1,6 +1,19 @@
 """Evaluation metrics (reference python/mxnet/metric.py, 1132 LoC;
 SURVEY.md §2.7/§5.5).  Updated per batch from device outputs by the
-Module layer (executor_group.py:549 in the reference)."""
+Module layer (executor_group.py:549 in the reference).
+
+Device-resident accumulation (epoch-level fusion, docs/PERF.md round
+11): metrics that implement `_device_delta` can run INSIDE a compiled
+bulk `lax.scan` — each step contributes a pure (sum_delta,
+count_delta) pair folded into the scan carry, so `steps_per_dispatch`
+stretches across what used to be per-batch host metric syncs.  The
+dispatch hands back one device scalar pair per metric which
+`update_device` queues WITHOUT a host sync; the first `get()` (epoch
+end, or a Speedometer callback) drains the queue.  `device_fold`
+builds the scan-side fold.  Integer-sum metrics (Accuracy,
+TopKAccuracy) match the host loop exactly; float-sum metrics agree to
+float32-ulp (the device computes the identical per-batch statistic,
+but XLA's reduce order differs from numpy's pairwise summation)."""
 import math
 
 import numpy as np
@@ -57,11 +70,52 @@ class EvalMetric:
     def update(self, labels, preds):
         raise NotImplementedError
 
+    # -- device-resident accumulation hooks ----------------------------
+    # pure jnp mirror of `update` returning (sum_delta, count_delta);
+    # None = this metric only accumulates on the host
+    _device_delta = None
+    _device_sum_dtype = 'float32'
+
+    def update_device(self, dsum, dcount):
+        """Fold a device-resident (sum, count) delta pair (jax
+        scalars from a fused dispatch) into ONE running device pair
+        WITHOUT synchronizing — the adds are async device ops, so the
+        pending state stays O(1) buffers however many dispatches run;
+        host sync happens when get() drains it (the epoch boundary),
+        not per dispatch."""
+        pend = self._pending_device
+        if pend is None:
+            self._pending_device = (dsum, dcount)
+        else:
+            self._pending_device = (pend[0] + dsum, pend[1] + dcount)
+
+    def _drain_device(self):
+        pend = getattr(self, '_pending_device', None)
+        if pend is not None:
+            self._pending_device = None
+            self.sum_metric += float(np.asarray(pend[0]))
+            self.num_inst += int(np.asarray(pend[1]))
+
+    def device_key(self):
+        """Hashable identity of this metric's device fold for the
+        compiled-program cache: the fold's math AND its
+        output_names/label_names routing are baked into the traced
+        scan, so two configs differing in either must never alias one
+        program."""
+        return (type(self).__name__,
+                tuple(sorted(self._kwargs.items())),
+                None if self.output_names is None
+                else tuple(self.output_names),
+                None if self.label_names is None
+                else tuple(self.label_names))
+
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._pending_device = None
 
     def get(self):
+        self._drain_device()
         if self.num_inst == 0:
             return (self.name, float('nan'))
         return (self.name, self.sum_metric / self.num_inst)
@@ -166,6 +220,20 @@ class Accuracy(EvalMetric):
             self.sum_metric += (pred == lab).sum()
             self.num_inst += len(pred)
 
+    _device_sum_dtype = 'int32'
+
+    def _device_delta(self, labels, preds):
+        import jax.numpy as jnp
+        ds, dc = jnp.zeros((), jnp.int32), 0
+        for label, pred in zip(labels, preds):
+            if pred.shape != label.shape:
+                pred = jnp.argmax(pred, axis=self.axis)
+            pred = pred.astype(jnp.int32).reshape(-1)
+            lab = label.astype(jnp.int32).reshape(-1)
+            ds = ds + (pred == lab).sum().astype(jnp.int32)
+            dc += pred.size
+        return ds, jnp.asarray(dc, jnp.int32)
+
 
 @register
 @alias('top_k_accuracy', 'top_k_acc')
@@ -191,6 +259,25 @@ class TopKAccuracy(EvalMetric):
                 self.sum_metric += (pred[:, num_classes - 1 - j].flat ==
                                     lab.flat).sum()
             self.num_inst += num_samples
+
+    _device_sum_dtype = 'int32'
+
+    def _device_delta(self, labels, preds):
+        # mirror of the host update (ties between equal scores may
+        # rank differently — jnp.argsort is stable, np's default is
+        # not — but real float scores don't tie)
+        import jax.numpy as jnp
+        ds, dc = jnp.zeros((), jnp.int32), 0
+        for label, pred in zip(labels, preds):
+            pred = pred.astype(jnp.float32)
+            lab = label.astype(jnp.int32).reshape(-1)
+            order = jnp.argsort(pred, axis=1)
+            num_samples, num_classes = pred.shape
+            for j in range(min(num_classes, self.top_k)):
+                ds = ds + (order[:, num_classes - 1 - j] ==
+                           lab).sum().astype(jnp.int32)
+            dc += num_samples
+        return ds, jnp.asarray(dc, jnp.int32)
 
 
 @register
@@ -262,6 +349,19 @@ class _RegressionMetric(EvalMetric):
             self.sum_metric += self._measure(diff)
             self.num_inst += 1
 
+    def _device_measure(self, diff):
+        raise NotImplementedError
+
+    def _device_delta(self, labels, preds):
+        import jax.numpy as jnp
+        ds, dc = jnp.zeros((), jnp.float32), 0
+        for label, pred in zip(labels, preds):
+            lab = label.reshape(-1, 1) if label.ndim == 1 else label
+            diff = lab - pred
+            ds = ds + self._device_measure(diff).astype(jnp.float32)
+            dc += 1
+        return ds, jnp.asarray(dc, jnp.int32)
+
 
 @register
 class MAE(_RegressionMetric):
@@ -270,6 +370,10 @@ class MAE(_RegressionMetric):
 
     def _measure(self, diff):
         return np.abs(diff).mean()
+
+    def _device_measure(self, diff):
+        import jax.numpy as jnp
+        return jnp.abs(diff).mean()
 
 
 @register
@@ -280,6 +384,9 @@ class MSE(_RegressionMetric):
     def _measure(self, diff):
         return (diff ** 2.0).mean()
 
+    def _device_measure(self, diff):
+        return (diff ** 2.0).mean()
+
 
 @register
 class RMSE(_RegressionMetric):
@@ -288,6 +395,10 @@ class RMSE(_RegressionMetric):
 
     def _measure(self, diff):
         return np.sqrt((diff ** 2.0).mean())
+
+    def _device_measure(self, diff):
+        import jax.numpy as jnp
+        return jnp.sqrt((diff ** 2.0).mean())
 
 
 @register
@@ -308,6 +419,17 @@ class CrossEntropy(EvalMetric):
             self.sum_metric += -np.log(picked + self.eps).sum()
             self.num_inst += idx.shape[0]
 
+    def _device_delta(self, labels, preds):
+        import jax.numpy as jnp
+        ds, dc = jnp.zeros((), jnp.float32), 0
+        for label, pred in zip(labels, preds):
+            idx = label.reshape(-1).astype(jnp.int32)
+            picked = pred[jnp.arange(idx.shape[0]), idx]
+            ds = ds - jnp.log(picked + self.eps).sum() \
+                .astype(jnp.float32)
+            dc += idx.shape[0]
+        return ds, jnp.asarray(dc, jnp.int32)
+
 
 @register
 class Loss(EvalMetric):
@@ -320,6 +442,14 @@ class Loss(EvalMetric):
         for pred in preds:
             self.sum_metric += pred.asnumpy().sum()
             self.num_inst += pred.size
+
+    def _device_delta(self, labels, preds):
+        import jax.numpy as jnp
+        ds, dc = jnp.zeros((), jnp.float32), 0
+        for pred in preds:
+            ds = ds + pred.sum().astype(jnp.float32)
+            dc += pred.size
+        return ds, jnp.asarray(dc, jnp.int32)
 
 
 @register
@@ -349,6 +479,69 @@ class CustomMetric(EvalMetric):
                             else (verdict, 1))
             self.sum_metric += delta
             self.num_inst += count
+
+
+class DeviceFold:
+    """Scan-side accumulator for one (possibly composite) metric's
+    device-resident running sums (built by `device_fold`).
+
+    `init()` -> zero carry (one (sum, count) scalar pair per leaf
+    metric, in each leaf's declared sum dtype); `update(carry,
+    label_dict, pred_dict)` is pure jnp (traceable inside the bulk
+    lax.scan) and applies each leaf's update_dict name routing;
+    `commit(carry)` queues the final device scalars on the host metric
+    objects (EvalMetric.update_device — no sync until get())."""
+
+    def __init__(self, leaves):
+        self.leaves = leaves
+        # baked into the traced scan: two different metric configs
+        # must never alias one compiled program
+        self.key = tuple(m.device_key() for m in leaves)
+
+    def init(self):
+        import jax.numpy as jnp
+        return tuple((jnp.zeros((), jnp.dtype(m._device_sum_dtype)),
+                      jnp.zeros((), jnp.int32)) for m in self.leaves)
+
+    def update(self, carry, label, pred):
+        out = []
+        for m, (s, c) in zip(self.leaves, carry):
+            picked_preds = (list(pred.values()) if m.output_names is None
+                            else [pred[n] for n in m.output_names])
+            picked_labels = (list(label.values())
+                             if m.label_names is None
+                             else [label[n] for n in m.label_names])
+            ds, dc = m._device_delta(picked_labels, picked_preds)
+            out.append((s + ds, c + dc))
+        return tuple(out)
+
+    def commit(self, carry):
+        for m, (s, c) in zip(self.leaves, carry):
+            m.update_device(s, c)
+
+
+def device_fold(metric):
+    """Build the device-resident fold for `metric`, or None when any
+    part of it only accumulates on the host (CustomMetric, Perplexity,
+    F1, a composite with its own name filters, ...) — callers fall
+    back to the per-batch host update loop then."""
+    if metric is None:
+        return None
+    leaves = []
+    stack = [metric]
+    while stack:
+        m = stack.pop(0)
+        if isinstance(m, CompositeEvalMetric):
+            if m.output_names is not None or m.label_names is not None:
+                # the composite-level name restriction applies before
+                # the children's routing; flattening would lose it
+                return None
+            stack = list(m.metrics) + stack
+            continue
+        if getattr(m, '_device_delta', None) is None:
+            return None
+        leaves.append(m)
+    return DeviceFold(leaves)
 
 
 def np_metric(numpy_feval, name=None, allow_extra_outputs=False):

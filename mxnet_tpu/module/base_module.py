@@ -149,8 +149,17 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """The training loop (reference base_module.py:376)."""
+            monitor=None, bulk=None):
+        """The training loop (reference base_module.py:376).
+
+        bulk: optional K > 1 — run the epoch in K-step fused
+        dispatches (Module.bulk_step) with the metric accumulating
+        device-resident inside the bulk lax.scan and lr schedules
+        evaluated per step, so steps_per_dispatch stretches across
+        what the per-batch loop treats as metric/logging boundaries.
+        batch_end_callback fires once per dispatch (nbatch advances by
+        the group size); an installed monitor, or a metric without a
+        device fold, falls back to the per-batch loop."""
         assert num_epoch is not None, 'please specify number of epochs'
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,
@@ -165,6 +174,14 @@ class BaseModule:
         validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        use_bulk = bulk is not None and int(bulk) > 1 and \
+            hasattr(self, 'bulk_step') and monitor is None
+        if use_bulk and metric_mod.device_fold(eval_metric) is None:
+            self.logger.warning(
+                'fit(bulk=%d): metric %s has no device fold; '
+                'falling back to per-batch metric updates', int(bulk),
+                eval_metric.name)
+            use_bulk = False
         # stage upcoming batches device-resident so the H2D copy of
         # batch N+1 overlaps step N's compute (Module overrides; the
         # default is identity)
@@ -173,19 +190,23 @@ class BaseModule:
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    _fire(batch_end_callback,
-                          BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                        eval_metric=eval_metric,
-                                        locals=locals()))
+            if use_bulk:
+                self._fit_epoch_bulk(train_data, int(bulk), eval_metric,
+                                     batch_end_callback, epoch)
+            else:
+                for nbatch, data_batch in enumerate(train_data):
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        _fire(batch_end_callback,
+                              BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                            eval_metric=eval_metric,
+                                            locals=locals()))
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
@@ -208,6 +229,41 @@ class BaseModule:
                     self.logger.info('Epoch[%d] Validation-%s=%f',
                                      epoch, name, val)
             train_data.reset()
+
+    def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
+                        batch_end_callback, epoch):
+        """One fit epoch in K-step fused dispatches: consecutive
+        batches group into bulk_step calls (device-side lax.scan,
+        device-resident metric accumulation, per-step lr schedules);
+        the trailing partial group runs as a smaller dispatch.
+        Callbacks fire once per dispatch with nbatch at the group's
+        last batch — the values a per-batch loop would show there."""
+        nbatch = 0
+        it = iter(train_data)
+        group = []
+        while True:
+            data_batch = next(it, None)
+            if data_batch is not None:
+                group.append(data_batch)
+                if len(group) < bulk:
+                    continue
+            if not group:
+                break
+            if len(group) == 1:
+                self.forward_backward(group[0])
+                self.update()
+                self.update_metric(eval_metric, group[0].label)
+            else:
+                self.bulk_step(batches=group, eval_metric=eval_metric)
+            nbatch += len(group)
+            if batch_end_callback is not None:
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch - 1,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
+            group = []
+            if data_batch is None:
+                break
 
     def _wrap_train_iter(self, train_data):
         """Hook for subclasses to decorate the training iterator (e.g.

@@ -11,6 +11,7 @@ import logging
 
 from .. import context as ctx_mod
 from .. import initializer as init_mod
+from .. import metric as metric_mod
 from .. import model as model_mod
 from .. import ndarray as nd
 from .. import optimizer as opt_mod
@@ -390,7 +391,36 @@ class Module(BaseModule):
         self._pending_fused = False
         self._exec_group.forward_backward(data_batch)
 
+    def _mesh_fp(self):
+        """Device fingerprint of the exec group's mesh (None when
+        single-device) — joins cache keys for programs whose closures
+        bind the mesh by value."""
+        from ..parallel import mesh as pmesh
+        return pmesh.mesh_fingerprint(self._exec_group.mesh)
+
+    def _ensure_reduce_plan(self, ex, fu, fnames):
+        """The backward-interleaved gradient-reduce plan for the fused
+        step (parallel/collectives.GradReducePlan), or None when no
+        explicit in-step all-reduce applies (single device, or ZeRO —
+        the sharded step_math buckets and reduce-scatters itself).
+        Cached: plan construction must stay off the per-step host hot
+        path."""
+        if self._exec_group.mesh is None or fu.zero:
+            return None
+        import numpy as np
+        shapes = tuple(tuple(ex.arg_dict[n].shape) for n in fnames)
+        dtypes = tuple(np.dtype(ex.arg_dict[n].dtype).str
+                       for n in fnames)
+        if getattr(self, '_reduce_plan_inputs', None) != (shapes,
+                                                         dtypes):
+            from ..parallel import collectives
+            self._reduce_plan = collectives.GradReducePlan(shapes,
+                                                           dtypes)
+            self._reduce_plan_inputs = (shapes, dtypes)
+        return self._reduce_plan
+
     def _run_fused_step(self):
+        import time
         ex = self._exec_group.executor
         fu = self._fused_updater
         fnames = ex._diff_names
@@ -398,29 +428,46 @@ class Module(BaseModule):
             fu.param_names = list(fnames)
         weights = [ex.arg_dict[n] for n in fnames]
         moms, masters, lrs, wds = fu.host_prep(weights)
+        plan = self._ensure_reduce_plan(ex, fu, fnames)
         # keyed on executor AND updater AND the updater's cache_key:
         # init_optimizer(force_init=True) makes a new FusedSGD whose
         # step_math bakes new hyperparams, and under ZeRO host_prep may
         # have just rebuilt the bucket layout (cache_key carries it) —
         # a stale program would run old-layout buckets against new
-        # state shapes.  (step_key routes the compiled step through the
-        # process-wide executable cache, so a mismatch here rarely
-        # means a recompile.)
-        fkey = fu.cache_key()
+        # state shapes.  The reduce plan (bucketing + schedule) is
+        # baked into the traced step, so it joins too — WITH the mesh
+        # fingerprint: the grad_reduce closure binds a concrete mesh,
+        # so unlike the mesh-free step body it cannot be retraced for
+        # a different device set.  (step_key routes the compiled step
+        # through the process-wide executable cache, so a mismatch
+        # here rarely means a recompile.)
+        fkey = (fu.cache_key(),
+                (plan.key, self._mesh_fp()) if plan is not None
+                else None)
         if self._fused_step_key != (ex, fu, fkey):
+            mesh = self._exec_group.mesh
+            gr = (lambda grads: plan.apply(grads, mesh)) \
+                if plan is not None else None
             self._fused_step = ex.make_fused_train_step(
-                fu.step_math, step_key=fkey)
+                fu.step_math, step_key=fkey, grad_reduce=gr)
             self._fused_step_key = (ex, fu, fkey)
+        from .. import profiler
+        t0 = time.perf_counter()
+        synced = profiler.is_running()   # executor blocks only then
         new_moms, new_masters = ex.run_fused_train_step(
             self._fused_step, fnames, moms, masters, lrs, wds,
             zero=bool(fu.zero))
         fu.commit(new_moms, new_masters)
-        self._note_step_counters(1)
+        self._note_step_counters(
+            1, (time.perf_counter() - t0) * 1e3 if synced else 0.0)
 
-    def _note_step_counters(self, k):
+    def _note_step_counters(self, k, dt_ms=0.0, metric_steps=0):
         """Feed the profiler's comm/memory counters after k fused
-        steps: ZeRO reduce-scatter / all-gather payload bytes and the
-        per-device optimizer-state residency."""
+        steps: ZeRO reduce-scatter / all-gather payload bytes,
+        per-device optimizer-state residency, and the round-11
+        reduce/metric counters (one model,
+        profiler.note_reduce_dispatch; dt_ms must be 0.0 for async
+        dispatches — no overlap window is estimated then)."""
         from .. import profiler
         fu = self._fused_updater
         if fu is None:
@@ -430,9 +477,21 @@ class Module(BaseModule):
             profiler.add_comm_bytes(reduce_scattered=rs * k,
                                     all_gathered=ag * k)
         profiler.set_optimizer_state_bytes(fu.state_bytes_per_device())
+        buckets, interleave = 0, True
+        if self._exec_group.mesh is not None:
+            if fu.zero and fu._layout is not None:
+                buckets = len(fu._layout.buckets)
+                interleave = fu._interleave
+            elif not fu.zero and \
+                    getattr(self, '_reduce_plan', None) is not None:
+                buckets = self._reduce_plan.n_buckets
+                interleave = self._reduce_plan.interleave
+        profiler.note_reduce_dispatch(buckets, interleave, k,
+                                      dt_ms=dt_ms,
+                                      metric_steps=metric_steps)
 
     def bulk_step(self, batches=None, batch=None, repeat=None,
-                  scan_dtype=None):
+                  scan_dtype=None, eval_metric=None):
         """Run several full training steps (forward+backward+optimizer
         update) as ONE XLA dispatch, looping on-device.
 
@@ -444,11 +503,22 @@ class Module(BaseModule):
         scanned) or `batch` + `repeat=K` (the one batch is reused K
         times — synthetic/steady-state benchmarking).
 
-        Caveats vs the per-step loop: lr/wd schedules advance in units
-        of the bulk size (evaluated once per call), per-batch metrics
-        are unavailable (only the final step's outputs are kept), and
-        monitors don't fire.  Falls back to the plain loop when the
-        step cannot fuse.
+        lr/wd schedules evaluate at EVERY step index of the dispatch
+        (per-step schedule columns scanned alongside the batches), so
+        a FactorScheduler boundary crossed mid-dispatch decays at the
+        right step — bit-identical to the per-step loop.
+
+        eval_metric: optional EvalMetric with a device fold
+        (metric.device_fold) — its accumulation then runs INSIDE the
+        scan from each step's outputs and labels, and ONE queued
+        device-scalar pair per dispatch reaches the host metric
+        (no sync until metric.get()).  This is what lets `fit(bulk=K)`
+        stretch steps_per_dispatch across metric/logging boundaries.
+        Metrics without a device fold raise — use the per-step loop.
+
+        Remaining caveats vs the per-step loop: only the final step's
+        outputs are kept (get_outputs), and monitors don't fire.
+        Falls back to the plain loop when the step cannot fuse.
 
         scan_dtype: optional storage dtype for the stacked DATA arrays
         (labels keep their bound dtype — low-precision floats can't
@@ -473,7 +543,10 @@ class Module(BaseModule):
                       else [batch] * repeat):
                 self.forward_backward(b)
                 self.update()
+                if eval_metric is not None:
+                    self.update_metric(eval_metric, b.label)
             return
+        import time
         self._materialize_fused()
         import jax.numpy as jnp
         eg = self._exec_group
@@ -482,12 +555,24 @@ class Module(BaseModule):
         fnames = ex._diff_names
         if fu.param_names != fnames:
             fu.param_names = list(fnames)
+        fold = None
+        if eval_metric is not None:
+            fold = metric_mod.device_fold(eval_metric)
+            if fold is None:
+                raise ValueError(
+                    'bulk_step: metric %r has no device fold (see '
+                    'metric.device_fold); run the per-step loop for '
+                    'host-only metrics'
+                    % (getattr(eval_metric, 'name', eval_metric),))
         scan_names = [n for n in eg.data_names + eg.label_names
                       if n in ex.arg_dict and n not in set(fnames)]
         scan_stacks = None
         if batches is not None:
             if k == 1:
-                return self._single_step(batches[0])
+                ret = self._single_step(batches[0])
+                if eval_metric is not None:
+                    self.update_metric(eval_metric, batches[0].label)
+                return ret
             eg.load_data_batch(batches[0])  # dtype/shape checks + cast
             data_set = set(eg.data_names)
             per_name = {n: [] for n in scan_names}
@@ -515,25 +600,70 @@ class Module(BaseModule):
             eg.load_data_batch(batch)
             cache_key = (ex, fu, 'repeat', k)
         weights = [ex.arg_dict[n] for n in fnames]
-        moms, masters, lrs, wds = fu.host_prep(weights)
+        # per-step schedule stacks: counts bump and lr/wd evaluate at
+        # every step index (host scheduler semantics).  ONE (K, n)
+        # array each — a single transfer per dispatch regardless of
+        # parameter count; the per-param split happens in the trace
+        moms, masters, lr_stack, wd_stack = fu.host_prep_steps(
+            weights, k)
+        lrs, wds = jnp.asarray(lr_stack), jnp.asarray(wd_stack)
+        if eg.mesh is not None:
+            import jax
+            from ..parallel import mesh as pmesh
+            repl = pmesh.replicated(eg.mesh)
+            lrs = jax.device_put(lrs, repl)
+            wds = jax.device_put(wds, repl)
+        plan = self._ensure_reduce_plan(ex, fu, fnames)
         # fu.cache_key() joins AFTER host_prep: under ZeRO it carries
-        # the bucket layout host_prep may have just rebuilt
-        fkey = fu.cache_key()
+        # the bucket layout host_prep may have just rebuilt; the
+        # reduce plan (+ the mesh its closure binds) and metric fold
+        # bake into the traced scan, so they join too (carry
+        # signature)
+        fkey = (fu.cache_key(),
+                (plan.key, self._mesh_fp()) if plan is not None
+                else None,
+                fold.key if fold is not None else None, 'lrstack')
         cache_key = cache_key + (fkey,)
-        for _ in range(k - 1):  # host_prep bumped counts once
-            for n in fnames:
-                self._optimizer._update_count(n)
         if getattr(self, '_bulk_cache_key', None) != cache_key:
+            mesh = eg.mesh
+            gr = (lambda grads: plan.apply(grads, mesh)) \
+                if plan is not None else None
+            metric_arg = None
+            if fold is not None:
+                scan_order = [n for n in ex._arg_names
+                              if n in set(scan_names) and
+                              n not in set(fnames)]
+                label_pos = {n: i for i, n in enumerate(scan_order)
+                             if n in eg.label_names}
+                out_names = self._symbol.list_outputs()
+
+                def m_update(mc, outs, sv, _lp=label_pos,
+                             _on=out_names, _fold=fold):
+                    label = {n: sv[i] for n, i in _lp.items()}
+                    pred = dict(zip(_on, outs))
+                    return _fold.update(mc, label, pred)
+
+                metric_arg = (fold.init, m_update)
             self._bulk_step_fn = ex.make_fused_multistep(
                 fu.step_math, scan_names,
                 repeat=(k if batches is None else None),
-                step_key=fkey)
+                step_key=fkey, grad_reduce=gr, metric=metric_arg,
+                lr_stacked=True)
             self._bulk_cache_key = cache_key
-        new_moms, new_masters = ex.run_fused_multistep(
+        from .. import profiler
+        t0 = time.perf_counter()
+        synced = profiler.is_running()   # executor blocks only then
+        new_moms, new_masters, mcarry = ex.run_fused_multistep(
             self._bulk_step_fn, fnames, scan_names, scan_stacks,
             moms, masters, lrs, wds, zero=bool(fu.zero))
         fu.commit(new_moms, new_masters)
-        self._note_step_counters(k)
+        if fold is not None:
+            # device scalars queue on the host metric WITHOUT a sync;
+            # the first metric.get() drains them
+            fold.commit(mcarry)
+        self._note_step_counters(
+            k, (time.perf_counter() - t0) * 1e3 if synced else 0.0,
+            metric_steps=k if fold is not None else 0)
         self._params_dirty = True
 
     def _single_step(self, data_batch):

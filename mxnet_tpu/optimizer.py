@@ -556,8 +556,18 @@ def sgd_update_math(acc, g, m, lr, wd, momentum=0.0, rescale=1.0,
     FusedSGD step (per-param, scalar lr/wd) and the ZeRO-1 sharded
     step (per-bucket, per-element lr/wd vectors) — ONE definition so
     the two modes cannot drift.  `g` must already be in `acc`'s dtype;
-    returns (new_acc, new_momentum)."""
+    returns (new_acc, new_momentum).
+
+    lr/wd may be python floats (weak-typed: the multiply stays in
+    acc's dtype) or traced jax scalars from a per-step schedule stack
+    (epoch-level fusion) — traced values are cast to acc's dtype so a
+    strong float32 scalar cannot silently promote a low-precision
+    update."""
     import jax.numpy as jnp
+    if hasattr(lr, 'dtype') and lr.dtype != acc.dtype:
+        lr = lr.astype(acc.dtype)
+    if hasattr(wd, 'dtype') and wd.dtype != acc.dtype:
+        wd = wd.astype(acc.dtype)
     g = g * rescale
     if clip is not None:
         g = jnp.clip(g, -clip, clip)
@@ -588,7 +598,8 @@ class FusedSGD:
     optimizer-state memory drops by the dp degree with the same total
     collective bytes on the wire."""
 
-    def __init__(self, optimizer, param_names, zero=0, mesh=None):
+    def __init__(self, optimizer, param_names, zero=0, mesh=None,
+                 interleave=None):
         import jax
         import jax.numpy as jnp
         assert type(optimizer) in (SGD, NAG)
@@ -600,9 +611,8 @@ class FusedSGD:
         self.mesh = mesh
         # static mesh fingerprint for cache_key (computed once: per-step
         # key checks must not re-stringify every device on large meshes)
-        self._mesh_fp = None if mesh is None else (
-            tuple(mesh.axis_names),
-            tuple(str(d) for d in mesh.devices.flat))
+        from .parallel.mesh import mesh_fingerprint
+        self._mesh_fp = mesh_fingerprint(mesh)
         if self.zero and mesh is not None and \
                 'data' not in mesh.axis_names:
             raise ValueError(
@@ -657,9 +667,17 @@ class FusedSGD:
         self.multi_precision = multi_precision
         if self.zero:
             from .parallel import zero as zero_mod
+            from .parallel import collectives as coll
             self._zero_mod = zero_mod
+            # reduction schedule is baked into the traced sharded step
+            # (end-of-backward mode inserts a barrier) — resolved once
+            # here (explicit API value > env) and reported by
+            # cache_key so the two schedules' programs never alias
+            self._interleave = coll.interleave_reduce_enabled(
+                interleave)
             self._zero_hyper = {'momentum': momentum, 'rescale': rescale,
-                                'clip': clip, 'nesterov': nesterov}
+                                'clip': clip, 'nesterov': nesterov,
+                                'interleave': self._interleave}
             # step_math / _jit_step are (re)bound in _host_prep_zero,
             # which captures the bucket layout BY VALUE: a step program
             # cached under one layout's key must never read a layout
@@ -684,7 +702,7 @@ class FusedSGD:
         if self.zero:
             key += (('zero', self.zero,
                      self._layout.key if self._layout is not None
-                     else None, self._mesh_fp),)
+                     else None, self._mesh_fp, self._interleave),)
         return key
 
     def host_prep(self, weights):
@@ -725,6 +743,28 @@ class FusedSGD:
             opt._update_count(name)
             lrs.append(opt._get_lr(name))
             wds.append(opt._get_wd(name))
+        return moms, masters, lrs, wds
+
+    def host_prep_steps(self, weights, k):
+        """host_prep for a K-step bulk dispatch: states init once, the
+        update counts bump K times, and the lr/wd schedules evaluate at
+        EVERY step index (the host scheduler runs exactly as the
+        per-step loop would, so a FactorScheduler boundary crossed
+        mid-dispatch decays at the right step — schedules no longer
+        advance in bulk-size units).  Returns (moms, masters, lrs,
+        wds) with lrs/wds float32 arrays of shape (k, n_params), fed
+        to the scan as per-step inputs."""
+        moms, masters, lrs0, wds0 = self.host_prep(weights)
+        n = len(self.param_names)
+        lrs = np.empty((max(1, k), n), np.float32)
+        wds = np.empty((max(1, k), n), np.float32)
+        lrs[0], wds[0] = lrs0, wds0
+        opt = self.optimizer
+        for s in range(1, k):
+            for j, name in enumerate(self.param_names):
+                opt._update_count(name)
+                lrs[s, j] = opt._get_lr(name)
+                wds[s, j] = opt._get_wd(name)
         return moms, masters, lrs, wds
 
     def _is_mp(self, w):
@@ -994,12 +1034,16 @@ class FusedSGD:
             self.optimizer._index_update_count = dict(counts)
 
 
-def create_fused_updater(optimizer, param_names, zero=0, mesh=None):
+def create_fused_updater(optimizer, param_names, zero=0, mesh=None,
+                         interleave=None):
     """Return a fused whole-model updater when the optimizer supports it,
     else None (caller falls back to the per-key Updater).  FusedSGD
     handles multi_precision natively (fp32 masters inside the jitted
     step, reference mp_sgd_update).  zero=1 selects the ZeRO stage-1
-    sharded update over `mesh`'s data axis (parallel/zero.py)."""
+    sharded update over `mesh`'s data axis (parallel/zero.py);
+    interleave overrides the gradient-reduction schedule the sharded
+    step bakes in (None = MXNET_TPU_INTERLEAVE_REDUCE)."""
     if type(optimizer) in (SGD, NAG):
-        return FusedSGD(optimizer, param_names, zero=zero, mesh=mesh)
+        return FusedSGD(optimizer, param_names, zero=zero, mesh=mesh,
+                        interleave=interleave)
     return None

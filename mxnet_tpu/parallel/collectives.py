@@ -6,7 +6,29 @@ reduce (comm.h:222).  On TPU every one of those patterns is an XLA
 collective over a named mesh axis; these wrappers exist so framework
 code and user custom ops have one obvious place to call them from
 inside shard_map/pjit-compiled code.
+
+Also home of the backward-interleaved gradient-reduction plan
+(`GradReducePlan`): instead of one end-of-backward reduce of every
+gradient, gradients are grouped into a few contiguous buckets ordered
+by backward AVAILABILITY (last layer's grads exist first) and each
+bucket's collective is issued as soon as its members are produced —
+XLA's latency-hiding scheduler then overlaps bucket i's collective
+with bucket i+1's wgrad compute.  The packed bucket psum is
+elementwise-identical to per-parameter reduces (a cross-replica sum
+doesn't care about concatenation), so the two modes agree bitwise.
+
+Env knobs (docs/PERF.md round 11):
+  MXNET_TPU_INTERLEAVE_REDUCE=0  force the end-of-backward baseline
+      (an optimization_barrier makes every wgrad complete before any
+      reduce issues — the A/B arm BENCH_OVERLAP measures against)
+  MXNET_TPU_REDUCE_BUCKETS=N     exact bucket count (per dtype group)
+  MXNET_TPU_ZERO_BUCKET_MB       bucket fill target otherwise (shared
+      with the ZeRO-1 bucketing, parallel/zero.py)
 """
+import os
+
+import numpy as np
+
 import jax
 from jax import lax
 
@@ -67,6 +89,128 @@ def allreduce_bucket(x, mesh):
     Trainer.step's per-parameter kvstore.push/pull, collapsed into the
     compiled step (identity when no mesh is active)."""
     return allgather_bucket(x, mesh)
+
+
+def interleave_reduce_enabled(explicit=None):
+    """Resolve the gradient-reduction schedule: an explicit API value
+    wins, else MXNET_TPU_INTERLEAVE_REDUCE (default on — interleaved
+    bucket-by-bucket reduces; 0 = one end-of-backward reduce)."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get('MXNET_TPU_INTERLEAVE_REDUCE', '1').strip() \
+        not in ('0',)
+
+
+def reduce_bucket_count():
+    """MXNET_TPU_REDUCE_BUCKETS as an int, or None (fill buckets by
+    the shared ZeRO bucket-MB target instead)."""
+    v = os.environ.get('MXNET_TPU_REDUCE_BUCKETS', '').strip()
+    if not v:
+        return None
+    n = int(v)
+    if n < 1:
+        raise ValueError('MXNET_TPU_REDUCE_BUCKETS must be >= 1, '
+                         'got %d' % n)
+    return n
+
+
+def grad_barrier(grads):
+    """Force every gradient to be computed before ANY use downstream:
+    the end-of-backward baseline the interleaved schedule is measured
+    against (and the historical behavior of one post-backward reduce).
+    Identity on values; only the schedule changes."""
+    grads = tuple(grads)
+    if not grads:
+        return []
+    return list(lax.optimization_barrier(grads))
+
+
+class GradReducePlan:
+    """Static bucketing plan for in-step gradient all-reduce.
+
+    Buckets are built over the REVERSED parameter order — the backward
+    pass produces the last layer's wgrads first, so the bucket holding
+    them closes (and its collective issues) while earlier layers'
+    wgrads are still computing.  Same-dtype runs concatenate into flat
+    buffers (one collective per bucket instead of one per parameter);
+    a dtype change always closes the current bucket.
+
+    `key` is the hashable identity joining the compiled-program cache
+    key (exec_cache) so programs built under different bucketings or
+    schedules never alias.
+    """
+
+    def __init__(self, shapes, dtypes, max_bytes=None, n_buckets=None,
+                 interleave=None):
+        if max_bytes is None:
+            from . import zero as zero_mod
+            max_bytes = zero_mod.bucket_bytes()
+        if n_buckets is None:
+            n_buckets = reduce_bucket_count()
+        self.interleave = interleave_reduce_enabled(interleave)
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtypes = [np.dtype(d) for d in dtypes]
+        sizes = [int(np.prod(s)) if len(s) else 1 for s in self.shapes]
+        rev = list(range(len(shapes)))[::-1]
+        if n_buckets is not None:
+            # exact bucket count: split the reversed order into
+            # n roughly-equal-bytes chunks (dtype changes still split)
+            total = sum(sizes[i] * self.dtypes[i].itemsize for i in rev)
+            target = max(1, -(-total // n_buckets))
+        else:
+            target = max_bytes
+        buckets = []
+        cur, cur_bytes, cur_dt = [], 0, None
+        for i in rev:
+            nbytes = sizes[i] * self.dtypes[i].itemsize
+            if cur and (self.dtypes[i] != cur_dt or
+                        cur_bytes >= target):
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+            cur_dt = self.dtypes[i]
+        if cur:
+            buckets.append(cur)
+        self.buckets = buckets
+        self.key = ('gradreduce', self.interleave,
+                    tuple(tuple(b) for b in buckets),
+                    tuple((s, dt.str)
+                          for s, dt in zip(self.shapes, self.dtypes)))
+
+    @property
+    def n_buckets(self):
+        return len(self.buckets)
+
+    def apply(self, grads, mesh):
+        """All-reduce `grads` (list aligned with the plan's parameter
+        order) across `mesh` bucket-by-bucket.  Under the
+        end-of-backward schedule (interleave off) a barrier first makes
+        every wgrad complete before any collective issues.  Identity
+        when no mesh is active.  Values are bitwise-identical across
+        schedules and to per-parameter reduces."""
+        if mesh is None:
+            return list(grads)
+        grads = list(grads)
+        if not self.interleave:
+            grads = grad_barrier(grads)
+        import jax.numpy as jnp
+        out = list(grads)
+        for b in self.buckets:
+            if len(b) == 1:
+                i = b[0]
+                out[i] = allreduce_bucket(grads[i], mesh)
+                continue
+            flat = jnp.concatenate([jnp.reshape(grads[i], (-1,))
+                                    for i in b])
+            red = allreduce_bucket(flat, mesh)
+            off = 0
+            for i in b:
+                n = int(np.prod(self.shapes[i])) \
+                    if len(self.shapes[i]) else 1
+                out[i] = jnp.reshape(red[off:off + n], self.shapes[i])
+                off += n
+        return out
 
 
 def ppermute(x, axis_name, perm):

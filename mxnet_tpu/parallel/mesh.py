@@ -76,3 +76,15 @@ def replicate_params(mesh, arrays):
     """Replicate parameter arrays across every mesh device."""
     sh = NamedSharding(mesh, P())
     return [jax.device_put(a, sh) for a in arrays]
+
+
+def mesh_fingerprint(mesh):
+    """Hashable device identity of a mesh for compiled-program cache
+    keys (None when no mesh).  ONE definition: programs whose
+    closures bind devices by value (AOT executables, grad-reduce
+    plans, ZeRO step math) key on this — two call sites with drifted
+    formats could alias programs across meshes."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names),
+            tuple(str(d) for d in mesh.devices.flat))

@@ -201,10 +201,21 @@ def sharded_sgd_step(layout, mesh, hyper, ws, gs, moms, masters, lrs,
     of the rescale/clip/wd/momentum core), here on concatenated 1-D
     buckets with per-element lr/wd vectors built in the accumulation
     dtype (so `vec * bucket` promotes exactly like the replicated
-    path's weak-typed `scalar * tensor`)."""
-    from .collectives import reduce_scatter_bucket, allgather_bucket
+    path's weak-typed `scalar * tensor`).
+
+    Reduction schedule: each gradient bucket's reduce-scatter issues as
+    soon as its member wgrads exist (backward-interleaved — XLA's
+    latency-hiding scheduler overlaps it with the remaining backward).
+    hyper['interleave']=False (MXNET_TPU_INTERLEAVE_REDUCE=0) restores
+    the end-of-backward baseline: an optimization_barrier makes every
+    wgrad complete before any collective issues.  Values are identical
+    either way; only the schedule changes."""
+    from .collectives import (reduce_scatter_bucket, allgather_bucket,
+                              grad_barrier)
     from ..optimizer import sgd_update_math
 
+    if not hyper.get('interleave', True):
+        gs = grad_barrier(gs)
     new_ws = [None] * len(ws)
     new_moms, new_masters = [], []
     for b in layout.buckets:

@@ -40,7 +40,49 @@ _COMM = {
     'bytes_reduce_scattered': 0,
     'bytes_all_gathered': 0,
     'optimizer_state_bytes_per_device': 0,
+    # backward-interleaved reduction + epoch-level fusion (round 11):
+    # gradient-bucket collectives issued inside fused steps, the
+    # ESTIMATED wall-clock window those collectives could overlap
+    # backward compute (dispatch time x backward-fraction model — see
+    # add_reduce_stats), and training steps whose metric accumulation
+    # ran device-resident inside the bulk scan
+    'reduce_buckets_issued': 0,
+    'overlap_window_ms': 0.0,
+    'scan_fused_metric_steps': 0,
 }
+
+
+def add_reduce_stats(buckets_issued=0, overlap_window_ms=0.0,
+                     metric_steps=0):
+    """Accumulate interleaved-reduce / epoch-fusion counters (the
+    fused step paths feed one call per dispatch).  overlap_window_ms
+    is an ESTIMATE: dispatch wall time x 1/2 (the backward's rough
+    share of a training step) x (B-1)/B for B buckets — the window in
+    which all but the last bucket's collective can hide behind
+    remaining wgrad compute.  It bounds the schedulable overlap; XLA's
+    latency-hiding scheduler decides the realized overlap."""
+    with _STATE['lock']:
+        _COMM['reduce_buckets_issued'] += int(buckets_issued)
+        _COMM['overlap_window_ms'] += float(overlap_window_ms)
+        _COMM['scan_fused_metric_steps'] += int(metric_steps)
+
+
+def note_reduce_dispatch(buckets, interleave, k, dt_ms=0.0,
+                         metric_steps=0):
+    """ONE counter model for a fused dispatch of k steps, shared by
+    the Module and gluon fused paths: `buckets` gradient-bucket
+    collectives issue per step, and the overlap-window estimate
+    applies the add_reduce_stats formula.  dt_ms must be the wall
+    time of a SYNCHRONIZED dispatch (callers pass 0.0 when the
+    dispatch returned after async enqueue — host return time says
+    nothing about device wall time, so no window is estimated
+    then)."""
+    overlap = dt_ms * 0.5 * (buckets - 1) / buckets \
+        if buckets > 1 and interleave and dt_ms > 0.0 else 0.0
+    if buckets or metric_steps:
+        add_reduce_stats(buckets_issued=buckets * k,
+                         overlap_window_ms=overlap,
+                         metric_steps=metric_steps)
 
 
 # host input-pipeline counters (parallel decode pool + device prefetch):
@@ -344,6 +386,11 @@ def summary(print_out=True):
                  % (cm['bytes_reduce_scattered'],
                     cm['bytes_all_gathered'],
                     cm['optimizer_state_bytes_per_device']))
+    lines.append('  reduce_buckets_issued=%d overlap_window_ms=%.3f '
+                 'scan_fused_metric_steps=%d'
+                 % (cm['reduce_buckets_issued'],
+                    cm['overlap_window_ms'],
+                    cm['scan_fused_metric_steps']))
     ip = input_stats()
     lines.append('  decode_ms=%.3f decoded_samples=%d '
                  'decode_wait_ms=%.3f queue_depth_avg=%.2f '

@@ -39,7 +39,12 @@ def test_neural_style():
     assert last < 0.5 * first, (first, last)
 
 
+@pytest.mark.slow
 def test_finetune():
+    # slow (~17s, round-11 headroom): checkpoint load + layer freeze +
+    # fit stays tier-1 via test_train.test_fused_sgd_state_roundtrip,
+    # test_module set_params/save_checkpoint, and the gluon
+    # frozen-params test (test_gluon_fused)
     mod = _load('examples/finetune/finetune.py', 'ex_finetune')
     base, head, full = mod.main(quick=True)
     assert base > 0.9, base
@@ -121,10 +126,15 @@ def test_nce_word_vectors():
     assert prec > 0.5, prec
 
 
+@pytest.mark.slow
 def test_cnn_text_classification():
     """TextCNN (reference example/cnn_text_classification role): the
     planted-bigram sentiment task needs the conv filters' locality —
-    bag-of-words can't solve it."""
+    bag-of-words can't solve it.
+
+    slow (~16s, round-11 headroom): Embedding+Conv training stays
+    tier-1 via test_nce_word_vectors (embedding gradients) and the
+    conv fit-convergence test (test_train)."""
     mod = _load('examples/cnn_text/text_cnn.py', 'ex_textcnn')
     acc = mod.main(quick=True)
     assert acc > 0.9, acc
@@ -165,11 +175,16 @@ def test_svm_mnist():
     assert margin > 0.7, margin
 
 
+@pytest.mark.slow
 def test_stochastic_depth():
     """User-defined BaseModule subclass inside SequentialModule
     (reference example/stochastic-depth): converges, gate statistics
     follow the death-rate schedule, expectation inference is
-    deterministic."""
+    deterministic.
+
+    slow (~16s, round-11 headroom): SequentialModule training stays
+    tier-1 via test_module's sequential coverage; the stochastic gate
+    is example-specific composition."""
     mod = _load('examples/stochastic_depth/sd_mnist.py', 'ex_sd')
     acc, gate_err, determ = mod.main(quick=True)
     assert acc > 0.9, acc
@@ -211,9 +226,14 @@ def test_memcost():
     assert remat <= bwd, (remat, bwd)
 
 
+@pytest.mark.slow
 def test_rnn_time_major():
     """Time-major unroll (reference example/rnn-time-major): layout
-    parity in accuracy and exact cross-layout forward equivalence."""
+    parity in accuracy and exact cross-layout forward equivalence.
+
+    slow (~22s, round-11 headroom): RNN unroll training stays tier-1
+    via test_rnn.test_lstm_bucketing_training and
+    test_gluon_rnn's cell unroll/backward tests."""
     mod = _load('examples/rnn_time_major/rnn_cell_demo.py', 'ex_tnc')
     acc_nt, acc_tn, max_dev = mod.main(quick=True)
     assert acc_nt > 0.9, acc_nt

@@ -35,22 +35,29 @@ def main():
                    help='run the BENCH_GLUON fused-Gluon training '
                         'smoke (one bench.py child) instead of the '
                         'model-family sweep')
+    p.add_argument('--overlap', action='store_true',
+                   help='run the BENCH_OVERLAP gradient-reduction '
+                        'schedule A/B (one bench.py child; spawns '
+                        'its own virtual CPU mesh when needed) '
+                        'instead of the model-family sweep')
     args = p.parse_args()
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
-    if args.gluon:
-        env = dict(os.environ, BENCH_GLUON='1')
+    if args.gluon or args.overlap:
+        name, var = (('gluon', 'BENCH_GLUON') if args.gluon
+                     else ('overlap', 'BENCH_OVERLAP'))
+        env = dict(os.environ, **{var: '1'})
         proc = subprocess.run([sys.executable, bench_py], env=env,
                               capture_output=True, text=True)
         if proc.returncode != 0:
             sys.stderr.write(proc.stderr)
-            raise RuntimeError('gluon bench failed')
+            raise RuntimeError('%s bench failed' % name)
         lines = proc.stdout.strip().splitlines()
         if not lines:
             # zero-exit child with no JSON: broken relay, not success
             sys.stderr.write(proc.stderr)
-            raise RuntimeError('gluon bench produced no output')
+            raise RuntimeError('%s bench produced no output' % name)
         print(lines[-1], flush=True)
         return
     for name in args.models.split(','):
