@@ -43,6 +43,10 @@ bucket-by-bucket all-reduce vs the end-of-backward baseline on a
 data-parallel mesh — see overlap_bench() for the BENCH_OVERLAP_*
 knobs; re-execs onto a virtual CPU mesh when the process has too few
 devices),
+BENCH_BUCKET=1 (dynamic-shape training mode: legacy 3-dispatch
+per-bucket loop vs the AOT-warmed fused bucket ladder vs the
+bucket-major bulked ladder on a synthetic length-mixed workload —
+see bucket_bench() for the BENCH_BUCKET_* knobs),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -629,6 +633,231 @@ def overlap_bench():
 
 
 # ---------------------------------------------------------------------------
+# BENCH_BUCKET=1: fused bucket-ladder training vs the legacy 3-dispatch loop
+# ---------------------------------------------------------------------------
+
+def bucket_bench():
+    """BENCH_BUCKET=1: measure dynamic-shape (bucketed) training on a
+    synthetic length-mixed workload in three arms and emit ONE JSON
+    line:
+
+      * legacy   — the pre-round-12 per-bucket loop: forward() /
+        backward() / update() = 3 dispatches per step, programs
+        compiled lazily per length.
+      * fused    — forward_backward()+update() through the fused
+        single-dispatch train program, on an AOT-warmed bucket ladder
+        (bucket_ladder + mask_label: off-rung lengths pad up, masked
+        positions contribute zero — ZERO XLA compiles in the measured
+        steady state).
+      * bulk     — the same ladder driven bucket-major: runs of
+        BENCH_BUCKET_BULK same-rung batches fuse into ONE lax.scan
+        dispatch each (fit(bulk=K) for variable-length data).
+
+    All arms process the same multiset of batch lengths; the bulk arm
+    sees them bucket-major (that reordering is exactly what
+    BucketSentenceIter(bucket_major=True) provides).  Arms run
+    best-of-BENCH_BUCKET_PASSES interleaved (this rig's cpu-shares
+    throttle swings single passes ~2x).  Parity gates: legacy vs
+    fused, and fused vs bulk, trained from identical init on identical
+    schedules.
+
+    Knobs: BENCH_BUCKET_BATCH (32), BENCH_BUCKET_VOCAB (64),
+    BENCH_BUCKET_EMBED (32), BENCH_BUCKET_HIDDEN (64),
+    BENCH_BUCKET_LADDER ('8,16'), BENCH_BUCKET_LENGTHS ('5,8,11,16'),
+    BENCH_BUCKET_STEPS (24 per pass), BENCH_BUCKET_PASSES (5),
+    BENCH_BUCKET_BULK (8)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, profiler
+    from mxnet_tpu import ndarray as nd
+    from mxnet_tpu import sym
+
+    batch = int(os.environ.get('BENCH_BUCKET_BATCH', 32))
+    vocab = int(os.environ.get('BENCH_BUCKET_VOCAB', 64))
+    embed = int(os.environ.get('BENCH_BUCKET_EMBED', 32))
+    hidden = int(os.environ.get('BENCH_BUCKET_HIDDEN', 64))
+    ladder = tuple(int(x) for x in os.environ.get(
+        'BENCH_BUCKET_LADDER', '8,16').split(','))
+    lengths = tuple(int(x) for x in os.environ.get(
+        'BENCH_BUCKET_LENGTHS', '5,8,11,16').split(','))
+    steps = int(os.environ.get('BENCH_BUCKET_STEPS', 24))
+    passes = max(1, int(os.environ.get('BENCH_BUCKET_PASSES', 5)))
+    bulk = int(os.environ.get('BENCH_BUCKET_BULK', 8))
+    mask = 0
+    default_key = max(ladder)
+
+    def sym_gen(seq_len):
+        data = sym.Variable('data')
+        label = sym.Variable('softmax_label')
+        emb = sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                            name='embed')
+        h = sym.Reshape(emb, shape=(-1, embed))
+        h = sym.Activation(sym.FullyConnected(h, num_hidden=hidden,
+                                              name='fc1'),
+                           act_type='relu')
+        fc = sym.FullyConnected(h, num_hidden=vocab, name='pred')
+        lab = sym.Reshape(label, shape=(-1,))
+        out = sym.SoftmaxOutput(fc, label=lab, use_ignore=True,
+                                ignore_label=mask, name='softmax')
+        return out, ('data',), ('softmax_label',)
+
+    def make_module(with_ladder, warm):
+        mx.random.seed(5)
+        mod = mx.mod.BucketingModule(
+            sym_gen, default_bucket_key=default_key,
+            bucket_ladder=(ladder if with_ladder else None),
+            mask_label=mask, warmup_buckets=warm)
+        mod.bind(
+            data_shapes=[mx.io.DataDesc('data', (batch, default_key),
+                                        layout='NT')],
+            label_shapes=[mx.io.DataDesc('softmax_label',
+                                         (batch, default_key),
+                                         layout='NT')])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer_params={'learning_rate': 0.05,
+                                             'momentum': 0.9})
+        return mod
+
+    rng = np.random.RandomState(3)
+
+    def make_batch(seq_len, seed):
+        rs = np.random.RandomState(1000 + 31 * seed + seq_len)
+        X = rs.randint(1, vocab, (batch, seq_len)).astype(np.float32)
+        y = np.roll(X, -1, axis=1)
+        y[:, -1] = mask
+        return mx.io.DataBatch(
+            [nd.array(X)], [nd.array(y)], bucket_key=seq_len,
+            provide_data=[mx.io.DataDesc('data', (batch, seq_len),
+                                         layout='NT')],
+            provide_label=[mx.io.DataDesc('softmax_label',
+                                          (batch, seq_len),
+                                          layout='NT')])
+
+    # one length schedule for every arm: mixed order for legacy/fused,
+    # bucket-major (sorted) for the bulk arm — same multiset of work
+    schedule = [lengths[rng.randint(len(lengths))] for _ in range(steps)]
+    mixed = [make_batch(l, i) for i, l in enumerate(schedule)]
+    major = sorted(mixed, key=lambda b: b.bucket_key)
+
+    # legacy arm = the true pre-round-12 configuration: NO ladder (one
+    # exact-shape module compiled lazily per length) driven through the
+    # 3-dispatch forward/backward/update loop; its compiles land in the
+    # warmup pass below, so the measured window is its steady state
+    mod_l = make_module(with_ladder=False, warm=None)
+    mod_f = make_module(with_ladder=True, warm=True)
+    mod_b = make_module(with_ladder=True, warm=True)
+    mod_b.warmup_buckets(bulk=bulk)
+
+    def legacy_steps():
+        for b in mixed:
+            mod_l.forward(b, is_train=True)   # dispatch 1 (fwd)
+            mod_l.backward()                  # dispatch 2 (fwd+bwd)
+            mod_l.update()                    # dispatch 3 (update)
+        mod_l.get_outputs()[0].asnumpy()      # host-fetch barrier
+
+    def fused_steps():
+        for b in mixed:
+            mod_f.forward_backward(b)
+            mod_f.update()
+        mod_f.get_outputs()[0].asnumpy()
+
+    def bulk_steps():
+        group = []
+        for b in major + [None]:
+            if b is not None and (not group or
+                                  (mod_b._rung_for(b.bucket_key) ==
+                                   mod_b._rung_for(group[0].bucket_key)
+                                   and len(group) < bulk)):
+                group.append(b)
+                continue
+            if len(group) >= 2:
+                mod_b.bulk_step(batches=group)
+            else:
+                for g in group:
+                    mod_b.forward_backward(g)
+                    mod_b.update()
+            group = [b] if b is not None else []
+        mod_b.get_outputs()[0].asnumpy()
+
+    # warmup passes (any lazy compiles happen here, outside the clock).
+    # bulk runs twice: partial-K trailing groups are not AOT-warmed, and
+    # their programs need both the fresh-buffer and the donated-output
+    # signatures compiled before the clock starts
+    legacy_steps()
+    fused_steps()
+    bulk_steps()
+    bulk_steps()
+
+    best = {'legacy': 0.0, 'fused': 0.0, 'bulk': 0.0}
+    c0 = exec_cache.stats()['total_compile_s']
+    for _ in range(passes):
+        for name, fn in (('legacy', legacy_steps), ('fused', fused_steps),
+                         ('bulk', bulk_steps)):
+            tic = time.time()
+            fn()
+            best[name] = max(best[name], steps / (time.time() - tic))
+    steady_compile_s = exec_cache.stats()['total_compile_s'] - c0
+
+    # parity: identical init + identical schedule per pair.  legacy
+    # (exact shapes) vs fused (padded to rung) also gates the masked-pad
+    # semantics: the two trajectories agree to float rounding
+    def clone_pair(ladder_a=True):
+        a = make_module(with_ladder=ladder_a, warm=None)
+        b = make_module(with_ladder=True, warm=None)
+        b.set_params(*a.get_params())
+        return a, b
+
+    pl, pf = clone_pair(ladder_a=False)
+    for b in mixed[:6]:
+        pl.forward(b, is_train=True)
+        pl.backward()
+        pl.update()
+        pf.forward_backward(b)
+        pf.update()
+
+    def max_diff(m1, m2):
+        a1, _ = m1.get_params()
+        a2, _ = m2.get_params()
+        return max(float(np.abs(a1[k].asnumpy() -
+                                a2[k].asnumpy()).max()) for k in a1)
+
+    parity_lf = max_diff(pl, pf)
+    ps, pb = clone_pair()
+    grp = major[:bulk]
+    grp = [g for g in grp
+           if ps._rung_for(g.bucket_key) ==
+           ps._rung_for(grp[0].bucket_key)]
+    for b in grp:
+        ps.forward_backward(b)
+        ps.update()
+    pb.bulk_step(batches=grp)
+    parity_fb = max_diff(ps, pb)
+
+    bs = profiler.bucketing_stats()
+    print(json.dumps({
+        'metric': 'bucket_ladder_train',
+        'value': round(best['fused'], 2),
+        'unit': 'steps/sec',
+        'legacy_sps': round(best['legacy'], 2),
+        'bulk_sps': round(best['bulk'], 2),
+        'speedup_vs_legacy': round(
+            best['fused'] / max(best['legacy'], 1e-9), 3),
+        'speedup_bulk_vs_legacy': round(
+            best['bulk'] / max(best['legacy'], 1e-9), 3),
+        'batch': batch, 'vocab': vocab, 'embed': embed,
+        'hidden': hidden, 'ladder': list(ladder),
+        'lengths': list(lengths), 'steps_per_pass': steps,
+        'passes': passes, 'bulk': bulk,
+        'steady_compile_s': round(steady_compile_s, 4),
+        'zero_compile_steady_state': bool(steady_compile_s == 0.0),
+        'train_pad_waste_frac': round(bs['train_pad_waste_frac'], 4),
+        'train_bucket_switches': bs['train_bucket_switches'],
+        'parity_legacy_vs_fused': parity_lf,
+        'parity_fused_vs_bulk': parity_fb,
+        'parity_ok': bool(parity_lf < 1e-5 and parity_fb < 1e-5),
+    }))
+
+
+# ---------------------------------------------------------------------------
 # BENCH_INFER=serve: dynamic-batching inference engine vs serial predict
 # ---------------------------------------------------------------------------
 
@@ -898,6 +1127,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_OVERLAP', '') == '1':
         overlap_bench()   # interleaved vs end-of-backward reduce
+        return
+    if os.environ.get('BENCH_BUCKET', '') == '1':
+        bucket_bench()   # fused bucket ladder vs legacy per-bucket loop
         return
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \

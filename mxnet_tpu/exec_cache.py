@@ -25,7 +25,10 @@ Two layers of reuse:
 Env knobs (documented in docs/PERF.md):
   MXNET_TPU_EXEC_CACHE=1|0         in-process cache (default on)
   MXNET_TPU_EXEC_CACHE_SIZE=N      LRU entries (default 64)
-  MXNET_TPU_PERSISTENT_CACHE_DIR   on-disk XLA cache dir (default off)
+  MXNET_TPU_PERSISTENT_CACHE_DIR   on-disk XLA cache dir (default off;
+                                   inert on the CPU backend — see
+                                   setup_persistent_cache)
+  MXNET_TPU_PERSISTENT_CACHE_FORCE=1  enable it on CPU anyway
 
 Counters (exposed via profiler.exec_cache_stats / profiler.summary):
   hits / misses        signature lookups at bind time
@@ -42,6 +45,7 @@ _LOCK = threading.RLock()
 _CACHE = OrderedDict()          # signature-scoped key -> cached object
 _STATS = {'hits': 0, 'misses': 0, 'total_compile_s': 0.0}
 _PERSISTENT_DIR = None          # set once by setup_persistent_cache
+_WARNED_CPU_CACHE = False       # one warning per process (CPU guard)
 
 # Every env knob whose value is baked into the TRACED program must be
 # registered here ((name, default) read at bind time) — a trace-affecting
@@ -81,12 +85,35 @@ def setup_persistent_cache():
 
     Must run before the first compilation: jax memoizes cache-usability
     per backend on first use, so Executor calls this at every bind —
-    only the first call with the env var set does work."""
-    global _PERSISTENT_DIR
+    only the first call with the env var set does work.
+
+    CPU-backend guard: XLA:CPU executable (de)serialization is
+    UNRELIABLE on the pinned jax — a warm-started process re-running a
+    cached program that contains gather/scatter (an Embedding
+    gradient, for one) gets silently corrupted buffers (weights at
+    1e12+ after a handful of steps; measured while building the
+    round-12 bucketing bench, cold process exact / warm process
+    garbage on the identical script).  Silent wrong-weights training
+    is disqualifying, so on the CPU backend the on-disk cache stays
+    OFF unless MXNET_TPU_PERSISTENT_CACHE_FORCE=1 explicitly accepts
+    the risk.  Accelerator backends are unaffected."""
+    global _PERSISTENT_DIR, _WARNED_CPU_CACHE
     target = os.environ.get('MXNET_TPU_PERSISTENT_CACHE_DIR') or None
     if target is None or target == _PERSISTENT_DIR:
         return _PERSISTENT_DIR
     import jax
+    if jax.default_backend() == 'cpu' and \
+            os.environ.get('MXNET_TPU_PERSISTENT_CACHE_FORCE',
+                           '0') in ('0', ''):
+        if not _WARNED_CPU_CACHE:
+            _WARNED_CPU_CACHE = True
+            import warnings
+            warnings.warn(
+                'MXNET_TPU_PERSISTENT_CACHE_DIR ignored on the CPU '
+                'backend: XLA:CPU deserialized executables can return '
+                'corrupted results (gather/scatter programs).  Set '
+                'MXNET_TPU_PERSISTENT_CACHE_FORCE=1 to override.')
+        return None
     jax.config.update('jax_compilation_cache_dir', target)
     # default thresholds skip small/fast programs; cache everything —
     # the point is cold-start elimination, not disk economy
@@ -226,6 +253,40 @@ def batch_ladder(max_batch, min_batch=1):
         b *= 2
     out.append(max_batch)
     return tuple(out)
+
+
+def train_ladder(bucket_keys):
+    """Normalized TRAINING bucket ladder: sorted unique rung keys (ints,
+    or equal-length tuples ordered lexicographically).  The training
+    analog of batch_ladder: BucketingModule pads each incoming batch up
+    to its covering rung (`ladder_rung`), so only the rung shapes ever
+    bind executors / compile programs — a mid-epoch novel length costs
+    pad waste instead of an XLA compile stall."""
+    keys = sorted(set(bucket_keys))
+    if not keys:
+        raise ValueError('train_ladder: empty bucket ladder')
+    return tuple(keys)
+
+
+def _rung_covers(rung, key):
+    r_seq = isinstance(rung, (tuple, list))
+    k_seq = isinstance(key, (tuple, list))
+    if r_seq != k_seq:
+        return False        # int ladder vs tuple key (or vice versa)
+    if r_seq:
+        return len(rung) == len(key) and \
+            all(int(r) >= int(k) for r, k in zip(rung, key))
+    return rung >= key
+
+
+def ladder_rung(ladder, key):
+    """Smallest rung of `ladder` (a train_ladder tuple) covering `key`
+    — every extent >= the key's, elementwise for tuple keys — or None
+    when no rung covers it (callers decide whether that is an error)."""
+    for rung in ladder:
+        if _rung_covers(rung, key):
+            return rung
+    return None
 
 
 def serve_step_key(sig, input_names=()):

@@ -773,6 +773,51 @@ class Executor:
         self.outputs = [nd.NDArray(o, self._ctx) for o in outs]
         return new_moms, new_masters, mcarry
 
+    def warm_fused_multistep(self, step, diff_names, scan_names,
+                             scan_stacks, moms, masters, lrs, wds,
+                             zero=False, rounds=2):
+        """AOT warmup: execute a make_fused_multistep program on CLONED
+        buffers so its XLA executable(s) compile now, without mutating
+        any bound parameter, aux state, optimizer state, or the PRNG
+        key (the bucket-ladder warmup — BucketingModule.warmup_buckets
+        — drives this for every rung before training starts).
+
+        Two rounds by default: round 1 calls with clones of the CURRENT
+        buffers — the exact signature of the module's first real step —
+        and round 2 feeds round 1's outputs back in, which is the
+        STEADY-STATE signature (donated jit outputs carry a different
+        committed/placement flavor than freshly-created arrays, and jax
+        keys executables on it).  Without round 2 the second real step
+        would still stall on a compile."""
+        import jax
+        diff_set = set(diff_names)
+        scan_set = set(scan_names)
+        inv_names = [n for n in self._arg_names
+                     if n not in diff_set and n not in scan_set]
+        diff_vals = tuple(self.arg_dict[n]._data for n in diff_names)
+        if scan_stacks is not None:
+            scan_vals = tuple(scan_stacks[n] for n in self._arg_names
+                              if n in scan_set and n not in diff_set)
+        else:
+            scan_vals = tuple(self.arg_dict[n]._data
+                              for n in self._arg_names
+                              if n in scan_set and n not in diff_set)
+        inv_vals = tuple(self.arg_dict[n]._data for n in inv_names)
+        aux_vals = tuple(self.aux_dict[n]._data for n in self._aux_names)
+        moms, masters = self._align_step_placement(diff_vals, moms,
+                                                   masters, zero=zero)
+
+        def clone(tree):
+            return jax.tree_util.tree_map(jnp.copy, tree)
+
+        dv, av = clone(diff_vals), clone(aux_vals)
+        mo, ma = clone(moms), clone(masters)
+        key = jnp.copy(self._key)
+        for _ in range(max(1, int(rounds))):
+            (_, av, dv, mo, ma, key, _mc) = step(
+                dv, scan_vals, inv_vals, av, key, mo, ma, lrs, wds)
+        jax.block_until_ready((dv, av))
+
     def run_fused_train_step(self, step, diff_names, moms, masters,
                              lrs, wds, zero=False):
         """Execute a step from make_fused_train_step over the bound

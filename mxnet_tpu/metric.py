@@ -201,9 +201,15 @@ class CompositeEvalMetric(EvalMetric):
 @alias('acc')
 class Accuracy(EvalMetric):
     def __init__(self, axis=1, name='accuracy', output_names=None,
-                 label_names=None):
-        super().__init__(name, output_names, label_names, axis=axis)
+                 label_names=None, ignore_label=None):
+        """ignore_label: positions whose label equals it are excluded
+        from both the hit count and the instance count — the masked
+        fold for bucket-ladder training, where batches padded up to
+        their rung carry mask_label at the padded positions."""
+        super().__init__(name, output_names, label_names, axis=axis,
+                         ignore_label=ignore_label)
         self.axis = axis
+        self.ignore_label = ignore_label
 
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
@@ -217,22 +223,33 @@ class Accuracy(EvalMetric):
             pred = pred.astype(np.int32).reshape(-1)
             lab = lab.astype(np.int32).reshape(-1)
             check_label_shapes(lab, pred)
-            self.sum_metric += (pred == lab).sum()
-            self.num_inst += len(pred)
+            if self.ignore_label is not None:
+                keep = lab != int(self.ignore_label)
+                self.sum_metric += ((pred == lab) & keep).sum()
+                self.num_inst += int(keep.sum())
+            else:
+                self.sum_metric += (pred == lab).sum()
+                self.num_inst += len(pred)
 
     _device_sum_dtype = 'int32'
 
     def _device_delta(self, labels, preds):
         import jax.numpy as jnp
-        ds, dc = jnp.zeros((), jnp.int32), 0
+        ds, dc = jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)
         for label, pred in zip(labels, preds):
             if pred.shape != label.shape:
                 pred = jnp.argmax(pred, axis=self.axis)
             pred = pred.astype(jnp.int32).reshape(-1)
             lab = label.astype(jnp.int32).reshape(-1)
-            ds = ds + (pred == lab).sum().astype(jnp.int32)
-            dc += pred.size
-        return ds, jnp.asarray(dc, jnp.int32)
+            if self.ignore_label is not None:
+                keep = lab != int(self.ignore_label)
+                ds = ds + ((pred == lab) & keep).sum() \
+                    .astype(jnp.int32)
+                dc = dc + keep.sum().astype(jnp.int32)
+            else:
+                ds = ds + (pred == lab).sum().astype(jnp.int32)
+                dc = dc + pred.size
+        return ds, dc
 
 
 @register
@@ -334,6 +351,32 @@ class Perplexity(EvalMetric):
             num += lab.shape[0]
         self.sum_metric += math.exp(loss / max(num, 1)) * max(num, 1)
         self.num_inst += max(num, 1)
+
+    def _device_delta(self, labels, preds):
+        # pure mirror of `update` (one exp of the step's mean loss,
+        # weighted by the step's non-ignored count) so the device fold
+        # matches the host loop's per-batch aggregation; ignore_label
+        # masking makes it the bucket-ladder metric (padded positions
+        # carry mask_label and contribute nothing).  Out-of-range
+        # ignore ids (e.g. -1) index the last column in BOTH numpy and
+        # jnp (negative wrap) before being masked out — identical.
+        import jax.numpy as jnp
+        loss = jnp.zeros((), jnp.float32)
+        num = jnp.zeros((), jnp.int32)
+        for label, pred in zip(labels, preds):
+            lab = label.reshape(-1).astype(jnp.int32)
+            probs = pred.reshape(-1, pred.shape[-1])
+            picked = probs[jnp.arange(lab.shape[0]), lab] \
+                .astype(jnp.float32)
+            if self.ignore_label is not None:
+                ignore = lab == int(self.ignore_label)
+                picked = jnp.where(ignore, 1.0, picked)
+                num = num - ignore.sum().astype(jnp.int32)
+            loss = loss - jnp.log(jnp.maximum(1e-10, picked)).sum()
+            num = num + lab.shape[0]
+        n = jnp.maximum(num, 1)
+        return (jnp.exp(loss / n.astype(jnp.float32)) *
+                n.astype(jnp.float32), n)
 
 
 class _RegressionMetric(EvalMetric):

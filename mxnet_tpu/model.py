@@ -205,7 +205,8 @@ class FeedForward(object):
             # unlabeled predict iters leave the label variable unbound;
             # it stays zero-filled (ignored by loss ops at inference)
             module.set_params(self.arg_params, self.aux_params or {},
-                              allow_missing=True)
+                              allow_missing=True,
+                              allow_extra=self.allow_extra_params)
         outs = self._module.predict(data, num_batch=num_batch)
         outs = outs if isinstance(outs, list) else [outs]
         arrs = [o.asnumpy() for o in outs]
@@ -219,7 +220,8 @@ class FeedForward(object):
                         label_shapes=data.provide_label,
                         for_training=False)
             module.set_params(self.arg_params, self.aux_params or {},
-                              allow_missing=True)
+                              allow_missing=True,
+                              allow_extra=self.allow_extra_params)
         res = self._module.score(data, eval_metric, num_batch=num_batch)
         return res[0][1]
 

@@ -182,6 +182,14 @@ class BaseModule:
                 'falling back to per-batch metric updates', int(bulk),
                 eval_metric.name)
             use_bulk = False
+        # AOT ladder warmup hook (BucketingModule): compile every
+        # rung's train program up front — through the process-wide
+        # exec_cache — so variable-length epochs hit ZERO mid-epoch
+        # XLA compile stalls.  Modules without the hook warm lazily.
+        warm = getattr(self, '_warmup_for_fit', None)
+        if warm is not None:
+            warm(bulk=int(bulk) if use_bulk else None,
+                 eval_metric=eval_metric if use_bulk else None)
         # stage upcoming batches device-resident so the H2D copy of
         # batch N+1 overlaps step N's compute (Module overrides; the
         # default is identity)
@@ -296,10 +304,10 @@ class BaseModule:
         raise NotImplementedError
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True):
+                   force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+                         force_init=force_init, allow_extra=allow_extra)
 
     def install_monitor(self, mon):
         raise NotImplementedError

@@ -6,19 +6,61 @@ shape-specialized compiled module (the reference's reason for bucketing
 — shape-specialized graphs — is exactly XLA's constraint, SURVEY.md §7
 hard parts), and buckets share parameter arrays via shared_module
 binding so there is one master copy of the weights.
+
+Fused bucket-ladder training (PERF round 12): every bucket's
+forward_backward+update runs through the underlying Module's fused
+single-dispatch (and bulk lax.scan) programs with ONE FusedSGD state
+shared across all rungs, and three knobs turn variable-length epochs
+into steady-state-zero-compile training:
+
+  * bucket_ladder= — batches whose bucket_key is not a rung pad UP to
+    the smallest covering rung (exec_cache.ladder_rung).  Padded label
+    positions carry mask_label, so a loss built with the standard
+    bucketing convention (SoftmaxOutput(use_ignore=True,
+    ignore_label=mask_label), the reference's own padding semantics)
+    gives masked positions exactly zero gradient and metrics with
+    ignore_label (Perplexity, Accuracy(ignore_label=...)) skip them:
+    the padded run matches the unpadded run to float rounding.  Pad
+    waste is measured (profiler train_pad_waste_rows) — the ladder
+    trades pad FLOPs for compile stalls.
+  * warmup_buckets= / MXNET_TPU_WARMUP_BUCKETS=1 — AOT-compile every
+    rung's fused train program at init_optimizer time (and the bulk
+    programs when fit(bulk=K) engages), all through the process-wide
+    exec_cache: mid-epoch compile stalls drop to zero, and a
+    re-created equivalent module warms entirely from cache.
+  * fit(bulk=K) — consecutive same-rung batches group into ONE K-step
+    lax.scan dispatch (bulk_step), stretching steps_per_dispatch over
+    variable-length data; BucketSentenceIter(bucket_major=True) orders
+    epochs to maximize the group length.
 """
 import logging
 
+import numpy as np
+
+from .. import exec_cache
+from .. import profiler
 from ..base import MXNetError
 from ..initializer import Uniform
-from .base_module import BaseModule
+from ..io import DataBatch, DataDesc
+from .base_module import BaseModule, BatchEndParam, _fire
 from .module import Module
 
 
 class BucketingModule(BaseModule):
     def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, bucket_ladder=None, mask_label=None,
+                 pad_value=0, warmup_buckets=None):
+        """bucket_ladder: optional rung keys (the default_bucket_key
+        always joins); batches with other keys pad up to the smallest
+        covering rung — requires mask_label.  mask_label: label value
+        padded positions carry (must be the loss's ignore_label / the
+        metric's ignore_label for exact masked semantics).  pad_value:
+        fill for padded DATA positions (masked-out by the loss, so the
+        value only needs to be in-domain — e.g. a valid token id).
+        warmup_buckets: True / list of keys → AOT-compile the rungs'
+        train programs at init_optimizer time (None defers to the
+        MXNET_TPU_WARMUP_BUCKETS env knob; see warmup_buckets())."""
         super().__init__(logger=logger)
         assert default_bucket_key is not None
         self._default_bucket_key = default_bucket_key
@@ -31,12 +73,27 @@ class BucketingModule(BaseModule):
         self._curr_module = None
         self._curr_bucket_key = None
         self._params_dirty = False
+        self._monitor = None
+        self._mask_label = mask_label
+        self._pad_value = pad_value
+        self._warmup_cfg = warmup_buckets
+        self._ladder = None
+        self._ladder_set = frozenset()
+        if bucket_ladder is not None:
+            self._ladder = exec_cache.train_ladder(
+                tuple(bucket_ladder) + (default_bucket_key,))
+            self._ladder_set = frozenset(self._ladder)
+        self._last_pad_labels = None
+        self._compile_t0 = None
+        self._warmed = set()        # (key, bulk) configs already warmed
+        self._in_warmup = False
 
     def _reset_bind(self):
         self.binded = False
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
+        self._warmed = set()
 
     @property
     def data_names(self):
@@ -92,7 +149,8 @@ class BucketingModule(BaseModule):
                                       arg_params=arg_params,
                                       aux_params=aux_params,
                                       allow_missing=allow_missing,
-                                      force_init=force_init)
+                                      force_init=force_init,
+                                      allow_extra=allow_extra)
         self._params_dirty = False
         self.params_initialized = True
 
@@ -100,7 +158,7 @@ class BucketingModule(BaseModule):
                    force_init=True, allow_extra=False):
         self.init_params(initializer=None, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+                         force_init=force_init, allow_extra=allow_extra)
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -149,27 +207,274 @@ class BucketingModule(BaseModule):
             if self.optimizer_initialized:
                 module.borrow_optimizer(
                     self._buckets[self._default_bucket_key])
+            if self._monitor is not None:
+                # buckets created AFTER install_monitor get the monitor
+                # too (the install loop alone missed them)
+                module.install_monitor(self._monitor)
             self._buckets[bucket_key] = module
+        if bucket_key != self._curr_bucket_key and not self._in_warmup:
+            # warmup's rung sweep is not a training-time switch; only
+            # real batch routing counts toward train_bucket_switches
+            profiler.add_bucket_stats(switches=1)
         self._curr_bucket_key = bucket_key
         self._curr_module = self._buckets[bucket_key]
 
+    # -- bucket ladder: rung mapping + pad-to-rung ------------------------
+    def _rung_for(self, bucket_key):
+        """The ladder rung `bucket_key` executes on — the key itself
+        when no ladder is configured or the key is a rung."""
+        if self._ladder is None or bucket_key in self._ladder_set:
+            return bucket_key
+        rung = exec_cache.ladder_rung(self._ladder, bucket_key)
+        if rung is None:
+            raise MXNetError(
+                'bucket key %r exceeds every ladder rung %s'
+                % (bucket_key, list(self._ladder)))
+        if self._mask_label is None:
+            raise MXNetError(
+                'bucket key %r is not a ladder rung and no mask_label '
+                'is configured: cannot pad with exact loss semantics '
+                '(pass mask_label= and build the loss with '
+                'use_ignore/ignore_label on it)' % (bucket_key,))
+        return rung
+
+    @staticmethod
+    def _desc_parts(d):
+        if isinstance(d, DataDesc):
+            return d.name, tuple(d.shape), d.layout, d.dtype
+        return d[0], tuple(d[1]), None, None
+
+    @staticmethod
+    def _pad_target(shape, layout, key, rung):
+        """`shape` with the bucket-dependent extent(s) substituted
+        key→rung: the axis the DataDesc layout marks 'T', else the
+        unique axis whose extent equals the key component (no
+        matching axis → shape unchanged, e.g. a per-sequence label)."""
+        olds = tuple(key) if isinstance(key, (tuple, list)) else (key,)
+        news = tuple(rung) if isinstance(rung, (tuple, list)) else (rung,)
+        shape = list(shape)
+        for old, new in zip(olds, news):
+            if old == new:
+                continue
+            axes = [i for i, d in enumerate(shape) if d == old]
+            if not axes:
+                continue
+            if len(axes) > 1 and layout:
+                t = layout.find('T')
+                if 0 <= t < len(shape) and shape[t] == old:
+                    axes = [t]
+            if len(axes) > 1:
+                raise MXNetError(
+                    'ambiguous bucket axis: extent %r appears %d times '
+                    "in shape %s and no 'T' layout disambiguates — pass "
+                    'DataDesc layouts' % (old, len(axes), tuple(shape)))
+            shape[axes[0]] = new
+        return tuple(shape)
+
+    def _pad_arrays(self, arrays, descs, key, rung, fill):
+        """Pad each array up to its rung-substituted shape.  Returns
+        (arrays, descs, padded_elems, total_elems)."""
+        import jax.numpy as jnp
+        from .. import ndarray as nd
+        out_arr, out_desc, padded, total = [], [], 0, 0
+        for a, d in zip(arrays, descs or [None] * len(arrays)):
+            if d is not None:
+                name, shape, layout, dtype = self._desc_parts(d)
+            else:
+                name, shape, layout, dtype = None, tuple(a.shape), None, None
+            target = self._pad_target(shape, layout, key, rung)
+            total += int(np.prod(shape))
+            if target == tuple(shape):
+                out_arr.append(a)
+                out_desc.append(d)
+                continue
+            data = a._data if isinstance(a, nd.NDArray) else \
+                jnp.asarray(a)
+            pads = []
+            for s, t in zip(data.shape, target):
+                if t < s:
+                    raise MXNetError(
+                        'ladder rung %r is narrower than the batch '
+                        '(%s vs %s)' % (rung, data.shape, target))
+                pads.append((0, t - s))
+            out_arr.append(nd.NDArray(
+                jnp.pad(data, pads,
+                        constant_values=np.asarray(fill).item())))
+            padded += int(np.prod(target) - np.prod(shape))
+            if isinstance(d, DataDesc):
+                out_desc.append(DataDesc(name, target, dtype, layout))
+            elif d is not None:
+                out_desc.append(DataDesc(name, target))
+            else:
+                out_desc.append(None)
+        return out_arr, out_desc, padded, total
+
+    def _map_batch(self, data_batch):
+        """Route a batch onto its ladder rung: identity when the key is
+        a rung, else pad data (pad_value) and labels (mask_label) up to
+        the rung shape.  Feeds the profiler pad-waste counters and
+        remembers the padded labels for update_metric (the caller's
+        unpadded labels no longer match the padded outputs)."""
+        key = data_batch.bucket_key
+        rung = self._rung_for(key)
+        if rung == key:
+            self._last_pad_labels = None
+            labels = data_batch.label or []
+            rows = sum(int(np.prod(l.shape)) for l in labels)
+            profiler.add_bucket_stats(rows=rows)
+            return data_batch
+        data, ddesc, dpad, _ = self._pad_arrays(
+            data_batch.data, data_batch.provide_data, key, rung,
+            self._pad_value)
+        label, ldesc = None, None
+        lpad = ltot = 0
+        if data_batch.label:
+            label, ldesc, lpad, ltot = self._pad_arrays(
+                data_batch.label, data_batch.provide_label, key, rung,
+                self._mask_label)
+        # "rows" = label positions (the entries a masked loss/metric
+        # sees); data-only batches fall back to data elements
+        profiler.add_bucket_stats(
+            pad_rows=(lpad if data_batch.label else dpad),
+            rows=(ltot if data_batch.label else 0))
+        mapped = DataBatch(data=data, label=label, pad=data_batch.pad,
+                           index=data_batch.index, bucket_key=rung,
+                           provide_data=ddesc, provide_label=ldesc)
+        self._last_pad_labels = label
+        return mapped
+
+    def _shapes_for(self, key):
+        """Bind shapes for bucket `key`, derived from the default
+        bucket's bound shapes by key substitution (warmup has no batch
+        to read shapes from)."""
+        base = self._buckets[self._default_bucket_key]
+
+        def sub(descs):
+            out = []
+            for d in descs or []:
+                name, shape, layout, dtype = self._desc_parts(d)
+                tgt = self._pad_target(shape, layout,
+                                       self._default_bucket_key, key)
+                out.append(DataDesc(name, tgt, dtype, layout)
+                           if isinstance(d, DataDesc)
+                           else DataDesc(name, tgt))
+            return out or None
+        return sub(base.data_shapes), sub(base.label_shapes)
+
+    # -- AOT ladder warmup -------------------------------------------------
+    def _warmup_enabled(self):
+        if self._warmup_cfg is None:
+            import os
+            return os.environ.get('MXNET_TPU_WARMUP_BUCKETS',
+                                  '0') not in ('0', '')
+        return bool(self._warmup_cfg)
+
+    def _warmup_keys(self):
+        if isinstance(self._warmup_cfg, (list, tuple)):
+            return list(self._warmup_cfg)
+        if self._ladder is not None:
+            return list(self._ladder)
+        return list(self._buckets)
+
+    def warmup_buckets(self, keys=None, bulk=None, eval_metric=None):
+        """AOT-compile every rung's fused train program up front
+        (Module.warmup_fused per rung: the single-step program, plus
+        the K-step bulk program when bulk=K is given) so the training
+        loop performs ZERO XLA compiles in steady state.  Programs key
+        into the process-wide exec_cache, so a re-created equivalent
+        module warms entirely from cache.  No parameter / optimizer /
+        schedule state changes.  keys defaults to the configured
+        ladder (or the warmup_buckets= list).  Returns the keys whose
+        programs were warmed (non-fusable setups warm nothing)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        keys = list(keys) if keys is not None else self._warmup_keys()
+        prev_key = self._curr_bucket_key
+        warmed = []
+        bulk_tag = None
+        if bulk and int(bulk) > 1:
+            # the bulk program's identity includes the metric fold
+            # baked into its scan — a different metric is a different
+            # program, so it must not be skipped as already-warmed
+            from .. import metric as metric_mod
+            fold = metric_mod.device_fold(eval_metric) \
+                if eval_metric is not None else None
+            bulk_tag = (int(bulk), fold.key if fold is not None else None)
+        self._in_warmup = True
+        try:
+            for key in keys:
+                # skip configs this module already warmed (fit() warms
+                # once at init_optimizer and again — with the bulk
+                # programs — via the _warmup_for_fit hook; only the
+                # not-yet-warmed part runs each time)
+                need_single = (key, None) not in self._warmed
+                need_bulk = bulk_tag is not None and \
+                    (key, bulk_tag) not in self._warmed
+                if not need_single and not need_bulk:
+                    warmed.append(key)
+                    continue
+                data_shapes, label_shapes = self._shapes_for(key)
+                t0 = exec_cache.stats()['total_compile_s']
+                self.switch_bucket(key, data_shapes, label_shapes)
+                ok = self._curr_module.warmup_fused(
+                    bulk=bulk if need_bulk else None,
+                    eval_metric=eval_metric, single=need_single)
+                dc = exec_cache.stats()['total_compile_s'] - t0
+                profiler.note_bucket_warmup(key, compiled=dc > 0.0)
+                if ok:
+                    warmed.append(key)
+                    self._warmed.add((key, None))
+                    if need_bulk:
+                        self._warmed.add((key, bulk_tag))
+        finally:
+            self._in_warmup = False
+        if prev_key is not None and prev_key != self._curr_bucket_key:
+            self._curr_bucket_key = prev_key
+            self._curr_module = self._buckets[prev_key]
+        return warmed
+
+    def _warmup_for_fit(self, bulk=None, eval_metric=None):
+        """fit() hook (base_module.py): warm the ladder — including the
+        bulk programs when fit(bulk=K) engages — when warmup is
+        configured on (warmup_buckets= / MXNET_TPU_WARMUP_BUCKETS)."""
+        if self._warmup_enabled():
+            self.warmup_buckets(bulk=bulk, eval_metric=eval_metric)
+
     def init_optimizer(self, kvstore='local', optimizer='sgd',
                        optimizer_params=(('learning_rate', 0.01),),
-                       force_init=False):
+                       force_init=False, zero=None):
+        """zero: ZeRO stage forwarded to the inner Module (the ONE
+        shared FusedSGD then runs the dp-sharded update on every
+        rung; see module.py init_optimizer)."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
             self.logger.warning('optimizer already initialized, ignoring.')
             return
         self._curr_module.init_optimizer(kvstore, optimizer,
                                          optimizer_params,
-                                         force_init=force_init)
+                                         force_init=force_init,
+                                         zero=zero)
         for mod in self._buckets.values():
             if mod is not self._curr_module:
                 mod.borrow_optimizer(self._curr_module)
         self.optimizer_initialized = True
+        if self._warmup_enabled():
+            self.warmup_buckets()
+
+    # -- per-batch ---------------------------------------------------------
+    def _note_rung_dispatch(self, steps):
+        """Per-rung compile/hit accounting around one train dispatch:
+        exec_cache.total_compile_s moved during the step → this rung
+        paid a compile stall (the counter warmup drives to zero)."""
+        t0, self._compile_t0 = self._compile_t0, None
+        dc = (exec_cache.stats()['total_compile_s'] - t0) \
+            if t0 is not None else 0.0
+        profiler.note_bucket_dispatch(self._curr_bucket_key, steps=steps,
+                                      compiled=dc > 0.0)
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
+        data_batch = self._map_batch(data_batch)
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
         self._curr_module.forward(data_batch, is_train=is_train)
@@ -180,8 +485,10 @@ class BucketingModule(BaseModule):
 
     def forward_backward(self, data_batch):
         assert self.binded and self.params_initialized
+        data_batch = self._map_batch(data_batch)
         self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
                            data_batch.provide_label)
+        self._compile_t0 = exec_cache.stats()['total_compile_s']
         self._curr_module.forward_backward(data_batch)
 
     def update(self):
@@ -189,8 +496,98 @@ class BucketingModule(BaseModule):
             self.optimizer_initialized
         self._params_dirty = True
         self._curr_module.update()
+        self._note_rung_dispatch(steps=1)
+
+    def bulk_step(self, batches=None, batch=None, repeat=None,
+                  scan_dtype=None, eval_metric=None):
+        """K same-rung training steps as ONE lax.scan dispatch
+        (Module.bulk_step through the rung's fused program) — the
+        bucket-ladder analog of fit(bulk=K) for fixed shapes.  All
+        batches must map to one rung (fit's epoch loop groups
+        consecutive same-rung batches; see _fit_epoch_bulk)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._compile_t0 = exec_cache.stats()['total_compile_s']
+        if batches is None:
+            assert batch is not None and repeat is not None
+            b = self._map_batch(batch)
+            self.switch_bucket(b.bucket_key, b.provide_data,
+                               b.provide_label)
+            self._params_dirty = True
+            self._curr_module.bulk_step(batch=b, repeat=repeat,
+                                        scan_dtype=scan_dtype,
+                                        eval_metric=eval_metric)
+            self._note_rung_dispatch(steps=repeat)
+            return
+        mapped = [self._map_batch(b) for b in batches]
+        rungs = {b.bucket_key for b in mapped}
+        if len(rungs) != 1:
+            raise MXNetError(
+                'bulk_step: batches span ladder rungs %s — group '
+                'same-rung batches per dispatch' % sorted(rungs))
+        self.switch_bucket(mapped[0].bucket_key, mapped[0].provide_data,
+                           mapped[0].provide_label)
+        self._params_dirty = True
+        self._curr_module.bulk_step(batches=mapped, scan_dtype=scan_dtype,
+                                    eval_metric=eval_metric)
+        self._note_rung_dispatch(steps=len(mapped))
+
+    def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
+                        batch_end_callback, epoch):
+        """Bucket-aware K-step grouping for fit(bulk=K): consecutive
+        batches mapping to the SAME ladder rung group into one
+        bulk_step dispatch; a rung change flushes the group.
+        BucketSentenceIter(bucket_major=True) orders epochs
+        bucket-by-bucket so groups reach the full K even on mixed
+        data."""
+        state = {'nbatch': 0}
+        group = []
+        group_rung = [None]
+
+        def flush():
+            if not group:
+                return
+            if len(group) >= bulk:
+                self.bulk_step(batches=list(group),
+                               eval_metric=eval_metric)
+            else:
+                # partial trailing group (rung change / epoch end):
+                # run per-step through the warmed single-step program
+                # — only the K=bulk scan program is AOT-warmed, and a
+                # fresh XLA compile for this group's K would cost far
+                # more than the few per-step dispatches it saves
+                for b in group:
+                    self.forward_backward(b)
+                    self.update()
+                    self.update_metric(eval_metric, b.label)
+            state['nbatch'] += len(group)
+            del group[:]
+            if batch_end_callback is not None:
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch,
+                                    nbatch=state['nbatch'] - 1,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
+
+        for data_batch in train_data:
+            rung = self._rung_for(data_batch.bucket_key)
+            if group and rung != group_rung[0]:
+                flush()
+            group_rung[0] = rung
+            group.append(data_batch)
+            if len(group) >= bulk:
+                flush()
+        flush()
 
     def get_outputs(self, merge_multi_context=True):
+        """Outputs of the LAST forward.  Ladder caveat: a batch that
+        was padded up to its rung returns RUNG-shaped outputs — the
+        padded positions are interleaved per the graph's own reshape
+        and are NOT sliced back out (which positions are pad is
+        graph-specific).  score()/fit() are exact (ignore-aware
+        metrics skip the mask_label positions); callers consuming raw
+        predictions (predict / iter_predict) should run exact buckets
+        (no ladder) or mask by label positions themselves."""
         assert self.binded and self.params_initialized
         return self._curr_module.get_outputs(merge_multi_context)
 
@@ -201,9 +598,16 @@ class BucketingModule(BaseModule):
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
+        if self._last_pad_labels is not None:
+            # outputs carry the rung shape; the caller's unpadded
+            # labels no longer match — use the padded ones (masked
+            # positions hold mask_label, which ignore-aware metrics
+            # skip)
+            labels = self._last_pad_labels
         self._curr_module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
+        self._monitor = mon     # buckets created later get it too
         for mod in self._buckets.values():
             mod.install_monitor(mon)

@@ -183,10 +183,31 @@ class Module(BaseModule):
         for name, arr in sorted(self._aux_params.items()):
             desc = init_mod.InitDesc(name, attrs.get(name, None))
             _impl(desc, arr, aux_params)
+        if not allow_extra:
+            self._check_extra_params(arg_params, aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params)
+
+    def _check_extra_params(self, arg_params, aux_params):
+        """allow_extra=False contract (reference module.py init_params):
+        provided dictionaries must not carry parameters this module's
+        symbol does not know — a typo'd or mismatched checkpoint key
+        must fail loudly, not be silently dropped."""
+        extra = []
+        if arg_params:
+            extra += [n for n in arg_params if n not in self._param_names
+                      and n not in self._data_names
+                      and n not in self._label_names
+                      and n not in self._state_names]
+        if aux_params:
+            extra += [n for n in aux_params if n not in self._aux_names]
+        if extra:
+            raise MXNetError(
+                'set_params/init_params got parameters not in the '
+                'symbol (pass allow_extra=True to ignore them): %s'
+                % sorted(extra))
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True, allow_extra=False):
@@ -194,10 +215,13 @@ class Module(BaseModule):
             self.init_params(initializer=None, arg_params=arg_params,
                              aux_params=aux_params,
                              allow_missing=allow_missing,
-                             force_init=force_init)
+                             force_init=force_init,
+                             allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
             return
+        if not allow_extra:
+            self._check_extra_params(arg_params, aux_params)
         self._exec_group.set_params(arg_params, aux_params)
         self._params_dirty = True
         self.params_initialized = True
@@ -419,28 +443,23 @@ class Module(BaseModule):
             self._reduce_plan_inputs = (shapes, dtypes)
         return self._reduce_plan
 
-    def _run_fused_step(self):
-        import time
-        ex = self._exec_group.executor
-        fu = self._fused_updater
-        fnames = ex._diff_names
-        if fu.param_names != fnames:
-            fu.param_names = list(fnames)
-        weights = [ex.arg_dict[n] for n in fnames]
-        moms, masters, lrs, wds = fu.host_prep(weights)
+    def _ensure_fused_program(self, ex, fu, fnames):
+        """Build (or fetch) the single-step fused program for this
+        executor/updater pair.  Must run AFTER fu.host_prep (under
+        ZeRO, fu.cache_key() carries the bucket layout host_prep may
+        have just rebuilt).
+
+        Keyed on executor AND updater AND the updater's cache_key:
+        init_optimizer(force_init=True) makes a new FusedSGD whose
+        step_math bakes new hyperparams — a stale program would run
+        old-layout buckets against new state shapes.  The reduce plan
+        (bucketing + schedule) is baked into the traced step, so it
+        joins too — WITH the mesh fingerprint: the grad_reduce closure
+        binds a concrete mesh, so unlike the mesh-free step body it
+        cannot be retraced for a different device set.  (step_key
+        routes the compiled step through the process-wide executable
+        cache, so a mismatch here rarely means a recompile.)"""
         plan = self._ensure_reduce_plan(ex, fu, fnames)
-        # keyed on executor AND updater AND the updater's cache_key:
-        # init_optimizer(force_init=True) makes a new FusedSGD whose
-        # step_math bakes new hyperparams, and under ZeRO host_prep may
-        # have just rebuilt the bucket layout (cache_key carries it) —
-        # a stale program would run old-layout buckets against new
-        # state shapes.  The reduce plan (bucketing + schedule) is
-        # baked into the traced step, so it joins too — WITH the mesh
-        # fingerprint: the grad_reduce closure binds a concrete mesh,
-        # so unlike the mesh-free step body it cannot be retraced for
-        # a different device set.  (step_key routes the compiled step
-        # through the process-wide executable cache, so a mismatch
-        # here rarely means a recompile.)
         fkey = (fu.cache_key(),
                 (plan.key, self._mesh_fp()) if plan is not None
                 else None)
@@ -451,6 +470,18 @@ class Module(BaseModule):
             self._fused_step = ex.make_fused_train_step(
                 fu.step_math, step_key=fkey, grad_reduce=gr)
             self._fused_step_key = (ex, fu, fkey)
+        return self._fused_step
+
+    def _run_fused_step(self):
+        import time
+        ex = self._exec_group.executor
+        fu = self._fused_updater
+        fnames = ex._diff_names
+        if fu.param_names != fnames:
+            fu.param_names = list(fnames)
+        weights = [ex.arg_dict[n] for n in fnames]
+        moms, masters, lrs, wds = fu.host_prep(weights)
+        self._ensure_fused_program(ex, fu, fnames)
         from .. import profiler
         t0 = time.perf_counter()
         synced = profiler.is_running()   # executor blocks only then
@@ -489,6 +520,129 @@ class Module(BaseModule):
         profiler.note_reduce_dispatch(buckets, interleave, k,
                                       dt_ms=dt_ms,
                                       metric_steps=metric_steps)
+
+    def _ensure_bulk_program(self, ex, fu, fnames, scan_names, k,
+                             stacked, scan_dtype, fold):
+        """Build (or fetch) the K-step bulk program.  Must run AFTER
+        fu.host_prep/host_prep_steps: under ZeRO, fu.cache_key()
+        carries the bucket layout host_prep may have just rebuilt; the
+        reduce plan (+ the mesh its closure binds) and metric fold
+        bake into the traced scan, so they join too (carry
+        signature)."""
+        eg = self._exec_group
+        plan = self._ensure_reduce_plan(ex, fu, fnames)
+        fkey = (fu.cache_key(),
+                (plan.key, self._mesh_fp()) if plan is not None
+                else None,
+                fold.key if fold is not None else None, 'lrstack')
+        cache_key = ((ex, fu, 'stacked', k, str(scan_dtype))
+                     if stacked else (ex, fu, 'repeat', k)) + (fkey,)
+        if getattr(self, '_bulk_cache_key', None) != cache_key:
+            mesh = eg.mesh
+            gr = (lambda grads: plan.apply(grads, mesh)) \
+                if plan is not None else None
+            metric_arg = None
+            if fold is not None:
+                scan_order = [n for n in ex._arg_names
+                              if n in set(scan_names) and
+                              n not in set(fnames)]
+                label_pos = {n: i for i, n in enumerate(scan_order)
+                             if n in eg.label_names}
+                out_names = self._symbol.list_outputs()
+
+                def m_update(mc, outs, sv, _lp=label_pos,
+                             _on=out_names, _fold=fold):
+                    label = {n: sv[i] for n, i in _lp.items()}
+                    pred = dict(zip(_on, outs))
+                    return _fold.update(mc, label, pred)
+
+                metric_arg = (fold.init, m_update)
+            self._bulk_step_fn = ex.make_fused_multistep(
+                fu.step_math, scan_names,
+                repeat=(None if stacked else k),
+                step_key=fkey, grad_reduce=gr, metric=metric_arg,
+                lr_stacked=True)
+            self._bulk_cache_key = cache_key
+        return self._bulk_step_fn
+
+    def warmup_fused(self, bulk=None, eval_metric=None, scan_dtype=None,
+                     single=True):
+        """AOT-warm this module's fused train program(s): compile the
+        single-step whole-train-step program — and, for bulk=K > 1, the
+        K-step stacked lax.scan program (with eval_metric's device fold
+        baked in when it has one) — by executing them on CLONED buffers
+        through executor.warm_fused_multistep.  No parameter, aux,
+        optimizer-state, or lr-schedule state changes.  The compiled
+        programs land in the process-wide exec_cache under the graph
+        signature + updater key, so an equivalent re-created module
+        re-warms entirely from cache (zero new XLA compiles).
+
+        Returns True when the step can fuse (False → nothing warmed:
+        ctx-group executors, monitors, or a non-fusable optimizer run
+        the legacy multi-dispatch path, which compiles lazily).
+        single=False skips the single-step warm (caller knows it is
+        already warm and only wants the bulk program)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        if not self._fusable_step():
+            return False
+        import jax.numpy as jnp
+        eg = self._exec_group
+        ex = eg.executor
+        fu = self._fused_updater
+        fnames = ex._diff_names
+        if fu.param_names != fnames:
+            fu.param_names = list(fnames)
+        weights = [ex.arg_dict[n] for n in fnames]
+        if single:
+            moms, masters, lrs, wds = fu.host_prep(weights,
+                                                   advance=False)
+            step = self._ensure_fused_program(ex, fu, fnames)
+            ex.warm_fused_multistep(step, fnames, (), None, moms,
+                                    masters, lrs, wds,
+                                    zero=bool(fu.zero))
+        if bulk is None or int(bulk) <= 1:
+            return True
+        k = int(bulk)
+        fold = metric_mod.device_fold(eval_metric) \
+            if eval_metric is not None else None
+        scan_names = [n for n in eg.data_names + eg.label_names
+                      if n in ex.arg_dict and n not in set(fnames)]
+        data_set = set(eg.data_names)
+        scan_stacks = {}
+        for n in scan_names:
+            bound = ex.arg_dict[n]._data
+            store = scan_dtype if (scan_dtype is not None and
+                                   n in data_set) else bound.dtype
+            scan_stacks[n] = jnp.zeros((k,) + tuple(bound.shape), store)
+        import jax
+        if eg.mesh is not None:
+            from ..parallel import mesh as pmesh
+            scan_stacks = {n: pmesh.shard_batch(eg.mesh, v, dim=1)
+                           for n, v in scan_stacks.items()}
+        else:
+            # real batches arrive committed (nd.array device_puts);
+            # the warm stacks must carry the same placement flavor or
+            # the first real bulk dispatch compiles a third signature
+            dev = self._context[0].jax_device()
+            scan_stacks = {n: jax.device_put(v, dev)
+                           for n, v in scan_stacks.items()}
+        moms, masters, lr_stack, wd_stack = fu.host_prep_steps(
+            weights, k, advance=False)
+        lrs, wds = jnp.asarray(lr_stack), jnp.asarray(wd_stack)
+        if eg.mesh is not None:
+            import jax
+            from ..parallel import mesh as pmesh
+            repl = pmesh.replicated(eg.mesh)
+            lrs = jax.device_put(lrs, repl)
+            wds = jax.device_put(wds, repl)
+        fn = self._ensure_bulk_program(ex, fu, fnames, scan_names, k,
+                                       stacked=True,
+                                       scan_dtype=scan_dtype, fold=fold)
+        ex.warm_fused_multistep(fn, fnames, scan_names, scan_stacks,
+                                moms, masters, lrs, wds,
+                                zero=bool(fu.zero))
+        return True
 
     def bulk_step(self, batches=None, batch=None, repeat=None,
                   scan_dtype=None, eval_metric=None):
@@ -595,10 +749,8 @@ class Module(BaseModule):
                 scan_stacks = {
                     n: pmesh.shard_batch(eg.mesh, v, dim=1)
                     for n, v in scan_stacks.items()}
-            cache_key = (ex, fu, 'stacked', k, str(scan_dtype))
         else:
             eg.load_data_batch(batch)
-            cache_key = (ex, fu, 'repeat', k)
         weights = [ex.arg_dict[n] for n in fnames]
         # per-step schedule stacks: counts bump and lr/wd evaluate at
         # every step index (host scheduler semantics).  ONE (K, n)
@@ -613,43 +765,9 @@ class Module(BaseModule):
             repl = pmesh.replicated(eg.mesh)
             lrs = jax.device_put(lrs, repl)
             wds = jax.device_put(wds, repl)
-        plan = self._ensure_reduce_plan(ex, fu, fnames)
-        # fu.cache_key() joins AFTER host_prep: under ZeRO it carries
-        # the bucket layout host_prep may have just rebuilt; the
-        # reduce plan (+ the mesh its closure binds) and metric fold
-        # bake into the traced scan, so they join too (carry
-        # signature)
-        fkey = (fu.cache_key(),
-                (plan.key, self._mesh_fp()) if plan is not None
-                else None,
-                fold.key if fold is not None else None, 'lrstack')
-        cache_key = cache_key + (fkey,)
-        if getattr(self, '_bulk_cache_key', None) != cache_key:
-            mesh = eg.mesh
-            gr = (lambda grads: plan.apply(grads, mesh)) \
-                if plan is not None else None
-            metric_arg = None
-            if fold is not None:
-                scan_order = [n for n in ex._arg_names
-                              if n in set(scan_names) and
-                              n not in set(fnames)]
-                label_pos = {n: i for i, n in enumerate(scan_order)
-                             if n in eg.label_names}
-                out_names = self._symbol.list_outputs()
-
-                def m_update(mc, outs, sv, _lp=label_pos,
-                             _on=out_names, _fold=fold):
-                    label = {n: sv[i] for n, i in _lp.items()}
-                    pred = dict(zip(_on, outs))
-                    return _fold.update(mc, label, pred)
-
-                metric_arg = (fold.init, m_update)
-            self._bulk_step_fn = ex.make_fused_multistep(
-                fu.step_math, scan_names,
-                repeat=(k if batches is None else None),
-                step_key=fkey, grad_reduce=gr, metric=metric_arg,
-                lr_stacked=True)
-            self._bulk_cache_key = cache_key
+        self._ensure_bulk_program(ex, fu, fnames, scan_names, k,
+                                  stacked=(batches is not None),
+                                  scan_dtype=scan_dtype, fold=fold)
         from .. import profiler
         t0 = time.perf_counter()
         synced = profiler.is_running()   # executor blocks only then

@@ -705,15 +705,24 @@ class FusedSGD:
                      else None, self._mesh_fp, self._interleave),)
         return key
 
-    def host_prep(self, weights):
+    def host_prep(self, weights, advance=True):
         """Per-step host-side bookkeeping shared by the standalone
         update and the whole-step fusion (executor.make_fused_train_step):
         lazily create momenta / fp32 masters, bump update counts, and
         evaluate lr/wd schedules.  Returns (moms, masters, lrs, wds)
-        aligned with param_names."""
+        aligned with param_names.
+
+        advance=False (AOT warmup, Module.warmup_fused): states still
+        materialize lazily — the warmup call must see exactly the
+        buffers a real step would — but the update counts / schedule
+        state are restored afterwards, so warming a ladder of bucket
+        programs does not advance the lr schedule."""
         import jax
         import jax.numpy as jnp
         opt = self.optimizer
+        saved_counts = None
+        if not advance:
+            saved_counts = self._snapshot_schedule_state()
         if self.zero:
             moms, masters = self._host_prep_zero(weights)
         else:
@@ -743,9 +752,31 @@ class FusedSGD:
             opt._update_count(name)
             lrs.append(opt._get_lr(name))
             wds.append(opt._get_wd(name))
+        if saved_counts is not None:
+            self._restore_schedule_state(saved_counts)
         return moms, masters, lrs, wds
 
-    def host_prep_steps(self, weights, k):
+    def _snapshot_schedule_state(self):
+        """Everything _get_lr mutates: the update counts AND the
+        stateful lr_scheduler's own attributes (FactorScheduler decays
+        base_lr / bumps count inside __call__ — restoring only the
+        counts would leave the schedule permanently advanced after an
+        advance=False warmup)."""
+        opt = self.optimizer
+        sched = getattr(opt, 'lr_scheduler', None)
+        return (dict(opt._index_update_count), opt.num_update,
+                dict(sched.__dict__) if sched is not None else None)
+
+    def _restore_schedule_state(self, saved):
+        opt = self.optimizer
+        counts, num_update, sched_state = saved
+        opt._index_update_count = counts
+        opt.num_update = num_update
+        if sched_state is not None:
+            opt.lr_scheduler.__dict__.clear()
+            opt.lr_scheduler.__dict__.update(sched_state)
+
+    def host_prep_steps(self, weights, k, advance=True):
         """host_prep for a K-step bulk dispatch: states init once, the
         update counts bump K times, and the lr/wd schedules evaluate at
         EVERY step index (the host scheduler runs exactly as the
@@ -753,18 +784,24 @@ class FusedSGD:
         mid-dispatch decays at the right step — schedules no longer
         advance in bulk-size units).  Returns (moms, masters, lrs,
         wds) with lrs/wds float32 arrays of shape (k, n_params), fed
-        to the scan as per-step inputs."""
+        to the scan as per-step inputs.  advance=False: see host_prep
+        (AOT warmup — schedule state restored afterwards)."""
+        opt = self.optimizer
+        saved_counts = None
+        if not advance:
+            saved_counts = self._snapshot_schedule_state()
         moms, masters, lrs0, wds0 = self.host_prep(weights)
         n = len(self.param_names)
         lrs = np.empty((max(1, k), n), np.float32)
         wds = np.empty((max(1, k), n), np.float32)
         lrs[0], wds[0] = lrs0, wds0
-        opt = self.optimizer
         for s in range(1, k):
             for j, name in enumerate(self.param_names):
                 opt._update_count(name)
                 lrs[s, j] = opt._get_lr(name)
                 wds[s, j] = opt._get_wd(name)
+        if saved_counts is not None:
+            self._restore_schedule_state(saved_counts)
         return moms, masters, lrs, wds
 
     def _is_mp(self, w):

@@ -159,6 +159,76 @@ def gluon_fused_stats():
     return out
 
 
+# bucketed-training counters (BucketingModule's fused bucket ladder,
+# PERF round 12) — mirroring the serve_* family: bucket switches, pad
+# waste from running short batches at their ladder rung, and per-rung
+# step/compile/warmup accounting (the zero-compile-steady-state story
+# is "every rung's compiles happened at warmup, none during steps")
+_BUCKET = {
+    'train_bucket_switches': 0,
+    'train_pad_waste_rows': 0,
+    'train_rows': 0,
+}
+_BUCKET_RUNGS = {}      # str(rung) -> {'steps','dispatches','compiles',
+#                                       'warmups','warm_compiles'}
+
+
+def _rung_entry(rung):
+    e = _BUCKET_RUNGS.get(str(rung))
+    if e is None:
+        e = {'steps': 0, 'dispatches': 0, 'compiles': 0,
+             'warmups': 0, 'warm_compiles': 0}
+        _BUCKET_RUNGS[str(rung)] = e
+    return e
+
+
+def add_bucket_stats(switches=0, pad_rows=0, rows=0):
+    """Accumulate bucket-ladder counters (BucketingModule feeds
+    switches from switch_bucket and pad/total label rows from the
+    pad-to-rung path)."""
+    with _STATE['lock']:
+        _BUCKET['train_bucket_switches'] += int(switches)
+        _BUCKET['train_pad_waste_rows'] += int(pad_rows)
+        _BUCKET['train_rows'] += int(rows)
+
+
+def note_bucket_dispatch(rung, steps=1, compiled=False):
+    """One train dispatch of `steps` steps on `rung`; compiled=True
+    when exec_cache compile time moved during it (a mid-epoch compile
+    stall — zero of these after warmup is the ladder's contract)."""
+    with _STATE['lock']:
+        e = _rung_entry(rung)
+        e['steps'] += int(steps)
+        e['dispatches'] += 1
+        if compiled:
+            e['compiles'] += 1
+
+
+def note_bucket_warmup(rung, compiled=False):
+    """One warmup_buckets visit of `rung`; compiled=False means the
+    rung's programs came entirely from the process-wide exec_cache
+    (the re-created-module re-warm path)."""
+    with _STATE['lock']:
+        e = _rung_entry(rung)
+        e['warmups'] += 1
+        if compiled:
+            e['warm_compiles'] += 1
+
+
+def bucketing_stats():
+    """Snapshot of the bucket-ladder counters plus the derived
+    train_pad_waste_frac (padded / total label rows) and the per-rung
+    table."""
+    with _STATE['lock']:
+        out = dict(_BUCKET)
+        out['train_rungs'] = {k: dict(v)
+                              for k, v in _BUCKET_RUNGS.items()}
+    total = out['train_rows'] + out['train_pad_waste_rows']
+    out['train_pad_waste_frac'] = \
+        out['train_pad_waste_rows'] / total if total else 0.0
+    return out
+
+
 # serving-engine counters (serving.InferenceEngine's dynamic batcher):
 # coalesced dispatches, batch fill / pad waste, batcher queue depth
 # observations, and a bounded ring of request latencies for p50/p99
@@ -303,6 +373,8 @@ def dump_profile():
                    'args': serving_stats()})
     events.append({'ph': 'M', 'name': 'gluon_fused', 'pid': 0,
                    'args': gluon_fused_stats()})
+    events.append({'ph': 'M', 'name': 'bucketing', 'pid': 0,
+                   'args': bucketing_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -415,6 +487,19 @@ def summary(print_out=True):
                  % (gf['gluon_fused_steps'],
                     gf['gluon_fused_dispatches'],
                     gf['gluon_fused_steps_per_dispatch']))
+    bk = bucketing_stats()
+    lines.append('  train_bucket_switches=%d train_pad_waste_rows=%d '
+                 'train_pad_waste_frac=%.3f'
+                 % (bk['train_bucket_switches'],
+                    bk['train_pad_waste_rows'],
+                    bk['train_pad_waste_frac']))
+    for rung in sorted(bk['train_rungs']):
+        e = bk['train_rungs'][rung]
+        lines.append('    rung %-8s steps=%d dispatches=%d compiles=%d '
+                     'warmups=%d warm_compiles=%d'
+                     % (rung, e['steps'], e['dispatches'],
+                        e['compiles'], e['warmups'],
+                        e['warm_compiles']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -449,6 +534,9 @@ def clear():
             _SERVING[k] = type(_SERVING[k])()
         for k in _GLUON_FUSED:
             _GLUON_FUSED[k] = 0
+        for k in _BUCKET:
+            _BUCKET[k] = 0
+        _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0
 

@@ -1,0 +1,467 @@
+"""Fused bucket-ladder training (PERF round 12): pad-to-rung masked
+parity, AOT ladder warmup / zero-compile steady state, per-bucket bulk
+dispatch, shared optimizer state across rungs, and the bucketing
+counters.  CPU-sized per the rig note in CHANGES.md."""
+import os
+import random
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import exec_cache, profiler
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import symbol as sym
+
+VOCAB = 12
+EMBED = 6
+BATCH = 4
+MASK = 0
+
+
+def sym_gen(seq_len):
+    """Tiny per-position LM: Embedding -> FC -> SoftmaxOutput with the
+    standard bucketing masking convention (use_ignore/ignore_label)."""
+    data = sym.Variable('data')
+    label = sym.Variable('softmax_label')
+    emb = sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                        name='embed')
+    h = sym.Reshape(emb, shape=(-1, EMBED))
+    fc = sym.FullyConnected(h, num_hidden=VOCAB, name='pred')
+    lab = sym.Reshape(label, shape=(-1,))
+    out = sym.SoftmaxOutput(fc, label=lab, use_ignore=True,
+                            ignore_label=MASK, name='softmax')
+    return out, ('data',), ('softmax_label',)
+
+
+def make_module(ladder=None, warmup=None, default_key=8):
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=default_key,
+                                 bucket_ladder=ladder, mask_label=MASK,
+                                 warmup_buckets=warmup)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (BATCH, default_key),
+                                         layout='NT')],
+             label_shapes=[mx.io.DataDesc('softmax_label',
+                                          (BATCH, default_key),
+                                          layout='NT')])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    return mod
+
+
+def make_batch(seq_len, seed=0):
+    rs = np.random.RandomState(100 * seed + seq_len)
+    X = rs.randint(1, VOCAB, (BATCH, seq_len)).astype(np.float32)
+    y = np.roll(X, -1, axis=1)
+    y[:, -1] = MASK
+    return mx.io.DataBatch(
+        [nd.array(X)], [nd.array(y)], bucket_key=seq_len,
+        provide_data=[mx.io.DataDesc('data', (BATCH, seq_len),
+                                     layout='NT')],
+        provide_label=[mx.io.DataDesc('softmax_label', (BATCH, seq_len),
+                                      layout='NT')])
+
+
+def params_np(mod):
+    args, _ = mod.get_params()
+    return {k: v.asnumpy().copy() for k, v in args.items()}
+
+
+def max_param_diff(a, b):
+    return max(float(np.abs(a[k] - b[k]).max()) for k in a)
+
+
+# ---------------------------------------------------------------------------
+# pad-to-rung masked parity
+# ---------------------------------------------------------------------------
+
+def test_padded_grad_and_update_parity():
+    """A batch shorter than its rung, padded with mask_label, must
+    produce the SAME gradients, parameter updates, and masked metric
+    as the unpadded run (masked positions contribute exactly zero;
+    float rounding differs across the two program shapes)."""
+    padded = make_module(ladder=[8])        # L=5 runs at rung 8
+    exact = make_module()                   # L=5 binds its own bucket
+    exact.set_params(*padded.get_params())
+
+    b = make_batch(5, seed=3)
+    # gradient parity through the legacy fwd/bwd path
+    padded.forward(b, is_train=True)
+    padded.backward()
+    exact.forward(b, is_train=True)
+    exact.backward()
+    gp = padded._buckets[8]._exec_group.executor
+    ge = exact._buckets[5]._exec_group.executor
+    for name in gp.grad_dict:
+        np.testing.assert_allclose(
+            gp.grad_dict[name].asnumpy(), ge.grad_dict[name].asnumpy(),
+            atol=2e-6, err_msg='grad mismatch for %s' % name)
+
+    # masked metric parity: the padded outputs/labels must score the
+    # same perplexity as the unpadded run
+    mp = mx.metric.Perplexity(ignore_label=MASK)
+    me = mx.metric.Perplexity(ignore_label=MASK)
+    padded.update_metric(mp, b.label)
+    exact.update_metric(me, b.label)
+    assert abs(mp.get()[1] - me.get()[1]) < 1e-4
+
+    # fused-update trajectory parity over mixed lengths
+    for i, seq_len in enumerate((5, 3, 8, 6, 5)):
+        bb = make_batch(seq_len, seed=i)
+        padded.forward_backward(bb)
+        padded.update()
+        exact.forward_backward(bb)
+        exact.update()
+    assert max_param_diff(params_np(padded), params_np(exact)) < 2e-6
+
+
+def test_shared_optimizer_state_across_rungs():
+    """ONE FusedSGD (momenta) is shared by every rung, and bucket
+    switching must not fork or reset it: the ladder run's optimizer
+    states match the exact-bucket run's after a mixed-length epoch."""
+    padded = make_module(ladder=[4, 8])
+    exact = make_module()
+    exact.set_params(*padded.get_params())
+    for i, seq_len in enumerate((3, 8, 4, 7, 2, 8)):
+        bb = make_batch(seq_len, seed=i)
+        padded.forward_backward(bb)
+        padded.update()
+        exact.forward_backward(bb)
+        exact.update()
+    fus = set(id(m._fused_updater) for m in padded._buckets.values())
+    assert len(fus) == 1, 'rungs must share one fused updater'
+    sp = padded._buckets[8]._fused_updater
+    se = exact._buckets[8]._fused_updater
+    for name in sp.states:
+        np.testing.assert_allclose(
+            np.asarray(sp.states[name]), np.asarray(se.states[name]),
+            atol=2e-6, err_msg='momentum mismatch for %s' % name)
+
+
+def test_rung_mapping_and_errors():
+    mod = make_module(ladder=[4, 8])
+    assert mod._rung_for(4) == 4 and mod._rung_for(8) == 8
+    assert mod._rung_for(3) == 4 and mod._rung_for(5) == 8
+    with pytest.raises(mx.base.MXNetError):
+        mod._rung_for(9)        # exceeds every rung
+    # tuple keys: elementwise cover; kind mismatch = no cover (clean
+    # MXNetError from _rung_for, not a TypeError from exec_cache)
+    lad = exec_cache.train_ladder([(4, 6), (8, 12)])
+    assert exec_cache.ladder_rung(lad, (3, 5)) == (4, 6)
+    assert exec_cache.ladder_rung(lad, (5, 5)) == (8, 12)
+    assert exec_cache.ladder_rung(lad, (9, 2)) is None
+    assert exec_cache.ladder_rung((4, 8), (2, 3)) is None  # int vs tuple
+    with pytest.raises(mx.base.MXNetError):
+        mod._rung_for((2, 3))
+    nomask = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                    bucket_ladder=[8])
+    nomask.bind(data_shapes=[mx.io.DataDesc('data', (BATCH, 8),
+                                            layout='NT')],
+                label_shapes=[mx.io.DataDesc('softmax_label', (BATCH, 8),
+                                             layout='NT')])
+    with pytest.raises(mx.base.MXNetError):
+        nomask._rung_for(5)     # padding without mask_label
+
+
+# ---------------------------------------------------------------------------
+# AOT ladder warmup: zero-compile steady state + cached re-warm
+# ---------------------------------------------------------------------------
+
+def test_ladder_warmup_zero_compile_steady_state():
+    mod = make_module(ladder=[4, 8], warmup=True)  # warms at init_optimizer
+    assert sorted(mod._buckets) == [4, 8]
+    s0 = exec_cache.stats()
+    b0 = profiler.bucketing_stats()
+    for i, seq_len in enumerate((3, 4, 8, 5, 7, 4, 8, 2)):
+        mod.forward_backward(make_batch(seq_len, seed=i))
+        mod.update()
+    s1 = exec_cache.stats()
+    assert s1['total_compile_s'] == s0['total_compile_s'], \
+        'steady-state bucketed training must perform ZERO XLA compiles'
+    assert s1['misses'] == s0['misses']
+    b1 = profiler.bucketing_stats()
+    for rung in ('4', '8'):
+        assert b1['train_rungs'][rung]['compiles'] == \
+            b0['train_rungs'].get(rung, {}).get('compiles', 0), \
+            'rung %s paid a mid-epoch compile' % rung
+    # pad accounting moved (lengths 3/5/7/2 padded up)
+    assert b1['train_pad_waste_rows'] > b0['train_pad_waste_rows']
+    assert b1['train_bucket_switches'] > b0['train_bucket_switches']
+
+
+def test_recreated_module_rewarms_from_cache():
+    make_module(ladder=[4, 8], warmup=True)     # populates exec_cache
+    s0 = exec_cache.stats()
+    mod2 = make_module(ladder=[4, 8])
+    warmed = mod2.warmup_buckets()
+    s1 = exec_cache.stats()
+    assert warmed == [4, 8]
+    assert s1['total_compile_s'] == s0['total_compile_s'], \
+        're-created module must warm entirely from the program cache'
+    assert s1['misses'] == s0['misses']
+
+
+def test_warmup_mutates_no_state():
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                 bucket_ladder=[4, 8], mask_label=MASK)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (BATCH, 8),
+                                         layout='NT')],
+             label_shapes=[mx.io.DataDesc('softmax_label', (BATCH, 8),
+                                          layout='NT')])
+    mod.init_params(initializer=mx.init.Xavier())
+    # a STATEFUL scheduler: warmup evaluating lr at k step indices must
+    # not advance it (FactorScheduler mutates base_lr/count in __call__)
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    mod.init_optimizer(optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9,
+                                         'lr_scheduler': sched})
+    before = params_np(mod)
+    opt = mod._curr_module._optimizer
+    counts0 = dict(opt._index_update_count)
+    nu0 = opt.num_update
+    sched0 = dict(sched.__dict__)
+    fu = mod._curr_module._fused_updater
+    mod.warmup_buckets(bulk=5,
+                       eval_metric=mx.metric.Perplexity(ignore_label=MASK))
+    assert max_param_diff(params_np(mod), before) == 0.0
+    assert opt._index_update_count == counts0
+    assert opt.num_update == nu0
+    assert sched.__dict__ == sched0, \
+        'warmup advanced the stateful lr schedule'
+    for name, v in fu.states.items():
+        assert float(np.abs(np.asarray(v)).max()) == 0.0, \
+            'warmup must not step momenta (%s)' % name
+    # the first real step trains at the UNdecayed rate
+    assert opt._get_lr(fu.param_names[0]) == 0.1
+
+
+# ---------------------------------------------------------------------------
+# per-bucket dispatch bulking
+# ---------------------------------------------------------------------------
+
+def test_bulk_step_one_dispatch_and_parity():
+    bulk = make_module(ladder=[4, 8], warmup=True)
+    ref = make_module(ladder=[4, 8])
+    ref.set_params(*bulk.get_params())
+    metric_b = mx.metric.Perplexity(ignore_label=MASK)
+    metric_r = mx.metric.Perplexity(ignore_label=MASK)
+    batches = [make_batch(7, seed=i) for i in range(4)]
+
+    ex8 = bulk._buckets[8]._exec_group.executor
+    d0 = ex8.fused_dispatches
+    bulk.bulk_step(batches=batches, eval_metric=metric_b)
+    assert ex8.fused_dispatches - d0 == 1, \
+        '4 same-rung steps must run as ONE lax.scan dispatch'
+    for b in batches:
+        ref.forward_backward(b)
+        ref.update()
+        ref.update_metric(metric_r, b.label)
+    assert max_param_diff(params_np(bulk), params_np(ref)) < 1e-5
+    assert abs(metric_b.get()[1] - metric_r.get()[1]) < 1e-3
+
+    with pytest.raises(mx.base.MXNetError):
+        bulk.bulk_step(batches=[make_batch(3), make_batch(8)])
+
+
+def test_fit_bulk_bucket_major_parity():
+    """fit(bulk=K) over a bucket_major iterator: same final params and
+    metric as the per-batch fit, zero mid-epoch compiles, and real
+    multi-step dispatches."""
+    rs = np.random.RandomState(0)
+    sentences = []
+    for _ in range(120):
+        ln = int(rs.choice([3, 4, 6, 8]))
+        s0 = int(rs.randint(1, VOCAB))
+        sentences.append([max(1, (s0 + i) % VOCAB) for i in range(ln)])
+
+    def run(bulk):
+        random.seed(11)
+        np.random.seed(11)
+        mx.random.seed(11)
+        it = mx.rnn.BucketSentenceIter(sentences, batch_size=BATCH,
+                                       buckets=[3, 4, 6, 8],
+                                       invalid_label=MASK,
+                                       bucket_major=True)
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                     bucket_ladder=[4, 8],
+                                     mask_label=MASK, warmup_buckets=True)
+        metric = mx.metric.Perplexity(ignore_label=MASK)
+        mod.fit(it, eval_metric=metric, num_epoch=1, bulk=bulk,
+                initializer=mx.init.Xavier(),
+                optimizer_params={'learning_rate': 0.1, 'momentum': 0.9})
+        return params_np(mod), metric.get()[1]
+
+    b0 = profiler.bucketing_stats()
+    p_bulk, m_bulk = run(bulk=4)
+    b1 = profiler.bucketing_stats()
+    p_step, m_step = run(bulk=None)
+    assert max_param_diff(p_bulk, p_step) < 1e-5
+    assert abs(m_bulk - m_step) / m_step < 1e-3
+    new_compiles = sum(
+        v['compiles'] for v in b1['train_rungs'].values()) - sum(
+        v['compiles'] for v in b0['train_rungs'].values())
+    assert new_compiles == 0, 'fit(bulk) paid a mid-epoch compile'
+    steps = sum(v['steps'] for v in b1['train_rungs'].values()) - sum(
+        v['steps'] for v in b0['train_rungs'].values())
+    dispatches = sum(
+        v['dispatches'] for v in b1['train_rungs'].values()) - sum(
+        v['dispatches'] for v in b0['train_rungs'].values())
+    assert steps > dispatches, 'no multi-step dispatch ever ran'
+
+
+def test_bucket_major_iter_contiguous_and_complete():
+    rs = np.random.RandomState(1)
+    sentences = [[int(w) + 1 for w in
+                  rs.randint(0, 10, size=rs.randint(2, 12))]
+                 for _ in range(200)]
+    kwargs = dict(batch_size=8, buckets=[4, 8, 12], invalid_label=0)
+    plain = mx.rnn.BucketSentenceIter(sentences, **kwargs)
+    major = mx.rnn.BucketSentenceIter(sentences, bucket_major=True,
+                                      **kwargs)
+    assert sorted(plain.idx) == sorted(major.idx)  # same batches
+    seen = [i for i, _ in major.idx]
+    runs = 1 + sum(1 for a, b in zip(seen, seen[1:]) if a != b)
+    assert runs == len(set(seen)), \
+        'bucket_major epochs must be bucket-contiguous'
+    major.reset()
+    assert sorted(plain.idx) == sorted(major.idx)
+
+
+def test_mesh_zero_ladder_composition():
+    """The warmed ladder composes with the data mesh and ZeRO-1: both
+    modes hit zero steady-state compiles and produce bit-identical
+    parameters (the sharded update is schedule-only different)."""
+    results = {}
+    for zero in (0, 1):
+        mx.random.seed(5)
+        ctx = [mx.cpu(i) for i in range(4)]
+        mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8,
+                                     context=ctx, bucket_ladder=[4, 8],
+                                     mask_label=MASK)
+        mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 8),
+                                             layout='NT')],
+                 label_shapes=[mx.io.DataDesc('softmax_label', (8, 8),
+                                              layout='NT')])
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer_params={'learning_rate': 0.1,
+                                             'momentum': 0.9},
+                           zero=zero)   # forwarded to the inner Module
+        mod.warmup_buckets()
+        s0 = exec_cache.stats()['total_compile_s']
+        for i, seq_len in enumerate((3, 8, 5, 4)):
+            rs = np.random.RandomState(100 * i + seq_len)
+            X = rs.randint(1, VOCAB, (8, seq_len)).astype(np.float32)
+            y = np.roll(X, -1, axis=1)
+            y[:, -1] = MASK
+            b = mx.io.DataBatch(
+                [nd.array(X)], [nd.array(y)], bucket_key=seq_len,
+                provide_data=[mx.io.DataDesc('data', (8, seq_len),
+                                             layout='NT')],
+                provide_label=[mx.io.DataDesc('softmax_label',
+                                              (8, seq_len),
+                                              layout='NT')])
+            mod.forward_backward(b)
+            mod.update()
+        assert exec_cache.stats()['total_compile_s'] == s0, \
+            'mesh/zero=%d ladder paid a steady-state compile' % zero
+        results[zero] = params_np(mod)
+    assert max_param_diff(results[0], results[1]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# checkpointing
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_roundtrip_across_rungs(tmp_path):
+    mod = make_module(ladder=[4, 8], warmup=True)
+    for i, seq_len in enumerate((3, 8, 4, 6)):
+        mod.forward_backward(make_batch(seq_len, seed=i))
+        mod.update()
+    states = str(tmp_path / 'opt.states')
+    mod._curr_module.save_optimizer_states(states)
+    args, auxs = mod.get_params()
+
+    mod2 = make_module(ladder=[4, 8], warmup=True)
+    mod2.set_params(args, auxs)
+    mod2._curr_module.load_optimizer_states(states)
+    for i, seq_len in enumerate((7, 2, 8, 5)):
+        b = make_batch(seq_len, seed=10 + i)
+        mod.forward_backward(b)
+        mod.update()
+        mod2.forward_backward(b)
+        mod2.update()
+    assert max_param_diff(params_np(mod), params_np(mod2)) < 2e-6
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_monitor_installed_on_later_buckets():
+    mod = make_module()
+    mon = mx.mon.Monitor(1, pattern='.*')
+    mod.install_monitor(mon)
+    assert mod._buckets[8]._exec_group.executor._monitor_callback \
+        is not None
+    mod.forward(make_batch(5), is_train=False)  # creates bucket 5
+    assert mod._buckets[5]._exec_group.executor._monitor_callback \
+        is not None, 'bucket created after install_monitor missed it'
+
+
+def test_init_params_allow_extra_forwarded():
+    mod = make_module()
+    args, auxs = mod.get_params()
+    extra = dict(args)
+    extra['not_a_param'] = nd.zeros((2, 2))
+    with pytest.raises(mx.base.MXNetError):
+        mod.set_params(extra, auxs)
+    mod.set_params(extra, auxs, allow_extra=True)   # forwarded through
+
+
+def test_masked_metric_device_folds():
+    """Accuracy(ignore_label=) and Perplexity device folds mirror the
+    host updates, masked positions excluded."""
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    probs = rs.dirichlet(np.ones(VOCAB), size=10).astype(np.float32)
+    labels = rs.randint(0, VOCAB, size=10).astype(np.float32)
+    labels[7:] = MASK
+    for metric in (mx.metric.Accuracy(ignore_label=MASK),
+                   mx.metric.Perplexity(ignore_label=MASK)):
+        fold = mx.metric.device_fold(metric)
+        assert fold is not None
+        carry = fold.update(fold.init(),
+                            {'softmax_label': jnp.asarray(labels)},
+                            {'softmax_output': jnp.asarray(probs)})
+        fold.commit(carry)
+        dev = metric.get()[1]
+        metric.reset()
+        metric.update([nd.array(labels)], [nd.array(probs)])
+        host = metric.get()[1]
+        assert abs(dev - host) < 1e-4, (metric.name, dev, host)
+    # unmasked Accuracy counts everything (unchanged default)
+    acc = mx.metric.Accuracy()
+    acc.update([nd.array(labels)], [nd.array(probs)])
+    assert acc.num_inst == 10
+
+
+def test_bucketing_counters_in_summary_and_dump(tmp_path):
+    mod = make_module(ladder=[4, 8], warmup=True)
+    for i, seq_len in enumerate((3, 8, 5)):
+        mod.forward_backward(make_batch(seq_len, seed=i))
+        mod.update()
+    stats = profiler.bucketing_stats()
+    assert stats['train_bucket_switches'] > 0
+    assert stats['train_pad_waste_rows'] > 0
+    assert 0.0 < stats['train_pad_waste_frac'] < 1.0
+    assert stats['train_rungs']['8']['steps'] > 0
+    text = profiler.summary(print_out=False)
+    assert 'train_bucket_switches' in text and 'rung' in text
+    import json
+    profiler.profiler_set_config(
+        filename=str(tmp_path / 'profile.json'))
+    out = profiler.dump_profile()
+    with open(out) as f:
+        events = json.load(f)['traceEvents']
+    meta = [e for e in events if e.get('name') == 'bucketing']
+    assert meta and 'train_pad_waste_rows' in meta[0]['args']

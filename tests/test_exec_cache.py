@@ -190,6 +190,9 @@ def test_persistent_cache_writes_to_disk(tmp_path, monkeypatch):
     import jax
     cc = pytest.importorskip('jax._src.compilation_cache')
     monkeypatch.setenv('MXNET_TPU_PERSISTENT_CACHE_DIR', str(tmp_path))
+    # the CPU-backend corruption guard (exec_cache round 12) would
+    # no-op this test's write; force-enable for the mechanics check
+    monkeypatch.setenv('MXNET_TPU_PERSISTENT_CACHE_FORCE', '1')
     # jax memoizes cache usability at first compile; reset so the
     # fresh dir takes effect inside this already-compiling process
     monkeypatch.setattr(exec_cache, '_PERSISTENT_DIR', None)

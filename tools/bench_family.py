@@ -40,13 +40,19 @@ def main():
                         'schedule A/B (one bench.py child; spawns '
                         'its own virtual CPU mesh when needed) '
                         'instead of the model-family sweep')
+    p.add_argument('--bucket', action='store_true',
+                   help='run the BENCH_BUCKET dynamic-shape training '
+                        'smoke (legacy per-bucket loop vs fused '
+                        'bucket ladder vs bulked ladder; one bench.py '
+                        'child) instead of the model-family sweep')
     args = p.parse_args()
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
-    if args.gluon or args.overlap:
+    if args.gluon or args.overlap or args.bucket:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
-                     else ('overlap', 'BENCH_OVERLAP'))
+                     else ('overlap', 'BENCH_OVERLAP') if args.overlap
+                     else ('bucket', 'BENCH_BUCKET'))
         env = dict(os.environ, **{var: '1'})
         proc = subprocess.run([sys.executable, bench_py], env=env,
                               capture_output=True, text=True)
