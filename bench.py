@@ -47,6 +47,9 @@ BENCH_BUCKET=1 (dynamic-shape training mode: legacy 3-dispatch
 per-bucket loop vs the AOT-warmed fused bucket ladder vs the
 bucket-major bulked ladder on a synthetic length-mixed workload —
 see bucket_bench() for the BENCH_BUCKET_* knobs),
+BENCH_CKPT=1 (elastic-checkpoint overhead A/B: no-checkpoint vs
+async cadence vs blocking cadence, ckpt_* counters + bit-parity
+gate — see ckpt_bench() for the BENCH_CKPT_* knobs),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -472,6 +475,163 @@ def gluon_bench():
         'parity_max_abs_diff': max_diff,
         'parity_ok': bool(max_diff < 1e-5),
     }))
+
+
+# ---------------------------------------------------------------------------
+# BENCH_CKPT=1: async elastic checkpoint overhead vs no-checkpoint
+# ---------------------------------------------------------------------------
+
+def ckpt_bench():
+    """BENCH_CKPT=1: measure the step-time overhead of the elastic
+    checkpoint cadence (mxnet_tpu/elastic.py CheckpointManager:
+    device-side async snapshot on the train thread, materialize+write
+    on a background thread) against the identical training loop with
+    no checkpointing, and emit ONE JSON line with steps/s for three
+    arms — nockpt, ckpt (async, every BENCH_CKPT_EVERY steps), and
+    ckpt_sync (the legacy blocking save at the same cadence, the
+    contrast that shows what async buys) — plus the ckpt_* counters
+    (ckpt_async_overlap_ms > 0 proves the host materialize+write ran
+    concurrent with training steps) and a bit-parity gate
+    (checkpointing must not perturb training).
+
+    The async arm's pass time INCLUDES the end-of-pass writer drain
+    (conservative: on this rig the writer contends for the same
+    cores).  Arms run best-of-BENCH_CKPT_PASSES interleaved (rig
+    note: single passes swing ~2x).  Knobs: BENCH_CKPT_BATCH (512 —
+    compute scales with batch while snapshot bytes don't, which is
+    what makes the smoke's overhead honest), BENCH_CKPT_DIM (128),
+    BENCH_CKPT_HIDDEN (512), BENCH_CKPT_LAYERS (4), BENCH_CKPT_STEPS
+    (80 per pass), BENCH_CKPT_EVERY (40), BENCH_CKPT_PASSES (5)."""
+    import shutil
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic, profiler
+    from mxnet_tpu import sym as S
+
+    batch = int(os.environ.get('BENCH_CKPT_BATCH', 512))
+    dim = int(os.environ.get('BENCH_CKPT_DIM', 128))
+    hidden = int(os.environ.get('BENCH_CKPT_HIDDEN', 512))
+    layers = int(os.environ.get('BENCH_CKPT_LAYERS', 4))
+    steps = int(os.environ.get('BENCH_CKPT_STEPS', 80))
+    every = int(os.environ.get('BENCH_CKPT_EVERY', 40))
+    passes = max(1, int(os.environ.get('BENCH_CKPT_PASSES', 5)))
+    classes = 10
+
+    def make_module(seed):
+        x = S.Variable('data')
+        for i in range(layers):
+            x = S.Activation(S.FullyConnected(
+                x, name='fc%d' % i, num_hidden=hidden),
+                act_type='relu')
+        net = S.SoftmaxOutput(S.FullyConnected(
+            x, name='out', num_hidden=classes), name='softmax')
+        mod = mx.mod.Module(net)
+        mod.bind(data_shapes=[mx.io.DataDesc('data', (batch, dim))],
+                 label_shapes=[mx.io.DataDesc('softmax_label',
+                                              (batch,))])
+        mx.random.seed(seed)
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer='sgd',
+                           optimizer_params={'learning_rate': 0.05,
+                                             'momentum': 0.9})
+        return mod
+
+    rs = np.random.RandomState(0)
+    b = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(batch, dim).astype(np.float32))],
+        label=[mx.nd.array((rs.rand(batch) * classes)
+                           .astype(np.float32))])
+
+    def run_steps(mod, n, mgr=None):
+        for s in range(n):
+            mod.forward_backward(b)
+            mod.update()
+            if mgr is not None:
+                mgr.step_end(epoch=0, batches_in_epoch=s + 1,
+                             batch_size=batch)
+        mod.get_params()        # host-fetch barrier
+
+    mod_plain = make_module(1)
+    mod_async = make_module(1)
+    mod_sync = make_module(1)
+    ckdirs = {'async': tempfile.mkdtemp(prefix='bench_ckpt_a_'),
+              'sync': tempfile.mkdtemp(prefix='bench_ckpt_s_')}
+    mgr_async = elastic.CheckpointManager(ckdirs['async'],
+                                          every_n_steps=every, keep=2)
+    mgr_async.attach(mod_async)
+    mgr_sync = elastic.CheckpointManager(ckdirs['sync'],
+                                         every_n_steps=every, keep=2,
+                                         async_=False)
+    mgr_sync.attach(mod_sync)
+
+    # warmup (compiles + first-snapshot copy programs) off the clock —
+    # the SAME step count for every arm, so the parity gate below
+    # compares identically-trained weights
+    run_steps(mod_plain, every)
+    run_steps(mod_async, every, mgr_async)
+    mgr_async.wait()
+    run_steps(mod_sync, every, mgr_sync)
+
+    profiler.clear()
+    best = {'nockpt': 0.0, 'ckpt': 0.0, 'ckpt_sync': 0.0}
+    # ckpt_* counters are process-global and the sync arm feeds them
+    # too — report the ASYNC arm's deltas only, so the JSON counters
+    # describe the cadence being measured
+    async_acc = {k: type(v)() for k, v in profiler.ckpt_stats().items()}
+
+    def timed_async(n):
+        before = profiler.ckpt_stats()
+        tic = time.time()
+        run_steps(mod_async, n, mgr_async)
+        mgr_async.wait()      # drain inside the clock (conservative)
+        dt = time.time() - tic
+        after = profiler.ckpt_stats()
+        for k in async_acc:
+            async_acc[k] += after[k] - before[k]
+        return n / dt
+
+    for _ in range(passes):
+        tic = time.time()
+        run_steps(mod_plain, steps)
+        best['nockpt'] = max(best['nockpt'],
+                             steps / (time.time() - tic))
+        best['ckpt'] = max(best['ckpt'], timed_async(steps))
+        tic = time.time()
+        run_steps(mod_sync, steps, mgr_sync)
+        best['ckpt_sync'] = max(best['ckpt_sync'],
+                                steps / (time.time() - tic))
+
+    # parity gate: the checkpointing arm trained the same number of
+    # steps from the same init — snapshots must not perturb training
+    pa, _ = mod_plain.get_params()
+    pb, _ = mod_async.get_params()
+    max_diff = max(float(np.abs(pa[n].asnumpy() -
+                                pb[n].asnumpy()).max()) for n in pa)
+
+    mgr_async.close()
+    mgr_sync.close()
+    st = async_acc          # async-arm deltas only (see above)
+    overhead = 1.0 - best['ckpt'] / max(best['nockpt'], 1e-9)
+    print(json.dumps({
+        'metric': 'elastic_ckpt_train',
+        'value': round(best['ckpt'], 2),
+        'unit': 'steps/sec',
+        'nockpt_sps': round(best['nockpt'], 2),
+        'ckpt_sync_sps': round(best['ckpt_sync'], 2),
+        'ckpt_overhead_frac': round(overhead, 4),
+        'ckpt_every': every,
+        'ckpt_snapshots': st['ckpt_snapshots'],
+        'ckpt_bytes': st['ckpt_bytes'],
+        'ckpt_async_overlap_ms': round(st['ckpt_async_overlap_ms'], 3),
+        'ckpt_commit_ms': round(st['ckpt_commit_ms'], 3),
+        'ckpt_skipped': st['ckpt_skipped'],
+        'batch': batch, 'dim': dim, 'hidden': hidden, 'layers': layers,
+        'steps_per_pass': steps, 'passes': passes,
+        'parity_max_abs_diff': max_diff,
+        'parity_ok': bool(max_diff == 0.0),
+    }))
+    for d in ckdirs.values():
+        shutil.rmtree(d, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -1130,6 +1290,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_BUCKET', '') == '1':
         bucket_bench()   # fused bucket ladder vs legacy per-bucket loop
+        return
+    if os.environ.get('BENCH_CKPT', '') == '1':
+        ckpt_bench()   # async elastic checkpoint overhead A/B
         return
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \

@@ -7,6 +7,9 @@ registry powering optimizers / metrics / initializers
 (reference: python/mxnet/base.py, python/mxnet/registry.py:1-158).
 """
 import ast
+import contextlib
+import os
+import tempfile
 import threading
 
 string_types = (str,)
@@ -17,6 +20,48 @@ integer_types = (int,)
 class MXNetError(Exception):
     """Error raised by the framework (name kept for API parity with
     the reference's python/mxnet/base.py:43)."""
+
+
+# process umask, read ONCE at import (single-threaded then): the
+# umask(0)/umask(restore) probe is not thread-safe, and atomic_file
+# runs concurrently on the elastic background writer
+try:
+    _UMASK = os.umask(0)
+    os.umask(_UMASK)
+except OSError:  # pragma: no cover
+    _UMASK = 0o022
+
+
+@contextlib.contextmanager
+def atomic_file(fname, mode='wb'):
+    """Crash-safe file write: yields a handle on a same-directory temp
+    file, fsyncs and os.replace()s it over `fname` on success, and
+    unlinks it on any failure — so a crash (or error) mid-write never
+    leaves a torn file under the final name for a later load to trust.
+    Symlink destinations are resolved first (the write goes THROUGH
+    the link, like plain open, instead of clobbering it).  Used by
+    every checkpoint writer (nd.save, save_optimizer_states, elastic
+    shard files)."""
+    fname = os.path.realpath(fname)
+    d = os.path.dirname(fname)
+    fd, tmp = tempfile.mkstemp(dir=d,
+                               prefix=os.path.basename(fname) + '.tmp')
+    try:
+        # mkstemp creates 0600; give the final file the permissions a
+        # plain open() would have (umask-honoring), so checkpoints
+        # stay readable by the serving/eval user they were before
+        os.fchmod(fd, 0o666 & ~_UMASK)
+        with os.fdopen(fd, mode) as f:
+            yield f
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, fname)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 class _NameManager:

@@ -1,0 +1,1264 @@
+"""Elastic training runtime: async sharded checkpoints, preemption-safe
+resume, and fault injection.
+
+The reference's whole recovery story is ps-lite heartbeats
+(KVStore::get_num_dead_node) plus synchronous whole-model
+`save_checkpoint` blobs (SURVEY.md §5.3/§5.4): a SIGKILL mid-epoch
+loses every step since the last epoch boundary, and a crash mid-write
+corrupts the newest checkpoint it was supposed to protect.  On a TPU
+build checkpoint-resume IS the recovery story (ROADMAP item 1), and it
+must never stall the fused train dispatch:
+
+  * `CheckpointManager` snapshots parameters + optimizer state on the
+    TRAIN thread as cheap device-side copies (one async `jnp.copy` per
+    buffer — enqueued behind the in-flight step, so the data captured
+    is exactly the post-step-N state even while step N+1's donated
+    dispatch reuses the original buffers), then materializes and
+    writes them on a background thread while training continues.
+    Under ZeRO-1 only the LOCAL 1/dp shard of each optimizer-state
+    bucket is copied (`addressable_shards`), so snapshot traffic
+    scales down with the dp degree exactly like the state itself.
+  * Checkpoints are directories of self-checksummed per-rank shard
+    files plus a rank-0 `manifest.json` carrying step / epoch / the
+    consumed-sample watermark (the PR-3 pipeline's resume point) /
+    ladder rung / RNG keys / optimizer schedule state.  Every file is
+    written to a temp name and `os.replace`d; the manifest commits
+    last, so a crash at ANY point leaves either the previous
+    checkpoint set or a complete new one — never a half-written one
+    that `resume` would trust.  Bounded keep-last-K retention; cadence
+    by steps or wall-clock.
+  * `resume()` restores MODE-PORTABLY: per-param optimizer state is
+    reassembled from the shard files (re-sharding the flat ZeRO
+    buckets under whatever dp width / zero stage the restoring run
+    uses) and fed through the updaters' mode-portable
+    `set_states` path, so fused/unfused, ZeRO on/off, and any dp
+    width restore from the same files.  Checksums validate every
+    file; a torn or incomplete newest checkpoint falls back to the
+    newest INTACT one (profiler `ckpt_torn_fallbacks`).
+  * SIGTERM/SIGINT handlers drain the in-flight dispatch at the next
+    step boundary, commit a final checkpoint within a deadline, and
+    raise `Preempted` so `Module.fit` unwinds cleanly.
+  * `MXNET_TPU_FAULT_*` knobs inject the failures the recovery path
+    must survive (kill-at-step, torn checkpoint, delayed/failed host
+    write, dead virtual host) — driven by the dryrun_multichip
+    preemption phase and tests/test_elastic.py.  The KVStore facade's
+    `num_dead_node`/`barrier` consult the dead-host knob, giving the
+    reference API honest semantics over the injected faults.
+
+Wiring: `Module.fit(..., checkpoint=mgr)` (auto-resume + per-step
+cadence + mid-epoch fast-forward), `gluon.fuse_step(..., checkpoint=
+mgr)` (auto-resume before the first dispatch, cadence after each),
+and BucketingModule (rung recorded in the manifest; the shared
+FusedSGD state restores across all rungs).  Counters:
+profiler.ckpt_stats().  Docs: docs/ELASTIC.md.
+"""
+import json
+import logging
+import os
+import pickle
+import queue
+import signal
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .base import MXNetError, atomic_file
+
+_CKPT_MAGIC = b'MXTPUCKv1\n'
+_CKPT_END = b'MXTPUCKEND'
+_MANIFEST = 'manifest.json'
+_STEP_DIR = 'step-%08d'
+FORMAT_VERSION = 1
+
+
+class Preempted(MXNetError):
+    """Raised (out of fit / step_end) after a preemption signal once
+    the final checkpoint has been committed."""
+
+    def __init__(self, step, checkpoint_dir=None):
+        super().__init__(
+            'training preempted at step %d (final checkpoint: %s)'
+            % (step, checkpoint_dir))
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (MXNET_TPU_FAULT_* knobs)
+# ---------------------------------------------------------------------------
+
+def fault_knob(name, default=None):
+    """Raw value of MXNET_TPU_FAULT_<name>, or `default` when unset /
+    empty.  Read lazily at each use so tests and the dryrun harness
+    can flip knobs mid-process."""
+    v = os.environ.get('MXNET_TPU_FAULT_' + name, '')
+    return v if v.strip() else default
+
+
+def _fault_int(name):
+    v = fault_knob(name)
+    try:
+        return None if v is None else int(v)
+    except ValueError:
+        return None
+
+
+def dead_hosts():
+    """Virtual ranks declared dead via MXNET_TPU_FAULT_DEAD_HOST
+    (comma-separated rank list).  Their checkpoint shards are withheld
+    (the host died before its write landed) and the KVStore facade
+    reports them through num_dead_node / fails barrier."""
+    v = fault_knob('DEAD_HOST')
+    if v is None:
+        return frozenset()
+    out = set()
+    for part in str(v).split(','):
+        part = part.strip()
+        if part:
+            try:
+                out.add(int(part))
+            except ValueError:
+                pass
+    return frozenset(out)
+
+
+def num_dead_node():
+    """Dead-node count the KVStore facade reports: real detection is
+    the runtime's job on TPU (a live process implies a live mesh), so
+    outside fault injection this is 0."""
+    return len(dead_hosts())
+
+
+def check_barrier():
+    """Raise when a barrier cannot logically complete because a
+    (virtual) host is dead — the honest ps::Postoffice::Barrier
+    semantics over the fault harness (a real dead host would hang the
+    collective; failing fast is the recoverable behavior)."""
+    dead = dead_hosts()
+    if dead:
+        raise MXNetError(
+            'barrier failed: %d dead node(s) %s (MXNET_TPU_FAULT_'
+            'DEAD_HOST) — recover via elastic checkpoint resume'
+            % (len(dead), sorted(dead)))
+
+
+# ---------------------------------------------------------------------------
+# Self-checksummed shard files
+# ---------------------------------------------------------------------------
+
+def _dtype_str(dt):
+    """Portable dtype tag ('float32', 'bfloat16', ...)."""
+    try:
+        return np.dtype(dt).name
+    except TypeError:
+        return str(dt)
+
+
+def _np_dtype(tag):
+    import jax.numpy as jnp
+    if tag == 'bfloat16':
+        return jnp.bfloat16
+    return np.dtype(tag)
+
+
+def write_shard_file(path, entries):
+    """Write named arrays as one self-checksummed blob: magic + JSON
+    header (names/dtypes/shapes/sizes) + raw payloads + crc32/length
+    trailer.  Torn writes (truncation, bit flips) fail validation at
+    read time without any out-of-band checksum.  Committed via temp +
+    os.replace so a crash mid-write never leaves a torn file under
+    the final name.  Returns (bytes_written, crc32)."""
+    header = []
+    payloads = []
+    for name, arr in entries:
+        a = np.ascontiguousarray(np.asarray(arr))
+        # zero-copy view of the array buffer: crc32 and f.write both
+        # take the buffer protocol, so the payload is never duplicated
+        # in host memory (checkpoints are the size of the model).
+        # ml_dtypes arrays (bfloat16) reject memoryview — reinterpret
+        # their buffer as uint8 instead (same bytes, still no copy)
+        try:
+            raw = memoryview(a).cast('B')
+        except (ValueError, TypeError):
+            raw = memoryview(a.reshape(-1).view(np.uint8))
+        header.append({'name': name, 'dtype': _dtype_str(a.dtype),
+                       'shape': list(a.shape), 'nbytes': a.nbytes})
+        payloads.append(raw)
+    hb = json.dumps(header).encode('utf-8')
+    crc = 0
+    with atomic_file(path) as f:
+        def put(b):
+            nonlocal crc
+            crc = zlib.crc32(b, crc)
+            f.write(b)
+        put(_CKPT_MAGIC)
+        put(struct.pack('<q', len(hb)))
+        put(hb)
+        for raw in payloads:
+            put(raw)
+        body_len = f.tell()
+        f.write(struct.pack('<Iq', crc & 0xffffffff, body_len))
+        f.write(_CKPT_END)
+    return os.path.getsize(path), crc & 0xffffffff
+
+
+def read_shard_file(path):
+    """Read + validate a shard file; returns {name: np.ndarray}.
+    Raises MXNetError on truncation / checksum mismatch / bad magic."""
+    trailer = struct.calcsize('<Iq') + len(_CKPT_END)
+    try:
+        with open(path, 'rb') as f:
+            blob = f.read()
+    except OSError as e:
+        raise MXNetError('checkpoint shard %s unreadable: %s'
+                         % (path, e))
+    if len(blob) < len(_CKPT_MAGIC) + 8 + trailer or \
+            not blob.startswith(_CKPT_MAGIC) or \
+            not blob.endswith(_CKPT_END):
+        raise MXNetError('checkpoint shard %s is torn or not a '
+                         'checkpoint file' % path)
+    crc_stored, body_len = struct.unpack(
+        '<Iq', blob[-trailer:-len(_CKPT_END)])
+    # memoryview slices are views, not copies: a multi-GB shard is
+    # held ONCE in host memory (the frombuffer arrays below are views
+    # into the same blob)
+    body = memoryview(blob)[:-trailer]
+    if body_len != len(body) or \
+            (zlib.crc32(body) & 0xffffffff) != crc_stored:
+        raise MXNetError('checkpoint shard %s failed checksum/length '
+                         'validation (torn write?)' % path)
+    off = len(_CKPT_MAGIC)
+    hlen, = struct.unpack('<q', body[off:off + 8])
+    off += 8
+    header = json.loads(bytes(body[off:off + hlen]).decode('utf-8'))
+    off += hlen
+    out = {}
+    for ent in header:
+        raw = body[off:off + ent['nbytes']]
+        off += ent['nbytes']
+        dt = _np_dtype(ent['dtype'])
+        out[ent['name']] = np.frombuffer(
+            raw, dtype=dt).reshape(ent['shape'])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Snapshot capture (train-thread side: cheap async device copies)
+# ---------------------------------------------------------------------------
+
+def _device_snap(x):
+    """A fresh device buffer holding x's current value, dispatched
+    asynchronously: the copy is enqueued BEHIND the in-flight step, so
+    it reads the post-step value, and it is a buffer the next donated
+    dispatch cannot invalidate.  The D2H transfer starts eagerly so the
+    writer thread's np.asarray mostly finds it done."""
+    import jax.numpy as jnp
+    c = jnp.copy(x)
+    try:
+        c.copy_to_host_async()
+    except Exception:
+        pass
+    return c
+
+
+def _local_full(arr):
+    """One full local copy of a (possibly mesh-replicated) array."""
+    shards = getattr(arr, 'addressable_shards', None)
+    if shards:
+        return _device_snap(shards[0].data)
+    return _device_snap(arr)
+
+
+def _local_bucket_shards(arr):
+    """[(lo, hi, device_copy)] covering this process's addressable,
+    replica-0 shards of a 1-D dp-sharded flat bucket — the LOCAL 1/dp
+    pieces only, so snapshot bytes scale down with the dp degree."""
+    shards = getattr(arr, 'addressable_shards', None)
+    if not shards:
+        n = int(np.prod(arr.shape)) if arr.shape else 1
+        return [(0, n, _device_snap(arr))]
+    out = []
+    n = int(arr.shape[0])
+    for s in shards:
+        if getattr(s, 'replica_id', 0) != 0:
+            continue
+        idx = s.index[0] if s.index else slice(None)
+        lo = idx.start or 0
+        hi = idx.stop if idx.stop is not None else n
+        out.append((int(lo), int(hi), _device_snap(s.data)))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _sched_state(opt):
+    """JSON-safe snapshot of the stateful lr scheduler (FactorScheduler
+    mutates base_lr/count inside __call__ — update counts alone would
+    leave a resumed schedule permanently behind)."""
+    sched = getattr(opt, 'lr_scheduler', None)
+    if sched is None:
+        return None
+    out = {}
+    for k, v in sched.__dict__.items():
+        if isinstance(v, (int, float, bool, str)) or v is None:
+            out[k] = v
+    return out
+
+
+def _metric_state(metric):
+    """Accumulated (sum_metric, num_inst) pairs for a metric tree —
+    pending device deltas are drained first, so the values are the
+    exact host-visible accumulation at snapshot time."""
+    if metric is None:
+        return None
+    if hasattr(metric, 'metrics'):       # CompositeEvalMetric
+        return {'composite': [_metric_state(m) for m in metric.metrics]}
+    try:
+        metric._drain_device()
+    except Exception:
+        pass
+    return {'sum_metric': float(getattr(metric, 'sum_metric', 0.0)),
+            'num_inst': int(getattr(metric, 'num_inst', 0))}
+
+
+def _restore_metric(metric, state):
+    if metric is None or state is None:
+        return
+    if 'composite' in state and hasattr(metric, 'metrics'):
+        for m, s in zip(metric.metrics, state['composite']):
+            _restore_metric(m, s)
+        return
+    metric.sum_metric = state.get('sum_metric', 0.0)
+    metric.num_inst = state.get('num_inst', 0)
+    metric._pending_device = None
+
+
+# ---------------------------------------------------------------------------
+# Target adapters: Module / BucketingModule / gluon FusedStep / Trainer
+# ---------------------------------------------------------------------------
+
+def _updater_of(target):
+    """(fused_updater, per_key_updater) of the training target."""
+    if hasattr(target, '_curr_module'):          # BucketingModule
+        target = target._buckets[target._default_bucket_key]
+    if hasattr(target, '_trainer'):              # gluon FusedStep
+        tr = target._trainer
+        per_key = tr._updaters[0] if tr._updaters else None
+        return tr._fused_updater, per_key
+    if hasattr(target, '_updaters'):             # bare gluon Trainer
+        per_key = target._updaters[0] if target._updaters else None
+        return target._fused_updater, per_key
+    return getattr(target, '_fused_updater', None), \
+        getattr(target, '_updater', None)
+
+
+def _capture_params(target):
+    """[(namespaced name, device-copy)] of every parameter + aux the
+    target trains, read straight off the device buffers (the host
+    mirror can be stale mid-epoch)."""
+    entries = []
+    if hasattr(target, '_curr_module'):          # BucketingModule
+        mod = target._curr_module
+    elif hasattr(target, '_trainer'):            # gluon FusedStep
+        # positional identity: a re-created net gets fresh
+        # auto-prefixes (dense0_ -> dense4_), so names alone cannot
+        # address a resumed run's parameters — the TRAINER order (and
+        # the sorted aux/frozen order _collect_params fixes) is the
+        # stable identity, exactly like FusedSGD's integer state keys
+        target._collect_params()
+        for i, p in enumerate(target._params):
+            entries.append(('gparam:%d:%s' % (i, p.name),
+                            _local_full(target._gather_param(p))))
+        for i, p in enumerate(target._aux_params):
+            entries.append(('gaux:%d:%s' % (i, p.name),
+                            _local_full(target._gather_param(p))))
+        for i, p in enumerate(target._frozen_params):
+            entries.append(('gfrozen:%d:%s' % (i, p.name),
+                            _local_full(target._gather_param(p))))
+        return entries
+    else:
+        mod = target
+    ex = mod._exec_group.executor
+    for n in mod._param_names:
+        if n in ex.arg_dict:
+            entries.append(('param:%s' % n,
+                            _local_full(ex.arg_dict[n]._data)))
+    for n in mod._aux_names:
+        if n in ex.aux_dict:
+            entries.append(('aux:%s' % n,
+                            _local_full(ex.aux_dict[n]._data)))
+    return entries
+
+
+def _capture_rng(target):
+    entries = []
+    if hasattr(target, '_curr_module'):
+        target = target._curr_module
+    if hasattr(target, '_trainer'):
+        if target._rng is not None:
+            entries.append(('rng:step', _local_full(target._rng)))
+        return entries
+    eg = getattr(target, '_exec_group', None)
+    if eg is not None and getattr(eg.executor, '_key', None) is not None:
+        entries.append(('rng:step', _local_full(eg.executor._key)))
+    return entries
+
+
+def _capture_optimizer(target):
+    """(entries, opt_meta): optimizer state as shard-file entries plus
+    the JSON manifest metadata needed to reassemble them.  ZeRO-1
+    buckets contribute only their LOCAL 1/dp shards; replicated state
+    contributes full per-param arrays; optimizers without a fused path
+    fall back to the per-key Updater's pickled states blob."""
+    fu, per_key = _updater_of(target)
+    entries = []
+    if fu is not None:
+        opt = fu.optimizer
+        meta = {'counts': [[k, int(v)] for k, v in
+                           opt._index_update_count.items()],
+                'num_update': int(opt.num_update),
+                'sched': _sched_state(opt),
+                'param_names': list(fu.param_names)}
+        if fu.zero and fu._staged is not None:
+            # restored but not yet re-bucketed: per-param staged values
+            staged_moms, staged_masters = fu._staged
+            meta['mode'] = 'replicated'
+            for n, v in staged_moms.items():
+                entries.append(('mom:%s' % n, np.asarray(v)))
+            for n, v in staged_masters.items():
+                if v is not None:
+                    entries.append(('master:%s' % n, np.asarray(v)))
+            return entries, meta
+        if fu.zero and fu._layout is not None and \
+                fu._zero_moms is not None:
+            lay = fu._layout
+            meta['mode'] = 'zero'
+            meta['param_names'] = list(fu._layout_names)
+            meta['zero_buckets'] = [
+                {'index': b.index, 'size': b.size, 'padded': b.padded,
+                 'sizes': list(b.sizes), 'offsets': list(b.offsets),
+                 'shapes': [list(s) for s in b.shapes],
+                 'param_idx': list(b.param_idx),
+                 'acc_dtype': b.acc_dtype.name, 'mp': bool(b.mp)}
+                for b in lay.buckets]
+            for b, mom, mas in zip(lay.buckets, fu._zero_moms,
+                                   fu._zero_masters):
+                for lo, hi, piece in _local_bucket_shards(mom):
+                    entries.append(
+                        ('zmom:%d:%d:%d' % (b.index, lo, hi), piece))
+                if b.mp and mas is not None:
+                    for lo, hi, piece in _local_bucket_shards(mas):
+                        entries.append(
+                            ('zmaster:%d:%d:%d' % (b.index, lo, hi),
+                             piece))
+            return entries, meta
+        meta['mode'] = 'replicated'
+        for n in fu.param_names:
+            v = fu.states.get(n) if not fu.zero else None
+            if v is not None:
+                entries.append(('mom:%s' % n, _local_full(v)))
+            m = fu.masters.get(n) if not fu.zero else None
+            if m is not None:
+                entries.append(('master:%s' % n, _local_full(m)))
+        return entries, meta
+    if per_key is not None and getattr(per_key, 'states', None):
+        blob = np.frombuffer(per_key.get_states(), dtype=np.uint8)
+        return [('optblob', blob)], {'mode': 'pickle'}
+    return [], {'mode': 'none'}
+
+
+def _assemble_optimizer(meta, arrays):
+    """Rebuild per-param (moms, masters) dicts from loaded shard
+    entries: ZeRO flat buckets are reassembled from their per-rank
+    pieces and unpacked with the manifest's layout — independent of
+    the dp width / zero stage of either run (re-sharding happens in
+    the restoring updater's own host_prep)."""
+    mode = meta.get('mode', 'none')
+    if mode == 'none':
+        return None
+    if mode == 'pickle':
+        return {'blob': arrays['optblob'].tobytes()}
+    names = meta.get('param_names', [])
+    moms = {}
+    masters = {}
+    if mode == 'replicated':
+        for key, v in arrays.items():
+            if key.startswith('mom:'):
+                moms[key[4:]] = v
+            elif key.startswith('master:'):
+                masters[key[7:]] = v
+    else:                                        # 'zero'
+        for b in meta['zero_buckets']:
+            for kind, dest in (('zmom', moms), ('zmaster', masters)):
+                pieces = []
+                for key, v in arrays.items():
+                    parts = key.split(':')
+                    if parts[0] != kind or int(parts[1]) != b['index']:
+                        continue
+                    pieces.append((int(parts[2]), int(parts[3]), v))
+                if not pieces:
+                    continue
+                pieces.sort()
+                flat = np.zeros((b['padded'],),
+                                dtype=_np_dtype(b['acc_dtype']))
+                covered = 0
+                for lo, hi, v in pieces:
+                    flat[lo:hi] = np.asarray(v).reshape(-1)
+                    covered += hi - lo
+                if covered < b['size']:
+                    raise MXNetError(
+                        'checkpoint bucket %d incomplete: %d of %d '
+                        'elements covered' % (b['index'], covered,
+                                              b['size']))
+                for i, off, n, shape in zip(b['param_idx'], b['offsets'],
+                                            b['sizes'], b['shapes']):
+                    dest[names[i]] = flat[off:off + n].reshape(shape)
+    # normalize gluon integer param names (JSON round-trips keys fine
+    # as list pairs, but entry names are strings)
+    def fix(d):
+        out = {}
+        name_set = {str(n): n for n in names}
+        for k, v in d.items():
+            out[name_set.get(k, k)] = v
+        return out
+    counts = {}
+    for kv in meta.get('counts') or []:
+        counts[kv[0]] = kv[1]
+    return {'moms': fix(moms), 'masters': fix(masters),
+            'counts': counts,
+            'num_update': meta.get('num_update'),
+            'sched': meta.get('sched')}
+
+
+def _restore_optimizer(target, meta, arrays):
+    asm = _assemble_optimizer(meta, arrays)
+    if asm is None:
+        return
+    fu, per_key = _updater_of(target)
+    if 'blob' in asm:
+        for u in (fu, per_key):
+            if u is not None:
+                u.set_states(asm['blob'])
+        return
+    payload = pickle.dumps((
+        {n: np.asarray(v) for n, v in asm['moms'].items()},
+        dict(asm['counts']),
+        {n: np.asarray(v) for n, v in asm['masters'].items()}))
+    applied = False
+    for u in (fu, per_key):
+        if u is not None:
+            u.set_states(payload)
+            applied = True
+    tr = None
+    if hasattr(target, '_trainer'):
+        tr = target._trainer
+    elif hasattr(target, '_updaters'):
+        tr = target
+    if tr is not None:
+        if tr._fused_updater is None:
+            # applied when fuse_step builds the fused updater
+            tr._pending_fused_states = payload
+            applied = True
+        tr._last_update_mode = None
+    if not applied:
+        raise MXNetError('restore: target has no optimizer to restore '
+                         'into (call init_optimizer first)')
+    opt = None
+    if fu is not None:
+        opt = fu.optimizer
+    elif per_key is not None:
+        opt = per_key.optimizer
+    elif tr is not None:
+        opt = tr._optimizer
+    if opt is not None:
+        if asm['num_update'] is not None:
+            opt.num_update = int(asm['num_update'])
+        if asm['sched'] and getattr(opt, 'lr_scheduler', None) \
+                is not None:
+            opt.lr_scheduler.__dict__.update(asm['sched'])
+
+
+def _restore_params(target, arrays):
+    from . import ndarray as nd
+    if hasattr(target, '_trainer'):              # gluon FusedStep
+        target._collect_params()
+        lists = {'gparam': target._params, 'gaux': target._aux_params,
+                 'gfrozen': target._frozen_params}
+        for key, v in arrays.items():
+            parts = key.split(':', 2)
+            plist = lists.get(parts[0])
+            if plist is None:
+                continue
+            i = int(parts[1])
+            if i >= len(plist):
+                raise MXNetError(
+                    'checkpoint parameter %s has no positional match '
+                    'in the restoring net (%d %s params)'
+                    % (key, len(plist), parts[0][1:]))
+            plist[i].set_data(nd.NDArray(np.asarray(v)))
+        return
+    args = {k[6:]: nd.NDArray(np.asarray(v)) for k, v in arrays.items()
+            if k.startswith('param:')}
+    auxs = {k[4:]: nd.NDArray(np.asarray(v)) for k, v in arrays.items()
+            if k.startswith('aux:')}
+    target.set_params(args, auxs, allow_missing=True, force_init=True)
+
+
+def _restore_rng(target, arrays):
+    key = arrays.get('rng:step')
+    if key is None:
+        return
+    import jax.numpy as jnp
+    if hasattr(target, '_curr_module'):
+        target = target._curr_module
+    if hasattr(target, '_trainer'):
+        if target._rng is not None:
+            import jax
+            target._rng = jax.device_put(
+                jnp.asarray(np.asarray(key)), target._rng.sharding) \
+                if hasattr(target._rng, 'sharding') else \
+                jnp.asarray(np.asarray(key))
+        return
+    eg = getattr(target, '_exec_group', None)
+    if eg is not None and getattr(eg.executor, '_key', None) is not None:
+        old = eg.executor._key
+        new = jnp.asarray(np.asarray(key), dtype=old.dtype)
+        try:
+            import jax
+            new = jax.device_put(new, old.sharding)
+        except Exception:
+            pass
+        eg.executor._key = new
+
+
+# ---------------------------------------------------------------------------
+# ResumeInfo + checkpoint discovery
+# ---------------------------------------------------------------------------
+
+class ResumeInfo(object):
+    """What a restored checkpoint says about where training was."""
+
+    __slots__ = ('step', 'epoch', 'batches_in_epoch', 'samples_consumed',
+                 'rung', 'directory', 'manifest')
+
+    def __init__(self, manifest, directory):
+        self.manifest = manifest
+        self.directory = directory
+        self.step = int(manifest.get('step', 0))
+        self.epoch = int(manifest.get('epoch', 0))
+        self.batches_in_epoch = int(manifest.get('batches_in_epoch', 0))
+        self.samples_consumed = int(manifest.get('samples_consumed', 0))
+        self.rung = manifest.get('rung')
+
+    def __repr__(self):
+        return ('ResumeInfo(step=%d, epoch=%d, batches_in_epoch=%d, '
+                'samples_consumed=%d, rung=%r)'
+                % (self.step, self.epoch, self.batches_in_epoch,
+                   self.samples_consumed, self.rung))
+
+
+def list_checkpoints(directory):
+    """Step numbers of the checkpoint dirs under `directory` that have
+    a manifest, newest first (manifest presence only — validation
+    happens at load)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for n in names:
+        if n.startswith('step-'):
+            try:
+                s = int(n[5:])
+            except ValueError:
+                continue
+            if os.path.isfile(os.path.join(directory, n, _MANIFEST)):
+                steps.append(s)
+    return sorted(steps, reverse=True)
+
+
+def _load_one(ckpt_dir):
+    """(manifest, arrays) for one checkpoint dir; raises MXNetError on
+    any validation failure (torn manifest, missing shard, checksum)."""
+    mpath = os.path.join(ckpt_dir, _MANIFEST)
+    try:
+        with open(mpath, 'r') as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MXNetError('checkpoint manifest %s unreadable: %s'
+                         % (mpath, e))
+    if manifest.get('format') != FORMAT_VERSION:
+        raise MXNetError('checkpoint %s has unsupported format %r'
+                         % (ckpt_dir, manifest.get('format')))
+    arrays = {}
+    for fname in manifest.get('files', []):
+        fpath = os.path.join(ckpt_dir, fname)
+        if not os.path.isfile(fpath):
+            raise MXNetError('checkpoint %s is missing shard %s (host '
+                             'died before its write landed?)'
+                             % (ckpt_dir, fname))
+        arrays.update(read_shard_file(fpath))
+    return manifest, arrays
+
+
+def load_newest_intact(directory):
+    """(manifest, arrays, ckpt_dir) of the newest checkpoint that
+    validates end-to-end, falling back past torn/incomplete ones
+    (counted in profiler ckpt_torn_fallbacks).  None when the
+    directory holds no intact checkpoint."""
+    from . import profiler
+    for step in list_checkpoints(directory):
+        ckpt_dir = os.path.join(directory, _STEP_DIR % step)
+        try:
+            manifest, arrays = _load_one(ckpt_dir)
+            return manifest, arrays, ckpt_dir
+        except MXNetError as e:
+            logging.warning('elastic: skipping checkpoint %s: %s',
+                            ckpt_dir, e)
+            profiler.add_ckpt_stats(torn_fallbacks=1)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+class CheckpointManager(object):
+    """Async, sharded, crash-safe checkpoints with cadence, retention,
+    preemption handling and fault injection (module docstring).
+
+    directory: checkpoint root (one `step-NNNNNNNN/` dir per commit).
+    every_n_steps / every_n_secs: cadence (either or both; None
+    disables that trigger — explicit save()/preemption still work).
+    keep: retention — newest K checkpoints survive (older pruned
+    after each commit).  async_: write on the background thread
+    (False: every save commits synchronously before returning).
+    rank/world: per-rank shard-file identity; default
+    jax.process_index()/count().  A world > process count (virtual
+    hosts) splits the local entries round-robin into per-rank files —
+    the dryrun/test harness for multi-host layouts on one process.
+    """
+
+    def __init__(self, directory, every_n_steps=None, every_n_secs=None,
+                 keep=3, async_=True, rank=None, world=None,
+                 deadline=30.0):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.every_n_steps = every_n_steps
+        self.every_n_secs = every_n_secs
+        self.keep = max(1, int(keep))
+        self.async_ = bool(async_)
+        self.deadline = float(deadline)
+        if rank is None or world is None:
+            try:
+                import jax
+                rank = jax.process_index() if rank is None else rank
+                world = jax.process_count() if world is None else world
+            except Exception:
+                rank, world = rank or 0, world or 1
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        self._target = None
+        self._step = 0
+        self._last_save_step = None
+        self._last_save_time = time.monotonic()
+        self._preempt = threading.Event()
+        self._preempt_signum = None
+        self._old_handlers = {}
+        self._queue = queue.Queue(maxsize=2)
+        self._idle = threading.Event()
+        self._idle.set()
+        self._writer = None
+        self._writer_err = None
+        self._resumed = None
+        self._lock = threading.Lock()
+
+    # -- target ------------------------------------------------------------
+    def attach(self, target):
+        """Declare the training object checkpoints are taken from /
+        restored into: a Module, a BucketingModule, a gluon FusedStep
+        (gluon.fuse_step return value), or a gluon Trainer."""
+        self._target = target
+        return self
+
+    def _require_target(self, target=None):
+        t = target if target is not None else self._target
+        if t is None:
+            raise MXNetError('CheckpointManager: no target attached '
+                             '(call attach(module_or_fused_step))')
+        return t
+
+    # -- properties --------------------------------------------------------
+    @property
+    def step(self):
+        return self._step
+
+    @property
+    def preempted(self):
+        return self._preempt.is_set()
+
+    @property
+    def last_resume(self):
+        """ResumeInfo of the restore this manager performed (None when
+        training started fresh)."""
+        return self._resumed
+
+    # -- signal handling ---------------------------------------------------
+    def install_signal_handlers(self, signals=(signal.SIGTERM,
+                                               signal.SIGINT)):
+        """Arm preemption-safe shutdown: the first signal marks the
+        run preempted — the next step_end() drains the in-flight
+        dispatch, commits a final checkpoint within the deadline and
+        raises Preempted.  A second signal restores the default
+        handler (a stuck drain can still be killed)."""
+        def _handler(signum, frame):
+            if self._preempt.is_set():
+                signal.signal(signum,
+                              self._old_handlers.get(signum,
+                                                     signal.SIG_DFL))
+                return
+            self._preempt_signum = signum
+            self._preempt.set()
+        for s in signals:
+            self._old_handlers[s] = signal.signal(s, _handler)
+        return self
+
+    def uninstall_signal_handlers(self):
+        for s, h in self._old_handlers.items():
+            try:
+                signal.signal(s, h)
+            except (ValueError, OSError):
+                pass
+        self._old_handlers = {}
+
+    def request_preempt(self):
+        """Programmatic preemption (what the signal handler does) —
+        the next step_end commits a final checkpoint and raises
+        Preempted."""
+        self._preempt.set()
+
+    # -- cadence -----------------------------------------------------------
+    def _due(self):
+        if self.every_n_steps is not None and \
+                self._step - (self._last_save_step or 0) >= \
+                int(self.every_n_steps) and \
+                self._step != self._last_save_step:
+            return True
+        if self.every_n_secs is not None and \
+                time.monotonic() - self._last_save_time >= \
+                float(self.every_n_secs):
+            return True
+        return False
+
+    def step_end(self, epoch=0, batches_in_epoch=0, batch_size=0,
+                 steps=1, metric=None, rung=None, target=None):
+        """Per-step bookkeeping hook (Module.fit and gluon FusedStep
+        call this after every optimizer step / fused dispatch):
+        advances the step counter, fires the fault knobs, commits the
+        final checkpoint + raises Preempted after a preemption signal,
+        and takes a cadence checkpoint when due.  steps: how many
+        optimizer steps the dispatch carried (bulk dispatches pass
+        K)."""
+        self._step += int(steps)
+        kill_at = _fault_int('KILL_AT_STEP')
+        if kill_at is not None and self._step >= kill_at:
+            # simulated preemption WITHOUT warning: SIGKILL self (the
+            # resume path must work from the last cadence checkpoint)
+            logging.warning('elastic: MXNET_TPU_FAULT_KILL_AT_STEP=%d '
+                            'firing at step %d', kill_at, self._step)
+            os.kill(os.getpid(), signal.SIGKILL)
+        samples = int(batches_in_epoch) * int(batch_size)
+        if self._preempt.is_set():
+            ckpt = self.save(epoch=epoch,
+                             batches_in_epoch=batches_in_epoch,
+                             batch_size=batch_size, metric=metric,
+                             rung=rung, target=target, sync=True)
+            raise Preempted(self._step, ckpt)
+        if self._due():
+            self.save(epoch=epoch, batches_in_epoch=batches_in_epoch,
+                      batch_size=batch_size, metric=metric, rung=rung,
+                      target=target, sync=not self.async_)
+        return samples
+
+    # -- save --------------------------------------------------------------
+    def save(self, epoch=0, batches_in_epoch=0, batch_size=0,
+             metric=None, rung=None, target=None, sync=False):
+        """Take a checkpoint of the attached target at the current
+        step.  The device-side snapshot happens on the CALLING thread
+        (cheap async copies); serialization + file I/O happen on the
+        background writer unless sync=True (which also drains the
+        writer within the deadline).  Returns the checkpoint dir path
+        (the path it WILL commit to, for async saves), or None when a
+        previous async write is still in flight (the snapshot is
+        skipped — training must not stall on a slow filesystem)."""
+        from . import profiler
+        t = self._require_target(target)
+        if not sync and not self._idle.is_set():
+            # never stall training on a slow filesystem: drop this
+            # cadence snapshot (retried next step while still due)
+            logging.info('elastic: skipping checkpoint at step %d '
+                         '(previous write still in flight)',
+                         self._step)
+            profiler.add_ckpt_stats(skipped=1)
+            return None
+        t0 = time.perf_counter()
+        entries = _capture_params(t)
+        entries += _capture_rng(t)
+        opt_entries, opt_meta = _capture_optimizer(t)
+        entries += opt_entries
+        if rung is None and hasattr(t, '_curr_bucket_key'):
+            rung = t._curr_bucket_key
+        manifest = {
+            'format': FORMAT_VERSION,
+            'step': self._step,
+            'epoch': int(epoch),
+            'batches_in_epoch': int(batches_in_epoch),
+            'batch_size': int(batch_size),
+            'samples_consumed': int(batches_in_epoch) * int(batch_size),
+            'rung': list(rung) if isinstance(rung, (tuple, list))
+            else rung,
+            'world': self.world,
+            'opt': opt_meta,
+            'metric': _metric_state(metric),
+            'time': time.time(),
+        }
+        snap_ms = (time.perf_counter() - t0) * 1e3
+        step_dir = os.path.join(self.directory, _STEP_DIR % self._step)
+        job = (dict(manifest), list(entries), step_dir, snap_ms)
+        self._last_save_step = self._step
+        self._last_save_time = time.monotonic()
+        if sync:
+            # drain any in-flight async write first: one writer at a
+            # time keeps commit/prune ordering simple and makes the
+            # final preemption checkpoint strictly newest.  If the
+            # drain times out (hung filesystem past the deadline) the
+            # sync write proceeds anyway — _write_checkpoint's lock
+            # still serializes it against the stalled writer, so the
+            # two can never interleave file writes or prune each
+            # other's in-progress dir
+            if not self.wait():
+                logging.warning(
+                    'elastic: async write still in flight past the '
+                    'deadline; final checkpoint queues behind it')
+            self._write_checkpoint(*job, background=False)
+        else:
+            self._ensure_writer()
+            self._idle.clear()
+            self._queue.put(job)
+        return step_dir
+
+    def _ensure_writer(self):
+        if self._writer is None or not self._writer.is_alive():
+            self._writer = threading.Thread(target=self._writer_loop,
+                                            name='elastic-ckpt-writer',
+                                            daemon=True)
+            self._writer.start()
+
+    def _writer_loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._write_checkpoint(*job, background=True)
+            except BaseException as e:        # noqa: B036
+                from . import profiler
+                profiler.add_ckpt_stats(failed_writes=1)
+                self._writer_err = e
+                logging.warning('elastic: async checkpoint write '
+                                'failed: %s', e)
+            finally:
+                if self._queue.empty():
+                    self._idle.set()
+                self._queue.task_done()
+
+    @staticmethod
+    def _multiprocess():
+        """True on a REAL multi-process jax run (each process then
+        owns exactly its rank's shard file; the single-process case —
+        including the virtual-host harness — splits entries itself)."""
+        try:
+            import jax
+            return jax.process_count() > 1
+        except Exception:
+            return False
+
+    def _rank_of_entry(self, name, ordinal):
+        """Which virtual rank's shard file an entry lands in
+        (single-process only): manifest scalars / params / rng are
+        rank-0; ZeRO bucket shards spread round-robin over the world
+        (the virtual-host harness for multi-host layouts).  On a real
+        multi-process run every local entry belongs to self.rank —
+        see _write_checkpoint."""
+        if self.world <= 1:
+            return 0
+        if name.startswith(('zmom:', 'zmaster:')):
+            return ordinal % self.world
+        return 0
+
+    def _barrier(self):
+        """Cross-process sync before the rank-0 manifest commit (all
+        shards must be durable first).  No-op single-process."""
+        if self._multiprocess():
+            try:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices('elastic_ckpt')
+            except Exception as e:
+                logging.warning('elastic: checkpoint barrier failed: '
+                                '%s', e)
+
+    def _write_checkpoint(self, manifest, entries, step_dir, snap_ms,
+                          background):
+        """Materialize the snapshot to host and commit it: per-rank
+        self-checksummed shard files first, manifest last (temp +
+        os.replace each) — the manifest IS the commit point.  Fault
+        knobs: WRITE_DELAY_MS sleeps first (slow filesystem),
+        WRITE_FAIL raises (failed host write), TORN_CKPT truncates a
+        shard AFTER commit (crash mid-write on a non-atomic store),
+        DEAD_HOST withholds that rank's file while the manifest still
+        lists it.
+
+        Serialized on self._lock: the background writer and a
+        sync/final save must never interleave shard writes or run
+        _prune while the other is mid-write (prune reaps
+        manifest-less dirs — an in-progress one must not qualify)."""
+        delay = _fault_int('WRITE_DELAY_MS')
+        if delay:
+            time.sleep(delay / 1e3)
+        with self._lock:
+            self._write_checkpoint_locked(manifest, entries, step_dir,
+                                          snap_ms, background)
+
+    def _write_checkpoint_locked(self, manifest, entries, step_dir,
+                                 snap_ms, background):
+        from . import profiler
+        t0 = time.perf_counter()
+        if fault_knob('WRITE_FAIL') is not None:
+            raise MXNetError('injected host write failure '
+                             '(MXNET_TPU_FAULT_WRITE_FAIL)')
+        os.makedirs(step_dir, exist_ok=True)
+        if self._multiprocess():
+            # real multi-process run: THIS process writes exactly its
+            # rank's file.  Replicated entries (params / rng / full
+            # momenta) are identical everywhere, so only rank 0 keeps
+            # them; other ranks contribute their local ZeRO shards.
+            # The manifest (rank 0, after the barrier) lists every
+            # rank's file — a rank whose write never landed makes the
+            # checkpoint visibly incomplete at resume.
+            own = list(entries) if self.rank == 0 else \
+                [e for e in entries
+                 if e[0].startswith(('zmom:', 'zmaster:'))]
+            by_rank = {self.rank: own}
+            files = ['state-r%05d.bin' % r for r in range(self.world)]
+        else:
+            by_rank = {}
+            zcount = 0
+            for name, arr in entries:
+                if name.startswith(('zmom:', 'zmaster:')):
+                    r = self._rank_of_entry(name, zcount)
+                    zcount += 1
+                else:
+                    r = self._rank_of_entry(name, 0)
+                by_rank.setdefault(r, []).append((name, arr))
+            files = ['state-r%05d.bin' % r for r in sorted(by_rank)]
+        dead = dead_hosts()
+        total_bytes = 0
+        for r in sorted(by_rank):
+            fname = 'state-r%05d.bin' % r
+            if r in dead:
+                logging.warning('elastic: withholding shard %s (dead '
+                                'virtual host %d)', fname, r)
+                continue
+            nbytes, _crc = write_shard_file(
+                os.path.join(step_dir, fname), by_rank[r])
+            total_bytes += nbytes
+        manifest['files'] = files
+        self._barrier()     # all ranks' shards durable before commit
+        if self.rank == 0:
+            with atomic_file(os.path.join(step_dir, _MANIFEST),
+                             mode='w') as f:
+                json.dump(manifest, f)
+        if fault_knob('TORN_CKPT') is not None and by_rank:
+            # simulate a crash mid-write on a store without atomic
+            # rename: truncate the newest shard file IN PLACE after
+            # commit — resume must detect it and fall back
+            victim = os.path.join(step_dir,
+                                  'state-r%05d.bin' % sorted(by_rank)[0])
+            if os.path.isfile(victim):
+                sz = os.path.getsize(victim)
+                with open(victim, 'r+b') as f:
+                    f.truncate(max(1, sz // 2))
+                logging.warning('elastic: MXNET_TPU_FAULT_TORN_CKPT '
+                                'truncated %s', victim)
+        commit_ms = (time.perf_counter() - t0) * 1e3
+        profiler.add_ckpt_stats(
+            snapshots=1, bytes=total_bytes,
+            async_overlap_ms=commit_ms if background else 0.0,
+            commit_ms=commit_ms + snap_ms)
+        self._prune()
+
+    def _prune(self):
+        steps = list_checkpoints(self.directory)
+        doomed = [os.path.join(self.directory, _STEP_DIR % s)
+                  for s in steps[self.keep:]]
+        # orphans: step dirs a SIGKILL left without a manifest (shard
+        # files and atomic_file temps committed, commit point never
+        # reached).  They can never become valid, and a resumed run's
+        # step numbers may never realign to overwrite them — so any
+        # manifest-less dir OLDER than the newest real checkpoint is
+        # garbage (newer ones might be a write in flight; left alone)
+        newest = steps[0] if steps else None
+        valid = set(steps)
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            names = []
+        for n in names:
+            if not n.startswith('step-'):
+                continue
+            try:
+                s = int(n[5:])
+            except ValueError:
+                continue
+            if s not in valid and newest is not None and s < newest:
+                doomed.append(os.path.join(self.directory, n))
+        for d in doomed:
+            try:
+                for n in os.listdir(d):
+                    os.unlink(os.path.join(d, n))
+                os.rmdir(d)
+            except OSError as e:
+                logging.warning('elastic: retention prune of %s '
+                                'failed: %s', d, e)
+
+    def wait(self, timeout=None):
+        """Block until pending async writes are committed (deadline
+        default).  Returns True when drained, False on timeout."""
+        timeout = self.deadline if timeout is None else timeout
+        ok = self._idle.wait(timeout)
+        if self._writer_err is not None:
+            err, self._writer_err = self._writer_err, None
+            logging.warning('elastic: previous async write failed: %s',
+                            err)
+        return ok
+
+    def close(self, timeout=None):
+        """Drain and stop the writer thread (idempotent).  timeout
+        bounds the drain + join (default: the manager deadline)."""
+        timeout = self.deadline if timeout is None else timeout
+        self.wait(timeout)
+        if self._writer is not None and self._writer.is_alive():
+            self._queue.put(None)
+            self._writer.join(timeout=timeout)
+        self._writer = None
+        self.uninstall_signal_handlers()
+
+    def __del__(self):
+        try:
+            # bounded: interpreter exit must not stall for the full
+            # deadline behind a pending write (daemon writers are
+            # frozen at finalization anyway — an un-close()d manager's
+            # in-flight checkpoint is already best-effort)
+            self.close(timeout=2.0)
+        except Exception:
+            pass
+
+    # -- resume ------------------------------------------------------------
+    def resumable(self):
+        """True when the directory holds at least one checkpoint (its
+        integrity is only established by restore())."""
+        return bool(list_checkpoints(self.directory))
+
+    def restore(self, target=None, metric=None):
+        """Restore the newest INTACT checkpoint into the target
+        (params, aux, optimizer state — re-sharded for the target's
+        mode — RNG key, metric accumulation) and return its
+        ResumeInfo.  Returns None when no intact checkpoint exists.
+        The target must be bound / initialized (Module: bind +
+        init_params + init_optimizer first)."""
+        from . import profiler
+        t = self._require_target(target)
+        loaded = load_newest_intact(self.directory)
+        if loaded is None:
+            return None
+        manifest, arrays, ckpt_dir = loaded
+        _restore_params(t, arrays)
+        _restore_optimizer(t, manifest.get('opt', {}), arrays)
+        _restore_rng(t, arrays)
+        if metric is not None:
+            _restore_metric(metric, manifest.get('metric'))
+        info = ResumeInfo(manifest, ckpt_dir)
+        self._step = info.step
+        self._last_save_step = info.step
+        self._last_save_time = time.monotonic()
+        self._resumed = info
+        profiler.add_ckpt_stats(restores=1)
+        logging.info('elastic: resumed from %s (%r)', ckpt_dir, info)
+        return info
+
+
+# ---------------------------------------------------------------------------
+# Data-pipeline fast-forward (the PR-3 consumed-sample watermark)
+# ---------------------------------------------------------------------------
+
+def fast_forward(data_iter, epochs=0, batches=0, batch_size=None):
+    """Advance a data iterator to the resume point: `epochs` completed
+    epochs (reset() per epoch, so epoch-seeded augmentation streams
+    and shuffles line up with an uninterrupted run) then `batches`
+    consumed batches of the current epoch.  Iterators exposing the
+    positional consumed-sample watermark (ImageIter's parallel
+    pipeline) jump straight to the position without re-decoding; any
+    other DataIter is drained batch-by-batch — identical samples
+    either way (per-sample seeded streams / deterministic order).
+    Returns the number of batches skipped."""
+    for _ in range(int(epochs)):
+        data_iter.reset()
+    batches = int(batches)
+    if batches <= 0:
+        return 0
+    seq = getattr(data_iter, 'seq', None)
+    parallel = getattr(data_iter, '_parallel', None)
+    if seq is not None and batch_size and \
+            hasattr(data_iter, '_next_pos') and \
+            hasattr(data_iter, 'cur') and \
+            parallel is not None and parallel():
+        # positional jump — PARALLEL pipeline only: its augmentation
+        # streams are per-sample seeded (position-addressable), so
+        # skipping re-decodes nothing and changes nothing.  The
+        # sequential path draws from the process-global RNG, which
+        # only a real drain replays — it falls through below.
+        # (Same watermark-based restart ImageIter uses for pool
+        # restarts: close/_discard_inflight.)
+        pos = min(int(batches) * int(batch_size), len(seq))
+        data_iter.cur = pos
+        data_iter._next_pos = pos
+        data_iter._discard_inflight()
+        return batches
+    skipped = 0
+    for _ in range(batches):
+        try:
+            next(data_iter)
+        except StopIteration:
+            break
+        skipped += 1
+    return skipped
+
+
+def resume(manager, target, data_iter=None, metric=None,
+           batch_size=None):
+    """One-call preemption recovery: restore the newest intact
+    checkpoint into `target` via `manager` and fast-forward
+    `data_iter` to the consumed-sample watermark so the continuation
+    is bit-identical to the uninterrupted run.  Returns the
+    ResumeInfo (None = nothing to resume; training starts fresh)."""
+    info = manager.attach(target).restore(metric=metric)
+    if info is None:
+        return None
+    if data_iter is not None:
+        bs = batch_size or info.manifest.get('batch_size') or \
+            getattr(data_iter, 'batch_size', 0)
+        fast_forward(data_iter, epochs=info.epoch,
+                     batches=info.batches_in_epoch, batch_size=bs)
+    return info

@@ -76,7 +76,7 @@ from . import block as block_mod
 
 
 def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
-              ema_decay=None, interleave=None):
+              ema_decay=None, interleave=None, checkpoint=None):
     """Build (and register on `trainer`) a FusedStep compiling the
     whole train step for `net` into one donated XLA dispatch.
 
@@ -107,11 +107,22 @@ def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
     schedule (None = MXNET_TPU_INTERLEAVE_REDUCE; see
     parallel/collectives.GradReducePlan).
 
+    checkpoint: optional elastic.CheckpointManager — wires the
+    elastic runtime into the imperative loop: before the FIRST fused
+    dispatch the newest intact checkpoint (if any) restores into the
+    net + trainer (parameters, optimizer state re-sharded for this
+    run's mode, RNG key), and every dispatch afterwards feeds the
+    manager's cadence/preemption hook (k steps per bulk dispatch), so
+    a SIGTERM mid-loop commits a final checkpoint and raises
+    elastic.Preempted out of the fused call.  The DATA position is
+    the caller's to restore (`checkpoint.last_resume.step` says how
+    many optimizer steps already ran).
+
     After this call `trainer.step_fused(batch_size, *args)` also runs
     the fused step."""
     return FusedStep(net, loss, trainer, mesh=mesh, zero=zero,
                      metric=metric, ema_decay=ema_decay,
-                     interleave=interleave)
+                     interleave=interleave, checkpoint=checkpoint)
 
 
 class FusedStep:
@@ -121,7 +132,10 @@ class FusedStep:
     runs K steps on-device (leading axis of the stacked inputs)."""
 
     def __init__(self, net, loss, trainer, mesh=None, zero=None,
-                 metric=None, ema_decay=None, interleave=None):
+                 metric=None, ema_decay=None, interleave=None,
+                 checkpoint=None):
+        self._checkpoint = checkpoint
+        self._ckpt_resume_tried = False
         self._net = net
         self._loss = loss
         self._trainer = trainer
@@ -524,6 +538,21 @@ class FusedStep:
             batch_size = int(arrays[0].shape[1 if bulk else 0])
         self._collect_params()
         self._finish_deferred(arrays, bulk)
+        if self._checkpoint is not None and not self._ckpt_resume_tried:
+            # elastic resume: restore BEFORE the updater is built so
+            # the restored optimizer state applies at its creation
+            # (trainer._pending_fused_states).  Placement must happen
+            # FIRST: _restore_rng overwrites self._rng, which only
+            # exists after _place() — restoring earlier would silently
+            # drop the checkpointed key and replay dropout masks from
+            # the fresh seed (restored params re-replicate via the
+            # set_data staleness check, so placing early is safe)
+            self._ckpt_resume_tried = True
+            if not self._placed:
+                self._place()
+            self._checkpoint.attach(self)
+            if self._checkpoint.last_resume is None:
+                self._checkpoint.restore(metric=self._metric)
         fu = self._ensure_updater(batch_size)
         tr = self._trainer
         if tr._last_update_mode == 'unfused' and tr._updaters and \
@@ -626,6 +655,13 @@ class FusedStep:
             profiler.add_comm_bytes(reduce_scattered=rs * k,
                                     all_gathered=ag * k)
         profiler.set_optimizer_state_bytes(fu.state_bytes_per_device())
+        if self._checkpoint is not None:
+            # cadence / preemption hook: k optimizer steps ran in this
+            # dispatch; a pending SIGTERM commits the final checkpoint
+            # here (the snapshot copies queue behind the dispatch —
+            # that IS the drain) and raises Preempted
+            self._checkpoint.step_end(steps=k, batch_size=batch_size,
+                                      metric=self._metric, target=self)
         ctx = self._ctxs[0]
         out = [nd.NDArray(v, ctx) for v in loss_out]
         return jtu.tree_unflatten(self._loss_treedef, out)

@@ -187,8 +187,9 @@ class Trainer(object):
         so a fused run's states restore into an un-fused trainer and
         vice versa — including a save before the first step."""
         assert self._optimizer is not None
+        from ..base import atomic_file
         updater = self._checkpoint_updater()
-        with open(fname, 'wb') as f:
+        with atomic_file(fname) as f:
             f.write(updater.get_states())
 
     def _checkpoint_updater(self):

@@ -511,9 +511,12 @@ class _SampleSource(object):
 def _decode_pool_worker(source, task_q, results, cond, alive, cur_gen):
     """Decode-pool worker loop (module-level: holds only the shared
     cells, mirroring io._prefetch_worker's no-owner-pin design).
-    Tasks are (generation, chunk_id, [(key, aug_seed), ...]); results
-    land keyed by (generation, chunk_id), exceptions included — they
-    re-raise at the consumer's next()."""
+    Tasks are (generation, chunk_id, [(key, aug_seed, pos), ...]);
+    results land keyed by (generation, chunk_id), exceptions included
+    — they re-raise at the consumer's next() wrapped with the failing
+    record's key and epoch position (`.record_key` / `.position`
+    attributes), so a corrupt record in a million-sample .rec is
+    locatable from the traceback alone."""
     from .. import profiler
     while True:
         task = task_q.get()
@@ -524,8 +527,20 @@ def _decode_pool_worker(source, task_q, results, cond, alive, cur_gen):
             continue  # stale epoch: reset() already dropped this chunk
         t0 = time.perf_counter()
         try:
-            payload = (True, [source(key, aug_seed)
-                              for key, aug_seed in items])
+            samples = []
+            for key, aug_seed, pos in items:
+                try:
+                    samples.append(source(key, aug_seed))
+                except BaseException as e:  # noqa: B036
+                    wrapped = MXNetError(
+                        'decode worker failed on record key=%r '
+                        '(epoch position %d): %s: %s'
+                        % (key, pos, type(e).__name__, e))
+                    wrapped.record_key = key
+                    wrapped.position = pos
+                    wrapped.__cause__ = e
+                    raise wrapped
+            payload = (True, samples)
         except BaseException as e:  # noqa: B036 - re-raised at next()
             payload = (False, e)
         profiler.add_input_stats(
@@ -804,7 +819,8 @@ class ImageIter(mxio.DataIter):
                 self._max_outstanding and self._submit_pos < len(self.seq):
             hi = min(self._submit_pos + self._chunk_records, len(self.seq))
             items = [(self.seq[p],
-                      mxrandom.stream_seed('image-aug', self._epoch, p))
+                      mxrandom.stream_seed('image-aug', self._epoch, p),
+                      p)
                      for p in range(self._submit_pos, hi)]
             self._pool.submit(self._submit_chunk, items)
             self._chunk_ranges[self._submit_chunk] = hi

@@ -196,7 +196,8 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError('Cannot save states for distributed training')
-        with open(fname, 'wb') as fout:
+        from .base import atomic_file
+        with atomic_file(fname) as fout:
             fout.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
@@ -229,14 +230,25 @@ class KVStore:
     @property
     def num_dead_node(self):
         # Failure detection is the runtime's job on TPU (no ps-lite
-        # heartbeats, SURVEY.md §5.3); a live process implies a live mesh.
-        return 0
+        # heartbeats, SURVEY.md §5.3); a live process implies a live
+        # mesh — so outside fault injection this is 0.  The elastic
+        # fault harness (MXNET_TPU_FAULT_DEAD_HOST) reports its dead
+        # virtual hosts here, giving the reference
+        # KVStore::get_num_dead_node API honest semantics over
+        # injected failures (recovery = elastic checkpoint resume).
+        from . import elastic
+        return elastic.num_dead_node()
 
     def barrier(self):
         """Global barrier across workers.  Failures PROPAGATE: a failed
         barrier means the process group is broken, and silently
         continuing would let workers diverge (reference
-        ps::Postoffice::Barrier aborts the process on failure)."""
+        ps::Postoffice::Barrier aborts the process on failure).  A
+        (virtual) dead host makes the barrier fail fast instead of
+        hanging the collective — the elastic fault harness's honest
+        barrier semantics (recover via elastic.resume)."""
+        from . import elastic
+        elastic.check_barrier()
         if self._is_dist:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices('kvstore_barrier')
@@ -382,6 +394,8 @@ class KVStoreDistPS(KVStore):
         return self._num_workers_env
 
     def barrier(self):
+        from . import elastic
+        elastic.check_barrier()     # injected dead hosts fail fast
         self._client.barrier()
 
     def send_heartbeat(self):
@@ -390,8 +404,11 @@ class KVStoreDistPS(KVStore):
 
     def get_num_dead_node(self, node_id=0, timeout_sec=60):
         """Workers silent on the servers longer than timeout_sec
-        (reference KVStore::get_num_dead_node, kvstore.h:287)."""
-        return self._client.num_dead(timeout_sec)
+        (reference KVStore::get_num_dead_node, kvstore.h:287), plus
+        any dead VIRTUAL hosts the elastic fault harness injects."""
+        from . import elastic
+        return self._client.num_dead(timeout_sec) + \
+            elastic.num_dead_node()
 
     @property
     def num_dead_node(self):

@@ -101,14 +101,20 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
 
 
 def load_checkpoint(prefix, epoch):
-    """Load symbol + params (reference model.py load_checkpoint)."""
-    loaded = nd.load('%s-%04d.params' % (prefix, epoch))
+    """Load symbol + params (reference model.py load_checkpoint).
+    Truncated/corrupt param blobs raise a clear MXNetError from
+    nd.load (magic + per-entry length validation) instead of an
+    opaque unpacking traceback."""
+    from .base import MXNetError
+    param_file = '%s-%04d.params' % (prefix, epoch)
+    loaded = nd.load(param_file)
     split = {'arg': {}, 'aux': {}}
     for key, value in loaded.items():
         kind, _, name = key.partition(':')
         if kind not in split:
-            raise ValueError('invalid checkpoint key %r (expected '
-                             'arg:/aux: prefix)' % key)
+            raise MXNetError('invalid checkpoint key %r in %s '
+                             '(expected arg:/aux: prefix)'
+                             % (key, param_file))
         split[kind][name] = value
     return (sym.load('%s-symbol.json' % prefix),
             split['arg'], split['aux'])

@@ -7,6 +7,7 @@ port unchanged; per-batch work runs as one fused XLA step via the
 executor group.
 """
 import logging
+import threading
 import time
 from collections import namedtuple
 
@@ -149,7 +150,7 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, bulk=None):
+            monitor=None, bulk=None, checkpoint=None):
         """The training loop (reference base_module.py:376).
 
         bulk: optional K > 1 — run the epoch in K-step fused
@@ -159,7 +160,17 @@ class BaseModule:
         what the per-batch loop treats as metric/logging boundaries.
         batch_end_callback fires once per dispatch (nbatch advances by
         the group size); an installed monitor, or a metric without a
-        device fold, falls back to the per-batch loop."""
+        device fold, falls back to the per-batch loop.
+
+        checkpoint: optional elastic.CheckpointManager — enables the
+        elastic runtime: if its directory holds a checkpoint, training
+        RESUMES from the newest intact one (params, optimizer state,
+        RNG, partial-epoch metric; the data pipeline fast-forwards to
+        the consumed-sample watermark, so continuation is
+        bit-identical to the uninterrupted run); each step feeds the
+        cadence (async non-blocking snapshots); SIGTERM/SIGINT drains
+        the in-flight dispatch, commits a final checkpoint and raises
+        elastic.Preempted.  See docs/ELASTIC.md."""
         assert num_epoch is not None, 'please specify number of epochs'
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,
@@ -190,19 +201,93 @@ class BaseModule:
         if warm is not None:
             warm(bulk=int(bulk) if use_bulk else None,
                  eval_metric=eval_metric if use_bulk else None)
+        # elastic resume: restore the newest intact checkpoint and
+        # fast-forward the pipeline to its consumed-sample watermark —
+        # on the RAW iterator, BEFORE the prefetch wrapper hides the
+        # positional jump (ImageIter skips the consumed prefix without
+        # re-decoding it) — so the continuation is bit-identical to
+        # the uninterrupted run (metric state restores after the
+        # epoch's reset below)
+        resume_info = None
+        signals_installed_here = False
+        batch_size = getattr(train_data, 'batch_size', 0)
+        if checkpoint is not None:
+            from .. import elastic
+            checkpoint.attach(self)
+            if not checkpoint._old_handlers and \
+                    threading.current_thread() is \
+                    threading.main_thread():
+                checkpoint.install_signal_handlers()
+                signals_installed_here = True
+            resume_info = checkpoint.restore()
+            if resume_info is not None:
+                begin_epoch = max(begin_epoch, resume_info.epoch)
+                elastic.fast_forward(
+                    train_data, epochs=resume_info.epoch,
+                    batches=resume_info.batches_in_epoch,
+                    batch_size=batch_size)
+
         # stage upcoming batches device-resident so the H2D copy of
         # batch N+1 overlaps step N's compute (Module overrides; the
         # default is identity)
         train_data = self._wrap_train_iter(train_data)
 
+        def _ckpt_step(nbatch_done, steps, epoch):
+            """nbatch_done: ABSOLUTE batches consumed this epoch (the
+            resumed epoch's offset included) — the consumed-sample
+            watermark the manifest records."""
+            if checkpoint is None:
+                return
+            checkpoint.step_end(epoch=epoch,
+                                batches_in_epoch=nbatch_done,
+                                batch_size=batch_size, steps=steps,
+                                metric=eval_metric)
+
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, epoch_end_callback,
+                             batch_end_callback, eval_end_callback,
+                             eval_batch_end_callback, monitor,
+                             begin_epoch, num_epoch, use_bulk, bulk,
+                             resume_info, checkpoint, _ckpt_step)
+        finally:
+            if signals_installed_here:
+                # fit armed the handlers, fit disarms them: a Ctrl-C
+                # AFTER training must be a normal KeyboardInterrupt,
+                # not silently swallowed into a preempt flag no
+                # step_end will ever consume
+                checkpoint.uninstall_signal_handlers()
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, monitor, begin_epoch,
+                    num_epoch, use_bulk, bulk, resume_info, checkpoint,
+                    _ckpt_step):
+        """The epoch loop body of fit() (split out so fit can disarm
+        its signal handlers in one finally regardless of how the loop
+        exits — normal completion, Preempted, or an error)."""
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
             eval_metric.reset()
+            # the resumed epoch continues mid-stream: its partial
+            # metric restores and nbatch continues at the watermark so
+            # callbacks/manifests see the indices an uninterrupted run
+            # would
+            epoch_off = 0
+            if resume_info is not None and epoch == resume_info.epoch:
+                from .. import elastic
+                elastic._restore_metric(
+                    eval_metric, resume_info.manifest.get('metric'))
+                epoch_off = resume_info.batches_in_epoch
             if use_bulk:
                 self._fit_epoch_bulk(train_data, int(bulk), eval_metric,
-                                     batch_end_callback, epoch)
+                                     batch_end_callback, epoch,
+                                     step_cb=_ckpt_step,
+                                     nbatch0=epoch_off)
             else:
                 for nbatch, data_batch in enumerate(train_data):
+                    nbatch += epoch_off
                     if monitor is not None:
                         monitor.tic()
                     self.forward_backward(data_batch)
@@ -215,6 +300,7 @@ class BaseModule:
                               BatchEndParam(epoch=epoch, nbatch=nbatch,
                                             eval_metric=eval_metric,
                                             locals=locals()))
+                    _ckpt_step(nbatch + 1, 1, epoch)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
@@ -237,16 +323,34 @@ class BaseModule:
                     self.logger.info('Epoch[%d] Validation-%s=%f',
                                      epoch, name, val)
             train_data.reset()
+            if checkpoint is not None and checkpoint.preempted:
+                # a signal that landed AFTER the epoch's last step_end
+                # (during validation / callbacks) must not be
+                # swallowed: commit the epoch boundary as the final
+                # checkpoint and unwind — a resume replays from the
+                # start of the next epoch (or exits immediately when
+                # this was the last one)
+                from .. import elastic
+                ckpt = checkpoint.save(epoch=epoch + 1,
+                                       batches_in_epoch=0,
+                                       batch_size=0, sync=True)
+                raise elastic.Preempted(checkpoint.step, ckpt)
+        if checkpoint is not None:
+            checkpoint.wait()   # drain pending async commits
 
     def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
-                        batch_end_callback, epoch):
+                        batch_end_callback, epoch, step_cb=None,
+                        nbatch0=0):
         """One fit epoch in K-step fused dispatches: consecutive
         batches group into bulk_step calls (device-side lax.scan,
         device-resident metric accumulation, per-step lr schedules);
         the trailing partial group runs as a smaller dispatch.
         Callbacks fire once per dispatch with nbatch at the group's
-        last batch — the values a per-batch loop would show there."""
-        nbatch = 0
+        last batch — the values a per-batch loop would show there.
+        step_cb(nbatch_done, steps, epoch): elastic checkpoint hook,
+        fired once per dispatch.  nbatch0: batch counter start (the
+        resumed epoch's consumed-batch watermark)."""
+        nbatch = int(nbatch0)
         it = iter(train_data)
         group = []
         while True:
@@ -263,12 +367,15 @@ class BaseModule:
                 self.update_metric(eval_metric, group[0].label)
             else:
                 self.bulk_step(batches=group, eval_metric=eval_metric)
-            nbatch += len(group)
+            k = len(group)
+            nbatch += k
             if batch_end_callback is not None:
                 _fire(batch_end_callback,
                       BatchEndParam(epoch=epoch, nbatch=nbatch - 1,
                                     eval_metric=eval_metric,
                                     locals=locals()))
+            if step_cb is not None:
+                step_cb(nbatch, k, epoch)
             group = []
             if data_batch is None:
                 break

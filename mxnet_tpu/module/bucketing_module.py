@@ -533,14 +533,17 @@ class BucketingModule(BaseModule):
         self._note_rung_dispatch(steps=len(mapped))
 
     def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
-                        batch_end_callback, epoch):
+                        batch_end_callback, epoch, step_cb=None,
+                        nbatch0=0):
         """Bucket-aware K-step grouping for fit(bulk=K): consecutive
         batches mapping to the SAME ladder rung group into one
         bulk_step dispatch; a rung change flushes the group.
         BucketSentenceIter(bucket_major=True) orders epochs
         bucket-by-bucket so groups reach the full K even on mixed
-        data."""
-        state = {'nbatch': 0}
+        data.  step_cb(nbatch_done, steps, epoch): elastic checkpoint
+        hook, fired once per flushed group.  nbatch0: batch counter
+        start (the resumed epoch's consumed-batch watermark)."""
+        state = {'nbatch': int(nbatch0)}
         group = []
         group_rung = [None]
 
@@ -560,7 +563,8 @@ class BucketingModule(BaseModule):
                     self.forward_backward(b)
                     self.update()
                     self.update_metric(eval_metric, b.label)
-            state['nbatch'] += len(group)
+            k = len(group)
+            state['nbatch'] += k
             del group[:]
             if batch_end_callback is not None:
                 _fire(batch_end_callback,
@@ -568,6 +572,8 @@ class BucketingModule(BaseModule):
                                     nbatch=state['nbatch'] - 1,
                                     eval_metric=eval_metric,
                                     locals=locals()))
+            if step_cb is not None:
+                step_cb(state['nbatch'], k, epoch)
 
         for data_batch in train_data:
             rung = self._rung_for(data_batch.bucket_key)

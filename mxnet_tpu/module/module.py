@@ -847,8 +847,9 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
+            from ..base import atomic_file
             updater = self._fused_updater or self._updater
-            with open(fname, 'wb') as fout:
+            with atomic_file(fname) as fout:
                 fout.write(updater.get_states())
 
     def load_optimizer_states(self, fname):

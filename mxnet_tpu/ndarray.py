@@ -565,13 +565,19 @@ _SAVE_MAGIC = b'MXTPU001'
 
 
 def save(fname, data):
+    """Write via a same-directory temp file + os.replace (crash-safe):
+    a process killed mid-save leaves either the previous file or the
+    complete new one under `fname`, never a torn blob that a later
+    load would trust — the availability contract checkpoint callbacks
+    (callback.do_checkpoint, Module.save_checkpoint) rely on."""
+    from .base import atomic_file
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
         items = list(data.items())
     else:
         items = [('', v) for v in data]
-    with open(fname, 'wb') as f:
+    with atomic_file(fname) as f:
         f.write(_SAVE_MAGIC)
         f.write(struct.pack('<q', len(items)))
         for name, arr in items:
@@ -593,23 +599,68 @@ def save(fname, data):
             f.write(raw)
 
 
+def _load_fail(fname, why):
+    raise MXNetError('Truncated or corrupt NDArray file %s: %s '
+                     '(a crash mid-write, torn copy, or not an '
+                     'MXTPU params blob)' % (fname, why))
+
+
 def load(fname):
+    """Load a save() blob.  Every length field is validated before it
+    is trusted, so a truncated or bit-flipped file raises a clear
+    MXNetError naming the file instead of an opaque struct/reshape
+    traceback from deep inside the decoder."""
+    def read_exact(f, n, what):
+        b = f.read(n)
+        if len(b) != n:
+            _load_fail(fname, 'expected %d more byte(s) for %s, file '
+                       'ends after %d' % (n, what, len(b)))
+        return b
+
+    def read_len(f, what, limit=1 << 40):
+        v, = struct.unpack('<q', read_exact(f, 8, what))
+        if v < 0 or v > limit:
+            _load_fail(fname, 'implausible %s %d' % (what, v))
+        return v
+
     with open(fname, 'rb') as f:
         magic = f.read(len(_SAVE_MAGIC))
         if magic != _SAVE_MAGIC:
-            raise MXNetError('Invalid NDArray file format: %s' % fname)
-        n, = struct.unpack('<q', f.read(8))
+            _load_fail(fname, 'bad magic %r' % magic[:16])
+        n = read_len(f, 'entry count', limit=1 << 32)
         items = []
         named = False
-        for _ in range(n):
-            ln, = struct.unpack('<q', f.read(8))
-            name = f.read(ln).decode('utf-8')
-            ld, = struct.unpack('<q', f.read(8))
-            dt = np.dtype(f.read(ld).decode('utf-8'))
-            ndim, = struct.unpack('<q', f.read(8))
-            shape = struct.unpack('<%dq' % ndim, f.read(8 * ndim)) if ndim else ()
-            lr, = struct.unpack('<q', f.read(8))
-            a = np.frombuffer(f.read(lr), dtype=dt).reshape(shape)
+        for i in range(n):
+            what = 'entry %d/%d' % (i + 1, n)
+            ln = read_len(f, '%s name length' % what, limit=1 << 20)
+            try:
+                name = read_exact(f, ln, '%s name' % what) \
+                    .decode('utf-8')
+            except UnicodeDecodeError as e:
+                _load_fail(fname, 'bad name for %s (%s)' % (what, e))
+            ld = read_len(f, '%s dtype length' % what, limit=1 << 10)
+            try:
+                dt = np.dtype(read_exact(f, ld, '%s dtype' % what)
+                              .decode('utf-8'))
+            except (TypeError, ValueError, UnicodeDecodeError) as e:
+                _load_fail(fname, 'bad dtype for %s (%s)' % (what, e))
+            ndim = read_len(f, '%s ndim' % what, limit=64)
+            shape = struct.unpack(
+                '<%dq' % ndim,
+                read_exact(f, 8 * ndim, '%s shape' % what)) \
+                if ndim else ()
+            if any(s < 0 for s in shape):
+                _load_fail(fname, 'negative dim in %s shape %s'
+                           % (what, shape))
+            lr = read_len(f, '%s payload length' % what)
+            expect = int(np.prod(shape, dtype=np.int64)) * dt.itemsize \
+                if shape else dt.itemsize
+            if lr != expect:
+                _load_fail(fname, '%s payload is %d bytes but shape %s '
+                           'dtype %s needs %d' % (what, lr, shape,
+                                                  dt.name, expect))
+            a = np.frombuffer(read_exact(f, lr, '%s payload' % what),
+                              dtype=dt).reshape(shape)
             if name:
                 named = True
             # honor the stored dtype exactly (no float64/int64 narrowing)

@@ -229,6 +229,49 @@ def bucketing_stats():
     return out
 
 
+# elastic-checkpoint counters (elastic.CheckpointManager): snapshots
+# committed, payload bytes written, host-side materialize+write wall
+# time that ran on the background writer WHILE training continued
+# (ckpt_async_overlap_ms — an upper bound on the overlap, like
+# overlap_window_ms; 0 for synchronous/final commits), end-to-end
+# commit time, torn/incomplete checkpoints skipped at resume, restores
+# performed, cadence snapshots skipped because a write was in flight,
+# and injected/real write failures survived
+_CKPT = {
+    'ckpt_snapshots': 0,
+    'ckpt_bytes': 0,
+    'ckpt_async_overlap_ms': 0.0,
+    'ckpt_commit_ms': 0.0,
+    'ckpt_torn_fallbacks': 0,
+    'ckpt_restores': 0,
+    'ckpt_skipped': 0,
+    'ckpt_failed_writes': 0,
+}
+
+
+def add_ckpt_stats(snapshots=0, bytes=0, async_overlap_ms=0.0,
+                   commit_ms=0.0, torn_fallbacks=0, restores=0,
+                   skipped=0, failed_writes=0):
+    """Accumulate elastic-checkpoint counters (the CheckpointManager's
+    writer/resume paths feed one call per event)."""
+    with _STATE['lock']:
+        _CKPT['ckpt_snapshots'] += int(snapshots)
+        _CKPT['ckpt_bytes'] += int(bytes)
+        _CKPT['ckpt_async_overlap_ms'] += float(async_overlap_ms)
+        _CKPT['ckpt_commit_ms'] += float(commit_ms)
+        _CKPT['ckpt_torn_fallbacks'] += int(torn_fallbacks)
+        _CKPT['ckpt_restores'] += int(restores)
+        _CKPT['ckpt_skipped'] += int(skipped)
+        _CKPT['ckpt_failed_writes'] += int(failed_writes)
+
+
+def ckpt_stats():
+    """Snapshot of the elastic-checkpoint counters (also merged into
+    summary() and dump_profile 'checkpoint' metadata)."""
+    with _STATE['lock']:
+        return dict(_CKPT)
+
+
 # serving-engine counters (serving.InferenceEngine's dynamic batcher):
 # coalesced dispatches, batch fill / pad waste, batcher queue depth
 # observations, and a bounded ring of request latencies for p50/p99
@@ -375,6 +418,8 @@ def dump_profile():
                    'args': gluon_fused_stats()})
     events.append({'ph': 'M', 'name': 'bucketing', 'pid': 0,
                    'args': bucketing_stats()})
+    events.append({'ph': 'M', 'name': 'checkpoint', 'pid': 0,
+                   'args': ckpt_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -500,6 +545,15 @@ def summary(print_out=True):
                      % (rung, e['steps'], e['dispatches'],
                         e['compiles'], e['warmups'],
                         e['warm_compiles']))
+    ck = ckpt_stats()
+    lines.append('  ckpt_snapshots=%d ckpt_bytes=%d '
+                 'ckpt_async_overlap_ms=%.3f ckpt_commit_ms=%.3f '
+                 'ckpt_torn_fallbacks=%d ckpt_restores=%d '
+                 'ckpt_skipped=%d ckpt_failed_writes=%d'
+                 % (ck['ckpt_snapshots'], ck['ckpt_bytes'],
+                    ck['ckpt_async_overlap_ms'], ck['ckpt_commit_ms'],
+                    ck['ckpt_torn_fallbacks'], ck['ckpt_restores'],
+                    ck['ckpt_skipped'], ck['ckpt_failed_writes']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -536,6 +590,8 @@ def clear():
             _GLUON_FUSED[k] = 0
         for k in _BUCKET:
             _BUCKET[k] = 0
+        for k in _CKPT:
+            _CKPT[k] = type(_CKPT[k])()
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

@@ -410,7 +410,8 @@ class Symbol:
                           indent=2)
 
     def save(self, fname):
-        with open(fname, 'w') as f:
+        from .base import atomic_file
+        with atomic_file(fname, mode='w') as f:
             f.write(self.tojson())
 
     # -- binding -----------------------------------------------------------

@@ -45,14 +45,20 @@ def main():
                         'smoke (legacy per-bucket loop vs fused '
                         'bucket ladder vs bulked ladder; one bench.py '
                         'child) instead of the model-family sweep')
+    p.add_argument('--ckpt', action='store_true',
+                   help='run the BENCH_CKPT elastic-checkpoint '
+                        'overhead A/B (no-checkpoint vs async cadence '
+                        'vs blocking cadence; one bench.py child) '
+                        'instead of the model-family sweep')
     args = p.parse_args()
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
-    if args.gluon or args.overlap or args.bucket:
+    if args.gluon or args.overlap or args.bucket or args.ckpt:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
-                     else ('bucket', 'BENCH_BUCKET'))
+                     else ('bucket', 'BENCH_BUCKET') if args.bucket
+                     else ('ckpt', 'BENCH_CKPT'))
         env = dict(os.environ, **{var: '1'})
         proc = subprocess.run([sys.executable, bench_py], env=env,
                               capture_output=True, text=True)
