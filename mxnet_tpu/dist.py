@@ -1,0 +1,900 @@
+"""Multi-host distributed runtime: coordinator bootstrap, health-checked
+barriers, coordinated elastic restart.
+
+The reference's multi-host story is the ps-lite tracker stack
+(SURVEY.md §3.4/§5.8): a scheduler process, the DMLC_ROLE env contract,
+a startup barrier across worker+server+scheduler, and heartbeat-driven
+GetDeadNodes.  On a TPU build that whole stack collapses into a tiny
+coordinator bootstrap — the role jax.distributed's coordination service
+plays — but the ROBUSTNESS contract must survive the collapse:
+
+  * **Bootstrap** — `dist.initialize()` reads the DMLC_* env contract
+    `tools/launch.py` exports (DMLC_PS_ROOT_URI / MXNET_TPU_DIST_PORT,
+    DMLC_WORKER_ID, DMLC_NUM_WORKER).  Rank 0 hosts the coordinator
+    (like jax.distributed's process 0); every rank connects with
+    retry + exponential backoff under a hard deadline
+    (MXNET_TPU_DIST_INIT_TIMEOUT_S): a late-starting worker or a
+    briefly unreachable coordinator never aborts the job, a
+    permanently absent one produces a clear MXNetError naming the
+    coordinator address / the missing ranks (the startup barrier),
+    never a hang.
+  * **Health** — a per-host heartbeat thread feeds a coordinator-side
+    liveness table; a rank silent longer than
+    MXNET_TPU_DIST_DEAD_AFTER_S is marked dead and every surviving
+    rank learns of it on its next heartbeat (the reply piggybacks the
+    dead set).  `elastic.num_dead_node()` / `KVStore.num_dead_node`
+    therefore report REAL cross-process deaths, and every barrier
+    carries a timeout (MXNET_TPU_BARRIER_TIMEOUT_S) that raises an
+    MXNetError naming which ranks failed to arrive.
+  * **Coordinated elastic restart** — a CheckpointManager registered
+    via `runtime.watch(mgr)` (Module.fit / gluon.fuse_step do this
+    automatically) is preempted when heartbeat loss reveals a dead
+    rank: the next step boundary drains the in-flight dispatch,
+    commits a final elastic checkpoint and raises `elastic.Preempted`
+    carrying the dead-rank set; the process exits PREEMPTED_EXIT so a
+    `tools/launch.py --elastic` supervisor relaunches at equal (or
+    `--elastic-shrink` reduced) world size and resumes bit-exact from
+    the mode-portable checkpoints.
+  * **Composition** — with real multi-host SPMD (jax.distributed,
+    opt-in via MXNET_TPU_DIST_JAX=1 / automatic on TPU pods) the
+    in-step GSPMD collectives span hosts and this runtime contributes
+    bootstrap + health only.  Without it (this rig; independent
+    processes over DCN), `dist.allreduce` is the coordinator-mediated
+    gradient sum: the KVStore `dist_sync` facade cross-host-sums the
+    mesh-reduced gradients once per step (`push_pull_all` batches
+    every key into ONE round trip), so data parallelism spans hosts
+    while each host keeps its in-step GSPMD allreduce / GradReducePlan
+    / ZeRO-1 mesh program locally.  The KVStore
+    rank/size/barrier/num_dead_node API stays the facade either way.
+
+Transport reuses the kvstore_server framing (length-prefixed,
+HMAC/Poly1305-tagged frames, restricted codec — see its trust-boundary
+note); the coordinator is ~the scheduler role of the reference's
+tracker, minus any data-path involvement in SPMD mode.
+
+Fault injection (tests + dryrun): MXNET_TPU_FAULT_HEARTBEAT_DROP
+suppresses a rank's heartbeats without killing it;
+MXNET_TPU_FAULT_BARRIER_STALL_S makes one rank arrive late;
+MXNET_TPU_FAULT_KILL_RANK gates KILL_AT_STEP to one rank.  Counters:
+profiler.dist_stats().  Docs: docs/DIST.md.
+"""
+import logging
+import os
+import socket
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from .base import MXNetError
+from .kvstore_server import _recv_msg, _send_msg, _tune_sock_bufs
+
+# exit code a preempted worker should use so a supervising
+# tools/launch.py --elastic treats it as restartable (EX_TEMPFAIL)
+PREEMPTED_EXIT = 75
+
+
+# ---------------------------------------------------------------------------
+# env knobs
+# ---------------------------------------------------------------------------
+
+def _env_float(name, default):
+    v = os.environ.get(name, '').strip()
+    if not v:
+        return float(default)
+    try:
+        return float(v)
+    except ValueError:
+        logging.warning('dist: ignoring non-numeric %s=%r', name, v)
+        return float(default)
+
+
+def init_timeout_s():
+    """Hard deadline for bootstrap (connect retry + startup barrier)."""
+    return _env_float('MXNET_TPU_DIST_INIT_TIMEOUT_S', 60.0)
+
+
+def barrier_timeout_s():
+    """Default barrier deadline: a rank that has not arrived by then
+    is named in the MXNetError instead of hanging the job."""
+    return _env_float('MXNET_TPU_BARRIER_TIMEOUT_S', 60.0)
+
+
+def heartbeat_interval_s():
+    return _env_float('MXNET_TPU_DIST_HEARTBEAT_S', 1.0)
+
+
+def dead_after_s():
+    """Silence threshold before a rank is declared dead (default 5
+    heartbeat intervals)."""
+    return _env_float('MXNET_TPU_DIST_DEAD_AFTER_S',
+                      5.0 * heartbeat_interval_s())
+
+
+# ---------------------------------------------------------------------------
+# coordinator (the collapsed scheduler/tracker role)
+# ---------------------------------------------------------------------------
+
+class Coordinator(object):
+    """Rank-0-hosted control-plane service: liveness table, named
+    barriers with deadlines, and the host-level allreduce.  One
+    handler thread per connection; all state under one condition
+    variable.  The coordinator never touches the SPMD data path — in
+    jax.distributed mode it is bootstrap + health only."""
+
+    def __init__(self, port=0, world=1, bind_addr=None,
+                 dead_after=None):
+        from .kvstore_server import KVStoreServer
+        self.world = int(world)
+        self.dead_after = dead_after_s() if dead_after is None \
+            else float(dead_after)
+        self._cv = threading.Condition()
+        self._last_seen = {}          # rank -> time.monotonic()
+        self._registered = set()
+        self._departed = set()        # clean byes (not deaths)
+        self._dead = set()            # sticky
+        self._barriers = {}           # name -> {'gen': int, 'arrived': set}
+        self._reduces = {}            # (name, round) -> round state
+        self._stopped = False
+        self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if bind_addr is None:
+            bind_addr = os.environ.get(
+                'DMLC_PS_BIND_URI',
+                os.environ.get('DMLC_PS_ROOT_URI', '127.0.0.1'))
+        # same trust boundary as the PS servers: a non-loopback bind
+        # without a real DMLC_PS_TOKEN refuses to start (the derived
+        # frame key authenticates nothing off-host)
+        KVStoreServer._check_bind_policy(bind_addr)
+        try:
+            self.listener.bind((bind_addr, port))
+        except OSError as e:
+            import errno
+            if e.errno != errno.EADDRNOTAVAIL and \
+                    not isinstance(e, socket.gaierror):
+                raise
+            # rank 0 on a different host than the advertised rendezvous
+            # address: fall back to all interfaces (token required)
+            KVStoreServer._check_bind_policy('')
+            self.listener.bind(('', port))
+        self.listener.listen(4 * self.world + 8)
+        self.port = self.listener.getsockname()[1]
+        self._accept_thread = None
+
+    # -- liveness ----------------------------------------------------------
+    def _scan_dead_locked(self):
+        """Mark registered ranks silent past the threshold dead.
+        Called under self._cv from every handler that cares — the
+        clients' heartbeat cadence is the clock, no timer thread."""
+        now = time.monotonic()
+        newly = [r for r, t in self._last_seen.items()
+                 if r not in self._departed and r not in self._dead and
+                 now - t > self.dead_after]
+        if newly:
+            self._dead.update(newly)
+            logging.warning('dist coordinator: rank(s) %s declared dead '
+                            '(no heartbeat for > %.1fs)', sorted(newly),
+                            self.dead_after)
+            self._cv.notify_all()
+
+    def _members_locked(self, live_only):
+        """Ranks a barrier/allreduce must hear from."""
+        members = set(range(self.world)) - self._departed
+        if live_only:
+            members -= self._dead
+        return members
+
+    # -- handlers ----------------------------------------------------------
+    def _handle_hello(self, rank):
+        rank = int(rank)
+        if not 0 <= rank < self.world:
+            return ('err', 'rank %d outside world size %d'
+                           % (rank, self.world))
+        with self._cv:
+            self._registered.add(rank)
+            self._departed.discard(rank)
+            self._last_seen[rank] = time.monotonic()
+            self._cv.notify_all()
+        return ('ok', self.world)
+
+    def _handle_heartbeat(self, rank):
+        with self._cv:
+            self._last_seen[int(rank)] = time.monotonic()
+            self._scan_dead_locked()
+            return ('ok', sorted(self._dead))
+
+    def _handle_dead(self):
+        with self._cv:
+            self._scan_dead_locked()
+            return ('ok', sorted(self._dead))
+
+    def _handle_bye(self, rank):
+        with self._cv:
+            self._departed.add(int(rank))
+            self._cv.notify_all()
+        return ('ok',)
+
+    def _handle_barrier(self, name, rank, timeout, live_only):
+        """Health-checked barrier: completes when every member rank
+        has arrived for the current generation; FAILS (instead of
+        hanging) when a member is dead (live_only=False) or the
+        deadline passes — the error names the offending ranks."""
+        rank = int(rank)
+        deadline = time.monotonic() + float(timeout)
+        with self._cv:
+            ent = self._barriers.setdefault(
+                str(name), {'gen': 0, 'arrived': set()})
+            gen = ent['gen']
+            ent['arrived'].add(rank)
+            self._last_seen[rank] = time.monotonic()
+            self._cv.notify_all()
+            while True:
+                self._scan_dead_locked()
+                if ent['gen'] != gen:
+                    return ('ok',)          # released by another arriver
+                members = self._members_locked(live_only)
+                if not live_only:
+                    dead_members = sorted(self._dead & members)
+                    if dead_members:
+                        return ('err',
+                                'barrier %r failed: rank(s) %s are dead '
+                                '(no heartbeat for > %.1fs) — recover '
+                                'via coordinated elastic restart'
+                                % (name, dead_members, self.dead_after))
+                if ent['arrived'] >= members:
+                    ent['gen'] += 1
+                    ent['arrived'] = set()
+                    self._cv.notify_all()
+                    return ('ok',)
+                now = time.monotonic()
+                if now >= deadline:
+                    absent = sorted(members - ent['arrived'])
+                    return ('err',
+                            'barrier %r timed out after %.1fs: rank(s) '
+                            '%s never arrived (%d of %d present).  Set '
+                            'MXNET_TPU_BARRIER_TIMEOUT_S to wait '
+                            'longer.' % (name, float(timeout), absent,
+                                         len(ent['arrived']),
+                                         len(members)))
+                self._cv.wait(min(0.2, deadline - now))
+
+    def _handle_allreduce(self, name, rnd, rank, values, timeout):
+        """Host-level sum over live ranks: each rank contributes a
+        tuple of arrays for (name, round); the last contributor sums
+        (deterministic rank order — every rank receives IDENTICAL
+        bytes) and all waiters are released with the result.  A rank
+        dying mid-round fails the round with an actionable error."""
+        rank = int(rank)
+        key = (str(name), int(rnd))
+        deadline = time.monotonic() + float(timeout)
+        values = tuple(np.ascontiguousarray(v) for v in values)
+        with self._cv:
+            ent = self._reduces.setdefault(
+                key, {'parts': {}, 'result': None, 'error': None,
+                      'summing': False, 'fetched': set()})
+            ent['parts'][rank] = values
+            self._last_seen[rank] = time.monotonic()
+            self._cv.notify_all()
+            while ent['result'] is None:
+                if ent['error'] is not None:
+                    return ('err', ent['error'])
+                self._scan_dead_locked()
+                members = self._members_locked(live_only=False)
+                dead_members = sorted(self._dead & members)
+                if dead_members:
+                    self._reduces.pop(key, None)
+                    return ('err',
+                            'allreduce %r failed: rank(s) %s died '
+                            'mid-round — recover via coordinated '
+                            'elastic restart' % (name, dead_members))
+                if set(ent['parts']) >= members and \
+                        not ent['summing']:
+                    # this handler computes the sum OUTSIDE the lock:
+                    # a multi-MB accumulation must not block the
+                    # heartbeat handlers behind the condition variable
+                    # (live ranks would be falsely declared dead).
+                    # RANK order, not arrival order — every run sums
+                    # identically, so restart parity stays bitwise.
+                    ent['summing'] = True
+                    ent['members'] = set(ent['parts'])
+                    parts = ent['parts']
+                    self._cv.release()
+                    err = sums = None
+                    try:
+                        ranks = sorted(parts)
+                        sums = []
+                        for i in range(len(parts[ranks[0]])):
+                            acc = parts[ranks[0]][i].copy()
+                            for r in ranks[1:]:
+                                acc += parts[r][i]
+                            sums.append(acc)
+                    except Exception as e:   # mismatched shapes etc.
+                        err = ('allreduce %r failed to sum: %s'
+                               % (name, e))
+                    finally:
+                        self._cv.acquire()
+                    if err is not None:
+                        ent['error'] = err
+                        self._cv.notify_all()
+                        return ('err', err)
+                    ent['result'] = tuple(sums)
+                    ent['parts'] = {}    # free the per-rank copies
+                    self._cv.notify_all()
+                    break
+                now = time.monotonic()
+                if now >= deadline:
+                    absent = sorted(members - set(ent['parts']))
+                    return ('err',
+                            'allreduce %r timed out after %.1fs: '
+                            'rank(s) %s never contributed'
+                            % (name, float(timeout), absent))
+                self._cv.wait(min(0.2, deadline - now))
+            result = ent['result']
+            ent['fetched'].add(rank)
+            if ent['fetched'] >= ent['members']:
+                self._reduces.pop(key, None)
+            return ('ok', result)
+
+    # -- connection loop ---------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                op = msg[0]
+                if op == 'hello':
+                    reply = self._handle_hello(msg[1])
+                elif op == 'heartbeat':
+                    reply = self._handle_heartbeat(msg[1])
+                elif op == 'dead':
+                    reply = self._handle_dead()
+                elif op == 'barrier':
+                    reply = self._handle_barrier(msg[1], msg[2], msg[3],
+                                                 bool(msg[4]))
+                elif op == 'allreduce':
+                    reply = self._handle_allreduce(msg[1], msg[2],
+                                                   msg[3], msg[4],
+                                                   msg[5])
+                elif op == 'bye':
+                    reply = self._handle_bye(msg[1])
+                elif op == 'stop':
+                    with self._cv:
+                        self._stopped = True
+                        self._cv.notify_all()
+                    _send_msg(conn, ('ok',))
+                    break
+                else:
+                    reply = ('err', 'unknown dist op %r' % (op,))
+                _send_msg(conn, reply)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def start(self):
+        """Begin accepting connections (daemon accept thread)."""
+        if self._accept_thread is not None:
+            return self
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name='dist-coordinator',
+            daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        self.listener.settimeout(0.2)
+        while True:
+            with self._cv:
+                if self._stopped:
+                    break
+            try:
+                conn, _ = self.listener.accept()
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_sock_bufs(conn)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+    def stop(self):
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# per-process runtime (client + optional embedded coordinator)
+# ---------------------------------------------------------------------------
+
+class DistRuntime(object):
+    """One process's view of the job: rank/world, the coordinator
+    connections (one for control RPCs, one the heartbeat thread owns —
+    a long barrier must never starve liveness), the locally-known dead
+    set, and the watched CheckpointManagers to preempt on death."""
+
+    def __init__(self, rank, world, address='127.0.0.1', port=None,
+                 start_coordinator=None, timeout=None,
+                 heartbeat=True, hb_interval=None, dead_after=None):
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        self.address = address
+        self.coordinator = None
+        self._owns_coordinator = False
+        self._closed = False
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        # control RPCs use one socket PER THREAD (threading.local): a
+        # writer thread waiting out a checkpoint-commit barrier must
+        # never stall the train thread's per-step allreduce behind a
+        # shared-socket lock
+        self._tls = threading.local()
+        self._socks = []
+        self._socks_lock = threading.Lock()
+        self._known_dead = set()
+        self._dead_lock = threading.Lock()
+        self._watched = weakref.WeakSet()
+        self._round = {}              # allreduce name -> round counter
+        self._hb_interval = heartbeat_interval_s() if hb_interval is None \
+            else float(hb_interval)
+        self._dead_after = dead_after_s() if dead_after is None \
+            else float(dead_after)
+        timeout = init_timeout_s() if timeout is None else float(timeout)
+        deadline = time.monotonic() + timeout
+        if start_coordinator is None:
+            start_coordinator = self.rank == 0
+        if start_coordinator:
+            self.coordinator = self._bind_coordinator(port, deadline)
+            self._owns_coordinator = True
+            port = self.coordinator.port
+            self.address = '127.0.0.1'   # connect to ourselves locally
+        if port is None:
+            raise MXNetError('dist: no coordinator port (set '
+                             'MXNET_TPU_DIST_PORT or DMLC_PS_ROOT_PORT)')
+        self.port = int(port)
+        self._hb_sock = None
+        try:
+            self._tls.sock = self._connect_retry(deadline, 'control')
+            with self._socks_lock:
+                self._socks.append(self._tls.sock)
+            self._rpc('hello', self.rank)
+            self._hb_sock = self._connect_retry(deadline, 'heartbeat')
+            # startup barrier: every rank must check in before training
+            # starts (the reference's worker+server+scheduler barrier
+            # role).  A missing rank is NAMED within the remaining
+            # init deadline.
+            remaining = max(1.0, deadline - time.monotonic())
+            self.barrier('__startup__', timeout=remaining)
+        except BaseException:
+            # failed bootstrap must not leak the embedded coordinator
+            # or half-open sockets (the error is the deliverable)
+            for s in self._socks + [self._hb_sock]:
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+            if self._owns_coordinator and self.coordinator is not None:
+                self.coordinator.stop()
+            raise
+        if heartbeat:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, name='dist-heartbeat', daemon=True)
+            self._hb_thread.start()
+
+    # -- bootstrap ---------------------------------------------------------
+    def _bind_coordinator(self, port, deadline):
+        """Bind-with-retry: a just-died previous round's coordinator
+        may briefly linger on the port (elastic relaunch)."""
+        delay = 0.1
+        while True:
+            try:
+                return Coordinator(port=port or 0, world=self.world,
+                                   dead_after=self._dead_after).start()
+            except OSError as e:
+                if time.monotonic() >= deadline:
+                    raise MXNetError(
+                        'dist.initialize: rank 0 could not bind the '
+                        'coordinator port %s: %s' % (port, e))
+                time.sleep(delay)
+                delay = min(2.0, delay * 2)
+
+    def _connect_retry(self, deadline, purpose):
+        """Connect with exponential backoff under the hard deadline —
+        a late-starting coordinator is tolerated, a permanently absent
+        one produces a clear error naming the address, never a hang."""
+        delay = 0.05
+        last_err = None
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise MXNetError(
+                    'dist.initialize: rank %d could not reach the '
+                    'coordinator at %s:%d within the '
+                    'MXNET_TPU_DIST_INIT_TIMEOUT_S deadline (%s '
+                    'connection; last error: %s).  Is rank 0 up?'
+                    % (self.rank, self.address, self.port, purpose,
+                       last_err))
+            try:
+                s = socket.create_connection(
+                    (self.address, self.port),
+                    timeout=min(5.0, max(0.1, budget)))
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_sock_bufs(s)
+                s.settimeout(None)
+                return s
+            except OSError as e:
+                last_err = e
+                time.sleep(min(delay, max(0.0, budget)))
+                delay = min(2.0, delay * 2)
+
+    # -- RPC plumbing ------------------------------------------------------
+    def _control_sock(self):
+        """This thread's control connection (created on first use —
+        the coordinator serves one handler thread per connection, so
+        per-thread sockets need no client-side locking)."""
+        s = getattr(self._tls, 'sock', None)
+        if s is None:
+            s = self._connect_retry(time.monotonic() + 5.0,
+                                    'control (reconnect)')
+            self._tls.sock = s
+            with self._socks_lock:
+                self._socks.append(s)
+        return s
+
+    def _drop_sock(self, sock):
+        """A timed-out or errored connection is DESYNCHRONIZED — a
+        late reply would be read as the NEXT request's answer — so it
+        must be closed and forgotten; the next call reconnects
+        fresh."""
+        try:
+            sock.close()
+        except OSError:
+            pass
+        if getattr(self._tls, 'sock', None) is sock:
+            self._tls.sock = None
+        if self._hb_sock is sock:
+            self._hb_sock = None
+        with self._socks_lock:
+            try:
+                self._socks.remove(sock)
+            except ValueError:
+                pass
+
+    def _rpc(self, *msg, **kw):
+        sock = kw.pop('sock', None)
+        timeout = kw.pop('timeout', None)
+        assert not kw
+        sock = self._control_sock() if sock is None else sock
+        old = sock.gettimeout()
+        try:
+            sock.settimeout(timeout)
+            _send_msg(sock, msg)
+            reply = _recv_msg(sock)
+        except socket.timeout:
+            self._drop_sock(sock)
+            raise MXNetError(
+                'dist: coordinator at %s:%d did not answer %r '
+                'within %.1fs' % (self.address, self.port, msg[0],
+                                  timeout))
+        except (ConnectionError, OSError) as e:
+            self._drop_sock(sock)
+            raise MXNetError(
+                'dist: lost the coordinator at %s:%d during %r: %s'
+                % (self.address, self.port, msg[0], e))
+        finally:
+            try:
+                sock.settimeout(old)
+            except OSError:
+                pass
+        if reply[0] != 'ok':
+            raise MXNetError(reply[1])
+        return reply[1] if len(reply) > 1 else None
+
+    # -- health ------------------------------------------------------------
+    def _note_dead(self, ranks):
+        """Record newly-learned deaths; preempt every watched
+        CheckpointManager ONCE per new set (their next step_end drains
+        the in-flight dispatch, commits the final checkpoint and
+        raises elastic.Preempted with the dead-rank set)."""
+        from . import profiler
+        with self._dead_lock:
+            new = set(int(r) for r in ranks) - self._known_dead
+            if not new:
+                return
+            self._known_dead.update(new)
+            dead_now = frozenset(self._known_dead)
+        profiler.add_dist_stats(dead_hosts_detected=len(new))
+        logging.warning('dist: rank %d learned of dead rank(s) %s — '
+                        'requesting coordinated preemption',
+                        self.rank, sorted(new))
+        for mgr in list(self._watched):
+            try:
+                mgr.request_preempt(dead_ranks=dead_now)
+            except Exception as e:   # never kill the heartbeat thread
+                logging.warning('dist: preempt request failed: %s', e)
+
+    def _hb_loop(self):
+        from . import elastic, profiler
+        miss_since = None
+        # a WEDGED (not vanished) coordinator blocks each attempt for
+        # the full RPC timeout, so the miss budget must be WALL TIME,
+        # not a miss count — and the per-attempt timeout must not
+        # dwarf the configured death deadline
+        rpc_timeout = max(2 * self._hb_interval,
+                          min(5.0, self._dead_after))
+        while not self._hb_stop.wait(self._hb_interval):
+            if self.rank in elastic.heartbeat_drop_ranks():
+                # injected network partition: this rank neither sends
+                # heartbeats nor learns the dead set (it will be the
+                # one DECLARED dead by everyone else)
+                profiler.add_dist_stats(heartbeats_missed=1)
+                continue
+            try:
+                if self._hb_sock is None:   # dropped after a timeout
+                    self._hb_sock = self._connect_retry(
+                        time.monotonic() + rpc_timeout,
+                        'heartbeat (reconnect)')
+                dead = self._rpc('heartbeat', self.rank,
+                                 sock=self._hb_sock,
+                                 timeout=rpc_timeout)
+                profiler.add_dist_stats(heartbeats_sent=1)
+                miss_since = None
+                if dead:
+                    self._note_dead(dead)
+            except MXNetError:
+                if self._closed:
+                    return
+                profiler.add_dist_stats(heartbeats_missed=1)
+                if miss_since is None:
+                    miss_since = time.monotonic()
+                # the coordinator (rank 0) is unreachable: after the
+                # same silence threshold a dead WORKER gets, declare
+                # rank 0 dead and preempt — survivors must not spin
+                # forever against a vanished coordinator
+                if time.monotonic() - miss_since >= self._dead_after \
+                        and self.rank != 0:
+                    self._note_dead([0])
+                    return
+
+    def dead_ranks(self):
+        """Locally-known dead ranks (kept fresh by the heartbeat
+        thread; cheap — no RPC)."""
+        with self._dead_lock:
+            return frozenset(self._known_dead)
+
+    def poll_dead(self):
+        """Explicitly query the coordinator's liveness table."""
+        dead = self._rpc('dead', timeout=30.0) or ()
+        if dead:
+            self._note_dead(dead)
+        return self.dead_ranks()
+
+    def num_dead(self):
+        return len(self.dead_ranks())
+
+    def watch(self, manager):
+        """Register a CheckpointManager for coordinated preemption on
+        heartbeat-detected death (weakly held)."""
+        self._watched.add(manager)
+        return manager
+
+    def unwatch(self, manager):
+        self._watched.discard(manager)
+
+    # -- barriers ----------------------------------------------------------
+    def barrier(self, name='user', timeout=None, live_only=False):
+        """Global health-checked barrier.  Raises MXNetError naming
+        the ranks that failed to arrive within `timeout` (default
+        MXNET_TPU_BARRIER_TIMEOUT_S) or that died while waiting —
+        never hangs.  live_only=True lets the barrier complete over
+        the surviving ranks (the elastic checkpoint-commit barrier)."""
+        from . import elastic, profiler
+        timeout = barrier_timeout_s() if timeout is None else \
+            float(timeout)
+        stall = elastic.barrier_stall_s(self.rank)
+        if stall:
+            logging.warning('dist: MXNET_TPU_FAULT_BARRIER_STALL_S '
+                            'delaying rank %d by %.1fs', self.rank,
+                            stall)
+            time.sleep(stall)
+        t0 = time.perf_counter()
+        try:
+            self._rpc('barrier', str(name), self.rank, float(timeout),
+                      bool(live_only), timeout=timeout + 15.0)
+        finally:
+            profiler.add_dist_stats(
+                barriers=1,
+                barrier_wait_ms=(time.perf_counter() - t0) * 1e3)
+
+    # -- host-level allreduce (the DCN dp leg) -----------------------------
+    def allreduce(self, arrays, name='grad', timeout=None):
+        """Sum `arrays` (list of np.ndarray) across all ranks through
+        the coordinator; every rank receives bit-identical results.
+        Identity at world 1.  Raises (naming ranks) on death/timeout
+        instead of hanging."""
+        from . import profiler
+        arrays = [np.asarray(a) for a in arrays]
+        if self.world <= 1:
+            return arrays
+        timeout = barrier_timeout_s() if timeout is None else \
+            float(timeout)
+        rnd = self._round[name] = self._round.get(name, 0) + 1
+        out = self._rpc('allreduce', str(name), rnd, self.rank,
+                        tuple(arrays), float(timeout),
+                        timeout=timeout + 15.0)
+        profiler.add_dist_stats(
+            allreduce_rounds=1,
+            allreduce_bytes=sum(a.nbytes for a in arrays))
+        return [np.asarray(v) for v in out]
+
+    # -- teardown ----------------------------------------------------------
+    def shutdown(self):
+        """Clean exit: deregister (a bye is not a death), stop the
+        heartbeat thread, close sockets, stop an owned coordinator."""
+        if self._closed:
+            return
+        self._closed = True
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+        try:
+            self._rpc('bye', self.rank, timeout=5.0)
+        except MXNetError:
+            pass
+        with self._socks_lock:
+            socks = list(self._socks) + [self._hb_sock]
+        for s in socks:
+            if s is None:
+                continue
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._owns_coordinator and self.coordinator is not None:
+            # wait (bounded) until every peer has said bye or been
+            # declared dead before the listener dies: a slower rank
+            # may still be fetching the last round's allreduce result
+            # or entering its final barrier, and killing the
+            # coordinator under it would turn a clean finish into a
+            # crash at the very last step
+            coord = self.coordinator
+            deadline = time.monotonic() + 10.0
+            others = set(range(self.world)) - {self.rank}
+            with coord._cv:
+                while time.monotonic() < deadline and \
+                        not others <= (coord._departed | coord._dead):
+                    coord._cv.wait(0.2)
+            coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# process-level singleton
+# ---------------------------------------------------------------------------
+
+_RUNTIME = None
+
+
+def initialize(rank=None, world=None, address=None, port=None,
+               timeout=None, heartbeat=True):
+    """Bootstrap this process into the job (idempotent).  Defaults
+    come from the tools/launch.py env contract: DMLC_WORKER_ID /
+    DMLC_NUM_WORKER / DMLC_PS_ROOT_URI / MXNET_TPU_DIST_PORT (falling
+    back to DMLC_PS_ROOT_PORT).  Rank 0 hosts the coordinator.  With
+    MXNET_TPU_DIST_JAX=1 also performs jax.distributed.initialize so
+    the in-step GSPMD collectives span hosts (real multi-host SPMD);
+    without it, cross-host data parallelism rides `dist.allreduce`
+    through the KVStore facade.  Returns the DistRuntime."""
+    global _RUNTIME
+    if _RUNTIME is not None:
+        return _RUNTIME
+    from . import profiler
+    env = os.environ
+    rank = int(env.get('DMLC_WORKER_ID', 0)) if rank is None else int(rank)
+    world = int(env.get('DMLC_NUM_WORKER', 1)) if world is None \
+        else int(world)
+    address = address or env.get('DMLC_PS_ROOT_URI', '127.0.0.1')
+    if port is None:
+        p = env.get('MXNET_TPU_DIST_PORT') or env.get('DMLC_PS_ROOT_PORT')
+        port = int(p) if p else None
+    if env.get('MXNET_TPU_DIST_JAX', '').strip() in ('1', 'true'):
+        import jax
+        jax_addr = env.get('MXNET_TPU_DIST_JAX_ADDR') or \
+            '%s:%d' % (address, (port or 9090) + 1)
+        jax.distributed.initialize(coordinator_address=jax_addr,
+                                   num_processes=world, process_id=rank)
+    _RUNTIME = DistRuntime(rank, world, address=address, port=port,
+                           timeout=timeout, heartbeat=heartbeat)
+    restarts = env.get('MXNET_TPU_DIST_RESTART_COUNT', '').strip()
+    if restarts:
+        try:
+            profiler.add_dist_stats(restarts=int(restarts))
+        except ValueError:
+            pass
+    logging.info('dist: initialized rank %d of %d (coordinator %s:%d)',
+                 _RUNTIME.rank, _RUNTIME.world, _RUNTIME.address,
+                 _RUNTIME.port)
+    return _RUNTIME
+
+
+def runtime():
+    """The process's DistRuntime, or None before initialize()."""
+    return _RUNTIME
+
+
+def rank():
+    return _RUNTIME.rank if _RUNTIME is not None else 0
+
+
+def world():
+    return _RUNTIME.world if _RUNTIME is not None else 1
+
+
+def dead_ranks():
+    """Real cross-process deaths this process knows of (empty set when
+    the runtime is not initialized)."""
+    return _RUNTIME.dead_ranks() if _RUNTIME is not None else frozenset()
+
+
+def detect_dead():
+    """Dead ranks, refreshing from the coordinator when the local
+    heartbeat view is still empty — a cross-host step can fail on a
+    death the coordinator noticed before this rank's next heartbeat
+    reply delivered it.  An unreachable coordinator counts as rank 0
+    dead (it lives in rank 0's process)."""
+    if _RUNTIME is None:
+        return frozenset()
+    dead = _RUNTIME.dead_ranks()
+    if dead:
+        return dead
+    try:
+        return _RUNTIME.poll_dead()
+    except MXNetError:
+        return frozenset() if _RUNTIME.rank == 0 else frozenset({0})
+
+
+def barrier(name='user', timeout=None):
+    if _RUNTIME is None:
+        return
+    _RUNTIME.barrier(name, timeout=timeout)
+
+
+def allreduce(arrays, name='grad'):
+    if _RUNTIME is None:
+        return [np.asarray(a) for a in arrays]
+    return _RUNTIME.allreduce(arrays, name=name)
+
+
+def host_span_active():
+    """True when cross-host data parallelism must ride the host-level
+    `dist.allreduce` (runtime up, but the processes are NOT one
+    jax.distributed SPMD program — each host runs its own mesh
+    program and gradients cross hosts through the coordinator).  Under
+    real multi-host SPMD (jax.process_count() > 1) the in-step GSPMD
+    collectives already span hosts and this returns False."""
+    if _RUNTIME is None:
+        return False
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return False
+    except Exception:
+        pass
+    return True
+
+
+def shutdown():
+    """Tear down the process runtime (idempotent)."""
+    global _RUNTIME
+    rt, _RUNTIME = _RUNTIME, None
+    if rt is not None:
+        rt.shutdown()

@@ -75,13 +75,20 @@ FORMAT_VERSION = 1
 
 
 class Preempted(MXNetError):
-    """Raised (out of fit / step_end) after a preemption signal once
-    the final checkpoint has been committed."""
+    """Raised (out of fit / step_end) after a preemption signal — or
+    after heartbeat loss revealed dead ranks (dist runtime) — once the
+    final checkpoint has been committed.  `dead_ranks` carries the set
+    of ranks whose death triggered the coordinated restart (empty for
+    signal-driven preemptions); a tools/launch.py --elastic supervisor
+    relaunches at equal-or-reduced world size and resumes."""
 
-    def __init__(self, step, checkpoint_dir=None):
-        super().__init__(
-            'training preempted at step %d (final checkpoint: %s)'
-            % (step, checkpoint_dir))
+    def __init__(self, step, checkpoint_dir=None, dead_ranks=None):
+        self.dead_ranks = frozenset(int(r) for r in (dead_ranks or ()))
+        msg = ('training preempted at step %d (final checkpoint: %s)'
+               % (step, checkpoint_dir))
+        if self.dead_ranks:
+            msg += '; dead rank(s): %s' % sorted(self.dead_ranks)
+        super().__init__(msg)
         self.step = step
         self.checkpoint_dir = checkpoint_dir
 
@@ -106,12 +113,11 @@ def _fault_int(name):
         return None
 
 
-def dead_hosts():
-    """Virtual ranks declared dead via MXNET_TPU_FAULT_DEAD_HOST
-    (comma-separated rank list).  Their checkpoint shards are withheld
-    (the host died before its write landed) and the KVStore facade
-    reports them through num_dead_node / fails barrier."""
-    v = fault_knob('DEAD_HOST')
+def _fault_rank_set(name):
+    """Comma-separated rank list of MXNET_TPU_FAULT_<name> as a
+    frozenset (non-integer entries ignored) — the one parser every
+    rank-list fault knob shares."""
+    v = fault_knob(name)
     if v is None:
         return frozenset()
     out = set()
@@ -125,24 +131,67 @@ def dead_hosts():
     return frozenset(out)
 
 
+def dead_hosts():
+    """Virtual ranks declared dead via MXNET_TPU_FAULT_DEAD_HOST
+    (comma-separated rank list).  Their checkpoint shards are withheld
+    (the host died before its write landed) and the KVStore facade
+    reports them through num_dead_node / fails barrier."""
+    return _fault_rank_set('DEAD_HOST')
+
+
+def heartbeat_drop_ranks():
+    """Ranks whose heartbeats are suppressed WITHOUT killing the
+    process (MXNET_TPU_FAULT_HEARTBEAT_DROP, comma-separated rank
+    list) — the injected network partition the dist runtime's
+    detection path must catch: everyone else declares the silent rank
+    dead within the deadline."""
+    return _fault_rank_set('HEARTBEAT_DROP')
+
+
+def barrier_stall_s(rank):
+    """Injected late barrier arrival (MXNET_TPU_FAULT_BARRIER_STALL_S):
+    'R:SECS' stalls only rank R; a bare 'SECS' stalls every rank.
+    Returns the stall for `rank` in seconds, or None."""
+    v = fault_knob('BARRIER_STALL_S')
+    if v is None:
+        return None
+    try:
+        if ':' in str(v):
+            r, secs = str(v).split(':', 1)
+            return float(secs) if int(r) == int(rank) else None
+        return float(v)
+    except ValueError:
+        return None
+
+
 def num_dead_node():
-    """Dead-node count the KVStore facade reports: real detection is
-    the runtime's job on TPU (a live process implies a live mesh), so
-    outside fault injection this is 0."""
-    return len(dead_hosts())
+    """Dead-node count the KVStore facade reports: REAL cross-process
+    deaths detected by the dist runtime's heartbeat table, plus any
+    virtual hosts the fault harness injects.  0 outside failures."""
+    from . import dist
+    return len(dead_hosts() | dist.dead_ranks())
 
 
 def check_barrier():
-    """Raise when a barrier cannot logically complete because a
-    (virtual) host is dead — the honest ps::Postoffice::Barrier
-    semantics over the fault harness (a real dead host would hang the
-    collective; failing fast is the recoverable behavior)."""
+    """Raise when a barrier cannot logically complete because a host
+    is dead — injected (MXNET_TPU_FAULT_DEAD_HOST) or REAL
+    (heartbeat-detected by the dist runtime).  The honest
+    ps::Postoffice::Barrier semantics: a dead host would hang the
+    collective; failing fast with the rank set named is the
+    recoverable behavior."""
     dead = dead_hosts()
     if dead:
         raise MXNetError(
             'barrier failed: %d dead node(s) %s (MXNET_TPU_FAULT_'
             'DEAD_HOST) — recover via elastic checkpoint resume'
             % (len(dead), sorted(dead)))
+    from . import dist
+    real = dist.dead_ranks()
+    if real:
+        raise MXNetError(
+            'barrier failed: rank(s) %s are dead (heartbeat loss) — '
+            'recover via coordinated elastic restart'
+            % sorted(real))
 
 
 # ---------------------------------------------------------------------------
@@ -350,8 +399,16 @@ def _updater_of(target):
     if hasattr(target, '_updaters'):             # bare gluon Trainer
         per_key = target._updaters[0] if target._updaters else None
         return target._fused_updater, per_key
-    return getattr(target, '_fused_updater', None), \
-        getattr(target, '_updater', None)
+    per_key = getattr(target, '_updater', None)
+    if per_key is None:
+        # update_on_kvstore: the optimizer state lives in the STORE's
+        # local updater (kvstore.set_optimizer), e.g. the dist_sync
+        # host-allreduce path — without this, momenta silently vanish
+        # from every update_on_kvstore checkpoint
+        kv = getattr(target, '_kvstore', None)
+        per_key = getattr(kv, '_updater', None) if kv is not None \
+            else None
+    return getattr(target, '_fused_updater', None), per_key
 
 
 def _capture_params(target):
@@ -533,7 +590,13 @@ def _assemble_optimizer(meta, arrays):
 
 
 def _restore_optimizer(target, meta, arrays):
-    asm = _assemble_optimizer(meta, arrays)
+    _apply_optimizer(target, _assemble_optimizer(meta, arrays))
+
+
+def _apply_optimizer(target, asm):
+    """Install a pre-assembled (and therefore pre-VALIDATED) optimizer
+    state — assembly is split out so restore() can reject an
+    incomplete checkpoint BEFORE any target mutation."""
     if asm is None:
         return
     fu, per_key = _updater_of(target)
@@ -604,6 +667,18 @@ def _restore_params(target, arrays):
     auxs = {k[4:]: nd.NDArray(np.asarray(v)) for k, v in arrays.items()
             if k.startswith('aux:')}
     target.set_params(args, auxs, allow_missing=True, force_init=True)
+    kv = getattr(target, '_kvstore', None)
+    if kv is not None and getattr(target, '_update_on_kvstore', False):
+        # update_on_kvstore: the STORE's copy of the weights is what
+        # the updater reads and the post-step pull hands back — left
+        # stale (init-time values from _initialize_kvstore, which ran
+        # before this restore), the very first resumed step would
+        # silently overwrite the restored parameters
+        from . import kvstore as kvs_mod
+        if type(kv) is kvs_mod.KVStore and hasattr(kv, '_store'):
+            for name, v in args.items():
+                if name in kv._store:
+                    kv._store[name] = v.copy()
 
 
 def _restore_rng(target, arrays):
@@ -703,16 +778,21 @@ def _load_one(ckpt_dir):
     return manifest, arrays
 
 
-def load_newest_intact(directory):
+def load_newest_intact(directory, validate=None):
     """(manifest, arrays, ckpt_dir) of the newest checkpoint that
     validates end-to-end, falling back past torn/incomplete ones
     (counted in profiler ckpt_torn_fallbacks).  None when the
-    directory holds no intact checkpoint."""
+    directory holds no intact checkpoint.  `validate(manifest,
+    arrays)` may run extra pre-acceptance checks — an MXNetError it
+    raises falls back the same way (restore() assembly-validates the
+    optimizer here, BEFORE any target mutation)."""
     from . import profiler
     for step in list_checkpoints(directory):
         ckpt_dir = os.path.join(directory, _STEP_DIR % step)
         try:
             manifest, arrays = _load_one(ckpt_dir)
+            if validate is not None:
+                validate(manifest, arrays)
             return manifest, arrays, ckpt_dir
         except MXNetError as e:
             logging.warning('elastic: skipping checkpoint %s: %s',
@@ -752,12 +832,21 @@ class CheckpointManager(object):
         self.async_ = bool(async_)
         self.deadline = float(deadline)
         if rank is None or world is None:
-            try:
-                import jax
-                rank = jax.process_index() if rank is None else rank
-                world = jax.process_count() if world is None else world
-            except Exception:
-                rank, world = rank or 0, world or 1
+            from . import dist
+            rt = dist.runtime()
+            if rt is not None:
+                # the dist runtime's rank/world IS the multi-host
+                # identity (each launched process owns its shard file)
+                rank = rt.rank if rank is None else rank
+                world = rt.world if world is None else world
+            else:
+                try:
+                    import jax
+                    rank = jax.process_index() if rank is None else rank
+                    world = jax.process_count() if world is None \
+                        else world
+                except Exception:
+                    rank, world = rank or 0, world or 1
         self.rank = int(rank)
         self.world = max(1, int(world))
         self._target = None
@@ -766,6 +855,7 @@ class CheckpointManager(object):
         self._last_save_time = time.monotonic()
         self._preempt = threading.Event()
         self._preempt_signum = None
+        self._preempt_dead = frozenset()
         self._old_handlers = {}
         self._queue = queue.Queue(maxsize=2)
         self._idle = threading.Event()
@@ -833,11 +923,22 @@ class CheckpointManager(object):
                 pass
         self._old_handlers = {}
 
-    def request_preempt(self):
-        """Programmatic preemption (what the signal handler does) —
-        the next step_end commits a final checkpoint and raises
-        Preempted."""
+    def request_preempt(self, dead_ranks=None):
+        """Programmatic preemption (what the signal handler — and the
+        dist runtime's heartbeat thread on detecting dead ranks —
+        does): the next step_end drains the in-flight dispatch,
+        commits a final checkpoint and raises Preempted carrying
+        `dead_ranks`."""
+        if dead_ranks:
+            self._preempt_dead = frozenset(
+                int(r) for r in dead_ranks)
         self._preempt.set()
+
+    @property
+    def preempt_dead_ranks(self):
+        """Dead ranks attached to a pending/raised preemption (empty
+        for signal-driven ones)."""
+        return self._preempt_dead
 
     # -- cadence -----------------------------------------------------------
     def _due(self):
@@ -863,11 +964,16 @@ class CheckpointManager(object):
         K)."""
         self._step += int(steps)
         kill_at = _fault_int('KILL_AT_STEP')
-        if kill_at is not None and self._step >= kill_at:
+        kill_rank = _fault_int('KILL_RANK')
+        if kill_at is not None and self._step >= kill_at and \
+                (kill_rank is None or kill_rank == self.rank):
             # simulated preemption WITHOUT warning: SIGKILL self (the
-            # resume path must work from the last cadence checkpoint)
+            # resume path must work from the last cadence checkpoint).
+            # KILL_RANK gates the kill to one rank of a launched job —
+            # the machine-loss half of the coordinated-restart matrix.
             logging.warning('elastic: MXNET_TPU_FAULT_KILL_AT_STEP=%d '
-                            'firing at step %d', kill_at, self._step)
+                            'firing at step %d (rank %d)', kill_at,
+                            self._step, self.rank)
             os.kill(os.getpid(), signal.SIGKILL)
         samples = int(batches_in_epoch) * int(batch_size)
         if self._preempt.is_set():
@@ -875,7 +981,8 @@ class CheckpointManager(object):
                              batches_in_epoch=batches_in_epoch,
                              batch_size=batch_size, metric=metric,
                              rung=rung, target=target, sync=True)
-            raise Preempted(self._step, ckpt)
+            raise Preempted(self._step, ckpt,
+                            dead_ranks=self._preempt_dead)
         if self._due():
             self.save(epoch=epoch, batches_in_epoch=batches_in_epoch,
                       batch_size=batch_size, metric=metric, rung=rung,
@@ -895,9 +1002,16 @@ class CheckpointManager(object):
         skipped — training must not stall on a slow filesystem)."""
         from . import profiler
         t = self._require_target(target)
-        if not sync and not self._idle.is_set():
+        if not sync and not self._idle.is_set() and \
+                not self._multiprocess():
             # never stall training on a slow filesystem: drop this
-            # cadence snapshot (retried next step while still due)
+            # cadence snapshot (retried next step while still due).
+            # MULTIPROCESS runs must NOT skip independently: every
+            # rank has to take the same snapshots or the cross-rank
+            # shard sets (and the commit-barrier generations) diverge
+            # and no checkpoint ever assembles complete — there the
+            # bounded writer queue absorbs the lag instead (the
+            # enqueue below blocks only once two writes are pending)
             logging.info('elastic: skipping checkpoint at step %d '
                          '(previous write still in flight)',
                          self._step)
@@ -976,14 +1090,19 @@ class CheckpointManager(object):
 
     @staticmethod
     def _multiprocess():
-        """True on a REAL multi-process jax run (each process then
-        owns exactly its rank's shard file; the single-process case —
-        including the virtual-host harness — splits entries itself)."""
+        """True on a REAL multi-process run — a jax.distributed SPMD
+        job or a dist-runtime (coordinator) job — where each process
+        owns exactly its rank's shard file.  The single-process case,
+        including the virtual-host harness, splits entries itself."""
         try:
             import jax
-            return jax.process_count() > 1
+            if jax.process_count() > 1:
+                return True
         except Exception:
-            return False
+            pass
+        from . import dist
+        rt = dist.runtime()
+        return rt is not None and rt.world > 1
 
     def _rank_of_entry(self, name, ordinal):
         """Which virtual rank's shard file an entry lands in
@@ -999,15 +1118,28 @@ class CheckpointManager(object):
         return 0
 
     def _barrier(self):
-        """Cross-process sync before the rank-0 manifest commit (all
-        shards must be durable first).  No-op single-process."""
-        if self._multiprocess():
-            try:
+        """Cross-process sync before the lead-rank manifest commit
+        (all shards must be durable first).  Under the dist runtime
+        this is a LIVE-ONLY coordinator barrier — survivors of a dead
+        rank can still commit their final checkpoint.  No-op
+        single-process; best-effort either way (a failed barrier must
+        not lose the checkpoint a survivor is about to commit)."""
+        if not self._multiprocess():
+            return
+        from . import dist
+        rt = dist.runtime()
+        try:
+            if rt is not None:
+                # bounded by the manager deadline: a desynced peer
+                # (skipped cadence save) must not pin the writer
+                # thread for the full barrier default
+                rt.barrier('elastic_ckpt', live_only=True,
+                           timeout=self.deadline)
+            else:
                 from jax.experimental import multihost_utils
                 multihost_utils.sync_global_devices('elastic_ckpt')
-            except Exception as e:
-                logging.warning('elastic: checkpoint barrier failed: '
-                                '%s', e)
+        except Exception as e:
+            logging.warning('elastic: checkpoint barrier failed: %s', e)
 
     def _write_checkpoint(self, manifest, entries, step_dir, snap_ms,
                           background):
@@ -1039,19 +1171,28 @@ class CheckpointManager(object):
             raise MXNetError('injected host write failure '
                              '(MXNET_TPU_FAULT_WRITE_FAIL)')
         os.makedirs(step_dir, exist_ok=True)
+        lead = 0
         if self._multiprocess():
             # real multi-process run: THIS process writes exactly its
             # rank's file.  Replicated entries (params / rng / full
-            # momenta) are identical everywhere, so only rank 0 keeps
-            # them; other ranks contribute their local ZeRO shards.
-            # The manifest (rank 0, after the barrier) lists every
-            # rank's file — a rank whose write never landed makes the
-            # checkpoint visibly incomplete at resume.
-            own = list(entries) if self.rank == 0 else \
+            # momenta) are identical everywhere, so only the LEAD rank
+            # — the lowest LIVE one; rank 0 may be the casualty —
+            # keeps them; other ranks contribute their local ZeRO
+            # shards.  The manifest (lead rank, after the live-only
+            # barrier) lists every LIVE rank's file: a dead rank's
+            # unique shards are gone with its machine (an older
+            # complete checkpoint covers them at resume), while listing
+            # a file that can never land would make every post-death
+            # checkpoint permanently unloadable.
+            from . import dist
+            gone = dead_hosts() | dist.dead_ranks()
+            live = [r for r in range(self.world) if r not in gone]
+            lead = min(live) if live else self.rank
+            own = list(entries) if self.rank == lead else \
                 [e for e in entries
                  if e[0].startswith(('zmom:', 'zmaster:'))]
             by_rank = {self.rank: own}
-            files = ['state-r%05d.bin' % r for r in range(self.world)]
+            files = ['state-r%05d.bin' % r for r in live]
         else:
             by_rank = {}
             zcount = 0
@@ -1076,7 +1217,7 @@ class CheckpointManager(object):
             total_bytes += nbytes
         manifest['files'] = files
         self._barrier()     # all ranks' shards durable before commit
-        if self.rank == 0:
+        if self.rank == lead:
             with atomic_file(os.path.join(step_dir, _MANIFEST),
                              mode='w') as f:
                 json.dump(manifest, f)
@@ -1097,7 +1238,11 @@ class CheckpointManager(object):
             snapshots=1, bytes=total_bytes,
             async_overlap_ms=commit_ms if background else 0.0,
             commit_ms=commit_ms + snap_ms)
-        self._prune()
+        if self.rank == lead:
+            # one pruner: concurrent ranks racing unlinks over the
+            # shared directory is pure noise (the lead also wrote the
+            # manifest, so its view of "newest" is authoritative)
+            self._prune()
 
     def _prune(self):
         steps = list_checkpoints(self.directory)
@@ -1180,12 +1325,25 @@ class CheckpointManager(object):
         init_params + init_optimizer first)."""
         from . import profiler
         t = self._require_target(target)
-        loaded = load_newest_intact(self.directory)
+        asm_box = {}
+
+        def _validate(manifest, arrays):
+            # assemble the optimizer state BEFORE mutating the
+            # target: a live-only final checkpoint can list (and
+            # checksum-validate) only the surviving ranks' files
+            # while a dead rank's UNIQUE ZeRO shards are gone —
+            # bucket-coverage validation must make such a checkpoint
+            # fall back to an older complete one, not crash the
+            # resume after params were overwritten
+            asm_box['asm'] = _assemble_optimizer(
+                manifest.get('opt', {}), arrays)
+
+        loaded = load_newest_intact(self.directory, validate=_validate)
         if loaded is None:
             return None
         manifest, arrays, ckpt_dir = loaded
         _restore_params(t, arrays)
-        _restore_optimizer(t, manifest.get('opt', {}), arrays)
+        _apply_optimizer(t, asm_box['asm'])
         _restore_rng(t, arrays)
         if metric is not None:
             _restore_metric(metric, manifest.get('metric'))

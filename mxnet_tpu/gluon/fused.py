@@ -551,6 +551,13 @@ class FusedStep:
             if not self._placed:
                 self._place()
             self._checkpoint.attach(self)
+            # coordinated elastic restart: a heartbeat-detected peer
+            # death preempts this manager — the next step_end commits
+            # the final checkpoint and raises Preempted(dead_ranks)
+            from .. import dist
+            rt = dist.runtime()
+            if rt is not None:
+                rt.watch(self._checkpoint)
             if self._checkpoint.last_resume is None:
                 self._checkpoint.restore(metric=self._metric)
         fu = self._ensure_updater(batch_size)

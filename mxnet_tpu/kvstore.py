@@ -82,21 +82,54 @@ class KVStore:
         with profiler.scope('kvstore_push', 'kvstore'):
             self._push_impl(key, value, priority)
 
-    def _push_impl(self, key, value, priority=0):
-        import jax
+    @staticmethod
+    def _merge_local(vlist):
+        """Sum a (possibly multi-device) gradient list to ONE stacked
+        reduction instead of a Python left-fold of n-1 sequential adds
+        (each a separate dispatch forming a serial dependency chain)."""
+        if len(vlist) == 1:
+            return vlist[0]
         import jax.numpy as jnp
+        return nd.NDArray(
+            jnp.sum(jnp.stack([v._data for v in vlist]), axis=0),
+            vlist[0].context)
+
+    def _cross_host_sum(self, merged_list):
+        """The DCN-spanning dp leg: sum the (already locally
+        mesh-reduced) gradients across worker PROCESSES through the
+        dist runtime's coordinator allreduce — the caller batches
+        however many keys it has into this ONE round.  Identity when
+        the processes are one jax.distributed SPMD program (the
+        in-step GSPMD allreduce already spans hosts) or when no
+        runtime is up."""
+        if not self._is_dist:
+            return merged_list
+        from . import dist
+        if not dist.host_span_active():
+            return merged_list
+        # NOTE: no world-1 short-circuit on purpose.  The host round
+        # trip does double duty: at world 1 the sum is the identity,
+        # but rebuilding the gradient from host bytes also pins it to
+        # the default device — the SAME placement every other world
+        # size produces — so the eager updater math downstream never
+        # sees a mesh-replicated grad meet a single-device momentum
+        # (jit refuses mixed placements).  A shrunk-to-1 elastic
+        # relaunch must behave exactly like its world>1 predecessor.
+        import jax.numpy as jnp
+        sums = dist.allreduce([v.asnumpy() for v in merged_list],
+                              name='kv_grad')
+        return [nd.NDArray(jnp.asarray(s), v.context)
+                for s, v in zip(sums, merged_list)]
+
+    def _push_impl(self, key, value, priority=0, _cross_summed=False):
+        import jax
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError('key %s not initialized' % str(k))
-            merged = vlist[0]
-            if len(vlist) > 1:
-                # one stacked reduction instead of a Python left-fold
-                # of n-1 sequential adds (each a separate dispatch
-                # forming a serial dependency chain)
-                merged = nd.NDArray(
-                    jnp.sum(jnp.stack([v._data for v in vlist]),
-                            axis=0), vlist[0].context)
+            merged = self._merge_local(vlist)
+            if not _cross_summed:
+                merged = self._cross_host_sum([merged])[0]
             if self._updater is not None:
                 # gradients produced by a mesh-sharded step arrive
                 # replicated over the mesh; the stored weight may live
@@ -146,8 +179,19 @@ class KVStore:
         """Push every gradient, then pull every weight — the per-step
         kvstore round as ONE call so dist stores can batch the wire
         protocol (reference: ps-lite batches ZPush/ZPull at the engine
-        level, kvstore_dist.h:123-149).  Local semantics are identical
-        to the per-key push/pull loop."""
+        level, kvstore_dist.h:123-149).  Under the dist runtime's
+        host-allreduce mode every key's cross-host sum rides ONE
+        coordinator round trip per step.  Local semantics are
+        identical to the per-key push/pull loop."""
+        from . import dist
+        if self._is_dist and dist.host_span_active():
+            merged = [self._merge_local(g if isinstance(g, list)
+                                        else [g]) for g in grad_lists]
+            merged = self._cross_host_sum(merged)
+            for k, m, o in zip(keys, merged, out_lists):
+                self._push_impl(k, m, _cross_summed=True)
+                self.pull(k, o)
+            return
         for k, g, o in zip(keys, grad_lists, out_lists):
             self.push(k, g)
             self.pull(k, o)
@@ -210,6 +254,10 @@ class KVStore:
     @property
     def rank(self):
         if self._is_dist:
+            from . import dist
+            rt = dist.runtime()
+            if rt is not None:
+                return rt.rank
             import jax
             return jax.process_index()
         return 0
@@ -217,6 +265,10 @@ class KVStore:
     @property
     def num_workers(self):
         if self._is_dist:
+            from . import dist
+            rt = dist.runtime()
+            if rt is not None:
+                return rt.world
             import jax
             return jax.process_count()
         return 1
@@ -229,27 +281,33 @@ class KVStore:
 
     @property
     def num_dead_node(self):
-        # Failure detection is the runtime's job on TPU (no ps-lite
-        # heartbeats, SURVEY.md §5.3); a live process implies a live
-        # mesh — so outside fault injection this is 0.  The elastic
-        # fault harness (MXNET_TPU_FAULT_DEAD_HOST) reports its dead
-        # virtual hosts here, giving the reference
-        # KVStore::get_num_dead_node API honest semantics over
-        # injected failures (recovery = elastic checkpoint resume).
+        # The reference KVStore::get_num_dead_node API with honest
+        # semantics: REAL cross-process deaths from the dist runtime's
+        # heartbeat liveness table (mxnet_tpu/dist.py), plus any
+        # virtual hosts the elastic fault harness injects
+        # (MXNET_TPU_FAULT_DEAD_HOST).  Recovery is a coordinated
+        # elastic restart / checkpoint resume, never heartbeat-and-pray.
         from . import elastic
         return elastic.num_dead_node()
 
-    def barrier(self):
-        """Global barrier across workers.  Failures PROPAGATE: a failed
-        barrier means the process group is broken, and silently
-        continuing would let workers diverge (reference
-        ps::Postoffice::Barrier aborts the process on failure).  A
-        (virtual) dead host makes the barrier fail fast instead of
-        hanging the collective — the elastic fault harness's honest
-        barrier semantics (recover via elastic.resume)."""
+    def barrier(self, timeout=None):
+        """Global barrier across workers.  Failures PROPAGATE with an
+        ACTIONABLE error, never a hang: under the dist runtime the
+        coordinator-side barrier raises an MXNetError naming the ranks
+        that failed to arrive within `timeout` (default
+        MXNET_TPU_BARRIER_TIMEOUT_S) or that died while the others
+        waited (reference ps::Postoffice::Barrier aborts the process
+        on failure; silently continuing would let workers diverge).
+        Injected dead virtual hosts fail fast the same way (recover
+        via coordinated elastic restart / elastic.resume)."""
         from . import elastic
         elastic.check_barrier()
         if self._is_dist:
+            from . import dist
+            rt = dist.runtime()
+            if rt is not None:
+                rt.barrier('kvstore_barrier', timeout=timeout)
+                return
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices('kvstore_barrier')
 
@@ -393,10 +451,13 @@ class KVStoreDistPS(KVStore):
     def num_workers(self):
         return self._num_workers_env
 
-    def barrier(self):
+    def barrier(self, timeout=None):
+        """PS-store barrier.  `timeout` bounds the per-server wait and
+        raises MXNetError instead of hanging (None = historical
+        blocking semantics); injected/real dead hosts fail fast."""
         from . import elastic
-        elastic.check_barrier()     # injected dead hosts fail fast
-        self._client.barrier()
+        elastic.check_barrier()
+        self._client.barrier(timeout=timeout)
 
     def send_heartbeat(self):
         """Stamp liveness on the servers (ps-lite heartbeats role)."""

@@ -495,7 +495,8 @@ class KVStoreServer(object):
         self.updater = None
         self.cv = threading.Condition()
         self.stopped = False
-        self.barrier_count = 0
+        self.barrier_count = 0        # anonymous (legacy) arrivals
+        self.barrier_ranks = set()    # rank-identified arrivals
         self.barrier_gen = 0
         # failure detection (reference ps-lite heartbeats ->
         # KVStore::get_num_dead_node, kvstore.h:287): clients identify
@@ -682,12 +683,24 @@ class KVStoreServer(object):
                 self._frame_cache[snap_key] = frame
         return frame
 
-    def _handle_barrier(self):
+    def _handle_barrier(self, rank=None):
+        """Barrier arrival.  Rank-identified arrivals dedupe into a
+        SET: a worker whose previous barrier RPC timed out client-side
+        and who retries (or simply reaches its next barrier site) must
+        not count twice and release the generation while a peer never
+        arrived — that silent divergence is exactly what the timeout
+        exists to prevent.  Anonymous (legacy client) arrivals keep
+        the historical count semantics."""
         with self.cv:
             gen = self.barrier_gen
-            self.barrier_count += 1
-            if self.barrier_count >= self.num_workers:
+            if rank is None:
+                self.barrier_count += 1
+            else:
+                self.barrier_ranks.add(int(rank))
+            if self.barrier_count + len(self.barrier_ranks) >= \
+                    self.num_workers:
                 self.barrier_count = 0
+                self.barrier_ranks = set()
                 self.barrier_gen += 1
                 self.cv.notify_all()
             else:
@@ -786,7 +799,8 @@ class KVStoreServer(object):
                     _send_parts(conn, frame)
                     continue
                 elif op == 'barrier':
-                    reply = self._handle_barrier()
+                    reply = self._handle_barrier(
+                        msg[1] if len(msg) > 1 else None)
                 elif op == 'set_optimizer':
                     reply = self._handle_set_optimizer(msg[1])
                 elif op == 'set_sync':
@@ -846,22 +860,36 @@ class DistServerClient(object):
     def __init__(self, host, base_port, num_servers, rank=None):
         self.num_servers = num_servers
         self.push_counts = {}         # key -> this worker's push count
+        self._host = host
+        self._base_port = base_port
+        self._rank = rank
         self.socks = []
         self.locks = []
         for i in range(num_servers):
-            s = self._connect_retry(host, base_port + i)
-            # blocking mode: sync pulls/barriers legitimately wait for
-            # peers that may still be starting up (jax import is slow)
-            s.settimeout(None)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            _tune_sock_bufs(s)
-            self.socks.append(s)
+            self.socks.append(None)
             self.locks.append(threading.Lock())
-        if rank is not None:
-            # identify once; all subsequent RPCs on these connections
+        for sid in range(num_servers):
+            with self.locks[sid]:
+                self._reconnect(sid)
+
+    def _reconnect(self, sid):
+        """Fresh connection to server `sid` (caller holds its lock):
+        used at startup and after a timed-out RPC dropped the old,
+        desynchronized socket.  Re-identifies the rank so liveness
+        stamping survives the reconnect."""
+        s = self._connect_retry(self._host, self._base_port + sid)
+        # blocking mode: sync pulls/barriers legitimately wait for
+        # peers that may still be starting up (jax import is slow)
+        s.settimeout(None)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _tune_sock_bufs(s)
+        self.socks[sid] = s
+        if self._rank is not None:
+            # identify once; all subsequent RPCs on this connection
             # double as heartbeats (no extra per-op round trips)
-            for sid in range(num_servers):
-                self._rpc(sid, 'hello', int(rank))
+            _send_msg(s, ('hello', int(self._rank)))
+            _recv_msg(s)
+        return s
 
     @staticmethod
     def _connect_retry(host, port, total_timeout=120.0):
@@ -876,10 +904,37 @@ class DistServerClient(object):
                     raise
                 time.sleep(0.2)
 
-    def _rpc(self, sid, *msg):
+    def _rpc(self, sid, *msg, **kw):
+        timeout = kw.pop('timeout', None)
+        assert not kw
         with self.locks[sid]:
-            _send_msg(self.socks[sid], msg)
-            reply = _recv_msg(self.socks[sid])
+            sock = self.socks[sid]
+            if sock is None:        # dropped after a timed-out RPC
+                sock = self._reconnect(sid)
+            old = sock.gettimeout()
+            try:
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                _send_msg(sock, msg)
+                reply = _recv_msg(sock)
+            except socket.timeout:
+                # the late reply stays buffered on this socket — a
+                # retry would read it as ITS OWN answer.  Close and
+                # forget the connection; the next RPC reconnects.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                self.socks[sid] = None
+                from .base import MXNetError
+                raise MXNetError(
+                    'kvstore server %d did not answer %r within %.1fs'
+                    % (sid, msg[0], timeout))
+            finally:
+                try:
+                    sock.settimeout(old)
+                except OSError:
+                    pass
         if reply[0] != 'ok':
             from .base import MXNetError
             raise MXNetError('kvstore server error: %s' % (reply[1],))
@@ -908,6 +963,8 @@ class DistServerClient(object):
             self.locks[sid].acquire()
         try:
             for sid in sids:
+                if self.socks[sid] is None:   # dropped after timeout
+                    self._reconnect(sid)
                 _send_msg(self.socks[sid], (op, by_sid[sid]))
             out = {}
             for sid in sids:
@@ -964,9 +1021,19 @@ class DistServerClient(object):
                 out[item[0]] = v
         return out
 
-    def barrier(self):
+    def barrier(self, timeout=None):
+        """Server-side barrier.  `timeout` (seconds) bounds the wait
+        per server and raises MXNetError instead of hanging on a
+        wedged-but-alive peer; None keeps the historical blocking
+        semantics (sync pulls legitimately wait out slow starters).
+        The rank rides along so the server dedupes re-arrivals after
+        a client-side timeout."""
         for sid in range(self.num_servers):
-            self._rpc(sid, 'barrier')
+            if self._rank is not None:
+                self._rpc(sid, 'barrier', int(self._rank),
+                          timeout=timeout)
+            else:
+                self._rpc(sid, 'barrier', timeout=timeout)
 
     def set_optimizer(self, optimizer_blob):
         for sid in range(self.num_servers):
@@ -994,6 +1061,8 @@ class DistServerClient(object):
 
     def close(self):
         for s in self.socks:
+            if s is None:
+                continue
             try:
                 s.close()
             except OSError:

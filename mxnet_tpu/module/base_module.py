@@ -210,15 +210,23 @@ class BaseModule:
         # epoch's reset below)
         resume_info = None
         signals_installed_here = False
+        watched_runtime = None
         batch_size = getattr(train_data, 'batch_size', 0)
         if checkpoint is not None:
-            from .. import elastic
+            from .. import dist, elastic
             checkpoint.attach(self)
             if not checkpoint._old_handlers and \
                     threading.current_thread() is \
                     threading.main_thread():
                 checkpoint.install_signal_handlers()
                 signals_installed_here = True
+            # coordinated elastic restart: heartbeat-detected peer
+            # deaths preempt this manager, so the next step boundary
+            # drains, commits the final checkpoint and raises
+            # Preempted carrying the dead-rank set
+            watched_runtime = dist.runtime()
+            if watched_runtime is not None:
+                watched_runtime.watch(checkpoint)
             resume_info = checkpoint.restore()
             if resume_info is not None:
                 begin_epoch = max(begin_epoch, resume_info.epoch)
@@ -257,6 +265,8 @@ class BaseModule:
                 # not silently swallowed into a preempt flag no
                 # step_end will ever consume
                 checkpoint.uninstall_signal_handlers()
+            if watched_runtime is not None:
+                watched_runtime.unwatch(checkpoint)
 
     def _fit_epochs(self, train_data, eval_data, eval_metric,
                     validation_metric, epoch_end_callback,
@@ -284,14 +294,20 @@ class BaseModule:
                 self._fit_epoch_bulk(train_data, int(bulk), eval_metric,
                                      batch_end_callback, epoch,
                                      step_cb=_ckpt_step,
-                                     nbatch0=epoch_off)
+                                     nbatch0=epoch_off,
+                                     checkpoint=checkpoint)
             else:
                 for nbatch, data_batch in enumerate(train_data):
                     nbatch += epoch_off
                     if monitor is not None:
                         monitor.tic()
-                    self.forward_backward(data_batch)
-                    self.update()
+                    try:
+                        self.forward_backward(data_batch)
+                        self.update()
+                    except MXNetError:
+                        self._peer_death_preempt(checkpoint, _ckpt_step,
+                                                 nbatch, epoch)
+                        raise
                     self.update_metric(eval_metric, data_batch.label)
                     if monitor is not None:
                         monitor.toc_print()
@@ -334,13 +350,34 @@ class BaseModule:
                 ckpt = checkpoint.save(epoch=epoch + 1,
                                        batches_in_epoch=0,
                                        batch_size=0, sync=True)
-                raise elastic.Preempted(checkpoint.step, ckpt)
+                raise elastic.Preempted(
+                    checkpoint.step, ckpt,
+                    dead_ranks=checkpoint.preempt_dead_ranks)
         if checkpoint is not None:
             checkpoint.wait()   # drain pending async commits
 
+    @staticmethod
+    def _peer_death_preempt(checkpoint, step_cb, nbatch, epoch):
+        """Convert a cross-host step failure caused by a
+        heartbeat-detected PEER death into a coordinated preemption:
+        params are still the consistent post-step-(nbatch-1) state
+        (the batched cross-host sum fails before ANY key updates), so
+        commit the final checkpoint and unwind as Preempted for the
+        elastic supervisor.  No-op (the caller re-raises the original
+        error) when no checkpoint manager is wired or no peer is
+        actually dead."""
+        if checkpoint is None or step_cb is None:
+            return
+        from .. import dist
+        dead = dist.detect_dead()
+        if not dead:
+            return
+        checkpoint.request_preempt(dead_ranks=dead)
+        step_cb(nbatch, 0, epoch)   # commits + raises Preempted
+
     def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
                         batch_end_callback, epoch, step_cb=None,
-                        nbatch0=0):
+                        nbatch0=0, checkpoint=None):
         """One fit epoch in K-step fused dispatches: consecutive
         batches group into bulk_step calls (device-side lax.scan,
         device-resident metric accumulation, per-step lr schedules);
@@ -361,12 +398,22 @@ class BaseModule:
                     continue
             if not group:
                 break
-            if len(group) == 1:
-                self.forward_backward(group[0])
-                self.update()
-                self.update_metric(eval_metric, group[0].label)
-            else:
-                self.bulk_step(batches=group, eval_metric=eval_metric)
+            try:
+                if len(group) == 1:
+                    self.forward_backward(group[0])
+                    self.update()
+                    self.update_metric(eval_metric, group[0].label)
+                else:
+                    self.bulk_step(batches=group,
+                                   eval_metric=eval_metric)
+            except MXNetError:
+                # peer death mid-dispatch: same conversion as the
+                # per-batch loop — nbatch still counts only COMPLETED
+                # dispatches, the consistent state the final
+                # checkpoint must record
+                self._peer_death_preempt(checkpoint, step_cb, nbatch,
+                                         epoch)
+                raise
             k = len(group)
             nbatch += k
             if batch_end_callback is not None:

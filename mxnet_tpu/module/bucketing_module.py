@@ -534,7 +534,7 @@ class BucketingModule(BaseModule):
 
     def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
                         batch_end_callback, epoch, step_cb=None,
-                        nbatch0=0):
+                        nbatch0=0, checkpoint=None):
         """Bucket-aware K-step grouping for fit(bulk=K): consecutive
         batches mapping to the SAME ladder rung group into one
         bulk_step dispatch; a rung change flushes the group.
@@ -542,7 +542,10 @@ class BucketingModule(BaseModule):
         bucket-by-bucket so groups reach the full K even on mixed
         data.  step_cb(nbatch_done, steps, epoch): elastic checkpoint
         hook, fired once per flushed group.  nbatch0: batch counter
-        start (the resumed epoch's consumed-batch watermark)."""
+        start (the resumed epoch's consumed-batch watermark).
+        checkpoint: elastic manager — a dispatch failing on a
+        heartbeat-detected peer death converts to a coordinated
+        preemption (base class _peer_death_preempt)."""
         state = {'nbatch': int(nbatch0)}
         group = []
         group_rung = [None]
@@ -550,19 +553,25 @@ class BucketingModule(BaseModule):
         def flush():
             if not group:
                 return
-            if len(group) >= bulk:
-                self.bulk_step(batches=list(group),
-                               eval_metric=eval_metric)
-            else:
-                # partial trailing group (rung change / epoch end):
-                # run per-step through the warmed single-step program
-                # — only the K=bulk scan program is AOT-warmed, and a
-                # fresh XLA compile for this group's K would cost far
-                # more than the few per-step dispatches it saves
-                for b in group:
-                    self.forward_backward(b)
-                    self.update()
-                    self.update_metric(eval_metric, b.label)
+            try:
+                if len(group) >= bulk:
+                    self.bulk_step(batches=list(group),
+                                   eval_metric=eval_metric)
+                else:
+                    # partial trailing group (rung change / epoch
+                    # end): run per-step through the warmed
+                    # single-step program — only the K=bulk scan
+                    # program is AOT-warmed, and a fresh XLA compile
+                    # for this group's K would cost far more than the
+                    # few per-step dispatches it saves
+                    for b in group:
+                        self.forward_backward(b)
+                        self.update()
+                        self.update_metric(eval_metric, b.label)
+            except MXNetError:
+                self._peer_death_preempt(checkpoint, step_cb,
+                                         state['nbatch'], epoch)
+                raise
             k = len(group)
             state['nbatch'] += k
             del group[:]

@@ -313,17 +313,26 @@ class Module(BaseModule):
         if zero is None and kvstore is not None:
             zero = getattr(kvstore, 'zero_stage', None)
         zero = zero_mod.zero_stage(zero)
+        host_span = False
+        if kvstore is not None and kvstore._is_dist and \
+                not isinstance(kvstore, kvs_mod.KVStoreDistPS):
+            from .. import dist
+            host_span = dist.host_span_active()
         self._fused_updater = None
         if kvstore is None or \
-                not isinstance(kvstore, kvs_mod.KVStoreDistPS):
+                (not isinstance(kvstore, kvs_mod.KVStoreDistPS) and
+                 not host_span):
             # In-XLA store (or none): the executor group is one SPMD
             # program whose gradient all-reduce is already an in-step
             # psum over the mesh — `dist_sync` without parameter
-            # servers is the SAME program spanning processes — so the
-            # optimizer update folds into the same donated dispatch
-            # (ZeRO-1 sharded when zero=1).  The store stays as the
-            # parameter facade; only the multi-process PS keeps the
-            # per-key eager push/pull path.
+            # servers under jax.distributed is the SAME program
+            # spanning processes — so the optimizer update folds into
+            # the same donated dispatch (ZeRO-1 sharded when zero=1).
+            # The store stays as the parameter facade; the
+            # multi-process PS keeps the per-key eager push/pull path,
+            # and the dist-runtime host-allreduce mode
+            # (dist.host_span_active) routes through the store so each
+            # step's mesh-reduced gradients cross hosts once.
             self._fused_updater = opt_mod.create_fused_updater(
                 optimizer, self._param_names, zero=zero,
                 mesh=self._exec_group.mesh)
@@ -332,6 +341,11 @@ class Module(BaseModule):
                 reason = ('the parameter-server kvstore runs updates '
                           'server-side (per-key, already state-sharded '
                           'across servers)')
+            elif host_span:
+                reason = ('the dist runtime host-allreduce mode runs '
+                          'the per-key kvstore update (ZeRO needs the '
+                          'in-step sharded dispatch — use '
+                          'MXNET_TPU_DIST_JAX=1 multi-host SPMD)')
             else:
                 reason = ('the %s optimizer has no fused sharded '
                           'update path' % type(optimizer).__name__)

@@ -230,8 +230,17 @@ def axis_size(axis_name):
     return lax.psum(1, axis_name)
 
 
-def barrier_all_hosts(name='mxnet_tpu_barrier'):
+def barrier_all_hosts(name='mxnet_tpu_barrier', timeout=None):
     """Host-level barrier (the reference's ps::Postoffice::Barrier role
-    at bootstrap, kvstore_dist.h:56)."""
+    at bootstrap, kvstore_dist.h:56).  Under the dist runtime this is
+    the coordinator's HEALTH-CHECKED barrier: it raises an MXNetError
+    naming ranks that failed to arrive within `timeout` (default
+    MXNET_TPU_BARRIER_TIMEOUT_S) or died while waiting, instead of
+    hanging the collective."""
+    from .. import dist
+    rt = dist.runtime()
+    if rt is not None:
+        rt.barrier(name, timeout=timeout)
+        return
     from jax.experimental import multihost_utils
     multihost_utils.sync_global_devices(name)

@@ -272,6 +272,49 @@ def ckpt_stats():
         return dict(_CKPT)
 
 
+# multi-host distributed-runtime counters (mxnet_tpu/dist.py): liveness
+# heartbeats sent / missed (dropped by fault injection or a lost
+# coordinator), health-checked barrier rounds + the wall time spent
+# waiting in them, real cross-process deaths this process learned of
+# through heartbeat loss, coordinator-mediated gradient allreduce
+# rounds/bytes (the DCN dp leg), and how many elastic relaunches this
+# process is downstream of (the launch.py --elastic supervisor exports
+# MXNET_TPU_DIST_RESTART_COUNT)
+_DIST = {
+    'dist_heartbeats_sent': 0,
+    'dist_heartbeats_missed': 0,
+    'dist_barriers': 0,
+    'dist_barrier_wait_ms': 0.0,
+    'dist_dead_hosts_detected': 0,
+    'dist_allreduce_rounds': 0,
+    'dist_allreduce_bytes': 0,
+    'dist_restarts': 0,
+}
+
+
+def add_dist_stats(heartbeats_sent=0, heartbeats_missed=0, barriers=0,
+                   barrier_wait_ms=0.0, dead_hosts_detected=0,
+                   allreduce_rounds=0, allreduce_bytes=0, restarts=0):
+    """Accumulate dist-runtime counters (the heartbeat thread, barrier
+    and allreduce paths feed one call per event)."""
+    with _STATE['lock']:
+        _DIST['dist_heartbeats_sent'] += int(heartbeats_sent)
+        _DIST['dist_heartbeats_missed'] += int(heartbeats_missed)
+        _DIST['dist_barriers'] += int(barriers)
+        _DIST['dist_barrier_wait_ms'] += float(barrier_wait_ms)
+        _DIST['dist_dead_hosts_detected'] += int(dead_hosts_detected)
+        _DIST['dist_allreduce_rounds'] += int(allreduce_rounds)
+        _DIST['dist_allreduce_bytes'] += int(allreduce_bytes)
+        _DIST['dist_restarts'] += int(restarts)
+
+
+def dist_stats():
+    """Snapshot of the dist-runtime counters (also merged into
+    summary() and dump_profile 'dist' metadata)."""
+    with _STATE['lock']:
+        return dict(_DIST)
+
+
 # serving-engine counters (serving.InferenceEngine's dynamic batcher):
 # coalesced dispatches, batch fill / pad waste, batcher queue depth
 # observations, and a bounded ring of request latencies for p50/p99
@@ -420,6 +463,8 @@ def dump_profile():
                    'args': bucketing_stats()})
     events.append({'ph': 'M', 'name': 'checkpoint', 'pid': 0,
                    'args': ckpt_stats()})
+    events.append({'ph': 'M', 'name': 'dist', 'pid': 0,
+                   'args': dist_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -554,6 +599,17 @@ def summary(print_out=True):
                     ck['ckpt_async_overlap_ms'], ck['ckpt_commit_ms'],
                     ck['ckpt_torn_fallbacks'], ck['ckpt_restores'],
                     ck['ckpt_skipped'], ck['ckpt_failed_writes']))
+    ds = dist_stats()
+    lines.append('  dist_heartbeats_sent=%d dist_heartbeats_missed=%d '
+                 'dist_barriers=%d dist_barrier_wait_ms=%.3f '
+                 'dist_dead_hosts_detected=%d dist_allreduce_rounds=%d '
+                 'dist_allreduce_bytes=%d dist_restarts=%d'
+                 % (ds['dist_heartbeats_sent'],
+                    ds['dist_heartbeats_missed'], ds['dist_barriers'],
+                    ds['dist_barrier_wait_ms'],
+                    ds['dist_dead_hosts_detected'],
+                    ds['dist_allreduce_rounds'],
+                    ds['dist_allreduce_bytes'], ds['dist_restarts']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -592,6 +648,8 @@ def clear():
             _BUCKET[k] = 0
         for k in _CKPT:
             _CKPT[k] = type(_CKPT[k])()
+        for k in _DIST:
+            _DIST[k] = type(_DIST[k])()
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

@@ -1,0 +1,479 @@
+"""Multi-host distributed runtime tests (mxnet_tpu/dist.py +
+tools/launch.py): coordinator bootstrap with retry/deadline,
+health-checked barriers that NAME absent/dead ranks instead of
+hanging, heartbeat-loss death detection feeding coordinated elastic
+restart (Preempted carries the dead-rank set), the KVStore
+rank/size/barrier/num_dead_node facade, the launcher's fail-fast +
+signal-forwarding + --elastic supervision, and the dist_* counters.
+
+The coordinated-restart contract under test: SIGKILL one of two
+launcher-spawned workers mid-epoch -> the survivor detects the death
+by heartbeat loss within the deadline, drains, commits a final
+elastic checkpoint, exits PREEMPTED_EXIT -> the supervisor relaunches
+at reduced world size -> training finishes BIT-IDENTICAL to the
+uninterrupted run.
+"""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import dist, elastic, profiler
+from mxnet_tpu import sym as S
+from mxnet_tpu.base import MXNetError
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LAUNCH = os.path.join(_REPO, 'tools', 'launch.py')
+
+
+def _mlp_symbol():
+    data = S.Variable('data')
+    fc1 = S.FullyConnected(data, name='fc1', num_hidden=16)
+    act = S.Activation(fc1, act_type='relu')
+    return S.SoftmaxOutput(
+        S.FullyConnected(act, name='fc2', num_hidden=4), name='softmax')
+
+
+def _pair(dead_after=0.5, hb=0.1, world=2):
+    """A coordinator + `world` in-process runtimes (virtual ranks) —
+    the single-process harness for the cross-process protocol."""
+    coord = dist.Coordinator(port=0, world=world,
+                             bind_addr='127.0.0.1',
+                             dead_after=dead_after).start()
+    rts = [None] * world
+    errs = [None] * world
+
+    def mk(r):
+        try:
+            rts[r] = dist.DistRuntime(
+                r, world, address='127.0.0.1', port=coord.port,
+                start_coordinator=False, timeout=15,
+                hb_interval=hb, dead_after=dead_after)
+        except BaseException as e:      # surfaced by the caller
+            errs[r] = e
+    ts = [threading.Thread(target=mk, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(e is None for e in errs), errs
+    return coord, rts
+
+
+def _teardown(coord, rts):
+    # rank 0 last: an owning rank 0 waits for its peers to say bye
+    # before stopping the coordinator (here the coordinator is
+    # standalone, but keep the canonical order anyway)
+    for rt in reversed(rts):
+        if rt is not None:
+            rt.shutdown()
+    coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# bootstrap: connect retry + deadline, startup barrier naming ranks
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_deadline_names_coordinator():
+    # nothing listens on this port: the connect retry must give up at
+    # the hard deadline with an error naming the address — not hang
+    probe = socket.socket()
+    probe.bind(('127.0.0.1', 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError, match='could not reach'):
+        dist.DistRuntime(1, 2, address='127.0.0.1', port=port,
+                         start_coordinator=False, timeout=1.2)
+    dt = time.monotonic() - t0
+    assert 1.0 <= dt < 10, dt
+
+
+def test_bootstrap_retries_until_late_coordinator():
+    # the coordinator comes up 0.6s AFTER the worker starts dialing:
+    # exponential-backoff retry under the deadline must succeed (a
+    # late-starting rank 0 is normal, not an abort)
+    probe = socket.socket()
+    probe.bind(('127.0.0.1', 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    box = {}
+
+    def late():
+        time.sleep(0.6)
+        box['coord'] = dist.Coordinator(
+            port=port, world=1, bind_addr='127.0.0.1').start()
+    t = threading.Thread(target=late)
+    t.start()
+    try:
+        rt = dist.DistRuntime(0, 1, address='127.0.0.1', port=port,
+                              start_coordinator=False, timeout=15,
+                              heartbeat=False)
+        assert rt.rank == 0 and rt.world == 1
+        rt.shutdown()
+    finally:
+        t.join()
+        box['coord'].stop()
+
+
+def test_startup_barrier_names_missing_rank():
+    # rank 1 never starts: rank 0's bootstrap must fail within the
+    # deadline with the MISSING rank named (the reference's
+    # worker+server+scheduler startup-barrier role, minus the hang)
+    coord = dist.Coordinator(port=0, world=2,
+                             bind_addr='127.0.0.1').start()
+    try:
+        with pytest.raises(MXNetError) as excinfo:
+            dist.DistRuntime(0, 2, address='127.0.0.1',
+                             port=coord.port, start_coordinator=False,
+                             timeout=1.5, heartbeat=False)
+        assert '[1]' in str(excinfo.value)
+        assert 'never arrived' in str(excinfo.value)
+    finally:
+        coord.stop()
+
+
+# ---------------------------------------------------------------------------
+# barriers: timeout naming absent ranks, stall knob, dead-rank failure
+# ---------------------------------------------------------------------------
+
+def test_barrier_timeout_names_absent_ranks():
+    coord, rts = _pair(dead_after=30)   # nobody dies; rank 1 just
+    try:                                # never shows up at the barrier
+        with pytest.raises(MXNetError) as excinfo:
+            rts[0].barrier('late', timeout=1.0)
+        msg = str(excinfo.value)
+        assert '[1]' in msg and 'never arrived' in msg
+        assert 'MXNET_TPU_BARRIER_TIMEOUT_S' in msg
+    finally:
+        _teardown(coord, rts)
+
+
+def test_barrier_stall_fault_arrives_late(monkeypatch):
+    # MXNET_TPU_FAULT_BARRIER_STALL_S='1:0.4': rank 1 arrives 0.4s
+    # late; within the timeout the barrier completes and the wait is
+    # visible in dist_barrier_wait_ms
+    profiler.clear()
+    coord, rts = _pair(dead_after=30)
+    monkeypatch.setenv('MXNET_TPU_FAULT_BARRIER_STALL_S', '1:0.4')
+    res = [None, None]
+
+    def bar(r):
+        try:
+            rts[r].barrier('stalled', timeout=10)
+            res[r] = 'ok'
+        except MXNetError as e:
+            res[r] = e
+    try:
+        ts = [threading.Thread(target=bar, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert res == ['ok', 'ok'], res
+        st = profiler.dist_stats()
+        assert st['dist_barriers'] >= 2
+        assert st['dist_barrier_wait_ms'] >= 300
+    finally:
+        _teardown(coord, rts)
+
+
+def test_heartbeat_loss_fails_barrier_naming_dead_rank(monkeypatch):
+    # rank 1 keeps running but its heartbeats are dropped (injected
+    # partition): the coordinator declares it dead and a waiting
+    # barrier FAILS FAST naming it, instead of hanging the collective
+    coord, rts = _pair(dead_after=0.5)
+    monkeypatch.setenv('MXNET_TPU_FAULT_HEARTBEAT_DROP', '1')
+    try:
+        with pytest.raises(MXNetError, match=r'\[1\] are dead'):
+            rts[0].barrier('doomed', timeout=15)
+    finally:
+        _teardown(coord, rts)
+
+
+# ---------------------------------------------------------------------------
+# death detection -> coordinated preemption + KVStore facade
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_loss_preempts_with_dead_rank_set(monkeypatch):
+    profiler.clear()
+    coord, rts = _pair(dead_after=0.5)
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 6))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (8,))])
+    mod.init_params()
+    mod.init_optimizer()
+    mgr = elastic.CheckpointManager(
+        os.path.join(os.environ.get('TMPDIR', '/tmp'),
+                     'dist_preempt_%d' % os.getpid()),
+        rank=0, world=1)
+    mgr.attach(mod)
+    rts[0].watch(mgr)
+    monkeypatch.setenv('MXNET_TPU_FAULT_HEARTBEAT_DROP', '1')
+    monkeypatch.setattr(dist, '_RUNTIME', rts[0])
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not mgr.preempted:
+            time.sleep(0.05)
+        assert mgr.preempted, 'heartbeat loss never preempted the mgr'
+        assert mgr.preempt_dead_ranks == frozenset({1})
+        # the next step boundary commits a final checkpoint and
+        # raises Preempted carrying the dead-rank set
+        with pytest.raises(elastic.Preempted) as excinfo:
+            mgr.step_end(epoch=0, batches_in_epoch=3, batch_size=8)
+        assert excinfo.value.dead_ranks == frozenset({1})
+        assert excinfo.value.checkpoint_dir is not None
+        # KVStore facade: num_dead_node reports the REAL death, the
+        # barrier fails fast naming it, rank/size ride the runtime
+        kv = mx.kvstore.KVStore('dist_sync')
+        assert kv.num_dead_node == 1
+        assert kv.rank == 0 and kv.num_workers == 2
+        with pytest.raises(MXNetError, match=r'\[1\]'):
+            kv.barrier()
+        assert elastic.num_dead_node() == 1
+        st = profiler.dist_stats()
+        assert st['dist_dead_hosts_detected'] >= 1
+        assert st['dist_heartbeats_sent'] > 0
+        assert st['dist_heartbeats_missed'] > 0
+    finally:
+        _teardown(coord, rts)
+    mgr.close()
+
+
+def test_allreduce_bitwise_and_dead_rank_failure(monkeypatch):
+    coord, rts = _pair(dead_after=0.5)
+    try:
+        out = [None, None]
+
+        def ar(r):
+            out[r] = rts[r].allreduce(
+                [np.full((3, 2), float(r + 1), np.float32),
+                 np.arange(4, dtype=np.int64) * (r + 1)], name='g')
+        ts = [threading.Thread(target=ar, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # every rank receives IDENTICAL bytes (sum in rank order)
+        for i in range(2):
+            np.testing.assert_array_equal(out[0][i], out[1][i])
+        assert out[0][0][0, 0] == 3.0
+        np.testing.assert_array_equal(out[0][1],
+                                      np.arange(4, dtype=np.int64) * 3)
+        # a dead contributor fails the round with the rank named
+        monkeypatch.setenv('MXNET_TPU_FAULT_HEARTBEAT_DROP', '1')
+        with pytest.raises(MXNetError, match=r'\[1\] died'):
+            rts[0].allreduce([np.ones(2, np.float32)], name='g2',
+                             timeout=15)
+    finally:
+        _teardown(coord, rts)
+
+
+def test_dist_counters_in_summary_and_dump(tmp_path):
+    profiler.clear()
+    profiler.add_dist_stats(heartbeats_sent=4, barriers=2,
+                            barrier_wait_ms=12.5,
+                            dead_hosts_detected=1, restarts=1)
+    text = profiler.summary(print_out=False)
+    assert 'dist_heartbeats_sent=4' in text
+    assert 'dist_dead_hosts_detected=1' in text
+    assert 'dist_restarts=1' in text
+    fname = str(tmp_path / 'prof.json')
+    profiler.profiler_set_config(mode='symbolic', filename=fname)
+    path = profiler.dump_profile()
+    meta = [e for e in json.load(open(path))['traceEvents']
+            if e.get('name') == 'dist']
+    assert meta and meta[0]['args']['dist_barriers'] == 2
+    profiler.clear()
+
+
+# ---------------------------------------------------------------------------
+# tools/launch.py: fail-fast, signal forwarding, --elastic supervision
+# ---------------------------------------------------------------------------
+
+def _launch_env(**extra):
+    env = dict(os.environ,
+               PYTHONPATH=_REPO + os.pathsep +
+               os.environ.get('PYTHONPATH', ''))
+    for stale in ('DMLC_PS_ROOT_URI', 'DMLC_PS_ROOT_PORT', 'DMLC_ROLE',
+                  'DMLC_NUM_WORKER', 'DMLC_NUM_SERVER',
+                  'MXNET_TPU_DIST_PORT',
+                  'MXNET_TPU_FAULT_KILL_AT_STEP',
+                  'MXNET_TPU_FAULT_KILL_RANK'):
+        env.pop(stale, None)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def test_launcher_fail_fast_kills_siblings_and_names_rank(tmp_path):
+    # worker 1 exits 3 immediately; worker 0 would sleep forever (the
+    # "blocked in a barrier" shape).  The launcher must kill it and
+    # exit promptly with worker 1's code and rank in the message.
+    prog = ("import os,sys,time\n"
+            "rank=int(os.environ['DMLC_WORKER_ID'])\n"
+            "sys.exit(3) if rank==1 else time.sleep(120)\n")
+    script = tmp_path / 'w.py'
+    script.write_text(prog)
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, _LAUNCH, '-n', '2', '-s', '0', '--grace', '3',
+         '--launcher', 'local', sys.executable, str(script)],
+        env=_launch_env(), capture_output=True, text=True, timeout=60)
+    dt = time.monotonic() - t0
+    assert proc.returncode == 3, (proc.returncode, proc.stderr)
+    assert 'worker 1' in proc.stderr and 'code 3' in proc.stderr
+    assert dt < 30, 'fail-fast took %.1fs (sibling not killed?)' % dt
+
+
+def test_launcher_forwards_sigterm_to_children(tmp_path):
+    # SIGTERM to the launcher must reach the children (the elastic
+    # final-checkpoint path runs under the launcher too): each child
+    # traps it, writes a marker, exits 0
+    prog = ("import os,signal,sys,time\n"
+            "rank=os.environ['DMLC_WORKER_ID']\n"
+            "out=sys.argv[1]\n"
+            "def h(s,f):\n"
+            "    open(os.path.join(out,'term_'+rank),'w').write('x')\n"
+            "    sys.exit(0)\n"
+            "signal.signal(signal.SIGTERM,h)\n"
+            "open(os.path.join(out,'ready_'+rank),'w').write('x')\n"
+            "time.sleep(60)\n")
+    script = tmp_path / 'w.py'
+    script.write_text(prog)
+    proc = subprocess.Popen(
+        [sys.executable, _LAUNCH, '-n', '2', '-s', '0', '--grace', '5',
+         '--launcher', 'local', sys.executable, str(script),
+         str(tmp_path)],
+        env=_launch_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not (
+            (tmp_path / 'ready_0').exists() and
+            (tmp_path / 'ready_1').exists()):
+        time.sleep(0.1)
+    assert (tmp_path / 'ready_0').exists(), 'workers never started'
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=30)
+    assert (tmp_path / 'term_0').exists(), 'worker 0 never got SIGTERM'
+    assert (tmp_path / 'term_1').exists(), 'worker 1 never got SIGTERM'
+
+
+def test_kill_one_of_two_workers_coordinated_restart(tmp_path):
+    """The acceptance-criteria path end to end: launcher-spawned
+    workers, SIGKILL of rank 1 mid-epoch detected by heartbeat loss,
+    survivor commits a final checkpoint + exits PREEMPTED_EXIT, the
+    --elastic supervisor relaunches at reduced world size, and the
+    final weights are BIT-IDENTICAL to the uninterrupted run."""
+    def run(tag, n, elastic_mode=False, **fault):
+        env = _launch_env(MXNET_TPU_DIST_HEARTBEAT_S='0.1',
+                          MXNET_TPU_DIST_DEAD_AFTER_S='0.8',
+                          MXNET_TPU_BARRIER_TIMEOUT_S='30',
+                          JAX_PLATFORMS='cpu', **fault)
+        cmd = [sys.executable, _LAUNCH, '-n', str(n), '-s', '0',
+               '--launcher', 'local']
+        if elastic_mode:
+            cmd += ['--elastic', '--elastic-shrink', '--max-restarts',
+                    '2', '--elastic-grace', '30']
+        cmd += [sys.executable, os.path.abspath(__file__),
+                'dist-worker', str(tmp_path), tag]
+        return subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=300)
+
+    proc = run('straight', 1)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    proc = run('elastic', 2, elastic_mode=True,
+               MXNET_TPU_FAULT_KILL_AT_STEP='5',
+               MXNET_TPU_FAULT_KILL_RANK='1')
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert 'PREEMPTED' in proc.stdout and 'dead_ranks=[1]' in \
+        proc.stdout, (proc.stdout, proc.stderr)
+    assert 'RESUMED step=' in proc.stdout, proc.stdout
+    assert 'elastic restart 1/' in proc.stderr, proc.stderr
+    a = np.load(str(tmp_path / 'params_straight_r0.npz'))
+    b = np.load(str(tmp_path / 'params_elastic_r0.npz'))
+    assert sorted(a.files) == sorted(b.files)
+    for n in a.files:
+        np.testing.assert_array_equal(a[n], b[n], err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# subprocess dist worker (test_kill_one_of_two_workers_*)
+# ---------------------------------------------------------------------------
+
+def _dist_worker(out_dir, tag):
+    """Child (under tools/launch.py): dist bootstrap, dist_sync
+    kvstore dp (cross-host grad sum through the coordinator), elastic
+    checkpoints watched by the runtime.  MXNET_TPU_FAULT_KILL_RANK=1
+    SIGKILLs rank 1 at KILL_AT_STEP; survivors preempt, commit and
+    exit PREEMPTED_EXIT for the --elastic supervisor."""
+    rt = dist.initialize()
+    mod = mx.mod.Module(_mlp_symbol())
+    bsz = 8
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (bsz, 6))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (bsz,))])
+    mx.random.seed(7)
+    mod.init_params(initializer=mx.init.Xavier())
+    kv = mx.kvstore.create('dist_sync')
+    mod.init_optimizer(kvstore=kv, optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    mgr = elastic.CheckpointManager(
+        os.path.join(out_dir, 'ck_' + tag), every_n_steps=2)
+    mgr.attach(mod)
+    rt.watch(mgr)
+    info = mgr.restore()
+    start = info.step if info is not None else 0
+    if info is not None:
+        print('RESUMED step=%d world=%d' % (start, rt.world))
+    feed = np.random.RandomState(3)
+    try:
+        for s in range(10):
+            x = feed.rand(bsz, 6).astype(np.float32)
+            y = (feed.rand(bsz) * 4).astype(np.float32)
+            if s < start:
+                continue
+            batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                    label=[mx.nd.array(y)])
+            try:
+                mod.forward_backward(batch)
+                mod.update()
+            except MXNetError:
+                dead = dist.detect_dead()
+                if not dead:
+                    raise
+                mgr.request_preempt(dead_ranks=dead)
+                mgr.step_end(epoch=0, batches_in_epoch=s,
+                             batch_size=bsz, steps=0)
+            time.sleep(0.04)
+            mgr.step_end(epoch=0, batches_in_epoch=s + 1,
+                         batch_size=bsz)
+    except elastic.Preempted as e:
+        print('PREEMPTED step=%d dead_ranks=%s'
+              % (e.step, sorted(e.dead_ranks)))
+        mgr.close()
+        sys.stdout.flush()
+        os._exit(dist.PREEMPTED_EXIT)
+    mgr.close()
+    params, _ = mod.get_params()
+    np.savez(os.path.join(out_dir, 'params_%s_r%d.npz'
+                          % (tag, rt.rank)),
+             **{n: v.asnumpy() for n, v in params.items()})
+    kv.barrier()
+    rt.shutdown()
+    print('DIST_WORKER_OK rank=%d world=%d' % (rt.rank, rt.world))
+
+
+if __name__ == '__main__':
+    if len(sys.argv) >= 4 and sys.argv[1] == 'dist-worker':
+        _dist_worker(sys.argv[2], sys.argv[3])
+    else:
+        raise SystemExit('usage: test_dist_runtime.py dist-worker '
+                         '<out_dir> <tag>')

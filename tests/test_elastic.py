@@ -593,6 +593,50 @@ def test_dead_virtual_host_and_kvstore_facade(tmp_path, monkeypatch):
     kv.barrier()
 
 
+def test_restore_falls_back_past_bucket_incomplete_checkpoint(tmp_path):
+    """A live-only FINAL checkpoint (dist runtime: a peer died, the
+    survivors' manifest lists only their files) can validate file-by-
+    file while a dead rank's unique ZeRO shards are simply gone.
+    restore() must assemble-validate the optimizer BEFORE mutating the
+    target and fall back to the older complete checkpoint — not crash
+    the resume with 'checkpoint bucket incomplete'."""
+    import shutil
+    profiler.clear()
+    mod = _make_module(ndev=4, zero=1)
+    _train(mod, _batches(1))
+    mgr = elastic.CheckpointManager(str(tmp_path), async_=False,
+                                    world=2)
+    mgr.attach(mod)
+    mgr._step = 1
+    mgr.save(sync=True)
+    _train(mod, _batches(1, seed=1))
+    mgr._step = 2
+    mgr.save(sync=True)
+    # simulate the live-only commit: rank 1's shard never landed and
+    # the manifest lists only rank 0's file (all listed files intact)
+    newest = os.path.join(str(tmp_path), 'step-%08d' % 2)
+    os.unlink(os.path.join(newest, 'state-r00001.bin'))
+    mpath = os.path.join(newest, elastic._MANIFEST)
+    with open(mpath) as f:
+        manifest = json.load(f)
+    manifest['files'] = ['state-r00000.bin']
+    with open(mpath, 'w') as f:
+        json.dump(manifest, f)
+    ref = os.path.join(str(tmp_path), 'ref')
+    shutil.copytree(os.path.join(str(tmp_path), 'step-%08d' % 1),
+                    os.path.join(ref, 'step-%08d' % 1))
+    other = _make_module(seed=9, ndev=4, zero=1)
+    info = elastic.CheckpointManager(str(tmp_path),
+                                     world=2).attach(other).restore()
+    assert info is not None and info.step == 1
+    assert profiler.ckpt_stats()['ckpt_torn_fallbacks'] >= 1
+    # ...and the state it applied is exactly the step-1 checkpoint's
+    twin = _make_module(seed=11, ndev=4, zero=1)
+    elastic.CheckpointManager(ref, world=2).attach(twin).restore()
+    _assert_params_equal(other, twin)
+    mgr.close()
+
+
 # ---------------------------------------------------------------------------
 # gluon fused wiring
 # ---------------------------------------------------------------------------

@@ -14,6 +14,23 @@ to call jax.distributed.initialize themselves; this launcher still
 provides rank/size env (DMLC_WORKER_ID / DMLC_NUM_WORKER) plus
 coordinator address (DMLC_PS_ROOT_URI/PORT) they can reuse.
 
+Local-mode robustness (mxnet_tpu/dist.py pairs with this contract):
+
+  * fail-fast — a worker exiting non-zero SIGTERMs every sibling's
+    process group (their elastic final-checkpoint path runs) and the
+    launcher exits with that worker's code, naming the rank; a crashed
+    worker can no longer leave siblings blocked in a barrier forever.
+  * SIGTERM/SIGINT forward to every child process group, so elastic's
+    final-checkpoint path runs under the launcher too.
+  * --elastic supervises coordinated restarts: a worker lost to a
+    signal (machine death) or exiting PREEMPTED_EXIT (a survivor that
+    committed its final elastic checkpoint) triggers a relaunch — at
+    the same world size, or reduced by the lost machines with
+    --elastic-shrink — up to --max-restarts times; workers resume
+    from their elastic checkpoints (MXNET_TPU_DIST_RESTART_COUNT
+    counts the relaunches).  Exports MXNET_TPU_DIST_PORT for the
+    dist.initialize() coordinator (rank 0 hosts it).
+
 Usage (mirrors the reference CLI):
   python tools/launch.py -n 2 -s 1 --launcher local \
       python train_script.py --kv-store dist_sync
@@ -25,11 +42,17 @@ import signal
 import socket
 import subprocess
 import sys
+import time
+
+# keep in sync with mxnet_tpu.dist.PREEMPTED_EXIT (the launcher must
+# not import the framework: it is a tiny supervisor, and the workers'
+# jax imports are exactly what it restarts)
+PREEMPTED_EXIT = 75
 
 
 def _free_port_range(n):
     """Find a base port with n consecutive free ports (server sid binds
-    base+sid, kvstore_server.py)."""
+    base+sid, kvstore_server.py; the dist coordinator binds base+S)."""
     for _ in range(64):
         probe = socket.socket()
         probe.bind(('', 0))
@@ -50,47 +73,189 @@ def _free_port_range(n):
     raise RuntimeError('could not find %d consecutive free ports' % n)
 
 
-def launch_local(args, command):
+def _signal_group(p, sig):
+    """Signal a child's whole process group (children start in their
+    own sessions so a worker's subprocess tree dies with it)."""
+    try:
+        os.killpg(p.pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            p.send_signal(sig)
+        except (ProcessLookupError, OSError):
+            pass
+
+
+def _stop_procs(procs, grace=10.0):
+    """SIGTERM (elastic final-checkpoint path) then SIGKILL leftovers."""
+    for p in procs:
+        if p.poll() is None:
+            _signal_group(p, signal.SIGTERM)
+    deadline = time.monotonic() + grace
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                pass
+    for p in procs:
+        if p.poll() is None:
+            _signal_group(p, signal.SIGKILL)
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+def _normalize_rc(rc):
+    """Shell convention for the launcher's own exit code: signal
+    deaths map to 128+signum (the child's code otherwise)."""
+    return rc if rc >= 0 else 128 - rc
+
+
+def _launch_round(args, command, world, restarts):
+    """One generation of the job: spawn servers + workers, supervise,
+    return {rank: returncode} for the workers.  Fail-fast semantics
+    (non-elastic): the first non-zero worker exit SIGTERMs every
+    sibling group and raises SystemExit with that worker's code and
+    rank.  Elastic: abnormal exits are collected; surviving workers
+    get --elastic-grace seconds to detect the death by heartbeat loss
+    and commit their final checkpoints before being SIGTERMed."""
     host = '127.0.0.1'
-    port = args.port or _free_port_range(args.num_servers)
+    # +2 ports past the servers: base+S for the dist coordinator
+    # (rank 0 binds it) and base+S+1 for jax.distributed's own
+    # coordination service when MXNET_TPU_DIST_JAX=1 derives it as
+    # coordinator port + 1 — both must come out of the probed-free
+    # range, not luck
+    port = args.port or _free_port_range(args.num_servers + 2)
     base_env = dict(os.environ)
     base_env.update({
         'DMLC_PS_ROOT_URI': host,
         'DMLC_PS_ROOT_PORT': str(port),
-        'DMLC_NUM_WORKER': str(args.num_workers),
+        'DMLC_NUM_WORKER': str(world),
         'DMLC_NUM_SERVER': str(args.num_servers),
+        'MXNET_TPU_DIST_PORT': str(port + args.num_servers),
+        'MXNET_TPU_DIST_RESTART_COUNT': str(restarts),
         # a per-job secret even on loopback: frames are then
         # unforgeable by other local users, and the set_optimizer
         # channel (which requires a token) works out of the box
         'DMLC_PS_TOKEN': os.environ.get('DMLC_PS_TOKEN')
                          or secrets.token_hex(16),
     })
-    procs = []
+    servers = []
+    workers = []
+    got_signal = []
+
+    def _forward(signum, frame):
+        # forward to every child group so elastic's final-checkpoint
+        # path runs under the launcher too; a second signal escalates
+        if got_signal:
+            for p in servers + workers:
+                _signal_group(p, signal.SIGKILL)
+        got_signal.append(signum)
+        for p in servers + workers:
+            if p.poll() is None:
+                _signal_group(p, signal.SIGTERM)
+
+    old_handlers = {s: signal.signal(s, _forward)
+                    for s in (signal.SIGTERM, signal.SIGINT)}
     try:
         for sid in range(args.num_servers):
             env = dict(base_env)
-            env.update({'DMLC_ROLE': 'server', 'DMLC_SERVER_ID': str(sid)})
-            procs.append(subprocess.Popen(
+            env.update({'DMLC_ROLE': 'server',
+                        'DMLC_SERVER_ID': str(sid)})
+            servers.append(subprocess.Popen(
                 [sys.executable, '-m', 'mxnet_tpu.kvstore_server'],
-                env=env))
-        for wid in range(args.num_workers):
+                env=env, start_new_session=True))
+        for wid in range(world):
             env = dict(base_env)
-            env.update({'DMLC_ROLE': 'worker', 'DMLC_WORKER_ID': str(wid)})
-            procs.append(subprocess.Popen(command, env=env))
-        # wait for workers (last num_workers processes)
-        rc = 0
-        for p in procs[args.num_servers:]:
-            rc = p.wait() or rc
-        return rc
+            env.update({'DMLC_ROLE': 'worker',
+                        'DMLC_WORKER_ID': str(wid)})
+            workers.append(subprocess.Popen(command, env=env,
+                                            start_new_session=True))
+        rcs = {}
+        launcher_killed = set()
+        grace_deadline = None
+        while len(rcs) < world:
+            for wid, p in enumerate(workers):
+                if wid in rcs:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    continue
+                rcs[wid] = rc
+                if rc != 0 and not got_signal:
+                    if not args.elastic:
+                        # fail-fast: kill the sibling process groups
+                        # and exit with this worker's code + rank —
+                        # a crashed worker must not leave siblings
+                        # blocked in a barrier forever
+                        _stop_procs([q for j, q in enumerate(workers)
+                                     if j != wid] + servers,
+                                    grace=args.grace)
+                        print('launcher: worker %d exited with %s — '
+                              'killed %d sibling(s), aborting'
+                              % (wid, 'signal %d' % -rc if rc < 0
+                                 else 'code %d' % rc,
+                                 len(workers) - 1), file=sys.stderr)
+                        raise SystemExit(_normalize_rc(rc))
+                    if grace_deadline is None:
+                        # give survivors time to detect the death by
+                        # heartbeat loss and commit final checkpoints
+                        grace_deadline = time.monotonic() + \
+                            args.elastic_grace
+            if grace_deadline is not None and \
+                    time.monotonic() >= grace_deadline:
+                # workers the LAUNCHER signals past the grace window
+                # are healthy survivors, not lost machines — record
+                # them so --elastic-shrink never shrinks the world on
+                # a launcher-inflicted SIGTERM/SIGKILL exit code
+                launcher_killed.update(j for j in range(world)
+                                       if j not in rcs)
+                _stop_procs([q for j, q in enumerate(workers)
+                             if j not in rcs], grace=args.grace)
+                grace_deadline = None
+            time.sleep(0.05)
+        return rcs, launcher_killed, bool(got_signal)
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _stop_procs(workers + servers, grace=args.grace)
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+
+
+def launch_local(args, command):
+    """Local launcher: every process on this machine.  With --elastic,
+    supervises coordinated restarts (module docstring)."""
+    restarts = 0
+    world = args.num_workers
+    while True:
+        rcs, launcher_killed, signaled = _launch_round(
+            args, command, world, restarts)
+        bad = {r: rc for r, rc in rcs.items() if rc != 0}
+        if not bad:
+            return 0
+        first = sorted(bad)[0]
+        if signaled or not args.elastic or restarts >= args.max_restarts:
+            desc = ', '.join(
+                'worker %d: %s' % (r, 'signal %d' % -rc if rc < 0
+                                   else 'code %d' % rc)
+                for r, rc in sorted(bad.items()))
+            print('launcher: job failed (%s)%s' % (
+                desc, '' if not args.elastic or signaled else
+                ' after %d restart(s)' % restarts), file=sys.stderr)
+            return _normalize_rc(bad[first])
+        lost = sorted(r for r, rc in bad.items()
+                      if rc < 0 and r not in launcher_killed)
+        if args.elastic_shrink and lost:
+            world = max(args.min_workers, world - len(lost))
+        restarts += 1
+        print('launcher: elastic restart %d/%d — %s; relaunching %d '
+              'worker(s)' % (
+                  restarts, args.max_restarts,
+                  ', '.join('worker %d %s' % (
+                      r, 'lost to signal %d' % -rc if rc < 0 else
+                      'preempted' if rc == PREEMPTED_EXIT else
+                      'exited %d' % rc) for r, rc in sorted(bad.items())),
+                  world), file=sys.stderr)
 
 
 def launch_ssh(args, command):
@@ -157,6 +322,27 @@ def main():
                         choices=['local', 'ssh'])
     parser.add_argument('-H', '--hostfile', default=None)
     parser.add_argument('--port', type=int, default=None)
+    parser.add_argument('--elastic', action='store_true',
+                        help='supervise coordinated restarts: relaunch '
+                        'when a worker is lost to a signal or exits '
+                        'PREEMPTED_EXIT (%d); workers resume from '
+                        'their elastic checkpoints' % PREEMPTED_EXIT)
+    parser.add_argument('--max-restarts', type=int, default=3,
+                        help='elastic restart budget (default 3)')
+    parser.add_argument('--elastic-shrink', action='store_true',
+                        help='relaunch at a world size reduced by the '
+                        'workers lost to signals (machine deaths); '
+                        'default relaunches at equal size')
+    parser.add_argument('--min-workers', type=int, default=1,
+                        help='floor for --elastic-shrink (default 1)')
+    parser.add_argument('--elastic-grace', type=float, default=60.0,
+                        help='seconds survivors get to detect a death '
+                        'by heartbeat loss and commit final elastic '
+                        'checkpoints before being SIGTERMed '
+                        '(default 60)')
+    parser.add_argument('--grace', type=float, default=10.0,
+                        help='SIGTERM-to-SIGKILL teardown grace '
+                        '(default 10)')
     parser.add_argument('command', nargs=argparse.REMAINDER)
     args = parser.parse_args()
     if args.command and args.command[0] == '--':
