@@ -1225,6 +1225,279 @@ def serve_bench():
     }))
 
 
+# ---------------------------------------------------------------------------
+# BENCH_FLEET=1: fleet serving tier (registry + SLO batching + HTTP front +
+# continuous batching) — the ISSUE-10 acceptance measurements
+# ---------------------------------------------------------------------------
+
+def fleet_bench():
+    """BENCH_FLEET=1: measure the fleet serving tier
+    (mxnet_tpu/serving_fleet.py) and emit ONE JSON line covering the
+    three acceptance claims:
+
+      (a) **SLO batching** — two tenants through the REAL HTTP front
+          (localhost sockets): 'fast' (small MLP, tight deadline,
+          priority 1) and 'bulk' (bigger MLP, loose deadline).  The
+          single-knob arm gives both engines one global max_wait_us
+          (tuned high for bulk coalescing, the pre-fleet story); the
+          SLO arm derives each tenant's batcher hold from its own
+          deadline.  Client-side p99 for the fast tenant must meet
+          its deadline under SLO and miss it under the global knob.
+      (b) **continuous batching** — mixed-length sequences through
+          ContinuousEngine vs the same engine in convoy mode
+          (admission only into an empty batch): throughput best-of-N,
+          gated on BIT-parity of the continuous outputs vs solo runs.
+      (c) **registry paging** — evict/re-warm cycles under a byte
+          budget that fits one model: steady-state exec_cache miss
+          delta must be ZERO.
+
+    Knobs: BENCH_FLEET_PASSES (3), BENCH_FLEET_REQS (per client, 40),
+    BENCH_FLEET_FAST_CLIENTS / _BULK_CLIENTS (2/2),
+    BENCH_FLEET_FAST_DEADLINE_MS (50 — sized so this rig's ~2x
+    cpu-shares throttle swings cannot flip either arm's verdict: the
+    SLO arm's measured p99 sits well under it, the single-knob arm's
+    well over), BENCH_FLEET_GLOBAL_WAIT_US (60000 — the single knob,
+    tuned for bulk fill), BENCH_FLEET_SEQS (24),
+    BENCH_FLEET_SLOTS (4).
+    """
+    import threading
+    import urllib.request
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, nd, sym
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.serving_fleet import (ContinuousEngine, HttpFront,
+                                         ModelRegistry, SLO)
+
+    sys.setswitchinterval(0.001)
+    passes = max(1, int(os.environ.get('BENCH_FLEET_PASSES', 3)))
+    reqs = int(os.environ.get('BENCH_FLEET_REQS', 40))
+    fast_clients = int(os.environ.get('BENCH_FLEET_FAST_CLIENTS', 2))
+    bulk_clients = int(os.environ.get('BENCH_FLEET_BULK_CLIENTS', 2))
+    fast_deadline = float(os.environ.get('BENCH_FLEET_FAST_DEADLINE_MS',
+                                         50))
+    global_wait = int(os.environ.get('BENCH_FLEET_GLOBAL_WAIT_US',
+                                     60000))
+    n_seqs = int(os.environ.get('BENCH_FLEET_SEQS', 24))
+    slots = int(os.environ.get('BENCH_FLEET_SLOTS', 4))
+    rng = np.random.RandomState(11)
+
+    def mlp_pred(dim, hidden, seed):
+        net = _serve_symbol(hidden, 16, dim)
+        probe = net.simple_bind(mx.cpu(), grad_req='null',
+                                data=(1, dim))
+        rs = np.random.RandomState(seed)
+        args = {k: nd.array(rs.randn(*v.shape).astype(np.float32) * .1)
+                for k, v in probe.arg_dict.items() if k != 'data'}
+        return lambda: Predictor(symbol=net, arg_params=args,
+                                 input_shapes={'data': (1, dim)})
+
+    fast_dim, bulk_dim = 32, 256
+    fast_loader = mlp_pred(fast_dim, 32, 1)
+    bulk_loader = mlp_pred(bulk_dim, 256, 2)
+
+    # -- (a) SLO vs single-knob, through the HTTP front ----------------
+    def http_arm(slo_mode):
+        reg = ModelRegistry()
+        fast_kw = dict(max_batch=8)
+        bulk_kw = dict(max_batch=8)
+        if not slo_mode:    # ONE global knob for every tenant,
+            fast_kw['max_wait_us'] = global_wait   # tuned for bulk
+            bulk_kw['max_wait_us'] = global_wait   # coalescing
+        reg.register('fast', loader=fast_loader,
+                     slo=SLO(deadline_ms=fast_deadline, priority=1),
+                     **fast_kw)
+        # bulk's deadline is 3x fast: its derived hold (~0.75x the
+        # global knob) keeps the arms' BULK behavior comparable, so
+        # the A/B isolates the fast tenant's treatment
+        reg.register('bulk', loader=bulk_loader,
+                     slo=SLO(deadline_ms=3 * fast_deadline),
+                     **bulk_kw)
+        reg.engine('fast')      # load + AOT-warm outside the clock:
+        reg.engine('bulk')      # the arms measure batching policy,
+        front = HttpFront(reg, port=0).start()   # not cold starts
+        host, port = front.address
+
+        def post(name, arr):
+            body = json.dumps({'instances': arr.tolist()}).encode()
+            req = urllib.request.Request(
+                'http://%s:%d/v1/models/%s:predict' % (host, port,
+                                                       name),
+                data=body,
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status == 200
+                resp.read()
+
+        best = None
+        for _ in range(passes):
+            fast_lat = []
+            errors = []
+
+            def fast_client():
+                x = rng.randn(1, fast_dim).astype(np.float32)
+                try:
+                    for _ in range(reqs):
+                        t0 = time.perf_counter()
+                        post('fast', x)
+                        fast_lat.append(
+                            (time.perf_counter() - t0) * 1e3)
+                except Exception as e:
+                    errors.append(e)
+
+            def bulk_client():
+                x = rng.randn(1, bulk_dim).astype(np.float32)
+                try:
+                    for _ in range(reqs):
+                        post('bulk', x)
+                except Exception as e:
+                    errors.append(e)
+
+            ts = [threading.Thread(target=fast_client)
+                  for _ in range(fast_clients)] + \
+                 [threading.Thread(target=bulk_client)
+                  for _ in range(bulk_clients)]
+            tic = time.time()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            elapsed = time.time() - tic
+            if errors:
+                raise errors[0]
+            p99 = float(np.percentile(fast_lat, 99))
+            p50 = float(np.percentile(fast_lat, 50))
+            total = (fast_clients + bulk_clients) * reqs
+            if best is None or p99 < best['fast_p99_ms']:
+                best = {'fast_p99_ms': p99, 'fast_p50_ms': p50,
+                        'rps': total / elapsed}
+        front.close()
+        reg.close()
+        return best
+
+    single = http_arm(slo_mode=False)
+    slo = http_arm(slo_mode=True)
+
+    # -- (b) continuous vs convoy on mixed-length sequences ------------
+    sdim, shid = 16, 32
+    data = sym.Variable('data')
+    h_in = sym.Variable('h')
+    pre = sym.FullyConnected(data, num_hidden=shid, name='ix') + \
+        sym.FullyConnected(h_in, num_hidden=shid, no_bias=True,
+                           name='hh')
+    h_new = sym.Activation(pre, act_type='tanh')
+    head = sym.FullyConnected(h_new, num_hidden=8, name='out')
+    cell = sym.Group([head, h_new])
+    rs = np.random.RandomState(5)
+    cp = {'ix_weight': nd.array(rs.randn(shid, sdim).astype(np.float32)
+                                * .3),
+          'ix_bias': nd.array(np.zeros(shid, np.float32)),
+          'hh_weight': nd.array(rs.randn(shid, shid).astype(np.float32)
+                                * .3),
+          'out_weight': nd.array(rs.randn(8, shid).astype(np.float32)
+                                 * .3),
+          'out_bias': nd.array(np.zeros(8, np.float32))}
+
+    def mk_cont(convoy):
+        return ContinuousEngine(cell, arg_params=cp, data_shape=(sdim,),
+                                state_shapes={'h': (shid,)},
+                                state_outputs={'h': 1}, slots=slots,
+                                convoy=convoy)
+
+    lens = [3 if i % 2 == 0 else 18 for i in range(n_seqs)]
+    seqs = [rs.randn(L, sdim).astype(np.float32) for L in lens]
+
+    # parity gate: co-resident continuous answers vs solo (sequential)
+    eng = mk_cont(convoy=False)
+    solo = [eng.infer(s) for s in seqs]
+    res = [None] * len(seqs)
+    ts = [threading.Thread(
+        target=lambda i=i: res.__setitem__(i, eng.infer(seqs[i])))
+        for i in range(len(seqs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    cont_bit_parity = all(
+        all(np.array_equal(a, b) for a, b in zip(res[i], solo[i]))
+        for i in range(len(seqs)))
+    eng.close()
+
+    def seq_pass(convoy):
+        engine = mk_cont(convoy)
+        out = [None] * len(seqs)
+        ts = [threading.Thread(
+            target=lambda i=i: out.__setitem__(i,
+                                               engine.infer(seqs[i])))
+            for i in range(len(seqs))]
+        tic = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        elapsed = time.time() - tic
+        st = engine.stats()
+        engine.close()
+        return len(seqs) / elapsed, st
+
+    cont_sps = convoy_sps = 0.0
+    cont_st = convoy_st = None
+    for _ in range(passes):
+        s, st = seq_pass(convoy=False)
+        if s > cont_sps:
+            cont_sps, cont_st = s, st
+        s, st = seq_pass(convoy=True)
+        if s > convoy_sps:
+            convoy_sps, convoy_st = s, st
+
+    # -- (c) registry paging: evict/re-warm at zero compiles -----------
+    reg = ModelRegistry(budget_bytes=1)      # forces single residency
+    reg.register('m1', loader=fast_loader, max_batch=4, max_wait_us=0)
+    reg.register('m2', loader=bulk_loader, max_batch=4, max_wait_us=0)
+    xf = rng.randn(1, fast_dim).astype(np.float32)
+    xb = rng.randn(1, bulk_dim).astype(np.float32)
+    reg.infer('m1', xf)
+    reg.infer('m2', xb)                      # both warmed once
+    before = exec_cache.stats()['misses']
+    cycles = 3
+    for _ in range(cycles):
+        reg.infer('m1', xf)
+        reg.infer('m2', xb)
+    rewarm_misses = exec_cache.stats()['misses'] - before
+    evictions = reg.stats()['evictions']
+    reg.close()
+
+    print(json.dumps({
+        'metric': 'serve_fleet',
+        'value': round(slo['fast_p99_ms'], 3),
+        'unit': 'ms_fast_tenant_p99',
+        'passes': passes,
+        'fast_deadline_ms': fast_deadline,
+        'fast_p99_single_knob_ms': round(single['fast_p99_ms'], 3),
+        'fast_p50_single_knob_ms': round(single['fast_p50_ms'], 3),
+        'fast_p99_slo_ms': round(slo['fast_p99_ms'], 3),
+        'fast_p50_slo_ms': round(slo['fast_p50_ms'], 3),
+        'slo_met': bool(slo['fast_p99_ms'] <= fast_deadline),
+        'single_knob_met': bool(
+            single['fast_p99_ms'] <= fast_deadline),
+        'global_wait_us': global_wait,
+        'http_rps_single_knob': round(single['rps'], 2),
+        'http_rps_slo': round(slo['rps'], 2),
+        'cont_seqs_per_s': round(cont_sps, 2),
+        'convoy_seqs_per_s': round(convoy_sps, 2),
+        'cont_speedup': round(cont_sps / convoy_sps, 3)
+        if convoy_sps else None,
+        'cont_utilization': round(cont_st['utilization'], 3),
+        'convoy_utilization': round(convoy_st['utilization'], 3),
+        'cont_bit_parity': bool(cont_bit_parity),
+        'cont_compiles_after_warmup':
+            cont_st['compiles_after_warmup'],
+        'evict_rewarm_cycles': cycles,
+        'evictions': evictions,
+        'evict_rewarm_compiles': rewarm_misses,
+    }))
+
+
 def is_oom(text):
     return 'RESOURCE_EXHAUSTED' in text or 'Out of memory' in text
 
@@ -1281,6 +1554,9 @@ def main():
 def _bench_main():
     if os.environ.get('BENCH_INFER', '') == 'serve':
         serve_bench()   # dynamic-batching inference engine bench
+        return
+    if os.environ.get('BENCH_FLEET', '') == '1':
+        fleet_bench()   # fleet tier: SLO batching / continuous / paging
         return
     if os.environ.get('BENCH_GLUON', '') == '1':
         gluon_bench()   # fused vs imperative Gluon training

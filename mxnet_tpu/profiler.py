@@ -385,6 +385,48 @@ def serving_stats():
     return out
 
 
+# fleet serving-tier counters (serving_fleet.ModelRegistry + HTTP
+# front + continuous batcher): registry paging activity, SLO shed
+# decisions, HTTP admission, and continuous-batching slot utilization
+_FLEET = {
+    'fleet_models_registered': 0,
+    'fleet_loads': 0,            # model made resident (engine warmed)
+    'fleet_evictions': 0,        # byte-budget LRU paged a model out
+    'fleet_shed_requests': 0,    # Overloaded raised at admission
+    'fleet_http_requests': 0,
+    'fleet_http_429': 0,         # backpressure surfaced to a client
+    'fleet_resident_bytes': 0,   # gauge: registry-resident weight bytes
+    'cont_ticks': 0,             # continuous-batcher step dispatches
+    'cont_active_row_ticks': 0,  # slot-ticks doing real sequence work
+    'cont_slot_ticks': 0,        # slot-ticks available (ticks x slots)
+    'cont_admitted': 0,
+    'cont_retired': 0,
+}
+
+
+def add_fleet_stats(resident_bytes=None, **deltas):
+    """Accumulate fleet serving-tier counters (resident_bytes is a
+    GAUGE — set, not added; everything else adds)."""
+    with _STATE['lock']:
+        for k, v in deltas.items():
+            _FLEET['fleet_' + k if 'fleet_' + k in _FLEET
+                   else k] += int(v)
+        if resident_bytes is not None:
+            _FLEET['fleet_resident_bytes'] = int(resident_bytes)
+
+
+def fleet_stats():
+    """Snapshot of the fleet serving counters plus the derived
+    continuous-batching utilization (active slot-ticks / available
+    slot-ticks; 1.0 = every slot of every dispatch did real work)."""
+    with _STATE['lock']:
+        out = dict(_FLEET)
+    st = out['cont_slot_ticks']
+    out['cont_utilization'] = \
+        out['cont_active_row_ticks'] / st if st else 0.0
+    return out
+
+
 def add_comm_bytes(reduce_scattered=0, all_gathered=0):
     """Accumulate logical collective payload bytes (ZeRO-1 fused
     steps: gradients reduce-scattered, updated params all-gathered)."""
@@ -465,6 +507,8 @@ def dump_profile():
                    'args': ckpt_stats()})
     events.append({'ph': 'M', 'name': 'dist', 'pid': 0,
                    'args': dist_stats()})
+    events.append({'ph': 'M', 'name': 'fleet', 'pid': 0,
+                   'args': fleet_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -610,6 +654,16 @@ def summary(print_out=True):
                     ds['dist_dead_hosts_detected'],
                     ds['dist_allreduce_rounds'],
                     ds['dist_allreduce_bytes'], ds['dist_restarts']))
+    fl = fleet_stats()
+    lines.append('  fleet_loads=%d fleet_evictions=%d '
+                 'fleet_shed_requests=%d fleet_http_requests=%d '
+                 'fleet_http_429=%d fleet_resident_bytes=%d '
+                 'cont_ticks=%d cont_utilization=%.3f'
+                 % (fl['fleet_loads'], fl['fleet_evictions'],
+                    fl['fleet_shed_requests'],
+                    fl['fleet_http_requests'], fl['fleet_http_429'],
+                    fl['fleet_resident_bytes'], fl['cont_ticks'],
+                    fl['cont_utilization']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -650,6 +704,8 @@ def clear():
             _CKPT[k] = type(_CKPT[k])()
         for k in _DIST:
             _DIST[k] = type(_DIST[k])()
+        for k in _FLEET:
+            _FLEET[k] = 0
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

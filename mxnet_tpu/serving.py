@@ -69,6 +69,15 @@ def _env_int(name, default):
         return default
 
 
+# per-engine latency window: enough samples for stable p99 at test/
+# smoke traffic volumes, bounded so a long-lived engine stays O(1)
+_LOCAL_LAT_CAP = 4096
+# EMA weight for the per-batch service-time / rows-per-batch estimates
+# the fleet admission control consumes (recent traffic dominates, one
+# throttled batch doesn't whipsaw the shed decision)
+_SVC_EMA_ALPHA = 0.25
+
+
 class _Request(object):
     """One infer() call in flight: host inputs, result slot, a done
     event the caller blocks on."""
@@ -240,6 +249,9 @@ class InferenceEngine(object):
         self._qrows = {}                # free_entry -> queued row count
         self._n_queued = 0              # total queued requests (O(1)
                                         # queue-depth stat at dispatch)
+        self._n_queued_rows = 0         # total queued ROWS (O(1)
+                                        # backlog_rows for admission
+                                        # control / shed decisions)
         self._cond = threading.Condition()
         self._inflight = deque()        # (program, outs, reqs, offs,
                                         #  rows, depth, pad_elem_frac)
@@ -247,13 +259,28 @@ class InferenceEngine(object):
         self._depth = max(1, int(depth))
         self._closed = False
         self._started = False
+        self._close_lock = threading.Lock()
         # lifetime counters (engine-local; profiler gets them too)
         self._lock = threading.Lock()
+        self._inflight_rows = 0         # coalesced/in-service rows:
+                                        # part of backlog_rows until
+                                        # the batch completes
         self._n_requests = 0
         self._n_batches = 0
         self._n_rows = 0
         self._n_padded_rows = 0
         self._fill_sum = 0.0
+        # engine-LOCAL observation window: the serve_* profiler family
+        # is process-global (every engine in the process feeds it), so
+        # a fleet registry / /statsz endpoint could not attribute
+        # latency/fill/queue-depth per model from it — these mirror
+        # the same observations scoped to THIS engine only
+        self._local_lats = []           # bounded latency ring (ms)
+        self._local_lat_pos = 0
+        self._qd_sum = 0
+        self._qd_obs = 0
+        self._svc_ms_ema = None         # per-batch service time EMA
+        self._rows_per_batch_ema = None
         self._warm_snapshot = None
         if warmup:
             self.warmup()
@@ -330,6 +357,8 @@ class InferenceEngine(object):
         buckets) through exec_cache, then snapshot the cache stats —
         steady-state traffic after this performs zero XLA compiles
         (stats()['compiles_after_warmup'] stays 0)."""
+        if self._closed:
+            raise MXNetError('InferenceEngine is closed')
         import jax
         rng = jax.random.PRNGKey(0)
         for free_entry in self._free_buckets:
@@ -432,6 +461,7 @@ class InferenceEngine(object):
                 raise MXNetError('InferenceEngine is closed')
             wake = False
             self._n_queued += len(staged)
+            self._n_queued_rows += sum(req.rows for _, req in staged)
             for entry, req in staged:
                 q = self._queues.setdefault(entry, deque())
                 q.append(req)
@@ -496,11 +526,15 @@ class InferenceEngine(object):
         multi-engine or serve-while-training process another
         component's compiles bill here too, so >0 means *something*
         compiled, not necessarily this engine.  The merged serve_*
-        keys (latency percentiles, queue depth, ...) likewise come
-        from the PROCESS-global profiler and span every engine in the
-        process; requests/batches/rows/fill/pad are this engine's
-        own."""
+        keys come from the PROCESS-global profiler and span every
+        engine in the process; everything else — requests/batches/
+        rows/fill/pad AND the un-prefixed latency_p50_ms /
+        latency_p99_ms / queue_depth_avg / service_ms_ema /
+        rows_per_batch_ema window — is scoped to THIS engine, so a
+        fleet registry or /statsz endpoint can attribute fill/p99/
+        shed per model."""
         with self._lock:
+            lats = list(self._local_lats)
             out = {
                 'requests': self._n_requests,
                 'batches': self._n_batches,
@@ -511,7 +545,16 @@ class InferenceEngine(object):
                 'pad_waste_frac': (self._n_padded_rows /
                                    (self._n_rows + self._n_padded_rows)
                                    if self._n_rows else 0.0),
+                'queue_depth_avg': (self._qd_sum / self._qd_obs
+                                    if self._qd_obs else 0.0),
+                'service_ms_ema': self._svc_ms_ema or 0.0,
+                'rows_per_batch_ema': self._rows_per_batch_ema or 0.0,
             }
+        out['latency_p50_ms'] = \
+            float(np.percentile(lats, 50)) if lats else 0.0
+        out['latency_p99_ms'] = \
+            float(np.percentile(lats, 99)) if lats else 0.0
+        out['backlog_rows'] = self.backlog_rows()
         snap = self._warm_snapshot
         if snap is not None:
             now = exec_cache.stats()
@@ -520,6 +563,25 @@ class InferenceEngine(object):
                 now['total_compile_s'] - snap['total_compile_s'], 6)
         out.update(profiler.serving_stats())
         return out
+
+    def backlog_rows(self):
+        """Rows queued + coalesced-but-unfinished (O(1)): the backlog
+        an admission controller weighs against the service rate."""
+        with self._cond:
+            queued = self._n_queued_rows
+        with self._lock:
+            return queued + self._inflight_rows
+
+    def service_estimate(self):
+        """(service_ms_per_batch, rows_per_batch) EMAs from the
+        engine-local window, or None before any traffic completed —
+        the per-tenant signal SLO admission control divides backlog
+        by.  rows_per_batch is clamped >= 1."""
+        with self._lock:
+            if self._svc_ms_ema is None:
+                return None
+            return (self._svc_ms_ema,
+                    max(1.0, self._rows_per_batch_ema))
 
     # ------------------------------------------------------------------
     # batcher (dispatcher thread)
@@ -542,6 +604,12 @@ class InferenceEngine(object):
             rows += r.rows
         self._qrows[entry] = self._qrows.get(entry, 0) - rows
         self._n_queued -= len(reqs)
+        self._n_queued_rows -= rows
+        # rows move from "queued" to "in service" atomically w.r.t.
+        # backlog accounting: they stay in backlog_rows until the
+        # completion thread hands their answers back
+        with self._lock:
+            self._inflight_rows += rows
         return reqs, rows
 
     def _dispatch_loop(self):
@@ -592,6 +660,8 @@ class InferenceEngine(object):
             try:
                 self._launch(entry, reqs, rows, depth, rng)
             except Exception as e:               # surface per-request
+                with self._lock:            # rows never reached the
+                    self._inflight_rows -= rows  # completion thread
                 for r in reqs:
                     r.error = e
                     r.event.set()
@@ -667,8 +737,10 @@ class InferenceEngine(object):
                 break
             prog, outs, reqs, offs, rows, depth, pad_frac = item
             try:
+                t0 = time.perf_counter()
                 with profiler.scope('serve_complete', 'serving'):
                     jax.block_until_ready(outs)
+                svc_ms = (time.perf_counter() - t0) * 1e3
                 np_outs = [np.asarray(o) for o in outs]
                 now = time.perf_counter()
                 masks = self._mirror_masks.get(prog.free_shapes)
@@ -688,6 +760,31 @@ class InferenceEngine(object):
                     self._n_rows += rows
                     self._n_padded_rows += prog.batch - rows
                     self._fill_sum += fill
+                    # engine-local window (per-model attribution: the
+                    # profiler serve_* family below is process-global)
+                    for lat in lats:
+                        if len(self._local_lats) < _LOCAL_LAT_CAP:
+                            self._local_lats.append(lat)
+                        else:
+                            self._local_lats[self._local_lat_pos] = lat
+                            self._local_lat_pos = \
+                                (self._local_lat_pos + 1) % _LOCAL_LAT_CAP
+                    self._qd_sum += depth
+                    self._qd_obs += 1
+                    # service-rate EMAs: the block-until-ready wall
+                    # time of this batch (under double buffering this
+                    # is the synchronous drain — an estimate, which is
+                    # all admission control needs) and the rows it
+                    # retired; the fleet shed decision divides them
+                    a = _SVC_EMA_ALPHA
+                    if self._svc_ms_ema is None:
+                        self._svc_ms_ema = svc_ms
+                        self._rows_per_batch_ema = float(rows)
+                    else:
+                        self._svc_ms_ema += a * (svc_ms -
+                                                 self._svc_ms_ema)
+                        self._rows_per_batch_ema += a * (
+                            rows - self._rows_per_batch_ema)
                 profiler.add_serving_stats(
                     requests=len(reqs), batches=1, rows=rows,
                     padded_rows=prog.batch - rows, fill=fill,
@@ -700,32 +797,46 @@ class InferenceEngine(object):
                     if not r.event.is_set():
                         r.error = e
                         r.event.set()
+            finally:
+                with self._lock:
+                    self._inflight_rows -= rows
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
     def close(self, timeout=30):
-        """Flush queued work, stop and join both worker threads
-        (idempotent).  Requests still queued are served before
-        shutdown; infer() after close raises."""
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-        with self._inflight_cond:
-            self._inflight_cond.notify_all()
-        if self._started:
-            self._dispatcher.join(timeout=timeout)
-            self._completer.join(timeout=timeout)
-            if self._dispatcher.is_alive() or self._completer.is_alive():
-                # a wedged dispatch outlived the join timeout: keep
-                # _started so a later close() retries the join instead
-                # of silently reporting a drained shutdown
-                warnings.warn('InferenceEngine.close(): worker threads '
-                              'still running after %ss (dispatch '
-                              'wedged?); call close() again to re-join'
-                              % timeout)
-            else:
-                self._started = False
+        """Reject-new + drain + join (idempotent, thread-safe):
+        requests already queued are served before shutdown, infer()
+        after (or racing) close raises the typed closed error, and
+        concurrent close() calls — a registry eviction thread and the
+        owning thread, say — serialize on their own lock, never on
+        `_prog_lock` (which a cold dispatch may hold for the length
+        of an XLA compile: close never acquires it, so eviction while
+        another thread is mid-infer() cannot deadlock — worst case
+        the join waits out the compile and warns past `timeout`)."""
+        with self._close_lock:
+            if self._closed and not self._started:
+                return self             # fully drained already
+            with self._cond:
+                self._closed = True
+                self._cond.notify_all()
+            with self._inflight_cond:
+                self._inflight_cond.notify_all()
+            if self._started:
+                self._dispatcher.join(timeout=timeout)
+                self._completer.join(timeout=timeout)
+                if self._dispatcher.is_alive() or \
+                        self._completer.is_alive():
+                    # a wedged dispatch outlived the join timeout:
+                    # keep _started so a later close() retries the
+                    # join instead of silently reporting a drained
+                    # shutdown
+                    warnings.warn('InferenceEngine.close(): worker '
+                                  'threads still running after %ss '
+                                  '(dispatch wedged?); call close() '
+                                  'again to re-join' % timeout)
+                else:
+                    self._started = False
         return self
 
     @property

@@ -1,0 +1,1201 @@
+"""Fleet serving tier: model registry, SLO-aware batching, HTTP front
+with backpressure, continuous batching for sequence models.
+
+`serving.InferenceEngine` (PERF round 9) is one-engine-one-model with a
+single global batching knob.  This module grows it into the fleet shape
+ROADMAP item 2 asks for — the first user-facing surface of the stack:
+
+  * **ModelRegistry** — hosts many named models' AOT rung artifacts
+    through the process-wide `exec_cache`, with byte-budgeted LRU
+    paging: a cold model's *weights* are evicted (engine closed +
+    drained, Predictor dropped — device memory freed), while its
+    compiled rung programs stay cached process-wide (they hold graph
+    code, not weight buffers — see serving._make_serve_fn), so a
+    re-warm rebinds + reloads params from the checkpoint artifacts and
+    performs ZERO new XLA compilations.  Cross-process, the
+    `export_compiled` artifacts + the PR-1 persistent XLA cache warm a
+    fresh process where the backend allows it (the PR-7 CPU-backend
+    guard keeps the on-disk cache off on XLA:CPU — in-process paging is
+    unaffected by that guard).
+  * **SLO-aware batching** — each model/tenant carries a deadline
+    (`SLO(deadline_ms=..., priority=...)`) instead of the one global
+    `max_wait_us` knob: the batcher hold is derived from the deadline
+    budget (`MXNET_TPU_SERVE_WAIT_FRACTION` of it), and admission
+    control sheds on backlog with a typed `Overloaded` error once
+    backlog rows x the engine-local service rate (the per-engine
+    counter window `InferenceEngine.stats()` now scopes) exceeds the
+    deadline — a client that cannot be served in time learns NOW, not
+    after its deadline already passed in a queue.
+  * **HTTP front** (`HttpFront`, driven by tools/serve_http.py) —
+    stdlib `http.server` threads, no new deps: POST
+    `/v1/models/<name>:predict`, GET `/healthz` and `/statsz`, with
+    bounded in-flight admission so backpressure propagates to clients
+    as 429s (+ Retry-After) instead of unbounded queues.
+  * **Continuous batching** (`ContinuousEngine`) — the sequence-model
+    analog of the dynamic batcher: a per-timestep cell runs at a fixed
+    slot count, and requests are ADMITTED into free slots and RETIRED
+    at their own length at every tick boundary, so a long sequence no
+    longer convoys short ones (the convoy baseline — fill the batch,
+    run everyone to the longest length — is the `convoy=True` mode the
+    bench A/Bs against).  One fixed program shape -> zero steady-state
+    compiles, and row independence makes co-residency bit-exact vs a
+    solo run.
+
+Env knobs (docs/SERVING.md has the full table):
+  MXNET_TPU_SERVE_REGISTRY_BYTES   registry byte budget (0 = unbounded)
+  MXNET_TPU_SERVE_DEADLINE_MS      default SLO deadline (unset = none)
+  MXNET_TPU_SERVE_WAIT_FRACTION    batcher hold as deadline fraction
+  MXNET_TPU_SERVE_SHED_FACTOR      shed when est > factor x deadline
+  MXNET_TPU_SERVE_MAX_QUEUE_ROWS   hard backlog cap per model (4096)
+  MXNET_TPU_SERVE_HTTP_INFLIGHT    bounded HTTP admission (64)
+  MXNET_TPU_SERVE_HTTP_PORT        default front port (8000)
+"""
+import json
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from . import exec_cache
+from . import profiler
+from .base import MXNetError
+from .serving import InferenceEngine, _env_int
+
+__all__ = ['Overloaded', 'SLO', 'ModelRegistry', 'ContinuousEngine',
+           'HttpFront']
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+class Overloaded(MXNetError):
+    """Typed shed error: the model's backlog x service rate exceeds its
+    deadline (or the hard queue cap), so admitting this request would
+    only burn queue memory on an answer that arrives too late.  The
+    HTTP front maps it to 429 + Retry-After; direct callers can back
+    off on `retry_after_ms`."""
+
+    def __init__(self, model, backlog_rows, est_ms, deadline_ms):
+        self.model = model
+        self.backlog_rows = int(backlog_rows)
+        self.est_ms = float(est_ms)
+        self.deadline_ms = None if deadline_ms is None \
+            else float(deadline_ms)
+        # suggest retrying after the excess backlog should have
+        # drained; clamped finite (the hard queue-cap path sheds with
+        # est=inf) so HTTP Retry-After arithmetic stays sane
+        self.retry_after_ms = min(
+            60000.0, max(1.0, (self.est_ms - (self.deadline_ms or 0.0))
+                         if np.isfinite(self.est_ms) else 1000.0))
+        super(Overloaded, self).__init__(
+            'model %r overloaded: estimated %.1fms for %d backlog rows'
+            '%s' % (model, self.est_ms, self.backlog_rows,
+                    '' if deadline_ms is None
+                    else ' > deadline %.1fms' % self.deadline_ms))
+
+
+class SLO(object):
+    """Per-model/tenant serving objective.
+
+    deadline_ms : float or None
+        End-to-end latency target.  Drives BOTH the batcher hold (the
+        engine's `max_wait_us` becomes WAIT_FRACTION of the deadline
+        budget instead of the global knob) and admission control
+        (shed with `Overloaded` once the backlog estimate exceeds
+        shed_factor x deadline).  None (and no
+        MXNET_TPU_SERVE_DEADLINE_MS default) = no deadline: global
+        batching knob, shed only at the hard queue cap.
+    priority : int
+        Higher = more important.  The registry evicts lowest-priority
+        models first (LRU within a priority), and the HTTP front's
+        scarce last admission slots are reserved for priority >= 1
+        (see HttpFront).
+    service_ms_hint : float or None
+        Estimated per-ROW service time used for shed decisions before
+        the engine-local counter window has observed real traffic
+        (after the first completed batch the measured EMA takes over).
+    shed_factor : float
+        Backlog estimate tolerance before shedding (default
+        MXNET_TPU_SERVE_SHED_FACTOR or 1.0).
+    """
+
+    def __init__(self, deadline_ms=None, priority=0,
+                 service_ms_hint=None, shed_factor=None):
+        if deadline_ms is None:
+            d = _env_float('MXNET_TPU_SERVE_DEADLINE_MS', 0.0)
+            deadline_ms = d if d > 0 else None
+        self.deadline_ms = None if deadline_ms is None \
+            else float(deadline_ms)
+        self.priority = int(priority)
+        self.service_ms_hint = None if service_ms_hint is None \
+            else float(service_ms_hint)
+        self.shed_factor = float(
+            shed_factor if shed_factor is not None else
+            _env_float('MXNET_TPU_SERVE_SHED_FACTOR', 1.0))
+
+    def wait_us(self):
+        """Deadline-driven batcher hold: the engine may hold an
+        underfull batch open for WAIT_FRACTION of the deadline budget
+        (coalescing opportunity without eating the whole budget in the
+        queue).  None when no deadline — the engine's global default
+        knob applies."""
+        if self.deadline_ms is None:
+            return None
+        frac = _env_float('MXNET_TPU_SERVE_WAIT_FRACTION', 0.25)
+        return max(0, int(self.deadline_ms * 1000.0 * frac))
+
+    def describe(self):
+        return {'deadline_ms': self.deadline_ms,
+                'priority': self.priority,
+                'shed_factor': self.shed_factor}
+
+
+# ---------------------------------------------------------------------------
+# model registry
+# ---------------------------------------------------------------------------
+
+class _ModelEntry(object):
+    __slots__ = ('name', 'loader', 'slo', 'engine_kwargs', 'pinned',
+                 'lock', 'engine', 'holder', 'bytes', 'last_used')
+
+    def __init__(self, name, loader, slo, engine_kwargs, pinned):
+        self.name = name
+        self.loader = loader
+        self.slo = slo
+        self.engine_kwargs = engine_kwargs
+        self.pinned = pinned
+        self.lock = threading.Lock()    # serializes load vs evict
+        self.engine = None              # engine-like (resident only)
+        self.holder = None              # the Predictor (weight owner)
+        self.bytes = 0
+        self.last_used = 0.0
+
+
+def _weight_bytes(executor):
+    """Resident weight/aux bytes of one bound executor — the unit the
+    registry's byte budget accounts (input staging is transient and
+    compiled programs are host-side code shared via exec_cache)."""
+    total = 0
+    for d in (executor.arg_dict, executor.aux_dict):
+        for a in d.values():
+            total += int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+    return total
+
+
+class ModelRegistry(object):
+    """Hosts many named models behind one serving surface, paging
+    their weights through a byte budget with LRU eviction while the
+    process-wide exec_cache keeps every model's compiled rung
+    programs warm (evict/re-warm cycles perform zero XLA compiles —
+    the programs hold graph code, not weight buffers).
+
+    Models are *registered* cheaply (a loader spec, nothing resident)
+    and made resident on first use.  A loader is either:
+
+      * ``prefix=/path/prefix, epoch=N, input_shapes={...}`` — the
+        Module.save_checkpoint artifacts; re-warm reloads params from
+        disk (the pageable, production shape), or
+      * ``loader=callable`` returning a fresh Predictor (or an
+        engine-like object with .infer/.close — a ContinuousEngine
+        for sequence models), or
+      * ``source=<live Predictor/Module>`` — registered PINNED: its
+        weights exist only in memory, so the registry counts but
+        never evicts it.
+
+    Parameters
+    ----------
+    budget_bytes : int, optional
+        Resident-weight budget (default MXNET_TPU_SERVE_REGISTRY_BYTES;
+        0/unset = unbounded).  A load may transiently overshoot by the
+        incoming model's size — the budget is enforced by evicting
+        colder models immediately after, so steady state stays under.
+    ctx : Context, optional
+        Device for checkpoint loaders (default cpu()).
+    """
+
+    def __init__(self, budget_bytes=None, ctx=None):
+        self.budget_bytes = int(
+            budget_bytes if budget_bytes is not None else
+            _env_int('MXNET_TPU_SERVE_REGISTRY_BYTES', 0))
+        self.max_queue_rows = _env_int('MXNET_TPU_SERVE_MAX_QUEUE_ROWS',
+                                       4096)
+        self._ctx = ctx
+        self._lock = threading.Lock()   # registry map + byte ledger
+        self._entries = {}
+        self._resident_bytes = 0
+        self._n_loads = 0
+        self._n_evictions = 0
+        self._n_shed = 0
+        self._closed = False
+
+    # -- registration ---------------------------------------------------
+    def register(self, name, loader=None, prefix=None, epoch=0,
+                 input_shapes=None, source=None, slo=None,
+                 **engine_kwargs):
+        """Register a model spec (nothing loads until first use).
+        Exactly one of `loader` / `prefix` / `source`.  `engine_kwargs`
+        forward to InferenceEngine (max_batch, batch_buckets,
+        free_dim_buckets, ...); `max_wait_us` defaults to the SLO's
+        deadline-derived hold instead of the global knob."""
+        given = [x is not None for x in (loader, prefix, source)]
+        if sum(given) != 1:
+            raise MXNetError('register(%r): exactly one of loader= / '
+                             'prefix= / source= required' % name)
+        pinned = False
+        if prefix is not None:
+            if input_shapes is None:
+                raise MXNetError('register(%r): prefix= needs '
+                                 'input_shapes=' % name)
+            from .predictor import Predictor
+            ctx = self._ctx
+            shapes = dict(input_shapes)
+
+            def loader(_p=prefix, _e=int(epoch), _s=shapes, _c=ctx):
+                return Predictor.from_checkpoint(_p, _e, _s, ctx=_c)
+        elif source is not None:
+            # live object: weights exist only in memory — evicting
+            # would lose them, so it is resident-forever (pinned)
+            pinned = True
+
+            def loader(_src=source):
+                return _src
+        entry = _ModelEntry(name, loader, slo or SLO(),
+                            dict(engine_kwargs), pinned)
+        with self._lock:
+            if self._closed:
+                raise MXNetError('ModelRegistry is closed')
+            if name in self._entries:
+                raise MXNetError('model %r already registered' % name)
+            self._entries[name] = entry
+        profiler.add_fleet_stats(models_registered=1)
+        return self
+
+    def models(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def _entry(self, name):
+        with self._lock:
+            ent = self._entries.get(name)
+        if ent is None:
+            raise MXNetError('unknown model %r (registered: %s)'
+                             % (name, self.models()))
+        return ent
+
+    # -- residency / paging ---------------------------------------------
+    def engine(self, name):
+        """The model's resident engine, loading (and byte-budget
+        paging) on demand.  Thread-safe; concurrent callers of the
+        same cold model serialize on the entry lock so the load and
+        ladder warmup happen once."""
+        ent = self._entry(name)
+        ent.last_used = time.monotonic()
+        eng = ent.engine
+        if eng is not None and not eng.closed:
+            return eng
+        return self._load(ent)
+
+    def _load(self, ent):
+        with ent.lock:
+            if self._closed:
+                raise MXNetError('ModelRegistry is closed')
+            if ent.engine is not None and not ent.engine.closed:
+                return ent.engine
+            obj = ent.loader()
+            if hasattr(obj, 'infer'):   # engine-like (ContinuousEngine
+                eng, holder = obj, obj  # or a pre-built engine)
+                nbytes = int(obj.resident_bytes()) \
+                    if hasattr(obj, 'resident_bytes') else 0
+            else:                       # a Predictor: wrap + warm
+                kwargs = dict(ent.engine_kwargs)
+                if 'max_wait_us' not in kwargs:
+                    w = ent.slo.wait_us()
+                    if w is not None:
+                        kwargs['max_wait_us'] = w
+                eng = InferenceEngine(obj, **kwargs)
+                holder = obj
+                nbytes = _weight_bytes(obj._executor)
+            ent.engine, ent.holder, ent.bytes = eng, holder, nbytes
+            with self._lock:
+                self._resident_bytes += nbytes
+                self._n_loads += 1
+            profiler.add_fleet_stats(
+                loads=1, resident_bytes=self._resident_bytes)
+        # budget enforcement AFTER the load: the incoming model's size
+        # is only known once its weights exist, so a load may
+        # transiently overshoot; colder models are paged out
+        # immediately (never the one just loaded)
+        self._enforce_budget(keep=ent)
+        return ent.engine
+
+    def _enforce_budget(self, keep=None):
+        if self.budget_bytes <= 0:
+            return
+        while True:
+            with self._lock:
+                if self._resident_bytes <= self.budget_bytes:
+                    return
+                victims = [e for e in self._entries.values()
+                           if e is not keep and not e.pinned and
+                           e.engine is not None and
+                           not e.engine.closed]
+                if not victims:
+                    return      # nothing evictable: overshoot stands
+                # lowest priority first, LRU within a priority
+                victim = min(victims, key=lambda e:
+                             (e.slo.priority, e.last_used))
+            self._evict_one(victim)
+
+    def _evict_one(self, ent):
+        """Page one model out: reject-new + drain its engine (close),
+        drop the weight holder, free the byte ledger.  The compiled
+        rung programs stay in exec_cache (host-side graph code, no
+        weight buffers) so a later re-warm compiles nothing."""
+        with ent.lock:
+            eng = ent.engine
+            if eng is None:
+                return
+            eng.close()
+            ent.engine = None
+            ent.holder = None
+            freed, ent.bytes = ent.bytes, 0
+            with self._lock:
+                self._resident_bytes -= freed
+                self._n_evictions += 1
+            profiler.add_fleet_stats(
+                evictions=1, resident_bytes=self._resident_bytes)
+
+    def evict(self, name):
+        """Manually page a model out (no-op when not resident).
+        Refuses pinned (source=) models: their weights exist only in
+        memory, so the loader would hand back the same closed object
+        forever — close() the registry to shut them down instead."""
+        ent = self._entry(name)
+        if ent.pinned:
+            raise MXNetError('model %r is pinned (registered from a '
+                             'live source=): evicting would lose its '
+                             'only weight copy; use close() to shut '
+                             'the registry down' % name)
+        self._evict_one(ent)
+        return self
+
+    # -- serving --------------------------------------------------------
+    def infer(self, name, *pos_inputs, **named_inputs):
+        """Admission-controlled inference: sheds with `Overloaded`
+        when the model's backlog x service rate exceeds its SLO
+        deadline (or the hard queue-row cap), else forwards to the
+        resident engine.  A concurrent eviction racing this call is
+        absorbed by one transparent reload+retry."""
+        ent = self._entry(name)
+        for attempt in (0, 1):
+            eng = self.engine(name)
+            self._admit(ent, eng)
+            try:
+                return eng.infer(*pos_inputs, **named_inputs)
+            except MXNetError as e:
+                # eviction race: the engine closed between our engine()
+                # and the enqueue — reload once; anything else is real
+                if attempt == 0 and getattr(eng, 'closed', False) and \
+                        'closed' in str(e):
+                    continue
+                raise
+
+    def predict(self, name, *pos_inputs, **named_inputs):
+        """First output of infer() (same conventions)."""
+        return self.infer(name, *pos_inputs, **named_inputs)[0]
+
+    def _admit(self, ent, eng):
+        """Shed-on-backlog: estimated time-to-answer for the CURRENT
+        backlog (rows x per-row service estimate from the
+        engine-local counter window, or the SLO hint before traffic)
+        against the deadline.  Estimates only — but an estimate that
+        says 'this answer arrives after its deadline' is enough to
+        prefer a fast typed error over a slow useless answer."""
+        slo = ent.slo
+        backlog = eng.backlog_rows() if hasattr(eng, 'backlog_rows') \
+            else 0
+        if backlog > self.max_queue_rows:
+            self._shed(ent, backlog, float('inf'))
+        if slo.deadline_ms is None:
+            return
+        est = eng.service_estimate() \
+            if hasattr(eng, 'service_estimate') else None
+        if est is not None:
+            svc_ms, rows_per_batch = est
+            per_row_ms = svc_ms / rows_per_batch
+        elif slo.service_ms_hint is not None:
+            per_row_ms = slo.service_ms_hint
+        else:
+            return                      # nothing to judge with yet
+        est_ms = (backlog + 1) * per_row_ms
+        if est_ms > slo.deadline_ms * slo.shed_factor:
+            self._shed(ent, backlog, est_ms)
+
+    def _shed(self, ent, backlog, est_ms):
+        with self._lock:
+            self._n_shed += 1
+        profiler.add_fleet_stats(shed_requests=1)
+        raise Overloaded(ent.name, backlog, est_ms,
+                         ent.slo.deadline_ms)
+
+    # -- observability / lifecycle --------------------------------------
+    def stats(self):
+        """Registry paging counters + per-model attribution (each
+        resident model's ENGINE-LOCAL window — fill, p50/p99, backlog
+        — which the per-engine counter scoping makes per-model
+        honest, unlike the process-global serve_* family)."""
+        with self._lock:
+            entries = list(self._entries.values())
+            out = {
+                'budget_bytes': self.budget_bytes,
+                'resident_bytes': self._resident_bytes,
+                'loads': self._n_loads,
+                'evictions': self._n_evictions,
+                'shed_requests': self._n_shed,
+            }
+        models = {}
+        for ent in entries:
+            eng = ent.engine
+            m = {'resident': eng is not None and not eng.closed,
+                 'pinned': ent.pinned,
+                 'bytes': ent.bytes}
+            m.update(ent.slo.describe())
+            if m['resident'] and hasattr(eng, 'stats'):
+                m['engine'] = eng.stats()
+            models[ent.name] = m
+        out['models'] = models
+        return out
+
+    def export_artifacts(self, name, batch_buckets=None):
+        """The model's `export_compiled` artifacts (one per rung when
+        batch_buckets is given) — with MXNET_TPU_PERSISTENT_CACHE_DIR
+        set (and the backend allowing it; the PR-7 CPU guard applies)
+        the compile also lands in the on-disk XLA cache, so a FRESH
+        process re-warms this model from disk."""
+        ent = self._entry(name)
+        self.engine(name)               # ensure resident
+        holder = ent.holder
+        if not hasattr(holder, 'export_compiled'):
+            raise MXNetError('model %r source has no export_compiled '
+                             '(sequence/engine-like models export via '
+                             'their own artifacts)' % name)
+        return holder.export_compiled(batch_buckets=batch_buckets)
+
+    def close(self):
+        """Evict everything and reject further use (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return self
+            self._closed = True
+            entries = list(self._entries.values())
+        for ent in entries:
+            self._evict_one(ent)
+        return self
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# continuous batching for sequence models
+# ---------------------------------------------------------------------------
+
+class _ContRequest(object):
+    __slots__ = ('seq', 'length', 't', 'ys', 'event', 'outputs',
+                 'error', 't_enq')
+
+    def __init__(self, seq):
+        self.seq = seq
+        self.length = seq.shape[0]
+        self.t = 0
+        self.ys = None                  # per-output list of step rows
+        self.event = threading.Event()
+        self.outputs = None
+        self.error = None
+        self.t_enq = time.perf_counter()
+
+
+class ContinuousEngine(object):
+    """Continuous batching over a per-timestep sequence cell: the
+    RNN/BucketingModule analog of the dynamic batcher.
+
+    The model is a SINGLE-timestep symbol — inputs `data_name` (one
+    step of the sequence, shape (slots,) + data_shape) plus named
+    recurrent state variables; outputs carry the per-step user outputs
+    and the next states (`state_outputs` maps each state input name to
+    the output index that feeds it back).  The engine binds it ONCE at
+    a fixed `slots` batch — one program shape, zero steady-state
+    compiles — and runs a tick loop:
+
+      tick:  admit waiting requests into free slots (their state is
+             reset via an in-graph `where(reset, init, state)` — no
+             second program), run one step for all slots, append each
+             ACTIVE slot's output row, retire slots whose sequence
+             just finished (hand back their stacked outputs), repeat.
+
+    A request occupies a slot for exactly its own length: a long
+    sequence no longer convoys short ones, and a freed slot is re-used
+    by the next request mid-flight.  Row independence of the cell
+    makes co-residency BIT-exact against running the same request
+    alone (same program, same slot arithmetic — tested).
+
+    `convoy=True` is the baseline the bench A/Bs against: admission
+    only into an EMPTY batch, everyone runs to the longest admitted
+    length (what a naive sequence batcher does).
+
+    Parameters
+    ----------
+    symbol : Symbol
+        The per-timestep cell graph.
+    arg_params / aux_params : dict
+        Parameter NDArrays (state variables must NOT appear here).
+    data_shape : tuple
+        Per-timestep input shape WITHOUT the slot dim, e.g. (16,).
+    state_shapes : dict name -> tuple
+        Recurrent state shapes WITHOUT the slot dim.
+    state_outputs : dict name -> int
+        Which output index carries each state's next value.
+    slots : int
+        Fixed co-resident request capacity (default
+        MXNET_TPU_SERVE_MAX_BATCH or 4).
+    init_states : dict name -> array, optional
+        Initial state per admitted request (default zeros).  Non-zero
+        inits are baked into the step program as constants, so that
+        program is NOT shared through exec_cache (zeros — the common
+        case — is).
+    max_queue : int
+        Backlog cap in REQUESTS: beyond it, infer() sheds with
+        `Overloaded` (default MXNET_TPU_SERVE_MAX_QUEUE_ROWS).
+    """
+
+    def __init__(self, symbol, arg_params=None, aux_params=None,
+                 data_name='data', data_shape=None, state_shapes=None,
+                 state_outputs=None, slots=None, ctx=None,
+                 init_states=None, convoy=False, max_queue=None):
+        from .context import cpu
+        if data_shape is None or not state_shapes or not state_outputs:
+            raise MXNetError('ContinuousEngine needs data_shape, '
+                             'state_shapes and state_outputs')
+        if set(state_shapes) != set(state_outputs):
+            raise MXNetError('state_shapes and state_outputs must name '
+                             'the same states')
+        self._ctx = ctx or cpu()
+        self.slots = int(slots if slots is not None else
+                         _env_int('MXNET_TPU_SERVE_MAX_BATCH', 4))
+        self.convoy = bool(convoy)
+        self.max_queue = int(max_queue if max_queue is not None else
+                             _env_int('MXNET_TPU_SERVE_MAX_QUEUE_ROWS',
+                                      4096))
+        self._data_name = data_name
+        self._data_shape = tuple(int(d) for d in data_shape)
+        self._state_names = sorted(state_shapes)
+        self._state_out_idx = [int(state_outputs[s])
+                               for s in self._state_names]
+        shapes = {data_name: (self.slots,) + self._data_shape}
+        for s in self._state_names:
+            shapes[s] = (self.slots,) + tuple(int(d)
+                                              for d in state_shapes[s])
+        ex = symbol.simple_bind(self._ctx, grad_req='null', **shapes)
+        ex.copy_params_from(arg_params or {}, aux_params or {})
+        for s in self._state_names:
+            if s in (arg_params or {}):
+                raise MXNetError('state %r must not be a parameter' % s)
+        self._ex = ex
+        self._symbol = symbol
+        n_outs = ex._n_outputs
+        bad = [i for i in self._state_out_idx
+               if i < 0 or i >= n_outs]
+        if bad:
+            raise MXNetError('state_outputs index %r out of range '
+                             '(%d outputs)' % (bad, n_outs))
+        self._y_idx = [i for i in range(n_outs)
+                       if i not in set(self._state_out_idx)]
+        self._dtype = np.dtype(ex.arg_dict[data_name].dtype)
+        self._step = _make_cont_step(ex, data_name, self._state_names,
+                                     self._state_out_idx, init_states)
+        # device-resident recurrent state (one buffer set, reused)
+        import jax
+        self._states = tuple(
+            jax.numpy.zeros(ex.arg_dict[s].shape,
+                            np.dtype(ex.arg_dict[s].dtype))
+            for s in self._state_names)
+        self._rng = jax.random.PRNGKey(0)
+        # warm the single program + validate the slot-dim contract
+        outs, states = self._step(
+            jax.numpy.zeros((self.slots,) + self._data_shape,
+                            self._dtype),
+            jax.numpy.zeros((self.slots,), np.bool_),
+            self._states, self._weights(), self._aux(), self._rng)
+        for i, o in zip(self._y_idx, outs):
+            if o.ndim == 0 or o.shape[0] != self.slots:
+                raise MXNetError(
+                    'ContinuousEngine requires row-independent outputs '
+                    'with a leading slot dim: output %d has shape %r '
+                    '(slots=%d) — a slot-reducing cell would mix '
+                    'co-resident sequences' % (i, tuple(o.shape),
+                                               self.slots))
+        jax.block_until_ready(outs)
+        self._warm_snapshot = exec_cache.stats()
+        # request plumbing
+        self._cond = threading.Condition()
+        self._queue = deque()
+        self._active = [None] * self.slots
+        self._closed = False
+        # engine-local counters
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._active_row_ticks = 0
+        self._admitted = 0
+        self._retired = 0
+        self._close_lock = threading.Lock()
+        self._loop = threading.Thread(target=self._tick_loop,
+                                      name='mxtpu-cont-batch',
+                                      daemon=True)
+        self._loop.start()
+        self._started = True
+
+    def _weights(self):
+        ex = self._ex
+        skip = set(self._state_names) | {self._data_name}
+        return tuple(ex.arg_dict[n]._data for n in ex.arg_dict
+                     if n not in skip)
+
+    def _aux(self):
+        ex = self._ex
+        return tuple(ex.aux_dict[n]._data for n in ex.aux_dict)
+
+    # -- public API -----------------------------------------------------
+    def infer(self, seq):
+        """Submit ONE sequence (np array (T,) + data_shape; T >= 1)
+        and block for its per-step outputs — a list of np arrays, one
+        per non-state model output, each (T,) + that output's
+        per-step shape.  Thread-safe; requests admit into free slots
+        at tick boundaries."""
+        return self.infer_many([seq])[0]
+
+    def infer_many(self, seqs):
+        """Submit several sequences ATOMICALLY (one queue hold — the
+        tick loop sees all of them at its next admission boundary, so
+        slot packing is deterministic for a quiet engine) and block
+        for all answers.  Returns a list of per-sequence output
+        lists, in submission order."""
+        reqs = [self._validate(s) for s in seqs]
+        with self._cond:
+            if self._closed:
+                raise MXNetError('ContinuousEngine is closed')
+            if len(self._queue) + len(reqs) > self.max_queue:
+                profiler.add_fleet_stats(shed_requests=1)
+                raise Overloaded('<continuous>', len(self._queue),
+                                 float('inf'), None)
+            self._queue.extend(reqs)
+            self._cond.notify_all()
+        for r in reqs:
+            r.event.wait()
+        for r in reqs:
+            if r.error is not None:
+                raise r.error
+        return [r.outputs for r in reqs]
+
+    def _validate(self, seq):
+        a = seq.asnumpy() if hasattr(seq, 'asnumpy') else \
+            np.asarray(seq)
+        a = np.ascontiguousarray(a, dtype=self._dtype)
+        if a.ndim != 1 + len(self._data_shape) or \
+                tuple(a.shape[1:]) != self._data_shape or \
+                a.shape[0] < 1:
+            raise MXNetError('sequence shape %r != (T,)+%r with T>=1'
+                             % (tuple(a.shape), self._data_shape))
+        return _ContRequest(a)
+
+    def stats(self):
+        """Engine-local continuous-batching counters: ticks (step
+        dispatches), slot utilization (active row-ticks / slot-ticks
+        — 1.0 means every slot of every dispatch advanced a real
+        sequence), admit/retire totals, and the zero-compile check
+        relative to construction."""
+        with self._lock:
+            ticks = self._ticks
+            out = {
+                'ticks': ticks,
+                'active_row_ticks': self._active_row_ticks,
+                'slot_ticks': ticks * self.slots,
+                'utilization': (self._active_row_ticks /
+                                (ticks * self.slots) if ticks else 0.0),
+                'admitted': self._admitted,
+                'retired': self._retired,
+                'slots': self.slots,
+                'convoy': self.convoy,
+            }
+        now = exec_cache.stats()
+        snap = self._warm_snapshot
+        out['compiles_after_warmup'] = now['misses'] - snap['misses']
+        out['compile_s_after_warmup'] = round(
+            now['total_compile_s'] - snap['total_compile_s'], 6)
+        return out
+
+    def backlog_rows(self):
+        with self._cond:
+            return len(self._queue) + \
+                sum(1 for s in self._active if s is not None)
+
+    def service_estimate(self):
+        return None                     # per-tick model: no batch EMA
+
+    def resident_bytes(self):
+        return _weight_bytes(self._ex)
+
+    # -- tick loop ------------------------------------------------------
+    def _tick_loop(self):
+        import jax
+        jnp = jax.numpy
+        while True:
+            admitted = []
+            with self._cond:
+                while not self._closed and not self._queue and \
+                        all(s is None for s in self._active):
+                    self._cond.wait()
+                if self._closed and not self._queue and \
+                        all(s is None for s in self._active):
+                    break
+                # admission at the tick boundary: continuous mode
+                # fills any free slot NOW; convoy mode only admits
+                # into an all-empty batch (then runs that cohort to
+                # its longest length — the baseline being beaten)
+                can_admit = any(s is None for s in self._active) if \
+                    not self.convoy else \
+                    all(s is None for s in self._active)
+                if can_admit:
+                    for i in range(self.slots):
+                        if self._active[i] is None and self._queue:
+                            req = self._queue.popleft()
+                            req.ys = [[] for _ in self._y_idx]
+                            self._active[i] = req
+                            admitted.append(i)
+            active = [(i, r) for i, r in enumerate(self._active)
+                      if r is not None]
+            if not active:
+                continue
+            x = np.zeros((self.slots,) + self._data_shape, self._dtype)
+            reset = np.zeros((self.slots,), np.bool_)
+            for i in admitted:
+                reset[i] = True
+            for i, r in active:
+                x[i] = r.seq[r.t]
+            try:
+                outs, self._states = self._step(
+                    jnp.asarray(x), jnp.asarray(reset), self._states,
+                    self._weights(), self._aux(), self._rng)
+                np_outs = [np.asarray(o) for o in outs]
+            except Exception as e:      # surface to every co-resident
+                with self._cond:
+                    for i, r in active:
+                        r.error = e
+                        r.event.set()
+                        self._active[i] = None
+                continue
+            retired = 0
+            for i, r in active:
+                for k, o in enumerate(np_outs):
+                    r.ys[k].append(o[i].copy())
+                r.t += 1
+                if r.t >= r.length:
+                    r.outputs = [np.stack(rows) for rows in r.ys]
+                    r.event.set()
+                    retired += 1
+                    with self._cond:
+                        self._active[i] = None
+            with self._lock:
+                self._ticks += 1
+                self._active_row_ticks += len(active)
+                self._admitted += len(admitted)
+                self._retired += retired
+            profiler.add_fleet_stats(
+                cont_ticks=1, cont_active_row_ticks=len(active),
+                cont_slot_ticks=self.slots,
+                cont_admitted=len(admitted), cont_retired=retired)
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, timeout=30):
+        """Reject-new + drain (queued and in-flight sequences finish)
+        + join the tick loop.  Idempotent and safe to call from a
+        registry eviction thread while another thread is mid-infer()
+        — same contract as InferenceEngine.close()."""
+        with self._close_lock:
+            if self._closed and not self._started:
+                return self
+            with self._cond:
+                self._closed = True
+                self._cond.notify_all()
+            if self._started:
+                self._loop.join(timeout=timeout)
+                if self._loop.is_alive():
+                    import warnings
+                    warnings.warn('ContinuousEngine.close(): tick loop '
+                                  'still running after %ss; call '
+                                  'close() again to re-join' % timeout)
+                else:
+                    self._started = False
+        return self
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close(timeout=5)
+        except Exception:               # interpreter teardown
+            pass
+
+
+def _make_cont_step(ex, data_name, state_names, state_out_idx,
+                    init_states):
+    """The continuous batcher's single step program: one timestep for
+    every slot, with per-slot state reset folded INTO the graph
+    (`where(reset, init, state)`) so admission costs no second
+    program.  Cached process-wide under the cell executor's graph
+    signature (zeros-init only — custom init values are baked-in
+    constants, see ContinuousEngine docs), so a re-created engine
+    compiles nothing."""
+    import jax
+    jnp = jax.numpy
+    names = list(ex.arg_dict)
+    data_pos = names.index(data_name)
+    state_pos = [names.index(s) for s in state_names]
+    skip = set(state_names) | {data_name}
+    other_pos = [i for i, n in enumerate(names) if n not in skip]
+    y_idx = [i for i in range(ex._n_outputs)
+             if i not in set(state_out_idx)]
+    key = None
+    if ex._sig is not None and not init_states:
+        key = (ex._sig, 'cont_step', data_name, tuple(state_names),
+               tuple(state_out_idx))
+        fn = exec_cache.get(key)
+        if fn is not None:
+            return fn
+    inits = None
+    if init_states:
+        inits = [jnp.asarray(np.asarray(init_states[s]))
+                 for s in state_names]
+    raw = ex.raw_forward
+    n_args = len(names)
+
+    def step(x, reset, state_vals, weight_vals, aux_vals, rng):
+        merged = [None] * n_args
+        merged[data_pos] = x
+        for k, (i, v) in enumerate(zip(state_pos, state_vals)):
+            mask = reset.reshape((-1,) + (1,) * (v.ndim - 1))
+            init = inits[k] if inits is not None else \
+                jnp.zeros((), v.dtype)
+            merged[i] = jnp.where(mask, init, v)
+        for i, v in zip(other_pos, weight_vals):
+            merged[i] = v
+        outs, _ = raw(tuple(merged), aux_vals, rng)
+        return (tuple(outs[i] for i in y_idx),
+                tuple(outs[i] for i in state_out_idx))
+
+    fn = exec_cache.TimedJit(jax.jit(step))
+    if key is not None:
+        exec_cache.put(key, fn)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# HTTP front (stdlib http.server — no new deps)
+# ---------------------------------------------------------------------------
+
+try:
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+except ImportError:                     # py<3.7 has no Threading server
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+    from socketserver import ThreadingMixIn
+
+    class ThreadingHTTPServer(ThreadingMixIn, HTTPServer):
+        daemon_threads = True
+
+
+class _FleetHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    """POST /v1/models/<name>:predict   {"inputs": {name: nested-list}}
+                                     or {"instances": nested-list}
+       GET  /healthz                    liveness
+       GET  /statsz                     registry + fleet counters
+
+    Error mapping: unknown model -> 404, malformed request -> 400,
+    `Overloaded` / admission-full -> 429 (+ Retry-After), registry
+    closed -> 503, anything else -> 500.  Every predict passes the
+    front's bounded in-flight gate FIRST, so a client flood turns
+    into fast 429s (backpressure), never an unbounded queue."""
+
+    protocol_version = 'HTTP/1.1'
+    server_version = 'mxtpu-serve/1.0'
+
+    def log_message(self, fmt, *args):  # quiet: profiler counts us
+        pass
+
+    def _reply(self, code, payload, retry_after_ms=None):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        if retry_after_ms is not None:
+            self.send_header('Retry-After',
+                             '%d' % max(1, int(retry_after_ms / 1000.0)
+                                        + 1))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        front = self.server.front
+        if self.path == '/healthz':
+            if front.closed or front.registry.closed:
+                self._reply(503, {'status': 'closing'})
+            else:
+                self._reply(200, {'status': 'ok',
+                                  'models': front.registry.models()})
+        elif self.path == '/statsz':
+            stats = front.registry.stats()
+            stats['fleet'] = profiler.fleet_stats()
+            stats['http'] = front.stats()
+            self._reply(200, stats)
+        else:
+            self._reply(404, {'error': 'not found', 'path': self.path})
+
+    def do_POST(self):
+        front = self.server.front
+        profiler.add_fleet_stats(http_requests=1)
+        front.note_request()
+        # drain the request body BEFORE any reply: these are HTTP/1.1
+        # keep-alive connections, and an early 404/429 sent while
+        # unread body bytes sit in rfile would leave them to be parsed
+        # as the NEXT request line on the persistent connection —
+        # corrupting every subsequent request from that client
+        try:
+            n = int(self.headers.get('Content-Length', 0) or 0)
+        except ValueError:
+            n = 0
+        raw = self.rfile.read(n) if n > 0 else b''
+        name = _predict_model(self.path)
+        if name is None:
+            self._reply(404, {'error': 'not found', 'path': self.path})
+            return
+        if not front.admit(name):
+            profiler.add_fleet_stats(http_429=1)
+            front.note_429()
+            self._reply(429, {'error': 'overloaded',
+                              'reason': 'in-flight limit',
+                              'model': name},
+                        retry_after_ms=1000)
+            return
+        try:
+            try:
+                body = json.loads(raw or b'{}')
+                pos, named = _decode_inputs(body)
+            except (ValueError, TypeError) as e:
+                self._reply(400, {'error': 'bad request',
+                                  'detail': str(e)})
+                return
+            try:
+                outs = front.registry.infer(name, *pos, **named)
+            except Overloaded as e:
+                profiler.add_fleet_stats(http_429=1)
+                front.note_429()
+                self._reply(429, {'error': 'overloaded',
+                                  'model': name,
+                                  'backlog_rows': e.backlog_rows,
+                                  'est_ms': _json_num(e.est_ms),
+                                  'deadline_ms': e.deadline_ms},
+                            retry_after_ms=e.retry_after_ms)
+                return
+            except MXNetError as e:
+                msg = str(e)
+                if 'unknown model' in msg:
+                    self._reply(404, {'error': 'unknown model',
+                                      'model': name})
+                elif 'closed' in msg:
+                    self._reply(503, {'error': 'closing'})
+                else:
+                    self._reply(400, {'error': 'bad request',
+                                      'detail': msg})
+                return
+            except Exception as e:      # pragma: no cover - safety net
+                self._reply(500, {'error': 'internal',
+                                  'detail': str(e)})
+                return
+            self._reply(200,
+                        {'outputs': [np.asarray(o).tolist()
+                                     for o in outs]})
+        finally:
+            front.release(name)
+
+
+def _predict_model(path):
+    """Model name from /v1/models/<name>:predict, else None."""
+    prefix, suffix = '/v1/models/', ':predict'
+    if path.startswith(prefix) and path.endswith(suffix):
+        name = path[len(prefix):-len(suffix)]
+        if name and '/' not in name:
+            return name
+    return None
+
+
+def _decode_inputs(body):
+    """JSON body -> (positional, named) np inputs.  {"inputs": {...}}
+    feeds named inputs; {"instances": [...]} is the single-input
+    shorthand (one positional array)."""
+    if not isinstance(body, dict):
+        raise ValueError('JSON object body required')
+    if 'inputs' in body:
+        named = body['inputs']
+        if not isinstance(named, dict):
+            raise ValueError('"inputs" must be an object of arrays')
+        return (), {k: np.asarray(v) for k, v in named.items()}
+    if 'instances' in body:
+        return (np.asarray(body['instances']),), {}
+    raise ValueError('body needs "inputs" or "instances"')
+
+
+def _json_num(x):
+    return None if x is None or not np.isfinite(x) else float(x)
+
+
+class HttpFront(object):
+    """The fleet's HTTP surface: a threaded stdlib server over a
+    ModelRegistry with BOUNDED in-flight admission — at most
+    `max_inflight` predicts execute concurrently, and the last
+    `priority_reserve` slots admit only models whose SLO priority is
+    >= 1, so under pressure the cheap/batch tenants 429 first and the
+    interactive ones keep their headroom.  Backpressure therefore
+    reaches clients as fast typed 429s (+ Retry-After), never as an
+    unbounded queue the deadline silently dies in.
+
+    Usage::
+
+        front = HttpFront(registry, port=8000).start()
+        ...
+        front.close()
+    """
+
+    def __init__(self, registry, host='127.0.0.1', port=None,
+                 max_inflight=None, priority_reserve=None):
+        self.registry = registry
+        self.max_inflight = int(
+            max_inflight if max_inflight is not None else
+            _env_int('MXNET_TPU_SERVE_HTTP_INFLIGHT', 64))
+        if priority_reserve is None:
+            priority_reserve = max(1, self.max_inflight // 8) \
+                if self.max_inflight > 1 else 0
+        self.priority_reserve = int(priority_reserve)
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._n_requests = 0
+        self._n_429 = 0
+        self._closed = False
+        port = int(port if port is not None else
+                   _env_int('MXNET_TPU_SERVE_HTTP_PORT', 8000))
+        self._server = _FleetHTTPServer((host, port), _FleetHandler)
+        self._server.front = self
+        self._thread = None
+
+    @property
+    def address(self):
+        """(host, port) actually bound (port 0 resolves here)."""
+        return self._server.server_address[:2]
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name='mxtpu-serve-http', daemon=True)
+            self._thread.start()
+        return self
+
+    def admit(self, name):
+        """Bounded admission; the reserve tail only admits priority
+        >= 1 tenants (registry SLO), unknown models pass through (the
+        handler 404s them with full detail)."""
+        if self._closed:
+            return False
+        prio = 0
+        try:
+            prio = self.registry._entry(name).slo.priority
+        except MXNetError:
+            pass
+        with self._lock:
+            limit = self.max_inflight if prio >= 1 else \
+                self.max_inflight - self.priority_reserve
+            if self._inflight >= limit:
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self, name):
+        with self._lock:
+            self._inflight -= 1
+
+    def note_request(self):
+        with self._lock:
+            self._n_requests += 1
+
+    def note_429(self):
+        with self._lock:
+            self._n_429 += 1
+
+    def stats(self):
+        with self._lock:
+            return {'inflight': self._inflight,
+                    'max_inflight': self.max_inflight,
+                    'priority_reserve': self.priority_reserve,
+                    'requests': self._n_requests,
+                    'rejected_429': self._n_429}
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def close(self):
+        """Stop accepting, shut the server down, join the serve
+        thread (idempotent).  The registry is NOT closed — it may
+        outlive the front (or be shared by several)."""
+        if self._closed:
+            return self
+        self._closed = True
+        if self._thread is not None:
+            # shutdown() BLOCKS until serve_forever exits — only safe
+            # when start() actually ran it
+            self._server.shutdown()
+            self._thread.join(timeout=10)
+        self._server.server_close()
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

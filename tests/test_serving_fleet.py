@@ -1,0 +1,676 @@
+"""Fleet serving tier tests (serving_fleet: ModelRegistry + SLO
+batching + HTTP front + continuous batching).
+
+Covers the ISSUE-10 contract: registry LRU evict/re-warm with ZERO
+recompiles, SLO deadline-derived batcher holds, shed-on-backlog as a
+typed Overloaded error, HTTP 200/404/429/healthz/statsz round-trips
+over localhost, continuous-batch admit/retire bit-parity vs solo runs
+(and its deterministic tick win over convoy batching), the per-engine
+counter scoping satellite, and close()-vs-eviction safety.  All
+models CPU-sized.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import exec_cache, model as model_mod, nd, profiler, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import InferenceEngine
+from mxnet_tpu.serving_fleet import (SLO, ContinuousEngine, HttpFront,
+                                     ModelRegistry, Overloaded)
+
+DIM = 6
+HID = 8
+OUT = 3
+
+
+def _mlp():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, num_hidden=HID, name='fc1')
+    act = sym.Activation(fc1, act_type='relu')
+    return sym.FullyConnected(act, num_hidden=OUT, name='fc2')
+
+
+def _params(seed=7):
+    rs = np.random.RandomState(seed)
+    return {
+        'fc1_weight': nd.array(rs.randn(HID, DIM).astype(np.float32) * .5),
+        'fc1_bias': nd.array(rs.randn(HID).astype(np.float32) * .1),
+        'fc2_weight': nd.array(rs.randn(OUT, HID).astype(np.float32) * .5),
+        'fc2_bias': nd.array(rs.randn(OUT).astype(np.float32) * .1),
+    }
+
+
+def _loader(seed):
+    return lambda: Predictor(symbol=_mlp(), arg_params=_params(seed),
+                             input_shapes={'data': (1, DIM)})
+
+
+def _ref(seed, x):
+    return Predictor(symbol=_mlp(), arg_params=_params(seed),
+                     input_shapes={'data': (x.shape[0], DIM)}).forward(
+                         data=x)[0].asnumpy()
+
+
+def _x(rows, seed=0):
+    return np.random.RandomState(seed).randn(rows, DIM).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# registry: residency, paging, re-warm
+# ---------------------------------------------------------------------------
+
+def test_registry_infer_parity_and_unknown_model():
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1), max_batch=4, max_wait_us=0)
+        x = _x(2, seed=3)
+        out = reg.infer('m', x)
+        np.testing.assert_allclose(out[0], _ref(1, x), rtol=2e-6,
+                                   atol=1e-6)
+        np.testing.assert_allclose(reg.predict('m', x), out[0])
+        with pytest.raises(MXNetError, match='unknown model'):
+            reg.infer('nope', x)
+        with pytest.raises(MXNetError, match='already registered'):
+            reg.register('m', loader=_loader(1))
+    with pytest.raises(MXNetError, match='closed'):
+        reg.infer('m', x)
+
+
+def test_registry_lru_evict_rewarm_zero_compiles():
+    # budget fits ONE tiny model: alternating traffic pages m1/m2 in
+    # and out; after each model warmed once, further evict/re-warm
+    # cycles must hit exec_cache for every rung — zero new compiles
+    x = _x(2, seed=5)
+    ref1, ref2 = _ref(1, x), _ref(2, x)
+    with ModelRegistry(budget_bytes=400) as reg:
+        reg.register('m1', loader=_loader(1), max_batch=4,
+                     max_wait_us=0)
+        reg.register('m2', loader=_loader(2), max_batch=4,
+                     max_wait_us=0)
+        np.testing.assert_allclose(reg.infer('m1', x)[0], ref1,
+                                   rtol=2e-6, atol=1e-6)
+        np.testing.assert_allclose(reg.infer('m2', x)[0], ref2,
+                                   rtol=2e-6, atol=1e-6)
+        st = reg.stats()
+        assert st['evictions'] >= 1          # m1 was paged out
+        assert st['resident_bytes'] <= 400
+        before = exec_cache.stats()['misses']
+        for _ in range(2):
+            np.testing.assert_allclose(reg.infer('m1', x)[0], ref1,
+                                       rtol=2e-6, atol=1e-6)
+            np.testing.assert_allclose(reg.infer('m2', x)[0], ref2,
+                                       rtol=2e-6, atol=1e-6)
+        assert exec_cache.stats()['misses'] == before
+        st = reg.stats()
+        assert st['evictions'] >= 4
+        assert st['models']['m2']['resident']
+        assert not st['models']['m1']['resident']
+
+
+def test_registry_pinned_source_never_evicted():
+    # a live Predictor's weights exist only in memory: registered
+    # pinned, counted in the ledger, never paged out even when a
+    # colder-by-LRU load overshoots the budget
+    pred = _loader(1)()
+    with ModelRegistry(budget_bytes=400) as reg:
+        reg.register('pinned', source=pred, max_batch=4, max_wait_us=0)
+        reg.register('pageable', loader=_loader(2), max_batch=4,
+                     max_wait_us=0)
+        x = _x(1)
+        reg.infer('pageable', x)
+        reg.infer('pinned', x)           # over budget: pageable pays
+        st = reg.stats()
+        assert st['models']['pinned']['resident']
+        assert st['models']['pinned']['pinned']
+        assert not st['models']['pageable']['resident']
+        # even a hopeless budget never pages the pinned model out
+        reg.budget_bytes = 1
+        reg._enforce_budget()
+        assert reg.stats()['models']['pinned']['resident']
+        # manual evict refuses too: the loader would hand back the
+        # same closed object forever (regression)
+        with pytest.raises(MXNetError, match='pinned'):
+            reg.evict('pinned')
+        assert reg.stats()['models']['pinned']['resident']
+
+
+def test_registry_priority_evict_order():
+    # three resident models over budget: the LOWEST priority goes
+    # first even when it is the most recently used
+    with ModelRegistry() as reg:      # budget set after warm
+        reg.register('low', loader=_loader(1), slo=SLO(priority=0),
+                     max_batch=2, max_wait_us=0)
+        reg.register('high', loader=_loader(2), slo=SLO(priority=2),
+                     max_batch=2, max_wait_us=0)
+        x = _x(1)
+        reg.infer('high', x)
+        time.sleep(0.01)
+        reg.infer('low', x)           # most recent, lowest priority
+        reg.budget_bytes = 400
+        reg._enforce_budget()
+        st = reg.stats()
+        assert not st['models']['low']['resident']
+        assert st['models']['high']['resident']
+
+
+def test_registry_prefix_loader_from_checkpoint(tmp_path):
+    # the production shape: register by checkpoint prefix; re-warm
+    # after manual eviction reloads params from disk
+    prefix = str(tmp_path / 'fleet_model')
+    model_mod.save_checkpoint(prefix, 3, _mlp(), _params(9), {})
+    x = _x(2, seed=1)
+    with ModelRegistry() as reg:
+        reg.register('ckpt', prefix=prefix, epoch=3,
+                     input_shapes={'data': (1, DIM)}, max_batch=4,
+                     max_wait_us=0)
+        np.testing.assert_allclose(reg.infer('ckpt', x)[0], _ref(9, x),
+                                   rtol=2e-6, atol=1e-6)
+        reg.evict('ckpt')
+        assert not reg.stats()['models']['ckpt']['resident']
+        np.testing.assert_allclose(reg.infer('ckpt', x)[0], _ref(9, x),
+                                   rtol=2e-6, atol=1e-6)
+    with pytest.raises(MXNetError, match='exactly one of'):
+        ModelRegistry().register('bad', prefix=prefix,
+                                 loader=_loader(1))
+
+
+# ---------------------------------------------------------------------------
+# SLO: deadline-derived holds, shed-on-backlog
+# ---------------------------------------------------------------------------
+
+def test_slo_deadline_drives_batcher_hold():
+    # deadline 40ms, default WAIT_FRACTION 0.25 -> 10ms hold, NOT the
+    # global MXNET_TPU_SERVE_WAIT_US knob; a lone request therefore
+    # flushes after ~10ms instead of the single-knob engine's hold
+    assert SLO(deadline_ms=40).wait_us() == 10000
+    assert SLO().wait_us() is None       # no deadline: global knob
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1),
+                     slo=SLO(deadline_ms=40), max_batch=8)
+        eng = reg.engine('m')
+        assert eng.max_wait_us == 10000
+        # explicit engine kwarg still wins over the derivation
+        reg.register('m2', loader=_loader(2),
+                     slo=SLO(deadline_ms=40), max_batch=8,
+                     max_wait_us=123)
+        assert reg.engine('m2').max_wait_us == 123
+
+
+def test_shed_on_backlog_typed_error():
+    profiler.clear()
+    with ModelRegistry() as reg:
+        # 500ms per-row hint against a 1ms deadline: the very first
+        # request is already hopeless — typed shed, never enqueued
+        reg.register('m', loader=_loader(1),
+                     slo=SLO(deadline_ms=1.0, service_ms_hint=500.0),
+                     max_batch=4, max_wait_us=0)
+        with pytest.raises(Overloaded) as ei:
+            reg.infer('m', _x(1))
+        e = ei.value
+        assert e.model == 'm'
+        assert e.est_ms > e.deadline_ms == 1.0
+        assert e.retry_after_ms >= 1.0
+        assert isinstance(e, MXNetError)     # typed AND catchable as
+        assert reg.engine('m').stats()['requests'] == 0
+        assert reg.stats()['shed_requests'] == 1
+    assert profiler.fleet_stats()['fleet_shed_requests'] == 1
+
+
+def test_shed_hard_queue_cap():
+    with ModelRegistry() as reg:
+        reg.max_queue_rows = 0           # every backlog is too deep
+        reg.register('m', loader=_loader(1), max_batch=4,
+                     max_wait_us=1000000)
+        eng = reg.engine('m')
+        t = threading.Thread(target=lambda: eng.infer(_x(1)))
+        t.start()                        # parks one row in the queue
+        deadline = time.time() + 10
+        while time.time() < deadline and eng.backlog_rows() == 0:
+            time.sleep(0.005)
+        with pytest.raises(Overloaded):
+            reg.infer('m', _x(1))
+        eng.close()                      # drains the parked request
+        t.join(timeout=30)
+        assert not t.is_alive()
+
+
+def test_measured_service_rate_takes_over_hint():
+    # after real traffic the engine-local EMA replaces the hint: a
+    # generous deadline admits even with a catastrophic hint
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1),
+                     slo=SLO(deadline_ms=60000.0,
+                             service_ms_hint=50000.0),
+                     max_batch=4, max_wait_us=0)
+        out = reg.infer('m', _x(1))      # admitted: 50s < 60s deadline
+        assert out[0].shape == (1, OUT)
+        eng = reg.engine('m')
+        est = eng.service_estimate()
+        assert est is not None
+        svc_ms, rows = est
+        assert 0 < svc_ms < 50000.0 and rows >= 1.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP front
+# ---------------------------------------------------------------------------
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_http_predict_healthz_statsz_roundtrip():
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1), max_batch=4,
+                     max_wait_us=0)
+        with HttpFront(reg, port=0).start() as front:
+            host, port = front.address
+            base = 'http://%s:%d' % (host, port)
+            x = _x(2, seed=8)
+            resp = _post('%s/v1/models/m:predict' % base,
+                         {'instances': x.tolist()})
+            assert resp.status == 200
+            outs = json.loads(resp.read())['outputs']
+            np.testing.assert_allclose(np.asarray(outs[0]), _ref(1, x),
+                                       rtol=2e-6, atol=1e-5)
+            # named-inputs form
+            resp = _post('%s/v1/models/m:predict' % base,
+                         {'inputs': {'data': x.tolist()}})
+            assert resp.status == 200
+            h = urllib.request.urlopen('%s/healthz' % base, timeout=30)
+            assert h.status == 200
+            assert json.loads(h.read())['models'] == ['m']
+            s = urllib.request.urlopen('%s/statsz' % base, timeout=30)
+            st = json.loads(s.read())
+            assert st['models']['m']['resident']
+            assert st['models']['m']['engine']['requests'] >= 2
+            assert st['http']['requests'] >= 2
+            # error mapping
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post('%s/v1/models/ghost:predict' % base,
+                      {'instances': x.tolist()})
+            assert ei.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post('%s/v1/models/m:predict' % base, {'bogus': 1})
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen('%s/nothing' % base, timeout=30)
+            assert ei.value.code == 404
+
+
+def test_http_backpressure_429_and_shed_mapping():
+    profiler.clear()
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1), max_batch=4,
+                     max_wait_us=0)
+        reg.register('shed', loader=_loader(2),
+                     slo=SLO(deadline_ms=1.0, service_ms_hint=500.0),
+                     max_batch=4, max_wait_us=0)
+        # max_inflight=0: the bounded admission gate itself 429s
+        with HttpFront(reg, port=0, max_inflight=0).start() as front:
+            base = 'http://%s:%d' % front.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post('%s/v1/models/m:predict' % base,
+                      {'instances': _x(1).tolist()})
+            assert ei.value.code == 429
+            assert int(ei.value.headers['Retry-After']) >= 1
+            # health stays green: backpressure is not sickness
+            h = urllib.request.urlopen('%s/healthz' % base, timeout=30)
+            assert h.status == 200
+        # an SLO shed maps to 429 with the Overloaded detail
+        with HttpFront(reg, port=0).start() as front:
+            base = 'http://%s:%d' % front.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post('%s/v1/models/shed:predict' % base,
+                      {'instances': _x(1).tolist()})
+            assert ei.value.code == 429
+            body = json.loads(ei.value.read())
+            assert body['error'] == 'overloaded'
+            assert body['deadline_ms'] == 1.0
+            assert 'Retry-After' in ei.value.headers
+    fl = profiler.fleet_stats()
+    assert fl['fleet_http_requests'] >= 2
+    assert fl['fleet_http_429'] >= 2
+
+
+def test_http_keepalive_survives_early_replies():
+    # HTTP/1.1 keep-alive: an early 404/429 must DRAIN the request
+    # body first — unread bytes would be parsed as the next request
+    # line on the persistent connection (regression)
+    import http.client
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1), max_batch=4,
+                     max_wait_us=0)
+        with HttpFront(reg, port=0).start() as front:
+            host, port = front.address
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            try:
+                body = json.dumps(
+                    {'instances': _x(1).tolist()}).encode()
+                # 1: unknown model -> 404 replied before body consumed
+                conn.request('POST', '/v1/models/ghost:predict', body,
+                             {'Content-Type': 'application/json'})
+                r = conn.getresponse()
+                assert r.status == 404
+                r.read()
+                # 2: SAME connection must still serve a good request
+                conn.request('POST', '/v1/models/m:predict', body,
+                             {'Content-Type': 'application/json'})
+                r = conn.getresponse()
+                assert r.status == 200
+                out = json.loads(r.read())['outputs']
+                assert np.asarray(out[0]).shape == (1, OUT)
+            finally:
+                conn.close()
+
+
+def test_http_priority_reserve_admits_interactive_tenant():
+    # one in-flight slot total, reserved for priority >= 1: the
+    # batch tenant 429s at the gate while the interactive one serves
+    with ModelRegistry() as reg:
+        reg.register('batch', loader=_loader(1), max_batch=4,
+                     max_wait_us=0)                    # priority 0
+        reg.register('inter', loader=_loader(2), slo=SLO(priority=1),
+                     max_batch=4, max_wait_us=0)
+        with HttpFront(reg, port=0, max_inflight=1,
+                       priority_reserve=1).start() as front:
+            base = 'http://%s:%d' % front.address
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post('%s/v1/models/batch:predict' % base,
+                      {'instances': _x(1).tolist()})
+            assert ei.value.code == 429
+            resp = _post('%s/v1/models/inter:predict' % base,
+                         {'instances': _x(1).tolist()})
+            assert resp.status == 200
+
+
+# ---------------------------------------------------------------------------
+# continuous batching
+# ---------------------------------------------------------------------------
+
+CDIM, CHID, COUT = 5, 4, 2
+
+
+def _cell():
+    data = sym.Variable('data')
+    h_in = sym.Variable('h')
+    pre = sym.FullyConnected(data, num_hidden=CHID, name='ix') + \
+        sym.FullyConnected(h_in, num_hidden=CHID, no_bias=True,
+                           name='hh')
+    h_new = sym.Activation(pre, act_type='tanh')
+    head = sym.FullyConnected(h_new, num_hidden=COUT, name='out')
+    return sym.Group([head, h_new])
+
+
+def _cell_params(seed=3):
+    rs = np.random.RandomState(seed)
+    return {
+        'ix_weight': nd.array(rs.randn(CHID, CDIM).astype(np.float32)
+                              * .3),
+        'ix_bias': nd.array(np.zeros(CHID, np.float32)),
+        'hh_weight': nd.array(rs.randn(CHID, CHID).astype(np.float32)
+                              * .3),
+        'out_weight': nd.array(rs.randn(COUT, CHID).astype(np.float32)
+                               * .3),
+        'out_bias': nd.array(np.zeros(COUT, np.float32)),
+    }
+
+
+def _cont(slots=2, convoy=False, **kw):
+    return ContinuousEngine(_cell(), arg_params=_cell_params(),
+                            data_shape=(CDIM,),
+                            state_shapes={'h': (CHID,)},
+                            state_outputs={'h': 1}, slots=slots,
+                            convoy=convoy, **kw)
+
+
+def _seqs(lens, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(L, CDIM).astype(np.float32) for L in lens]
+
+
+def test_continuous_matches_host_recurrence():
+    p = {k: v.asnumpy() for k, v in _cell_params().items()}
+    seq = _seqs([6])[0]
+    with _cont(slots=3) as eng:
+        out = eng.infer(seq)
+    assert [o.shape for o in out] == [(6, COUT)]
+    h = np.zeros(CHID, np.float32)
+    ys = []
+    for t in range(6):
+        h = np.tanh(seq[t] @ p['ix_weight'].T + p['ix_bias'] +
+                    h @ p['hh_weight'].T)
+        ys.append(h @ p['out_weight'].T + p['out_bias'])
+    np.testing.assert_allclose(out[0], np.stack(ys), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_continuous_admit_retire_bit_parity_vs_solo():
+    # mixed lengths co-resident (admit/retire mid-flight) must be
+    # BIT-identical to each sequence run alone: same program shape,
+    # row-independent cell
+    seqs = _seqs([3, 9, 2, 6, 4], seed=4)
+    with _cont(slots=2) as eng:
+        solo = [eng.infer(s) for s in seqs]      # one at a time
+        res = [None] * len(seqs)
+
+        def client(i):
+            res[i] = eng.infer(seqs[i])
+
+        ts = [threading.Thread(target=client, args=(i,))
+              for i in range(len(seqs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        st = eng.stats()
+    for i in range(len(seqs)):
+        for a, b in zip(res[i], solo[i]):
+            assert np.array_equal(a, b)
+    assert st['admitted'] == st['retired'] == 2 * len(seqs)
+    assert st['compiles_after_warmup'] == 0
+
+
+def test_continuous_beats_convoy_ticks_deterministic():
+    # 2 slots, lengths [2, 8, 2, 8] submitted atomically:
+    # continuous packs freed slots mid-flight -> 12 ticks; convoy
+    # admits only into an empty batch -> two 8-tick waves = 16
+    seqs = _seqs([2, 8, 2, 8], seed=6)
+    with _cont(slots=2) as eng:
+        cont_res = eng.infer_many(seqs)
+        cont = eng.stats()
+    with _cont(slots=2, convoy=True) as eng:
+        conv_res = eng.infer_many(seqs)
+        conv = eng.stats()
+    assert cont['ticks'] == 12
+    assert conv['ticks'] == 16
+    assert cont['utilization'] > conv['utilization']
+    for a, b in zip(cont_res, conv_res):     # same answers either way
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+
+
+def test_continuous_recreated_engine_zero_compiles():
+    with _cont(slots=2) as eng:
+        eng.infer(_seqs([3])[0])
+    before = exec_cache.stats()['misses']
+    with _cont(slots=2) as eng:
+        eng.infer(_seqs([3])[0])
+        assert eng.stats()['compiles_after_warmup'] == 0
+    assert exec_cache.stats()['misses'] == before
+
+
+def test_continuous_rejects_bad_specs():
+    with pytest.raises(MXNetError, match='data_shape'):
+        ContinuousEngine(_cell(), arg_params=_cell_params())
+    with pytest.raises(MXNetError, match='same states'):
+        ContinuousEngine(_cell(), arg_params=_cell_params(),
+                         data_shape=(CDIM,),
+                         state_shapes={'h': (CHID,)},
+                         state_outputs={'g': 1})
+    with pytest.raises(MXNetError, match='out of range'):
+        ContinuousEngine(_cell(), arg_params=_cell_params(),
+                         data_shape=(CDIM,),
+                         state_shapes={'h': (CHID,)},
+                         state_outputs={'h': 5})
+    with _cont(slots=2) as eng:
+        with pytest.raises(MXNetError, match='sequence shape'):
+            eng.infer(np.zeros((4, CDIM + 1), np.float32))
+        with pytest.raises(MXNetError, match='sequence shape'):
+            eng.infer(np.zeros((0, CDIM), np.float32))
+
+
+def test_continuous_close_rejects_new_and_drains():
+    eng = _cont(slots=2)
+    res = {}
+
+    def client():
+        res['out'] = eng.infer(_seqs([30])[0])
+
+    t = threading.Thread(target=client)
+    t.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and eng.stats()['admitted'] == 0:
+        time.sleep(0.005)
+    eng.close()                          # in-flight sequence finishes
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert res['out'][0].shape == (30, COUT)
+    with pytest.raises(MXNetError, match='closed'):
+        eng.infer(_seqs([2])[0])
+    eng.close()                          # idempotent
+
+
+# ---------------------------------------------------------------------------
+# close() vs eviction (satellite 2) + per-engine scoping (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_engine_close_safe_under_concurrent_infer_storm():
+    # many client threads hammer infer() while another thread closes
+    # mid-flight: every call either returns a correct answer or
+    # raises the typed closed error — no deadlock, no lost caller
+    eng = InferenceEngine(_loader(1)(), max_batch=4, max_wait_us=500)
+    x = _x(1, seed=2)
+    ref = _ref(1, x)
+    results = []
+    errors = []
+
+    def client():
+        for _ in range(20):
+            try:
+                results.append(eng.infer(x)[0])
+            except MXNetError as e:
+                assert 'closed' in str(e)
+                errors.append(e)
+                return
+
+    ts = [threading.Thread(target=client) for _ in range(6)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    closers = [threading.Thread(target=eng.close) for _ in range(3)]
+    for c in closers:
+        c.start()
+    for t in ts + closers:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ts + closers)
+    assert results                        # some traffic got through
+    for out in results:
+        np.testing.assert_allclose(out, ref, rtol=2e-6, atol=1e-6)
+    eng.close()                           # still idempotent after
+
+
+def test_registry_eviction_race_is_absorbed():
+    # traffic on both models with a budget that fits one: every
+    # infer() rides an evict/re-warm storm; the registry retries the
+    # closed-engine race internally so callers never see it
+    x = _x(1, seed=7)
+    ref1, ref2 = _ref(1, x), _ref(2, x)
+    with ModelRegistry(budget_bytes=400) as reg:
+        reg.register('m1', loader=_loader(1), max_batch=2,
+                     max_wait_us=0)
+        reg.register('m2', loader=_loader(2), max_batch=2,
+                     max_wait_us=0)
+        errors = []
+
+        def traffic(name, ref):
+            try:
+                for _ in range(12):
+                    np.testing.assert_allclose(
+                        reg.infer(name, x)[0], ref, rtol=2e-6,
+                        atol=1e-6)
+            except Exception as e:
+                errors.append(e)
+
+        ts = [threading.Thread(target=traffic, args=('m1', ref1)),
+              threading.Thread(target=traffic, args=('m2', ref2))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in ts)
+        assert not errors, errors
+        assert reg.stats()['evictions'] >= 1
+
+
+def test_per_engine_counter_scoping():
+    # two engines in one process: each stats() attributes ONLY its
+    # own traffic in the un-prefixed local window, while the serve_*
+    # profiler family stays process-global (documented)
+    profiler.clear()
+    e1 = InferenceEngine(_loader(1)(), max_batch=4, max_wait_us=0)
+    e2 = InferenceEngine(_loader(2)(), max_batch=4, max_wait_us=0)
+    try:
+        for i in range(3):
+            e1.infer(_x(1, seed=i))
+        e2.infer(_x(2, seed=9))
+        s1, s2 = e1.stats(), e2.stats()
+        assert s1['requests'] == 3 and s2['requests'] == 1
+        assert s1['latency_p50_ms'] > 0 and s2['latency_p50_ms'] > 0
+        assert s1['latency_p99_ms'] >= s1['latency_p50_ms']
+        assert s1['service_ms_ema'] > 0
+        assert s2['rows_per_batch_ema'] == pytest.approx(2.0)
+        assert s1['backlog_rows'] == 0
+        # global family spans both engines
+        assert s1['serve_requests'] >= 4
+    finally:
+        e1.close()
+        e2.close()
+
+
+def test_fleet_counters_in_summary_and_dump(tmp_path):
+    profiler.clear()
+    with ModelRegistry(budget_bytes=400) as reg:
+        reg.register('m1', loader=_loader(1), max_batch=2,
+                     max_wait_us=0)
+        reg.register('m2', loader=_loader(2), max_batch=2,
+                     max_wait_us=0)
+        reg.infer('m1', _x(1))
+        reg.infer('m2', _x(1))
+    with _cont(slots=2) as eng:
+        eng.infer(_seqs([3])[0])
+    fl = profiler.fleet_stats()
+    assert fl['fleet_loads'] >= 2
+    assert fl['fleet_evictions'] >= 1
+    assert fl['cont_ticks'] >= 3
+    assert 0 < fl['cont_utilization'] <= 1
+    text = profiler.summary(print_out=False)
+    for key in ('fleet_loads', 'fleet_evictions', 'fleet_http_requests',
+                'fleet_resident_bytes', 'cont_utilization'):
+        assert key in text
+    out = tmp_path / 'fleet_profile.json'
+    profiler.profiler_set_config(filename=str(out))
+    profiler.dump_profile()
+    events = json.loads(out.read_text())['traceEvents']
+    meta = [e for e in events if e.get('name') == 'fleet']
+    assert meta and meta[0]['args']['fleet_loads'] >= 2

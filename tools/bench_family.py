@@ -50,15 +50,24 @@ def main():
                         'overhead A/B (no-checkpoint vs async cadence '
                         'vs blocking cadence; one bench.py child) '
                         'instead of the model-family sweep')
+    p.add_argument('--serve-fleet', action='store_true',
+                   help='run the BENCH_FLEET fleet serving-tier smoke '
+                        '(SLO vs single-knob batching through the '
+                        'HTTP front, continuous vs convoy sequence '
+                        'batching, registry evict/re-warm zero-compile '
+                        'check; one bench.py child) instead of the '
+                        'model-family sweep')
     args = p.parse_args()
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
-    if args.gluon or args.overlap or args.bucket or args.ckpt:
+    if args.gluon or args.overlap or args.bucket or args.ckpt or \
+            args.serve_fleet:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
                      else ('bucket', 'BENCH_BUCKET') if args.bucket
-                     else ('ckpt', 'BENCH_CKPT'))
+                     else ('ckpt', 'BENCH_CKPT') if args.ckpt
+                     else ('serve-fleet', 'BENCH_FLEET'))
         env = dict(os.environ, **{var: '1'})
         proc = subprocess.run([sys.executable, bench_py], env=env,
                               capture_output=True, text=True)

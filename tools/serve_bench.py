@@ -17,6 +17,18 @@ buckets) with the rungs already measured kept.
 Each rung prints bench.py's JSON line (throughput, speedup vs serial,
 p50/p99 latency, batch fill, pad waste, exec-cache misses after
 warmup).  CPU-sized by default: safe on a no-TPU rig.
+
+--fleet switches to the fleet-tier bench (bench.py BENCH_FLEET=1):
+a mixed multi-model closed loop through the HTTP front, laddered
+over --clients as the FAST tenant's client count — per rung it
+reports the fast tenant's p99 under the single global batching knob
+vs per-tenant SLO-derived holds, continuous vs convoy sequence
+batching (bit-parity gated), and the registry evict/re-warm
+zero-compile check.
+
+  python tools/serve_bench.py --fleet [--clients 1,2,4]
+                              [--requests 40] [--passes 3]
+                              [--deadline-ms 25]
 """
 import argparse
 import os
@@ -44,9 +56,45 @@ def main():
                    help='mixed free-dim shapes across the bucket ladder')
     p.add_argument('--dim', type=int, default=256)
     p.add_argument('--hidden', type=int, default=256)
+    p.add_argument('--fleet', action='store_true',
+                   help='fleet-tier bench (BENCH_FLEET=1): multi-model '
+                        'SLO/continuous/paging through the HTTP front')
+    p.add_argument('--deadline-ms', type=float, default=0,
+                   help='fleet mode: fast-tenant SLO deadline '
+                        '(0 = bench default)')
     args = p.parse_args()
 
     bench_py = os.path.join(import_path, 'bench.py')
+    if args.fleet:
+        if args.clients == '1,2,4,8':   # fleet default ladder is
+            args.clients = '1,2,4'      # smaller: 2 tenants per rung
+        for rung in args.clients.split(','):
+            clients = int(rung.strip())
+            env = dict(os.environ, BENCH_FLEET='1',
+                       BENCH_FLEET_FAST_CLIENTS=str(clients),
+                       BENCH_FLEET_REQS=str(args.requests),
+                       BENCH_FLEET_PASSES=str(args.passes))
+            if args.deadline_ms:
+                env['BENCH_FLEET_FAST_DEADLINE_MS'] = \
+                    str(args.deadline_ms)
+            proc = subprocess.run([sys.executable, bench_py], env=env,
+                                  capture_output=True, text=True)
+            if proc.returncode != 0:
+                sys.stderr.write(proc.stderr)
+                if is_oom(proc.stderr or ''):
+                    sys.stderr.write('fleet bench: OOM at %d clients; '
+                                     'stopping the ladder\n' % clients)
+                    break
+                raise RuntimeError('fleet bench (%d clients) rc=%d, '
+                                   'failed without OOM'
+                                   % (clients, proc.returncode))
+            lines = proc.stdout.strip().splitlines()
+            if not lines:
+                sys.stderr.write(proc.stderr)
+                raise RuntimeError('fleet bench (%d clients) produced '
+                                   'no output' % clients)
+            print(lines[-1], flush=True)
+        return
     for rung in args.clients.split(','):
         clients = int(rung.strip())
         env = dict(os.environ, BENCH_INFER='serve',
