@@ -1498,6 +1498,203 @@ def fleet_bench():
     }))
 
 
+def fleet_supervisor_bench():
+    """BENCH_FLEET=1 + BENCH_FLEET_SUPERVISOR=1 (tools/serve_bench.py
+    --fleet --supervisor): the localhost fault drill for the
+    self-healing fleet (mxnet_tpu/fleet_supervisor.py) — one JSON line
+    covering the ISSUE-11 acceptance claims:
+
+      (a) **replica-death survival** — a BENCH_FLEET_SUP_REPLICAS
+          (3) replica fleet under a closed-loop client load survives
+          SIGKILL of one replica with ZERO lost accepted requests
+          (the router retries to survivors; clients honor the
+          429/Retry-After contract via post_with_backoff), and the
+          supervisor respawns the replica within the grace window.
+      (b) **canary auto-rollback** — a push with
+          MXNET_TPU_FAULT_CANARY_DEGRADE_MS injected into the
+          candidate arm auto-rolls back to the prior model, with the
+          rollback visible in /statsz counters.
+
+    Steady-state routed throughput is measured best-of
+    BENCH_FLEET_SUP_PASSES (3) per the rig note; the kill and canary
+    drills are pass/fail and run once each (they assert behavior, not
+    speed).  Knobs: BENCH_FLEET_SUP_REPLICAS (3), _CLIENTS (2),
+    _REQS (30 per client), _PASSES (3), _GRACE_S (60).
+    """
+    import shutil
+    import signal as _signal
+    import threading
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import model as model_mod
+    from mxnet_tpu.fleet_supervisor import (FleetSupervisor,
+                                            post_with_backoff)
+
+    sys.setswitchinterval(0.001)
+    replicas = int(os.environ.get('BENCH_FLEET_SUP_REPLICAS', 3))
+    clients = int(os.environ.get('BENCH_FLEET_SUP_CLIENTS', 2))
+    reqs = int(os.environ.get('BENCH_FLEET_SUP_REQS', 30))
+    passes = max(1, int(os.environ.get('BENCH_FLEET_SUP_PASSES', 3)))
+    grace_s = float(os.environ.get('BENCH_FLEET_SUP_GRACE_S', 60))
+    dim, hidden, out_dim = 32, 32, 8
+    rng = np.random.RandomState(11)
+
+    def mlp(seed):
+        net = _serve_symbol(hidden, out_dim, dim)
+        import mxnet_tpu as mx
+        probe = net.simple_bind(mx.cpu(), grad_req='null',
+                                data=(1, dim))
+        rs = np.random.RandomState(seed)
+        args = {k: nd.array(rs.randn(*v.shape).astype(np.float32) * .1)
+                for k, v in probe.arg_dict.items() if k != 'data'}
+        return net, args
+
+    tmp = tempfile.mkdtemp(prefix='mxnet_tpu_fleet_sup_')
+    sup = None
+    try:
+        net, args = mlp(1)
+        prefix_a = os.path.join(tmp, 'stable')
+        model_mod.save_checkpoint(prefix_a, 0, net, args, {})
+        net2, args2 = mlp(2)
+        prefix_b = os.path.join(tmp, 'candidate')
+        model_mod.save_checkpoint(prefix_b, 0, net2, args2, {})
+
+        # fast liveness for the drill; degrade pre-armed (it only
+        # bites '@' canary arms, which exist only during the push)
+        env = {'JAX_PLATFORMS': 'cpu',
+               'MXNET_TPU_FAULT_CANARY_DEGRADE_MS': '100'}
+        os.environ['MXNET_TPU_FLEET_HEARTBEAT_S'] = '0.25'
+        os.environ['MXNET_TPU_FLEET_DEAD_AFTER_S'] = '1.5'
+        os.environ['MXNET_TPU_FLEET_CANARY_MIN_SAMPLES'] = '8'
+        sup = FleetSupervisor(
+            models=[{'name': 'm', 'prefix': prefix_a, 'epoch': 0,
+                     'input_shapes': {'data': [1, dim]},
+                     'max_batch': 8, 'max_wait_us': 0,
+                     'deadline_ms': 5000}],
+            replicas=replicas, env=env)
+        t0 = time.time()
+        sup.start()
+        sup.wait_healthy()
+        boot_s = time.time() - t0
+        host, port = sup.router.address
+        url = 'http://%s:%d/v1/models/m:predict' % (host, port)
+        x = rng.randn(1, dim).astype(np.float32).tolist()
+
+        def drive(n, failures, latencies=None):
+            for _ in range(n):
+                t1 = time.perf_counter()
+                try:
+                    st, _ = post_with_backoff(url, {'instances': x},
+                                              deadline_s=30)
+                    if st != 200:
+                        failures.append(st)
+                except Exception as e:
+                    failures.append(repr(e))
+                if latencies is not None:
+                    latencies.append(
+                        (time.perf_counter() - t1) * 1e3)
+
+        # steady-state routed throughput, best-of-N passes
+        best_rps = 0.0
+        for _ in range(passes):
+            failures = []
+            ts = [threading.Thread(target=drive,
+                                   args=(reqs, failures))
+                  for _ in range(clients)]
+            tic = time.time()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.time() - tic
+            if failures:
+                raise RuntimeError('steady-state failures: %r'
+                                   % failures[:3])
+            best_rps = max(best_rps, clients * reqs / dt)
+
+        # (a) kill drill: SIGKILL one replica mid-load; every accepted
+        # request must still complete (router retry + client backoff)
+        failures = []
+        lats = []
+        ts = [threading.Thread(target=drive,
+                               args=(reqs, failures, lats))
+              for _ in range(clients)]
+        for t in ts:
+            t.start()
+        time.sleep(0.2)
+        victim = sup.replicas()[0]
+        victim.proc.send_signal(_signal.SIGKILL)
+        t_kill = time.time()
+        for t in ts:
+            t.join()
+        lost = len(failures)
+        respawn_s = None
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            live = sup.replicas()
+            if len(live) >= replicas and all(sup._probe(r)
+                                             for r in live):
+                respawn_s = time.time() - t_kill
+                break
+            time.sleep(0.1)
+        restarts = sup.stats()['restarts']
+
+        # (b) canary push with degraded candidate -> auto-rollback,
+        # observed through the public /statsz endpoint
+        sup.push('m', prefix_b, epoch=0, frac=0.5)
+        rollback_seen = False
+        deadline = time.time() + grace_s
+        while time.time() < deadline and not rollback_seen:
+            failures2 = []
+            drive(8, failures2)
+            import urllib.request
+            st = json.loads(urllib.request.urlopen(
+                'http://%s:%d/statsz' % (host, port),
+                timeout=30).read())
+            fs = st['fleet_supervisor']
+            rollback_seen = \
+                fs['fleet_supervisor_canary_rollbacks'] >= 1 and \
+                st['canary']['m']['state'] == 'rolled_back'
+        stable_after = sup.router.stable_arm('m')
+        router_stats = sup.router.stats()
+        sup.stop()
+
+        print(json.dumps({
+            'metric': 'fleet_supervisor',
+            'value': round(respawn_s, 3) if respawn_s else None,
+            'unit': 's_respawn_after_sigkill',
+            'replicas': replicas,
+            'passes': passes,
+            'boot_s': round(boot_s, 3),
+            'rps_routed_best': round(best_rps, 2),
+            'kill_drill_lost_accepted': lost,
+            'kill_drill_p99_ms': round(float(np.percentile(lats, 99)),
+                                       3) if lats else None,
+            'supervisor_restarts': restarts,
+            'router_retries': router_stats['retries'],
+            'router_503': router_stats['unavailable_503'],
+            'canary_rollback_in_statsz': bool(rollback_seen),
+            'stable_arm_after_rollback': stable_after,
+            'survived': bool(lost == 0 and respawn_s is not None and
+                             rollback_seen and stable_after == 'm'),
+        }))
+        if lost or respawn_s is None or not rollback_seen or \
+                stable_after != 'm':
+            raise SystemExit('fleet supervisor drill FAILED: lost=%d '
+                             'respawn=%s rollback=%s stable=%r'
+                             % (lost, respawn_s, rollback_seen,
+                                stable_after))
+    finally:
+        # a failed drill must not orphan the replica PROCESSES (they
+        # outlive this bench process and keep burning the rig's cores)
+        if sup is not None:
+            try:
+                sup.stop()              # idempotent
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def is_oom(text):
     return 'RESOURCE_EXHAUSTED' in text or 'Out of memory' in text
 
@@ -1556,7 +1753,10 @@ def _bench_main():
         serve_bench()   # dynamic-batching inference engine bench
         return
     if os.environ.get('BENCH_FLEET', '') == '1':
-        fleet_bench()   # fleet tier: SLO batching / continuous / paging
+        if os.environ.get('BENCH_FLEET_SUPERVISOR', '') == '1':
+            fleet_supervisor_bench()   # self-healing fleet fault drill
+        else:
+            fleet_bench()   # fleet tier: SLO / continuous / paging
         return
     if os.environ.get('BENCH_GLUON', '') == '1':
         gluon_bench()   # fused vs imperative Gluon training

@@ -59,6 +59,7 @@ from . import torch_bridge as th
 from . import predictor
 from . import serving
 from . import serving_fleet
+from . import fleet_supervisor
 from . import elastic
 from . import dist
 from . import pallas_ops

@@ -1,0 +1,1818 @@
+"""Self-healing serving fleet: replica supervisor, routing front with
+retry-on-replica-death, canary hot-swap with auto-rollback, and shadow
+replay.
+
+The reference's `dist_async` parameter server kept serving through
+worker churn by design (SURVEY §2.4); the PR-10 fleet tier
+(serving_fleet.py) is one process away from an outage.  This module is
+the multi-process robustness layer on top — the serving analog of
+`tools/launch.py --elastic`:
+
+  * **ReplicaServer** — one serving replica: a ModelRegistry + the
+    HTTP front, extended with admin ops (`POST /v1/models/<n>:load` /
+    `:unload`) so a supervisor can hot-swap model versions on a LIVE
+    replica, and with the fault-injection hooks the kill/detect/
+    restart/rollback paths are tested through.  Runs in-process (tests)
+    or as a subprocess (`python -m mxnet_tpu.fleet_supervisor`, config
+    via MXNET_TPU_FLEET_REPLICA_CONFIG).
+  * **FleetRouter** — the fleet's public surface: spreads
+    `/v1/models/<name>:predict` across live replicas (round robin),
+    RETRIES a request on replica death — a connection refused was
+    never delivered (safe to redispatch always); a connection lost
+    after delivery redispatches only idempotent requests (the default
+    for pure inference; `X-Mxtpu-Non-Idempotent: 1` restricts that
+    request to never-delivered retries so a non-idempotent submit is
+    never double-executed) — bounded by the model's SLO deadline, and
+    converts a fully-dead fleet into FAST typed 503s, never hangs.
+    Also hosts the continuous-deployment state: canary split (N% of
+    traffic to a candidate arm, per-arm latency/error windows,
+    auto-rollback past the regression knobs, auto-promote when
+    healthy) and shadow replay (tee logged traffic to the candidate
+    without serving its answers; count divergences).
+  * **FleetSupervisor** — spawns N localhost replica processes,
+    health-checks them via `/healthz` heartbeats with the dist.py
+    liveness pattern (a replica silent past DEAD_AFTER is declared
+    dead), SIGKILLs + respawns crashed or wedged replicas with
+    exponential backoff under a restart budget, scales the replica
+    count from the PR-10 counter windows (ScalePolicy), and drives
+    continuous deployment: `push(name, prefix, epoch)` loads the
+    candidate on every live replica and opens the canary split.
+
+Env knobs (docs/SERVING.md has the full table):
+  MXNET_TPU_FLEET_HEARTBEAT_S        health-probe cadence (0.5)
+  MXNET_TPU_FLEET_DEAD_AFTER_S       silence before declared dead (5x)
+  MXNET_TPU_FLEET_SPAWN_TIMEOUT_S    replica boot deadline (120)
+  MXNET_TPU_FLEET_RESTART_BACKOFF_S  first respawn delay (0.5, x2 to 10)
+  MXNET_TPU_FLEET_MAX_RESTARTS       restarts per slot per window (5)
+  MXNET_TPU_FLEET_RESTART_WINDOW_S   restart-budget window (60)
+  MXNET_TPU_FLEET_PROXY_TIMEOUT_S    router attempt/budget cap (30)
+  MXNET_TPU_FLEET_DRAIN_S            retire draining grace (5)
+  MXNET_TPU_FLEET_CANARY_FRAC        candidate traffic share (0.1)
+  MXNET_TPU_FLEET_CANARY_MIN_SAMPLES canary window before judging (20)
+  MXNET_TPU_FLEET_CANARY_REGRESS_FACTOR  rollback when cand p99 >
+                                     factor x stable p99 (2.0)
+  MXNET_TPU_FLEET_CANARY_ERR_FRAC    rollback error-rate knob (0.05)
+  MXNET_TPU_FLEET_CANARY_PROMOTE_SAMPLES healthy samples to promote (200)
+  MXNET_TPU_FLEET_REQUEST_LOG        shadow/replay log capacity (64)
+  MXNET_TPU_FLEET_SHADOW_RTOL        divergence tolerance (1e-4)
+
+Fault injection (mirrors the elastic/dist MXNET_TPU_FAULT_* matrix):
+  MXNET_TPU_FAULT_REPLICA_KILL_AFTER_S  'SECS' or 'IDX:SECS' — the
+      replica process hard-exits after SECS (crash injection)
+  MXNET_TPU_FAULT_REPLICA_WEDGE      'IDX[,IDX...]' or 'IDX:SECS' —
+      the replica stops answering /healthz WITHOUT exiting (wedge)
+  MXNET_TPU_FAULT_CANARY_DEGRADE_MS  inflate every canary-arm ('@' in
+      the served name) predict by this many ms (regression injection)
+
+Counters: profiler.fleet_supervisor_stats() (replica_spawns/restarts/
+retires, replicas_live, router_requests/retries/503, canary_pushes/
+promotions/rollbacks, shadow_requests/divergences) — in summary(),
+dump_profile, and the router's /statsz.  Docs: docs/SERVING.md.
+"""
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+import http.client
+import numpy as np
+
+from . import profiler
+from .base import MXNetError
+from .elastic import fault_knob
+from .serving import _env_int
+from .serving_fleet import (BudgetExceeded, HttpFront, ModelRegistry,
+                            SLO, _env_float, _FleetHandler,
+                            _FleetHTTPServer, _predict_model)
+
+__all__ = ['ReplicaServer', 'FleetRouter', 'FleetSupervisor',
+           'ScalePolicy', 'post_with_backoff', 'run_replica']
+
+
+# ---------------------------------------------------------------------------
+# env knobs (read lazily, dist.py style, so tests can flip them)
+# ---------------------------------------------------------------------------
+
+def heartbeat_interval_s():
+    return _env_float('MXNET_TPU_FLEET_HEARTBEAT_S', 0.5)
+
+
+def dead_after_s():
+    """Silence threshold before a replica is declared dead (default 5
+    probe intervals — the dist.py liveness pattern)."""
+    return _env_float('MXNET_TPU_FLEET_DEAD_AFTER_S',
+                      5.0 * heartbeat_interval_s())
+
+
+def spawn_timeout_s():
+    return _env_float('MXNET_TPU_FLEET_SPAWN_TIMEOUT_S', 120.0)
+
+
+def restart_backoff_s():
+    return _env_float('MXNET_TPU_FLEET_RESTART_BACKOFF_S', 0.5)
+
+
+def max_restarts():
+    return _env_int('MXNET_TPU_FLEET_MAX_RESTARTS', 5)
+
+
+def restart_window_s():
+    return _env_float('MXNET_TPU_FLEET_RESTART_WINDOW_S', 60.0)
+
+
+def proxy_timeout_s():
+    return _env_float('MXNET_TPU_FLEET_PROXY_TIMEOUT_S', 30.0)
+
+
+def drain_s():
+    return _env_float('MXNET_TPU_FLEET_DRAIN_S', 5.0)
+
+
+def canary_frac():
+    return _env_float('MXNET_TPU_FLEET_CANARY_FRAC', 0.1)
+
+
+def canary_min_samples():
+    return _env_int('MXNET_TPU_FLEET_CANARY_MIN_SAMPLES', 20)
+
+
+def canary_regress_factor():
+    return _env_float('MXNET_TPU_FLEET_CANARY_REGRESS_FACTOR', 2.0)
+
+
+def canary_err_frac():
+    return _env_float('MXNET_TPU_FLEET_CANARY_ERR_FRAC', 0.05)
+
+
+def canary_promote_samples():
+    return _env_int('MXNET_TPU_FLEET_CANARY_PROMOTE_SAMPLES', 200)
+
+
+def request_log_cap():
+    return _env_int('MXNET_TPU_FLEET_REQUEST_LOG', 64)
+
+
+def shadow_rtol():
+    return _env_float('MXNET_TPU_FLEET_SHADOW_RTOL', 1e-4)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection knob parsers (the elastic/dist fault-matrix idiom)
+# ---------------------------------------------------------------------------
+
+def replica_kill_after_s(index):
+    """MXNET_TPU_FAULT_REPLICA_KILL_AFTER_S: 'SECS' kills every
+    replica after SECS; 'IDX:SECS' only replica IDX.  None = off."""
+    v = fault_knob('REPLICA_KILL_AFTER_S')
+    if v is None:
+        return None
+    try:
+        if ':' in str(v):
+            i, secs = str(v).split(':', 1)
+            return float(secs) if int(i) == int(index) else None
+        return float(v)
+    except ValueError:
+        return None
+
+
+def replica_wedged(index, age_s):
+    """MXNET_TPU_FAULT_REPLICA_WEDGE: 'IDX[,IDX...]' wedges those
+    replica indices from the start; 'IDX:SECS' wedges replica IDX once
+    it is older than SECS.  A wedged replica stops answering /healthz
+    WITHOUT exiting — the hang the supervisor must detect by probe
+    timeout, not by process death."""
+    v = fault_knob('REPLICA_WEDGE')
+    if v is None:
+        return False
+    s = str(v)
+    try:
+        if ':' in s:
+            i, secs = s.split(':', 1)
+            return int(i) == int(index) and float(age_s) >= float(secs)
+        return int(index) in set(int(p) for p in s.split(',')
+                                 if p.strip())
+    except ValueError:
+        return False
+
+
+def canary_degrade_ms():
+    """MXNET_TPU_FAULT_CANARY_DEGRADE_MS: milliseconds of injected
+    latency for every canary-arm predict (served names containing
+    '@') — the regression the auto-rollback path is tested with."""
+    v = fault_knob('CANARY_DEGRADE_MS')
+    try:
+        return float(v) if v is not None else 0.0
+    except ValueError:
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# HTTP plumbing
+# ---------------------------------------------------------------------------
+
+class _NotDelivered(Exception):
+    """The request never reached a replica (connect refused/timed
+    out): redispatching can never double-execute anything."""
+
+
+class _MaybeExecuted(Exception):
+    """The connection died AFTER the request was sent: the replica may
+    have executed it — only idempotent requests may redispatch."""
+
+
+def _http_json(method, host, port, path, payload=None, timeout=5.0,
+               headers=None):
+    """One JSON round trip; returns (status, headers-dict, body-dict).
+    Raises OSError family on transport failure."""
+    body = None if payload is None else json.dumps(payload).encode()
+    hdrs = {'Content-Type': 'application/json'}
+    hdrs.update(headers or {})
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request(method, path, body, hdrs if body is not None
+                     else (headers or {}))
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            data = json.loads(raw) if raw else {}
+        except ValueError:
+            data = {'raw': raw.decode('utf-8', 'replace')}
+        return resp.status, dict(resp.getheaders()), data
+    finally:
+        conn.close()
+
+
+def post_with_backoff(url, payload, deadline_s=30.0, timeout_s=None,
+                      max_sleep_s=5.0):
+    """Closed-loop client helper honoring the fleet's backpressure
+    contract (the PR-10 caveat: clients used to hammer through 429s):
+
+      * 429 -> sleep per the body's `retry_after_ms` (preferred: ms
+        resolution) or the Retry-After header, capped, then retry;
+      * 503 / connection errors -> exponential backoff retry (the
+        fleet may be mid-restart);
+      * anything else -> returned as-is.
+
+    Returns (status, body_dict).  Raises MXNetError when `deadline_s`
+    passes without a non-backoff answer — bounded, never a hot loop.
+    Used by the fleet bench's clients and usable by any caller of the
+    HTTP front."""
+    from urllib.parse import urlsplit
+    u = urlsplit(url)
+    host, port = u.hostname, u.port or 80
+    path = u.path + (('?' + u.query) if u.query else '')
+    t_end = time.monotonic() + float(deadline_s)
+    delay = 0.05
+    last = None
+    while True:
+        left = t_end - time.monotonic()
+        if left <= 0:
+            raise MXNetError(
+                'post_with_backoff: no answer from %s within %.1fs '
+                '(last: %s)' % (url, deadline_s, last))
+        try:
+            status, hdrs, body = _http_json(
+                'POST', host, port, path, payload,
+                timeout=min(left, timeout_s or proxy_timeout_s()))
+        except (OSError, http.client.HTTPException) as e:
+            last = repr(e)
+            time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            delay = min(max_sleep_s, delay * 2)
+            continue
+        if status == 429:
+            ra_ms = body.get('retry_after_ms')
+            if ra_ms is None:
+                try:
+                    ra_ms = float(hdrs.get('Retry-After', 1)) * 1000.0
+                except ValueError:
+                    ra_ms = 1000.0
+            last = '429 retry_after_ms=%s' % ra_ms
+            time.sleep(min(max_sleep_s, max(0.001, ra_ms / 1e3),
+                           max(0.0, t_end - time.monotonic())))
+            continue
+        if status == 503:
+            last = '503 %s' % (body.get('error'),)
+            time.sleep(min(delay, max(0.0, t_end - time.monotonic())))
+            delay = min(max_sleep_s, delay * 2)
+            continue
+        return status, body
+
+
+# ---------------------------------------------------------------------------
+# replica: registry + front + admin ops + fault hooks
+# ---------------------------------------------------------------------------
+
+class _ReplicaHandler(_FleetHandler):
+    """The replica-side HTTP handler: everything _FleetHandler serves,
+    plus supervisor admin ops and the fault-injection hooks.
+
+      POST /v1/models/<name>:load    {prefix, epoch, input_shapes,...}
+      POST /v1/models/<name>:unload
+    """
+
+    def do_GET(self):
+        rs = getattr(self.server.front, 'replica', None)
+        if rs is not None and self.path == '/healthz' and rs.wedged():
+            # injected wedge: hold the probe open forever — the
+            # supervisor must detect this by probe TIMEOUT, the
+            # failure mode process death cannot exercise
+            time.sleep(3600)
+            return
+        _FleetHandler.do_GET(self)
+
+    def do_POST(self):
+        name = _predict_model(self.path)
+        if name is not None:
+            d = canary_degrade_ms()
+            if d > 0 and '@' in name:
+                time.sleep(d / 1e3)
+            return _FleetHandler.do_POST(self)
+        admin = _admin_model(self.path)
+        raw = self._read_body()         # drain-before-reply contract
+        if admin is None:
+            self._reply(404, {'error': 'not found', 'path': self.path})
+            return
+        mname, op = admin
+        rs = getattr(self.server.front, 'replica', None)
+        if rs is None:
+            self._reply(503, {'error': 'no replica attached'})
+            return
+        try:
+            if op == 'load':
+                try:
+                    spec = json.loads(raw or b'{}')
+                except ValueError as e:
+                    self._reply(400, {'error': 'bad request',
+                                      'detail': str(e)})
+                    return
+                rs.load_model(mname, spec)
+                self._reply(200, {'status': 'loaded', 'model': mname})
+            else:
+                rs.unload_model(mname)
+                self._reply(200, {'status': 'unloaded',
+                                  'model': mname})
+        except BudgetExceeded as e:
+            self._reply(507, {'error': 'insufficient storage',
+                              'model': mname,
+                              'need_bytes': e.need_bytes,
+                              'budget_bytes': e.budget_bytes})
+        except MXNetError as e:
+            msg = str(e)
+            if 'already registered' in msg:
+                # idempotent load: a supervisor retry after a lost
+                # reply must not fail the push
+                self._reply(200, {'status': 'already', 'model': mname})
+            elif 'unknown model' in msg:
+                self._reply(404, {'error': 'unknown model',
+                                  'model': mname})
+            else:
+                self._reply(400, {'error': 'bad request',
+                                  'detail': msg})
+
+
+def _admin_model(path):
+    """(name, op) from /v1/models/<name>:load|:unload, else None."""
+    prefix = '/v1/models/'
+    if not path.startswith(prefix):
+        return None
+    rest = path[len(prefix):]
+    for op in ('load', 'unload'):
+        suffix = ':' + op
+        if rest.endswith(suffix):
+            name = rest[:-len(suffix)]
+            if name and '/' not in name:
+                return name, op
+    return None
+
+
+class ReplicaServer(object):
+    """One serving replica: a ModelRegistry behind the admin-extended
+    HTTP front.  `models` is a list of spec dicts::
+
+        {'name': 'm', 'prefix': '/ckpt/m', 'epoch': 0,
+         'input_shapes': {'data': [1, 784]},
+         'deadline_ms': 20, 'priority': 1,          # optional SLO
+         'max_batch': 8, 'max_wait_us': None}       # engine kwargs
+
+    (tests may pass {'name': ..., 'loader': callable} instead of a
+    prefix).  Models register lazily — weights load on first use, so
+    a replica boots fast and warms from the persistent/exec cache."""
+
+    _ENGINE_KEYS = ('max_batch', 'max_wait_us', 'batch_buckets',
+                    'est_bytes')
+
+    def __init__(self, models=(), budget_bytes=None, host='127.0.0.1',
+                 port=0, index=0, max_inflight=None):
+        self.index = int(index)
+        self._t0 = time.monotonic()
+        self.registry = ModelRegistry(budget_bytes=budget_bytes)
+        for spec in models or ():
+            self.load_model(spec['name'], spec, warm=False)
+        self.front = HttpFront(self.registry, host=host, port=port,
+                               max_inflight=max_inflight,
+                               handler_cls=_ReplicaHandler)
+        self.front.replica = self
+
+    @property
+    def address(self):
+        return self.front.address
+
+    def start(self):
+        self.front.start()
+        return self
+
+    def wedged(self):
+        return replica_wedged(self.index,
+                              time.monotonic() - self._t0)
+
+    def load_model(self, name, spec, warm=True):
+        """Register (and by default make resident) one model from a
+        wire spec — the supervisor's hot-swap op."""
+        slo = SLO(deadline_ms=spec.get('deadline_ms'),
+                  priority=int(spec.get('priority', 0) or 0),
+                  service_ms_hint=spec.get('service_ms_hint'))
+        kwargs = {k: spec[k] for k in self._ENGINE_KEYS
+                  if spec.get(k) is not None}
+        if spec.get('loader') is not None:
+            self.registry.register(name, loader=spec['loader'],
+                                   slo=slo, **kwargs)
+        else:
+            shapes = {k: tuple(int(d) for d in v)
+                      for k, v in dict(spec['input_shapes']).items()}
+            self.registry.register(name, prefix=spec['prefix'],
+                                   epoch=int(spec.get('epoch', 0)),
+                                   input_shapes=shapes, slo=slo,
+                                   **kwargs)
+        if warm:
+            self.registry.engine(name)
+        return self
+
+    def unload_model(self, name):
+        self.registry.unregister(name)
+        return self
+
+    def warm_all(self):
+        """Make every registered model resident + AOT-warmed.  The
+        subprocess entry runs this BEFORE announcing its port: a
+        replica must never enter the routing pool cold — lazy first-
+        request loads would inject ~100ms outliers into the canary
+        windows and the fleet's tail latency right after a restart."""
+        for name in self.registry.models():
+            self.registry.engine(name)
+        return self
+
+    def close(self):
+        self.front.close()
+        self.registry.close()
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def run_replica(config, index=0, out=None):
+    """Subprocess replica entrypoint: serve `config` until SIGTERM/
+    SIGINT, announcing the bound port as 'MXTPU_REPLICA_PORT=<port>'
+    on stdout (the supervisor's spawn handshake).  Installs the
+    injected-crash timer (MXNET_TPU_FAULT_REPLICA_KILL_AFTER_S)."""
+    out = out or sys.stdout
+    rs = ReplicaServer(models=config.get('models', ()),
+                       budget_bytes=config.get('budget_bytes'),
+                       host=config.get('host', '127.0.0.1'),
+                       index=index).start()
+    if config.get('warm_at_boot', True):
+        rs.warm_all()                   # never enter the pool cold
+    host, port = rs.address
+    out.write('MXTPU_REPLICA_PORT=%d\n' % port)
+    out.flush()
+    k = replica_kill_after_s(index)
+    if k is not None:
+        t = threading.Timer(k, lambda: os._exit(17))
+        t.daemon = True
+        t.start()
+    stop = threading.Event()
+    for s in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(s, lambda *_: stop.set())
+    stop.wait()
+    rs.close()
+
+
+def _replica_main():
+    cfg = json.loads(
+        os.environ.get('MXNET_TPU_FLEET_REPLICA_CONFIG', '{}') or '{}')
+    idx = int(os.environ.get('MXNET_TPU_FLEET_REPLICA_INDEX', '0'))
+    run_replica(cfg, index=idx)
+
+
+# ---------------------------------------------------------------------------
+# scale policy (pure decision from the PR-10 counter windows)
+# ---------------------------------------------------------------------------
+
+class ScalePolicy(object):
+    """Hysteresis over the fleet's counter-window observations: a
+    sustained hot signal (p99 over the SLO deadline, or backlog at/
+    above `backlog_hot` rows) for `up_after` consecutive windows asks
+    for +1 replica; a sustained fully-idle fleet (no requests, no
+    backlog) for `down_after` windows asks for -1.  Any mixed window
+    resets both streaks — one throttle spike never flips the fleet."""
+
+    def __init__(self, up_after=3, down_after=10, backlog_hot=64):
+        self.up_after = int(up_after)
+        self.down_after = int(down_after)
+        self.backlog_hot = int(backlog_hot)
+        self._hot = 0
+        self._idle = 0
+
+    def decide(self, obs):
+        """obs: {'p99_over_deadline': bool, 'backlog_rows': int,
+        'requests_delta': int} -> +1 (spawn), -1 (retire), 0."""
+        backlog = int(obs.get('backlog_rows', 0))
+        hot = bool(obs.get('p99_over_deadline')) or \
+            backlog >= self.backlog_hot
+        idle = not hot and backlog == 0 and \
+            int(obs.get('requests_delta', 0)) == 0
+        if hot:
+            self._hot += 1
+            self._idle = 0
+        elif idle:
+            self._idle += 1
+            self._hot = 0
+        else:
+            self._hot = self._idle = 0
+        if self._hot >= self.up_after:
+            self._hot = 0
+            return 1
+        if self._idle >= self.down_after:
+            self._idle = 0
+            return -1
+        return 0
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+class _RouterHandler(_FleetHandler):
+    """The fleet's public handler: /healthz, /statsz, and proxied
+    predicts.  Reuses _FleetHandler's reply/drain plumbing but never
+    touches a registry — everything goes through server.router."""
+
+    def do_GET(self):
+        router = self.server.router
+        if self.path == '/healthz':
+            n = len(router.backends())
+            if router.closed or n == 0:
+                self._reply(503, {'status': 'no-live-replicas',
+                                  'backends': n})
+            else:
+                self._reply(200, {'status': 'ok', 'backends': n})
+        elif self.path == '/statsz':
+            self._reply(200, router.statsz())
+        else:
+            self._reply(404, {'error': 'not found', 'path': self.path})
+
+    def do_POST(self):
+        router = self.server.router
+        raw = self._read_body()         # drain-before-reply contract
+        name = _predict_model(self.path)
+        if name is None:
+            self._reply(404, {'error': 'not found', 'path': self.path})
+            return
+        idempotent = self.headers.get('X-Mxtpu-Non-Idempotent',
+                                      '') != '1'
+        status, body, hdrs = router.dispatch(name, raw,
+                                             idempotent=idempotent)
+        self.send_response(status)
+        self.send_header('Content-Type', 'application/json')
+        self.send_header('Content-Length', str(len(body)))
+        for k, v in hdrs.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class FleetRouter(object):
+    """Routes `/v1/models/<name>:predict` across live replicas with
+    retry-on-replica-death, fast 503s for a dead fleet, and the
+    continuous-deployment state (canary split / shadow tee).  Backend
+    membership is owned by the FleetSupervisor (or tests) via
+    add_backend/remove_backend; `deadlines` maps public model names to
+    their SLO deadline_ms — the retry budget for that model's
+    requests."""
+
+    def __init__(self, host='127.0.0.1', port=0, deadlines=None,
+                 on_event=None):
+        self._lock = threading.Lock()
+        self._backends = []             # [{'id','host','port'}]
+        self._rr = 0
+        self._req_mark = 0
+        self._deadline_ms = dict(deadlines or {})
+        self._alias = {}                # public name -> served arm
+        self._canary = {}               # public name -> canary state
+        self._reqlog = {}               # public name -> deque of bodies
+        self._lat_w = {}                # public name -> deque of ms
+        self._n_requests = 0
+        self._n_retries = 0
+        self._n_503 = 0
+        self.on_event = on_event        # (kind, name, info) callback
+        self.extra_stats = None         # merged into /statsz
+        self._closed = False
+        self._shadow_q = deque()
+        self._shadow_busy = False
+        self._shadow_cond = threading.Condition()
+        self._shadow_thread = threading.Thread(
+            target=self._shadow_loop, name='mxtpu-fleet-shadow',
+            daemon=True)
+        self._shadow_thread.start()
+        self._server = _FleetHTTPServer((host, int(port)),
+                                        _RouterHandler)
+        self._server.router = self
+        self._thread = None
+
+    # -- membership -----------------------------------------------------
+    @property
+    def address(self):
+        return self._server.server_address[:2]
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name='mxtpu-fleet-router', daemon=True)
+            self._thread.start()
+        return self
+
+    def add_backend(self, bid, host, port):
+        with self._lock:
+            self._backends = [b for b in self._backends
+                              if b['id'] != bid] + \
+                [{'id': bid, 'host': host, 'port': int(port)}]
+        return self
+
+    def remove_backend(self, bid):
+        with self._lock:
+            self._backends = [b for b in self._backends
+                              if b['id'] != bid]
+        return self
+
+    def backends(self):
+        with self._lock:
+            return list(self._backends)
+
+    def set_deadline(self, name, deadline_ms):
+        with self._lock:
+            self._deadline_ms[name] = deadline_ms
+
+    # -- dispatch -------------------------------------------------------
+    def dispatch(self, name, raw, idempotent=True):
+        """Proxy one predict body.  Returns (status, body_bytes,
+        extra_headers).  Never hangs: bounded by the model's SLO
+        deadline (or the proxy-timeout knob), and a fully-dead fleet
+        answers a fast typed 503."""
+        profiler.add_fleet_supervisor_stats(router_requests=1)
+        with self._lock:
+            self._n_requests += 1
+        arm, is_canary = self._pick_arm(name)
+        deadline_ms = self._deadline_ms.get(name)
+        budget_s = (deadline_ms / 1e3) if deadline_ms \
+            else proxy_timeout_s()
+        t_end = time.monotonic() + budget_s
+        tried = set()
+        path = '/v1/models/%s:predict' % arm
+        while True:
+            b = self._pick_backend(exclude=tried)
+            left = t_end - time.monotonic()
+            if b is None or left <= 0:
+                return self._unavailable(
+                    name, 'no live replicas' if not tried else
+                    ('deadline exhausted after %d attempt(s)'
+                     % len(tried)) if left <= 0 else
+                    'all replicas failed')
+            tried.add(b['id'])
+            t0 = time.perf_counter()
+            try:
+                status, hdrs, body = self._proxy(
+                    b, path, raw, timeout=min(left, proxy_timeout_s()))
+            except _NotDelivered as e:
+                # never reached a replica: ALWAYS safe to redispatch
+                self._note_backend_error(b, e)
+                with self._lock:
+                    self._n_retries += 1
+                profiler.add_fleet_supervisor_stats(router_retries=1)
+                continue
+            except _MaybeExecuted as e:
+                # transport failure, NOT a model answer: recording it
+                # into the canary windows would let an unrelated
+                # replica crash mid-push fake an error-rate regression
+                # and roll back a healthy candidate (the retried
+                # request records its real outcome once, below)
+                self._note_backend_error(b, e)
+                if not idempotent:
+                    # the replica may have executed the submit: a
+                    # redispatch could double-execute — fail typed
+                    # instead, within the deadline
+                    return 502, json.dumps(
+                        {'error': 'replica failed mid-request',
+                         'model': name, 'retriable': False,
+                         'detail': str(e)}).encode(), {}
+                with self._lock:
+                    self._n_retries += 1
+                profiler.add_fleet_supervisor_stats(router_retries=1)
+                continue
+            lat_ms = (time.perf_counter() - t0) * 1e3
+            # canary health: 5xx is a failure, and so are 429 (the
+            # arm sheds — a candidate that cannot serve within its
+            # SLO would otherwise log fast "healthy" samples and get
+            # PROMOTED) and 404 (the arm is missing on the replica).
+            # Other 4xx are the client's fault and arm-independent.
+            self._record_arm(name, is_canary, lat_ms,
+                             ok=status < 500 and
+                             status not in (404, 429))
+            if is_canary:
+                self._maybe_decide(name)
+            elif status == 200:
+                self._log_and_tee(name, raw, body)
+            out_hdrs = {}
+            if 'Retry-After' in hdrs:
+                out_hdrs['Retry-After'] = hdrs['Retry-After']
+            return status, body, out_hdrs
+
+    def _unavailable(self, name, why):
+        with self._lock:
+            self._n_503 += 1
+        profiler.add_fleet_supervisor_stats(router_503=1)
+        return 503, json.dumps({'error': 'fleet unavailable',
+                                'model': name,
+                                'detail': why}).encode(), \
+            {'Retry-After': '1'}
+
+    def _proxy(self, backend, path, raw, timeout):
+        conn = http.client.HTTPConnection(backend['host'],
+                                          backend['port'],
+                                          timeout=max(0.05, timeout))
+        try:
+            try:
+                conn.connect()
+            except (OSError, socket.timeout) as e:
+                raise _NotDelivered(e)
+            try:
+                conn.request('POST', path, raw,
+                             {'Content-Type': 'application/json'})
+                resp = conn.getresponse()
+                body = resp.read()
+                return resp.status, dict(resp.getheaders()), body
+            except (OSError, socket.timeout,
+                    http.client.HTTPException) as e:
+                raise _MaybeExecuted(e)
+        finally:
+            conn.close()
+
+    def _pick_backend(self, exclude=()):
+        with self._lock:
+            cands = [b for b in self._backends
+                     if b['id'] not in exclude]
+            if not cands:
+                return None
+            self._rr += 1
+            return cands[self._rr % len(cands)]
+
+    def _note_backend_error(self, backend, err):
+        if self.on_event is not None:
+            try:
+                self.on_event('backend_error', backend['id'],
+                              {'error': str(err)})
+            except Exception:           # observer must not break serve
+                logging.exception('fleet router: on_event failed')
+
+    # -- per-model windows (scaling + canary signals) -------------------
+    def _record_arm(self, name, is_canary, lat_ms, ok):
+        with self._lock:
+            w = self._lat_w.get(name)
+            if w is None:
+                w = self._lat_w[name] = deque(maxlen=256)
+            w.append(lat_ms)
+            c = self._canary.get(name)
+            if c is not None and c['state'] == 'running':
+                (c['cand_w'] if is_canary
+                 else c['stable_w']).append((lat_ms, ok))
+
+    def latency_p99_ms(self, name):
+        with self._lock:
+            w = list(self._lat_w.get(name, ()))
+        return float(np.percentile(w, 99)) if w else 0.0
+
+    def requests_delta(self):
+        """Total proxied requests since the previous call — the scale
+        loop's idle signal."""
+        with self._lock:
+            n = self._n_requests
+            delta = n - self._req_mark
+            self._req_mark = n
+        return delta
+
+    # -- canary / shadow ------------------------------------------------
+    def start_canary(self, name, candidate, frac=None, mode='canary'):
+        """Open a canary split (or shadow tee) for `name`: `frac` of
+        traffic (canary mode) goes to the `candidate` arm, everything
+        else to the stable arm; per-arm windows feed auto-rollback /
+        auto-promote.  Shadow mode serves 100% stable and tees logged
+        bodies to the candidate asynchronously."""
+        if mode not in ('canary', 'shadow'):
+            raise MXNetError('canary mode must be canary|shadow')
+        with self._lock:
+            self._canary[name] = {
+                'candidate': candidate,
+                'frac': canary_frac() if frac is None else float(frac),
+                'mode': mode, 'acc': 0.0, 'state': 'running',
+                'stable_w': deque(maxlen=512),
+                'cand_w': deque(maxlen=512),
+                'shadow_requests': 0, 'shadow_divergences': 0,
+                'started': time.time(),
+            }
+        profiler.add_fleet_supervisor_stats(canary_pushes=1)
+        return self
+
+    def _pick_arm(self, name):
+        with self._lock:
+            stable = self._alias.get(name, name)
+            c = self._canary.get(name)
+            if c is not None and c['state'] == 'running' and \
+                    c['mode'] == 'canary' and c['frac'] > 0:
+                c['acc'] += c['frac']
+                if c['acc'] >= 1.0:
+                    c['acc'] -= 1.0
+                    return c['candidate'], True
+            return stable, False
+
+    def stable_arm(self, name):
+        with self._lock:
+            return self._alias.get(name, name)
+
+    def _maybe_decide(self, name):
+        with self._lock:
+            c = self._canary.get(name)
+            if c is None or c['state'] != 'running':
+                return
+            decision = self._decide_locked(c)
+            if decision is None:
+                return
+            c['state'] = 'rolled_back' if decision == 'rollback' \
+                else 'promoted'
+            c['decided'] = time.time()
+            candidate = c['candidate']
+            old_stable = self._alias.get(name, name)
+            if decision == 'promote':
+                self._alias[name] = candidate
+        report = self.canary_report(name)
+        if decision == 'rollback':
+            profiler.add_fleet_supervisor_stats(canary_rollbacks=1)
+            self._async_unload(candidate)
+        else:
+            profiler.add_fleet_supervisor_stats(canary_promotions=1)
+            self._async_unload(old_stable)
+        if self.on_event is not None:
+            try:
+                self.on_event(decision, name,
+                              {'candidate': candidate,
+                               'report': report})
+            except Exception:
+                logging.exception('fleet router: on_event failed')
+
+    def _decide_locked(self, c):
+        cand = list(c['cand_w'])
+        n = len(cand)
+        if n < canary_min_samples():
+            return None
+        errs = sum(1 for _l, ok in cand if not ok) / float(n)
+        if errs > canary_err_frac():
+            return 'rollback'
+        stable = [l for l, ok in c['stable_w'] if ok]
+        if stable:
+            lats = [l for l, _ in cand]
+            f = canary_regress_factor()
+            # judge BOTH tails: p99 is the SLO-facing signal, but a
+            # single cold-start/throttle outlier in the small stable
+            # window inflates its p99 to ~max and would mask a real
+            # regression — the median ratio is robust to that (a true
+            # degrade shifts the whole distribution, an outlier
+            # doesn't), so either tripping rolls back
+            c50 = float(np.percentile(lats, 50))
+            s50 = max(0.5, float(np.percentile(stable, 50)))
+            c99 = float(np.percentile(lats, 99))
+            s99 = max(1.0, float(np.percentile(stable, 99)))
+            if c50 > f * s50 or c99 > f * s99:
+                return 'rollback'
+        if n >= canary_promote_samples():
+            return 'promote'
+        return None
+
+    def canary_report(self, name):
+        """Per-arm window snapshot for `name`'s canary (None when no
+        push is active) — also embedded in /statsz."""
+        with self._lock:
+            c = self._canary.get(name)
+            if c is None:
+                return None
+            cand = list(c['cand_w'])
+            stable = list(c['stable_w'])
+            out = {'candidate': c['candidate'], 'mode': c['mode'],
+                   'state': c['state'], 'frac': c['frac'],
+                   'cand_samples': len(cand),
+                   'stable_samples': len(stable),
+                   'shadow_requests': c['shadow_requests'],
+                   'shadow_divergences': c['shadow_divergences']}
+        for key, w in (('cand', cand), ('stable', stable)):
+            lats = [l for l, _ in w]
+            out[key + '_p50_ms'] = round(
+                float(np.percentile(lats, 50)), 3) if lats else 0.0
+            out[key + '_p99_ms'] = round(
+                float(np.percentile(lats, 99)), 3) if lats else 0.0
+            out[key + '_err_frac'] = round(
+                sum(1 for _l, ok in w if not ok) / float(len(w)),
+                4) if w else 0.0
+        return out
+
+    def promote(self, name):
+        """Manually promote an active canary/shadow candidate (the
+        shadow mode never auto-promotes — its divergence report is
+        advisory)."""
+        with self._lock:
+            c = self._canary.get(name)
+            if c is None or c['state'] != 'running':
+                raise MXNetError('no running canary for %r' % name)
+            c['state'] = 'promoted'
+            candidate = c['candidate']
+            old_stable = self._alias.get(name, name)
+            self._alias[name] = candidate
+        profiler.add_fleet_supervisor_stats(canary_promotions=1)
+        self._async_unload(old_stable)
+        if self.on_event is not None:
+            try:
+                self.on_event('promote', name,
+                              {'candidate': candidate,
+                               'report': self.canary_report(name)})
+            except Exception:
+                logging.exception('fleet router: on_event failed')
+        return self
+
+    def clear_canary(self, name, unload=True):
+        """Abort an active push (counts as a rollback when it was
+        still running)."""
+        with self._lock:
+            c = self._canary.get(name)
+            if c is None:
+                return self
+            was_running = c['state'] == 'running'
+            c['state'] = 'rolled_back' if was_running else c['state']
+            candidate = c['candidate']
+        if was_running:
+            profiler.add_fleet_supervisor_stats(canary_rollbacks=1)
+            if unload:
+                self._async_unload(candidate)
+            # the supervisor must learn of the abort too, or its
+            # _pending entry goes stale: future push() calls refuse
+            # forever and every respawned replica keeps loading the
+            # dead candidate arm
+            if self.on_event is not None:
+                try:
+                    self.on_event('rollback', name,
+                                  {'candidate': candidate,
+                                   'report': self.canary_report(name)})
+                except Exception:
+                    logging.exception('fleet router: on_event failed')
+        return self
+
+    def _async_unload(self, arm):
+        """Best-effort: drop a superseded arm from every backend (the
+        supervisor keeps the desired set for future spawns)."""
+        backends = self.backends()
+
+        def work():
+            for b in backends:
+                try:
+                    _http_json('POST', b['host'], b['port'],
+                               '/v1/models/%s:unload' % arm,
+                               payload={}, timeout=10.0)
+                except (OSError, http.client.HTTPException):
+                    pass
+
+        threading.Thread(target=work, name='mxtpu-fleet-unload',
+                         daemon=True).start()
+
+    # -- shadow tee -----------------------------------------------------
+    def _log_and_tee(self, name, raw, stable_body):
+        cap = request_log_cap()
+        if cap <= 0:
+            return
+        with self._lock:
+            log = self._reqlog.get(name)
+            if log is None or log.maxlen != cap:
+                log = self._reqlog[name] = deque(log or (), maxlen=cap)
+            log.append(raw)
+            c = self._canary.get(name)
+            tee = c is not None and c['state'] == 'running' and \
+                c['mode'] == 'shadow'
+        if tee:
+            with self._shadow_cond:
+                if len(self._shadow_q) < 4 * cap:   # bounded: drop
+                    self._shadow_q.append(
+                        (name, raw, stable_body))
+                    self._shadow_cond.notify()
+
+    def _shadow_loop(self):
+        while True:
+            with self._shadow_cond:
+                while not self._shadow_q and not self._closed:
+                    self._shadow_cond.wait(0.2)
+                if self._closed and not self._shadow_q:
+                    return
+                if not self._shadow_q:
+                    continue
+                name, raw, stable_body = self._shadow_q.popleft()
+                self._shadow_busy = True
+            try:
+                with self._lock:
+                    c = self._canary.get(name)
+                    candidate = c['candidate'] if c is not None \
+                        else None
+                b = self._pick_backend()
+                if candidate is None or b is None:
+                    continue
+                try:
+                    status, _h, body = self._proxy(
+                        b, '/v1/models/%s:predict' % candidate, raw,
+                        timeout=proxy_timeout_s())
+                    diverged = status != 200 or \
+                        not _outputs_close(stable_body, body)
+                except (_NotDelivered, _MaybeExecuted):
+                    # transport failure: the candidate was never
+                    # consulted — counting a divergence here would let
+                    # a restarting replica discredit an identical-
+                    # weights candidate (same principle as the canary
+                    # windows and replay(): transport is not a model
+                    # answer)
+                    continue
+                profiler.add_fleet_supervisor_stats(
+                    shadow_requests=1,
+                    shadow_divergences=1 if diverged else 0)
+                with self._lock:
+                    c = self._canary.get(name)
+                    if c is not None:
+                        c['shadow_requests'] += 1
+                        if diverged:
+                            c['shadow_divergences'] += 1
+            finally:
+                with self._shadow_cond:
+                    self._shadow_busy = False
+                    self._shadow_cond.notify_all()
+
+    def shadow_drain(self, timeout=30.0):
+        """Block until the shadow tee queue is empty AND the worker
+        has finished its in-flight item (tests/bench)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._shadow_cond:
+                if not self._shadow_q and not self._shadow_busy:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def replay(self, name, arm=None):
+        """Replay `name`'s logged bodies against `arm` (default: the
+        active candidate) AND the stable arm, comparing outputs.
+        Returns {'replayed': n, 'divergences': d}."""
+        with self._lock:
+            bodies = list(self._reqlog.get(name, ()))
+            c = self._canary.get(name)
+            if arm is None:
+                if c is None:
+                    raise MXNetError('replay(%r): no candidate arm '
+                                     'active and none given' % name)
+                arm = c['candidate']
+            stable = self._alias.get(name, name)
+        replayed = divergences = 0
+        for raw in bodies:
+            b = self._pick_backend()
+            if b is None:
+                break
+            try:
+                s1, _h1, body1 = self._proxy(
+                    b, '/v1/models/%s:predict' % stable, raw,
+                    timeout=proxy_timeout_s())
+                b2 = self._pick_backend() or b
+                s2, _h2, body2 = self._proxy(
+                    b2, '/v1/models/%s:predict' % arm, raw,
+                    timeout=proxy_timeout_s())
+            except (_NotDelivered, _MaybeExecuted):
+                continue
+            replayed += 1
+            if s1 != 200 or s2 != 200 or \
+                    not _outputs_close(body1, body2):
+                divergences += 1
+        profiler.add_fleet_supervisor_stats(
+            shadow_requests=replayed, shadow_divergences=divergences)
+        return {'replayed': replayed, 'divergences': divergences}
+
+    # -- observability / lifecycle --------------------------------------
+    def stats(self):
+        with self._lock:
+            return {'requests': self._n_requests,
+                    'retries': self._n_retries,
+                    'unavailable_503': self._n_503,
+                    'backends': [b['id'] for b in self._backends]}
+
+    def statsz(self):
+        with self._lock:                # promote mutates _alias under
+            aliases = dict(self._alias)  # the lock; copy under it too
+            names = list(self._canary)
+        out = {'router': self.stats(),
+               'aliases': aliases,
+               'fleet_supervisor': profiler.fleet_supervisor_stats()}
+        canary = {}
+        for n in names:
+            r = self.canary_report(n)
+            if r is not None:
+                canary[n] = r
+        out['canary'] = canary
+        if self.extra_stats is not None:
+            try:
+                out['supervisor'] = self.extra_stats()
+            except Exception as e:
+                out['supervisor'] = {'error': str(e)}
+        return out
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def close(self):
+        if self._closed:
+            return self
+        self._closed = True
+        with self._shadow_cond:
+            self._shadow_cond.notify_all()
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=10)
+        self._server.server_close()
+        self._shadow_thread.join(timeout=5)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _outputs_close(body_a, body_b, rtol=None):
+    """Compare two predict response bodies' 'outputs' numerically
+    (the shadow divergence test).  Shape/parse mismatch = divergent."""
+    try:
+        a = json.loads(body_a)['outputs']
+        b = json.loads(body_b)['outputs']
+        if len(a) != len(b):
+            return False
+        tol = shadow_rtol() if rtol is None else rtol
+        for u, v in zip(a, b):
+            ua, va = np.asarray(u, np.float64), np.asarray(v,
+                                                           np.float64)
+            if ua.shape != va.shape or \
+                    not np.allclose(ua, va, rtol=tol, atol=tol):
+                return False
+        return True
+    except (ValueError, KeyError, TypeError):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class _Replica(object):
+    __slots__ = ('index', 'gen', 'proc', 'host', 'port', 'last_ok',
+                 'spawned_at', 'restart_times', 'next_attempt',
+                 'backoff', 'cfg_names')
+
+    def __init__(self, index, gen=0):
+        self.index = index
+        self.gen = gen                  # spawn generation: a respawn
+        self.proc = None                # gets a FRESH router id, so a
+        self.host = None                # request that excluded the
+        self.port = None                # dead incarnation can still
+        self.last_ok = 0.0              # reach the recovered one
+        self.spawned_at = 0.0
+        self.restart_times = deque()    # restart-budget window
+        self.next_attempt = 0.0         # respawn backoff schedule
+        self.backoff = 0.0
+        self.cfg_names = ()             # arm names in the spawn config
+
+    @property
+    def bid(self):
+        return 'r%dg%d' % (self.index, self.gen)
+
+
+class FleetSupervisor(object):
+    """Spawns, health-checks, restarts, and scales a localhost replica
+    fleet behind a FleetRouter, and drives continuous deployment
+    (canary push / shadow replay) across it.
+
+    Parameters
+    ----------
+    models : list of spec dicts (see ReplicaServer)
+        The desired model set every replica serves.  Each needs a
+        `prefix` checkpoint loader (replicas are separate processes —
+        live objects cannot cross).
+    replicas : int
+        Initial fleet size (also min unless min_replicas given).
+    autoscale : bool
+        Drive spawn/retire from the ScalePolicy over the counter
+        windows (p99-vs-deadline at the router, backlog from /statsz).
+    """
+
+    def __init__(self, models, replicas=2, host='127.0.0.1',
+                 router_port=0, budget_bytes=None, autoscale=False,
+                 min_replicas=None, max_replicas=None, python=None,
+                 env=None, scale_policy=None):
+        if not models:
+            raise MXNetError('FleetSupervisor needs at least one '
+                             'model spec')
+        self._models = {}
+        for m in models:
+            spec = dict(m)
+            spec['serve_name'] = spec['name']
+            self._models[spec['name']] = spec
+        self.n_replicas = int(replicas)
+        self.min_replicas = int(min_replicas if min_replicas is not None
+                                else max(1, self.n_replicas // 2))
+        self.max_replicas = int(max_replicas if max_replicas is not None
+                                else 2 * self.n_replicas)
+        self.host = host
+        self.budget_bytes = budget_bytes
+        self.autoscale = bool(autoscale)
+        self._python = python or sys.executable
+        self._env = dict(env or {})
+        self._policy = scale_policy or ScalePolicy()
+        self._lock = threading.Lock()
+        self._replicas = []             # live _Replica objects
+        self._dead_pending = []         # awaiting backoff respawn
+        self._next_index = 0
+        self._spawn_gen = 0
+        self._pending = {}              # public name -> candidate spec
+        self._push_seq = 0
+        self._stop = threading.Event()
+        self._loop_thread = None
+        self._started = False
+        self._n_restarts = 0
+        self._n_retired = 0
+        self._abandoned = 0
+        self.router = FleetRouter(
+            host=host, port=router_port,
+            deadlines={m['name']: m.get('deadline_ms')
+                       for m in models if m.get('deadline_ms')},
+            on_event=self._on_router_event)
+        self.router.extra_stats = self._sup_stats
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        """Spawn the initial fleet (in parallel), start the router and
+        the health/scale loop."""
+        if self._started:
+            return self
+        self._started = True
+        procs = [self._spawn_proc(self._take_index())
+                 for _ in range(self.n_replicas)]
+        try:
+            for rep in procs:
+                self._finish_spawn(rep)
+        except BaseException:
+            # a failed handshake must not orphan the siblings that
+            # already spawned (they are separate OS processes — only
+            # this list knows about them yet) nor latch _started
+            for rep in procs:
+                if rep.proc is not None and rep.proc.poll() is None:
+                    try:
+                        rep.proc.kill()
+                    except OSError:
+                        pass
+            with self._lock:
+                reps, self._replicas = self._replicas, []
+            for r in reps:
+                self.router.remove_backend(r.bid)
+            profiler.add_fleet_supervisor_stats(replicas_live=0)
+            self._started = False
+            raise
+        self.router.start()
+        self._loop_thread = threading.Thread(
+            target=self._loop, name='mxtpu-fleet-supervisor',
+            daemon=True)
+        self._loop_thread.start()
+        return self
+
+    def _take_index(self):
+        with self._lock:
+            i = self._next_index
+            self._next_index += 1
+        return i
+
+    def _replica_config(self):
+        """The wire config a fresh replica serves: every desired
+        model under its CURRENT arm name, plus any active push's
+        candidate (a new replica must be able to answer canary-arm
+        traffic)."""
+        specs = []
+        with self._lock:
+            for m in self._models.values():
+                spec = {k: v for k, v in m.items()
+                        if k not in ('name', 'serve_name')}
+                spec['name'] = m['serve_name']
+                specs.append(spec)
+            for cand in self._pending.values():
+                specs.append(dict(cand))
+        return {'models': specs, 'budget_bytes': self.budget_bytes,
+                'host': self.host}
+
+    def _spawn_proc(self, index):
+        """Start one replica subprocess (non-blocking half)."""
+        with self._lock:
+            self._spawn_gen += 1
+            gen = self._spawn_gen
+        rep = _Replica(index, gen=gen)
+        env = dict(os.environ)
+        env.update(self._env)
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        env['PYTHONPATH'] = pkg_parent + os.pathsep + \
+            env.get('PYTHONPATH', '')
+        config = self._replica_config()
+        rep.cfg_names = tuple(m['name'] for m in config['models'])
+        env['MXNET_TPU_FLEET_REPLICA_CONFIG'] = json.dumps(config)
+        env['MXNET_TPU_FLEET_REPLICA_INDEX'] = str(index)
+        # -c (not -m): runpy would import the module a second time
+        # under __main__ after the package import already loaded it
+        rep.proc = subprocess.Popen(
+            [self._python, '-c',
+             'from mxnet_tpu.fleet_supervisor import _replica_main; '
+             '_replica_main()'],
+            env=env, stdout=subprocess.PIPE, text=True)
+        rep.spawned_at = time.monotonic()
+        return rep
+
+    def _finish_spawn(self, rep):
+        """Blocking half: wait for the port handshake, register the
+        replica with the router.  The handshake read happens on a
+        side thread so the SPAWN_TIMEOUT_S deadline is enforced even
+        against a replica that hangs during boot WITHOUT printing or
+        exiting — a bare readline() would block this (single)
+        supervisor loop thread forever and stop fleet-wide health
+        probing."""
+        deadline = rep.spawned_at + spawn_timeout_s()
+        holder = {}
+        got = threading.Event()
+
+        def read_port():
+            while True:
+                line = rep.proc.stdout.readline()
+                if not line:
+                    break               # EOF: process died
+                if line.startswith('MXTPU_REPLICA_PORT='):
+                    holder['port'] = int(line.strip().split('=', 1)[1])
+                    break
+            got.set()
+
+        threading.Thread(target=read_port, daemon=True).start()
+        got.wait(timeout=max(0.1, deadline - time.monotonic()))
+        port = holder.get('port')
+        if port is None:
+            try:
+                rep.proc.kill()         # also unblocks the reader
+            except OSError:
+                pass
+            raise MXNetError(
+                'fleet replica %d failed to start within %.0fs '
+                '(exit code %s)' % (rep.index, spawn_timeout_s(),
+                                    rep.proc.poll()))
+        # keep draining the child's stdout so the pipe never fills
+        t = threading.Thread(target=_drain, args=(rep.proc.stdout,),
+                             daemon=True)
+        t.start()
+        rep.host, rep.port = self.host, port
+        rep.last_ok = time.monotonic()
+        # membership FIRST (under the lock, refusing when stop() has
+        # begun — a respawn finishing after stop()'s sweep would leak
+        # a live process forever), THEN reconcile, THEN routing:
+        #
+        #  * a push can resolve (rollback/promote) while this replica
+        #    was booting with the spawn-time arm set baked into its
+        #    config — the reconcile drops arms the desired set no
+        #    longer names and loads arms it missed;
+        #  * appending to _replicas BEFORE computing `desired` closes
+        #    the push() race: a push that lands after the append sees
+        #    this replica in replicas() and loads the candidate
+        #    itself (the :load op is idempotent — 'already' — so both
+        #    sides doing it is fine), one that landed before is in
+        #    _pending and therefore in `desired`;
+        #  * add_backend comes LAST so the router never routes
+        #    canary-arm traffic to a replica that has not reconciled
+        #    yet (its 404s would be recorded as candidate failures
+        #    and could roll back a healthy push).
+        with self._lock:
+            if self._stop.is_set():
+                try:
+                    rep.proc.kill()
+                except OSError:
+                    pass
+                raise MXNetError('fleet supervisor stopping: replica '
+                                 '%d spawn abandoned' % rep.index)
+            self._replicas.append(rep)
+            live = len(self._replicas)
+            desired = {}
+            for m in self._models.values():
+                desired[m['serve_name']] = {
+                    k: v for k, v in m.items()
+                    if k not in ('name', 'serve_name')}
+            for c in self._pending.values():
+                desired[c['name']] = {k: v for k, v in c.items()
+                                      if k != 'name'}
+        for arm in set(rep.cfg_names) - set(desired):
+            try:
+                _http_json('POST', self.host, port,
+                           '/v1/models/%s:unload' % arm, payload={},
+                           timeout=10.0)
+            except (OSError, http.client.HTTPException):
+                pass
+        for arm in set(desired) - set(rep.cfg_names):
+            try:
+                _http_json('POST', self.host, port,
+                           '/v1/models/%s:load' % arm,
+                           payload=desired[arm], timeout=60.0)
+            except (OSError, http.client.HTTPException):
+                pass
+        self.router.add_backend(rep.bid, rep.host, rep.port)
+        profiler.add_fleet_supervisor_stats(replica_spawns=1,
+                                            replicas_live=live)
+        logging.info('fleet supervisor: replica %d up on %s:%d',
+                     rep.index, rep.host, rep.port)
+        return rep
+
+    def spawn_replica(self):
+        """Add one replica to the fleet (blocking until healthy)."""
+        return self._finish_spawn(self._spawn_proc(self._take_index()))
+
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def live_replicas(self):
+        return len(self.replicas())
+
+    def wait_healthy(self, timeout=None):
+        """Block until every current replica answers /healthz (raises
+        past `timeout`, default the spawn deadline)."""
+        deadline = time.monotonic() + (timeout or spawn_timeout_s())
+        while True:
+            pending = [r for r in self.replicas()
+                       if not self._probe(r)]
+            if not pending:
+                return self
+            if time.monotonic() >= deadline:
+                raise MXNetError(
+                    'fleet not healthy within deadline: replica(s) %s '
+                    'unresponsive' % [r.index for r in pending])
+            time.sleep(0.1)
+
+    def stop(self):
+        """Stop the loops, close the router, terminate the replicas
+        (SIGTERM, then SIGKILL stragglers)."""
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10)
+        self.router.close()
+        with self._lock:
+            reps, self._replicas = self._replicas, []
+        for r in reps:
+            if r.proc is not None and r.proc.poll() is None:
+                try:
+                    r.proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + 5.0
+        for r in reps:
+            if r.proc is None:
+                continue
+            try:
+                r.proc.wait(timeout=max(0.1,
+                                        deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    r.proc.kill()
+                    r.proc.wait(timeout=5)
+                except OSError:
+                    pass
+        profiler.add_fleet_supervisor_stats(replicas_live=0)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- health / restart / scale loop ----------------------------------
+    def _probe(self, rep, timeout=None):
+        try:
+            status, _h, _b = _http_json(
+                'GET', rep.host, rep.port, '/healthz',
+                timeout=timeout or min(2.0, dead_after_s()))
+            return status == 200
+        except (OSError, http.client.HTTPException, ValueError):
+            return False
+
+    def _loop(self):
+        last_scale = time.monotonic()
+        while not self._stop.wait(heartbeat_interval_s()):
+            try:
+                self._health_once()
+                if self.autoscale and \
+                        time.monotonic() - last_scale >= \
+                        2 * heartbeat_interval_s():
+                    last_scale = time.monotonic()
+                    self._scale_once()
+            except Exception:           # the loop must survive
+                logging.exception('fleet supervisor loop error')
+
+    def _health_once(self):
+        """One liveness pass: probe every replica, declare the silent
+        ones dead (process exit OR wedge — silence past DEAD_AFTER),
+        kill + respawn under the backoff/budget rules."""
+        now = time.monotonic()
+        for rep in self.replicas():
+            exited = rep.proc is not None and rep.proc.poll() is not None
+            if not exited:
+                if self._probe(rep):
+                    rep.last_ok = time.monotonic()
+                    rep.backoff = 0.0
+                    continue
+                if now - rep.last_ok <= dead_after_s():
+                    continue            # not silent long enough yet
+            self._declare_dead(rep, 'exited code %s' % rep.proc.poll()
+                               if exited else
+                               'no /healthz for > %.1fs (wedged?)'
+                               % dead_after_s())
+        self._respawn_due()
+
+    def _declare_dead(self, rep, why):
+        logging.warning('fleet supervisor: replica %d dead (%s) — '
+                        'restarting', rep.index, why)
+        self.router.remove_backend(rep.bid)
+        with self._lock:
+            if rep in self._replicas:
+                self._replicas.remove(rep)
+            live = len(self._replicas)
+        profiler.add_fleet_supervisor_stats(replicas_live=live)
+        if rep.proc is not None and rep.proc.poll() is None:
+            try:
+                rep.proc.kill()        # SIGKILL: it is wedged, not
+                rep.proc.wait(timeout=10)   # listening to SIGTERM
+            except OSError:
+                pass
+        # restart budget: at most MAX_RESTARTS per window, with
+        # exponential backoff between attempts (the launch.py
+        # --elastic / dist.py reconnect discipline)
+        now = time.monotonic()
+        rep.restart_times.append(now)
+        while rep.restart_times and \
+                now - rep.restart_times[0] > restart_window_s():
+            rep.restart_times.popleft()
+        if len(rep.restart_times) > max_restarts():
+            logging.error(
+                'fleet supervisor: replica slot %d exhausted its '
+                'restart budget (%d in %.0fs) — abandoning the slot',
+                rep.index, len(rep.restart_times), restart_window_s())
+            with self._lock:
+                self._abandoned += 1
+            return
+        rep.backoff = min(10.0, (rep.backoff * 2) or
+                          restart_backoff_s())
+        rep.next_attempt = now + rep.backoff
+        with self._lock:
+            self._dead_pending.append(rep)
+
+    def _respawn_due(self):
+        with self._lock:
+            pending = list(self._dead_pending)
+        now = time.monotonic()
+        for rep in pending:
+            if now < rep.next_attempt:
+                continue
+            with self._lock:
+                self._dead_pending.remove(rep)
+            try:
+                fresh = self._spawn_proc(rep.index)
+                fresh.restart_times = rep.restart_times
+                fresh.backoff = rep.backoff
+                self._finish_spawn(fresh)
+                with self._lock:
+                    self._n_restarts += 1
+                profiler.add_fleet_supervisor_stats(replica_restarts=1)
+            except Exception:
+                # ANY spawn failure (handshake MXNetError, but also a
+                # transient Popen OSError) re-queues the slot — losing
+                # it here would silently shrink the fleet with neither
+                # a restart nor an abandoned_slots count
+                logging.exception('fleet supervisor: respawn of '
+                                  'replica %d failed', rep.index)
+                rep.backoff = min(10.0, (rep.backoff * 2) or
+                                  restart_backoff_s())
+                rep.next_attempt = time.monotonic() + rep.backoff
+                with self._lock:
+                    self._dead_pending.append(rep)
+
+    def _scale_obs(self):
+        """One observation for the ScalePolicy from the PR-10 counter
+        windows: router-observed p99 vs each model's deadline, summed
+        replica backlog rows (/statsz), and the request delta."""
+        over = False
+        for name, m in list(self._models.items()):
+            d = m.get('deadline_ms')
+            if d and self.router.latency_p99_ms(name) > float(d):
+                over = True
+                break
+        backlog = 0
+        for rep in self.replicas():
+            try:
+                # tight timeout: this runs on the SINGLE supervisor
+                # loop thread — a wedged replica must not stall the
+                # next health pass past the death deadline
+                _s, _h, st = _http_json(
+                    'GET', rep.host, rep.port, '/statsz',
+                    timeout=min(1.0, dead_after_s() / 2))
+                for mm in st.get('models', {}).values():
+                    eng = mm.get('engine') or {}
+                    backlog += int(eng.get('backlog_rows', 0) or 0)
+            except (OSError, http.client.HTTPException, ValueError):
+                pass
+        return {'p99_over_deadline': over, 'backlog_rows': backlog,
+                'requests_delta': self.router.requests_delta()}
+
+    def _scale_once(self):
+        delta = self._policy.decide(self._scale_obs())
+        live = self.live_replicas()
+        if delta > 0 and live < self.max_replicas:
+            logging.info('fleet supervisor: scaling up (%d -> %d)',
+                         live, live + 1)
+            try:
+                self.spawn_replica()
+            except MXNetError:
+                logging.exception('fleet supervisor: scale-up spawn '
+                                  'failed')
+        elif delta < 0 and live > self.min_replicas:
+            self.retire_replica()
+
+    def retire_replica(self):
+        """Retire one replica with connection draining: the router
+        stops routing to it first, in-flight requests get the drain
+        grace, then SIGTERM (the replica's clean shutdown path)."""
+        with self._lock:
+            if not self._replicas:
+                return None
+            rep = self._replicas.pop()  # newest first
+            live = len(self._replicas)
+        self.router.remove_backend(rep.bid)
+        profiler.add_fleet_supervisor_stats(replicas_live=live)
+        logging.info('fleet supervisor: retiring replica %d '
+                     '(draining %.1fs)', rep.index, drain_s())
+
+        def finish():
+            time.sleep(drain_s())
+            if rep.proc is not None and rep.proc.poll() is None:
+                try:
+                    rep.proc.terminate()
+                    rep.proc.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    try:
+                        rep.proc.kill()
+                    except OSError:
+                        pass
+            with self._lock:
+                self._n_retired += 1
+            profiler.add_fleet_supervisor_stats(replica_retires=1)
+
+        threading.Thread(target=finish, name='mxtpu-fleet-retire',
+                         daemon=True).start()
+        return rep
+
+    # -- continuous deployment ------------------------------------------
+    def push(self, name, prefix, epoch=0, frac=None, mode='canary'):
+        """Hot-swap `name` to the `prefix`/`epoch` checkpoint behind a
+        canary split (or shadow tee): the candidate is loaded on every
+        live replica under a versioned arm name, then `frac` of
+        traffic (canary) — or a tee of all logged traffic (shadow) —
+        exercises it.  Auto-rollback/auto-promote per the knobs; the
+        decision lands in the supervisor's desired model set so future
+        spawns serve the surviving version.  Returns the arm name."""
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                raise MXNetError('push(%r): unknown model (have %s)'
+                                 % (name, sorted(self._models)))
+            if name in self._pending:
+                raise MXNetError('push(%r): a push is already active '
+                                 '(%s)' % (name,
+                                           self._pending[name]['name']))
+            self._push_seq += 1
+            cand_name = '%s@v%d' % (name, self._push_seq)
+            spec = {k: v for k, v in m.items()
+                    if k not in ('name', 'serve_name')}
+            spec['name'] = cand_name
+            spec['prefix'] = prefix
+            spec['epoch'] = int(epoch)
+            self._pending[name] = spec
+        loaded = []
+        try:
+            for rep in self.replicas():
+                status, _h, body = _http_json(
+                    'POST', rep.host, rep.port,
+                    '/v1/models/%s:load' % cand_name,
+                    payload={k: v for k, v in spec.items()
+                             if k != 'name'},
+                    timeout=spawn_timeout_s())
+                if status != 200:
+                    raise MXNetError(
+                        'push(%r): replica %d refused the candidate '
+                        '(%s: %s)' % (name, rep.index, status, body))
+                loaded.append(rep)
+        except Exception:
+            # undo half a push: the fleet must never route to an arm
+            # only some replicas can serve
+            for rep in loaded:
+                try:
+                    _http_json('POST', rep.host, rep.port,
+                               '/v1/models/%s:unload' % cand_name,
+                               payload={}, timeout=10.0)
+                except (OSError, http.client.HTTPException):
+                    pass
+            with self._lock:
+                self._pending.pop(name, None)
+            raise
+        self.router.start_canary(name, cand_name, frac=frac,
+                                 mode=mode)
+        return cand_name
+
+    def _on_router_event(self, kind, name, info):
+        if kind == 'promote':
+            with self._lock:
+                m = self._models.get(name)
+                cand = self._pending.pop(name, None)
+                if m is not None and cand is not None:
+                    m['serve_name'] = cand['name']
+                    m['prefix'] = cand['prefix']
+                    m['epoch'] = cand['epoch']
+        elif kind == 'rollback':
+            with self._lock:
+                self._pending.pop(name, None)
+
+    # -- observability --------------------------------------------------
+    def _sup_stats(self):
+        with self._lock:
+            reps = list(self._replicas)
+            out = {'desired_replicas': self.n_replicas,
+                   'min_replicas': self.min_replicas,
+                   'max_replicas': self.max_replicas,
+                   'restarts': self._n_restarts,
+                   'retired': self._n_retired,
+                   'abandoned_slots': self._abandoned,
+                   'models': {n: m['serve_name']
+                              for n, m in self._models.items()}}
+        out['replicas'] = [
+            {'index': r.index, 'port': r.port,
+             'alive': r.proc is not None and r.proc.poll() is None}
+            for r in reps]
+        return out
+
+    def stats(self):
+        return self._sup_stats()
+
+
+def _drain(stream):
+    try:
+        for _line in stream:
+            pass
+    except (OSError, ValueError):
+        pass
+
+
+if __name__ == '__main__':
+    _replica_main()

@@ -427,6 +427,48 @@ def fleet_stats():
     return out
 
 
+# self-healing fleet-supervisor counters (fleet_supervisor.FleetRouter +
+# FleetSupervisor): replica lifecycle (spawn/restart/retire + the live
+# gauge), router retry/fast-503 behavior under replica death, and
+# continuous-deployment outcomes (canary pushes/promotions/rollbacks,
+# shadow-replay traffic and divergences)
+_FLEET_SUP = {
+    'fleet_supervisor_replica_spawns': 0,
+    'fleet_supervisor_replica_restarts': 0,
+    'fleet_supervisor_replica_retires': 0,
+    'fleet_supervisor_replicas_live': 0,    # gauge
+    'fleet_supervisor_router_requests': 0,
+    'fleet_supervisor_router_retries': 0,
+    'fleet_supervisor_router_503': 0,
+    'fleet_supervisor_canary_pushes': 0,
+    'fleet_supervisor_canary_promotions': 0,
+    'fleet_supervisor_canary_rollbacks': 0,
+    'fleet_supervisor_shadow_requests': 0,
+    'fleet_supervisor_shadow_divergences': 0,
+}
+
+
+def add_fleet_supervisor_stats(replicas_live=None, **deltas):
+    """Accumulate fleet-supervisor counters (replicas_live is a GAUGE
+    — set, not added; everything else adds).  Keys arrive without the
+    fleet_supervisor_ prefix (router_retries=1, canary_rollbacks=1,
+    ...)."""
+    with _STATE['lock']:
+        for k, v in deltas.items():
+            _FLEET_SUP['fleet_supervisor_' + k] += int(v)
+        if replicas_live is not None:
+            _FLEET_SUP['fleet_supervisor_replicas_live'] = \
+                int(replicas_live)
+
+
+def fleet_supervisor_stats():
+    """Snapshot of the fleet-supervisor counters (also merged into
+    summary(), dump_profile's 'fleet_supervisor' metadata lane, and
+    the router's /statsz)."""
+    with _STATE['lock']:
+        return dict(_FLEET_SUP)
+
+
 def add_comm_bytes(reduce_scattered=0, all_gathered=0):
     """Accumulate logical collective payload bytes (ZeRO-1 fused
     steps: gradients reduce-scattered, updated params all-gathered)."""
@@ -509,6 +551,8 @@ def dump_profile():
                    'args': dist_stats()})
     events.append({'ph': 'M', 'name': 'fleet', 'pid': 0,
                    'args': fleet_stats()})
+    events.append({'ph': 'M', 'name': 'fleet_supervisor', 'pid': 0,
+                   'args': fleet_supervisor_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -664,6 +708,29 @@ def summary(print_out=True):
                     fl['fleet_http_requests'], fl['fleet_http_429'],
                     fl['fleet_resident_bytes'], fl['cont_ticks'],
                     fl['cont_utilization']))
+    fs = fleet_supervisor_stats()
+    lines.append('  fleet_supervisor_replica_spawns=%d '
+                 'fleet_supervisor_replica_restarts=%d '
+                 'fleet_supervisor_replica_retires=%d '
+                 'fleet_supervisor_replicas_live=%d '
+                 'fleet_supervisor_router_retries=%d '
+                 'fleet_supervisor_router_503=%d'
+                 % (fs['fleet_supervisor_replica_spawns'],
+                    fs['fleet_supervisor_replica_restarts'],
+                    fs['fleet_supervisor_replica_retires'],
+                    fs['fleet_supervisor_replicas_live'],
+                    fs['fleet_supervisor_router_retries'],
+                    fs['fleet_supervisor_router_503']))
+    lines.append('  fleet_supervisor_canary_pushes=%d '
+                 'fleet_supervisor_canary_promotions=%d '
+                 'fleet_supervisor_canary_rollbacks=%d '
+                 'fleet_supervisor_shadow_requests=%d '
+                 'fleet_supervisor_shadow_divergences=%d'
+                 % (fs['fleet_supervisor_canary_pushes'],
+                    fs['fleet_supervisor_canary_promotions'],
+                    fs['fleet_supervisor_canary_rollbacks'],
+                    fs['fleet_supervisor_shadow_requests'],
+                    fs['fleet_supervisor_shadow_divergences']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -706,6 +773,8 @@ def clear():
             _DIST[k] = type(_DIST[k])()
         for k in _FLEET:
             _FLEET[k] = 0
+        for k in _FLEET_SUP:
+            _FLEET_SUP[k] = 0
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

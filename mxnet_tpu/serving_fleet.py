@@ -43,6 +43,8 @@ ROADMAP item 2 asks for — the first user-facing surface of the stack:
 
 Env knobs (docs/SERVING.md has the full table):
   MXNET_TPU_SERVE_REGISTRY_BYTES   registry byte budget (0 = unbounded)
+  MXNET_TPU_SERVE_STRICT_BUDGET    1 = refuse (typed BudgetExceeded)
+                                   instead of transiently overshooting
   MXNET_TPU_SERVE_DEADLINE_MS      default SLO deadline (unset = none)
   MXNET_TPU_SERVE_WAIT_FRACTION    batcher hold as deadline fraction
   MXNET_TPU_SERVE_SHED_FACTOR      shed when est > factor x deadline
@@ -63,8 +65,8 @@ from . import profiler
 from .base import MXNetError
 from .serving import InferenceEngine, _env_int
 
-__all__ = ['Overloaded', 'SLO', 'ModelRegistry', 'ContinuousEngine',
-           'HttpFront']
+__all__ = ['Overloaded', 'BudgetExceeded', 'SLO', 'ModelRegistry',
+           'ContinuousEngine', 'HttpFront']
 
 
 def _env_float(name, default):
@@ -98,6 +100,34 @@ class Overloaded(MXNetError):
             '%s' % (model, self.est_ms, self.backlog_rows,
                     '' if deadline_ms is None
                     else ' > deadline %.1fms' % self.deadline_ms))
+
+
+class BudgetExceeded(MXNetError):
+    """Typed strict-budget refusal (MXNET_TPU_SERVE_STRICT_BUDGET=1):
+    making this model resident would push the registry past its byte
+    budget and nothing evictable remains to make room — the load is
+    refused (or undone) instead of transiently overshooting.  The HTTP
+    front maps it to 507 Insufficient Storage."""
+
+    def __init__(self, model, need_bytes, budget_bytes, resident_bytes):
+        self.model = model
+        self.need_bytes = int(need_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.resident_bytes = int(resident_bytes)
+        super(BudgetExceeded, self).__init__(
+            'model %r refused under the strict registry budget: needs '
+            '%d bytes but only %d of the %d-byte budget is free and '
+            'nothing evictable remains (set '
+            'MXNET_TPU_SERVE_STRICT_BUDGET=0 to allow transient '
+            'overshoot)' % (model, self.need_bytes,
+                            max(0, self.budget_bytes -
+                                self.resident_bytes),
+                            self.budget_bytes))
+
+
+def _strict_budget():
+    return os.environ.get('MXNET_TPU_SERVE_STRICT_BUDGET',
+                          '').strip() in ('1', 'true')
 
 
 class SLO(object):
@@ -162,9 +192,11 @@ class SLO(object):
 
 class _ModelEntry(object):
     __slots__ = ('name', 'loader', 'slo', 'engine_kwargs', 'pinned',
-                 'lock', 'engine', 'holder', 'bytes', 'last_used')
+                 'lock', 'engine', 'holder', 'bytes', 'last_used',
+                 'est_bytes', 'dead')
 
-    def __init__(self, name, loader, slo, engine_kwargs, pinned):
+    def __init__(self, name, loader, slo, engine_kwargs, pinned,
+                 est_bytes=None):
         self.name = name
         self.loader = loader
         self.slo = slo
@@ -175,6 +207,16 @@ class _ModelEntry(object):
         self.holder = None              # the Predictor (weight owner)
         self.bytes = 0
         self.last_used = 0.0
+        # estimated resident bytes BEFORE the first load (checkpoint
+        # param-file size for prefix= models, or an explicit
+        # est_bytes= at register); replaced by the exact measured
+        # bytes after the first load so later re-warms pre-enforce
+        # the budget precisely
+        self.est_bytes = est_bytes
+        # set (under self.lock) by unregister(): a _load that raced
+        # the pop must refuse instead of resurrecting an engine no
+        # map entry can ever reach again
+        self.dead = False
 
 
 def _weight_bytes(executor):
@@ -229,6 +271,9 @@ class ModelRegistry(object):
         self._lock = threading.Lock()   # registry map + byte ledger
         self._entries = {}
         self._resident_bytes = 0
+        self._peak_resident_bytes = 0   # high-water mark: with known
+                                        # estimates the pre-load
+                                        # enforcement keeps it <= budget
         self._n_loads = 0
         self._n_evictions = 0
         self._n_shed = 0
@@ -237,12 +282,15 @@ class ModelRegistry(object):
     # -- registration ---------------------------------------------------
     def register(self, name, loader=None, prefix=None, epoch=0,
                  input_shapes=None, source=None, slo=None,
-                 **engine_kwargs):
+                 est_bytes=None, **engine_kwargs):
         """Register a model spec (nothing loads until first use).
         Exactly one of `loader` / `prefix` / `source`.  `engine_kwargs`
         forward to InferenceEngine (max_batch, batch_buckets,
         free_dim_buckets, ...); `max_wait_us` defaults to the SLO's
-        deadline-derived hold instead of the global knob."""
+        deadline-derived hold instead of the global knob.  `est_bytes`
+        pre-sizes the model for budget enforcement BEFORE its first
+        load (prefix= models default to the checkpoint param-file
+        size); after the first load the measured bytes take over."""
         given = [x is not None for x in (loader, prefix, source)]
         if sum(given) != 1:
             raise MXNetError('register(%r): exactly one of loader= / '
@@ -258,6 +306,15 @@ class ModelRegistry(object):
 
             def loader(_p=prefix, _e=int(epoch), _s=shapes, _c=ctx):
                 return Predictor.from_checkpoint(_p, _e, _s, ctx=_c)
+            if est_bytes is None:
+                # the serialized params are a close upper bound on the
+                # resident arg/aux bytes (names + shape headers ride
+                # along) — good enough to pre-enforce the budget
+                try:
+                    est_bytes = os.path.getsize(
+                        '%s-%04d.params' % (prefix, int(epoch)))
+                except OSError:
+                    est_bytes = None
         elif source is not None:
             # live object: weights exist only in memory — evicting
             # would lose them, so it is resident-forever (pinned)
@@ -266,7 +323,8 @@ class ModelRegistry(object):
             def loader(_src=source):
                 return _src
         entry = _ModelEntry(name, loader, slo or SLO(),
-                            dict(engine_kwargs), pinned)
+                            dict(engine_kwargs), pinned,
+                            est_bytes=est_bytes)
         with self._lock:
             if self._closed:
                 raise MXNetError('ModelRegistry is closed')
@@ -302,9 +360,27 @@ class ModelRegistry(object):
         return self._load(ent)
 
     def _load(self, ent):
+        # pre-load budget enforcement: when the incoming model's size
+        # is known (param-file estimate, explicit est_bytes, or exact
+        # bytes from an earlier residency), colder models are paged
+        # out BEFORE the load so the ledger never overshoots — and
+        # under MXNET_TPU_SERVE_STRICT_BUDGET=1 an unsatisfiable load
+        # is refused with a typed BudgetExceeded instead of
+        # transiently overshooting.  Runs OUTSIDE ent.lock: evicting a
+        # victim takes the victim's entry lock, and two concurrent
+        # loads evicting each other while holding their own locks
+        # would deadlock.
+        if self.budget_bytes > 0 and ent.est_bytes:
+            self._make_room(ent, int(ent.est_bytes))
         with ent.lock:
             if self._closed:
                 raise MXNetError('ModelRegistry is closed')
+            if ent.dead:
+                # unregister() raced this load: the entry is gone from
+                # the map, so loading would leak an unreachable live
+                # engine and permanently inflate the byte ledger
+                raise MXNetError('unknown model %r (unregistered)'
+                                 % ent.name)
             if ent.engine is not None and not ent.engine.closed:
                 return ent.engine
             obj = ent.loader()
@@ -322,17 +398,83 @@ class ModelRegistry(object):
                 holder = obj
                 nbytes = _weight_bytes(obj._executor)
             ent.engine, ent.holder, ent.bytes = eng, holder, nbytes
+            ent.est_bytes = nbytes or ent.est_bytes
             with self._lock:
                 self._resident_bytes += nbytes
+                self._peak_resident_bytes = max(
+                    self._peak_resident_bytes, self._resident_bytes)
                 self._n_loads += 1
             profiler.add_fleet_stats(
                 loads=1, resident_bytes=self._resident_bytes)
-        # budget enforcement AFTER the load: the incoming model's size
-        # is only known once its weights exist, so a load may
-        # transiently overshoot; colder models are paged out
-        # immediately (never the one just loaded)
+        # budget enforcement after the load backstops the estimate
+        # (the measured bytes may exceed it, or no estimate existed):
+        # colder models are paged out immediately (never the one just
+        # loaded); under the strict knob a load that STILL overshoots
+        # with nothing left to evict is undone and refused typed
         self._enforce_budget(keep=ent)
-        return ent.engine
+        if self.budget_bytes > 0 and _strict_budget() and \
+                not ent.pinned:
+            with self._lock:
+                over = self._resident_bytes - self.budget_bytes
+                resident = self._resident_bytes
+            if over > 0:
+                self._evict_one(ent)
+                raise BudgetExceeded(ent.name, ent.est_bytes or 0,
+                                     self.budget_bytes,
+                                     resident - (ent.est_bytes or 0))
+        # return the engine THIS call loaded (or found), not
+        # ent.engine: a concurrent load's budget enforcement may have
+        # evicted the entry again already (ent.engine = None) — the
+        # returned closed engine then surfaces the typed closed error
+        # that infer()'s reload-retry absorbs
+        return eng
+
+    def _make_room(self, ent, need):
+        """Evict colder models until `need` bytes fit under the
+        budget (same victim order as _enforce_budget).  Under the
+        strict knob, raise typed BudgetExceeded when room cannot be
+        made — BEFORE the load spends time and memory."""
+        with self._lock:
+            if ent.engine is not None and not ent.engine.closed:
+                return                  # concurrent load already won
+            resident = self._resident_bytes
+            evictable = sum(
+                e.bytes for e in self._entries.values()
+                if e is not ent and not e.pinned and
+                e.engine is not None and not e.engine.closed)
+        if resident - evictable + need > self.budget_bytes:
+            # unsatisfiable even after evicting EVERY unpinned tenant
+            # (the floor is the pinned/unevictable bytes, not zero):
+            # decidable NOW — never destroy resident tenants for a
+            # load that could not fit anyway
+            if _strict_budget():
+                raise BudgetExceeded(ent.name, need,
+                                     self.budget_bytes, resident)
+            return                      # overshoot stands (documented)
+        while True:
+            with self._lock:
+                if ent.engine is not None and not ent.engine.closed:
+                    return              # a concurrent load already won:
+                                        # ent's bytes are in the ledger,
+                                        # counting `need` again would
+                                        # evict colder tenants (or 507)
+                                        # for a model already serving
+                if self._resident_bytes + need <= self.budget_bytes:
+                    return
+                victims = [e for e in self._entries.values()
+                           if e is not ent and not e.pinned and
+                           e.engine is not None and
+                           not e.engine.closed]
+                if not victims:
+                    resident = self._resident_bytes
+                    break
+                victim = min(victims, key=lambda e:
+                             (e.slo.priority, e.last_used))
+            self._evict_one(victim)
+        if _strict_budget() and \
+                (ent.engine is None or ent.engine.closed):
+            raise BudgetExceeded(ent.name, need, self.budget_bytes,
+                                 resident)
 
     def _enforce_budget(self, keep=None):
         if self.budget_bytes <= 0:
@@ -385,23 +527,56 @@ class ModelRegistry(object):
         self._evict_one(ent)
         return self
 
+    def unregister(self, name):
+        """Remove a model from the registry entirely: reject-new (the
+        name is unknown the moment this returns), drain + close its
+        engine, free its bytes.  Unlike evict(), this applies to
+        pinned (source=) models too — it is explicit destruction, the
+        fleet hot-swap path for retiring a rolled-back or superseded
+        model version."""
+        with self._lock:
+            ent = self._entries.pop(name, None)
+        if ent is None:
+            raise MXNetError('unknown model %r (registered: %s)'
+                             % (name, self.models()))
+        with ent.lock:                  # serialize with an in-flight
+            ent.dead = True             # _load: it must not resurrect
+        self._evict_one(ent)            # an unreachable engine
+        return self
+
     # -- serving --------------------------------------------------------
     def infer(self, name, *pos_inputs, **named_inputs):
         """Admission-controlled inference: sheds with `Overloaded`
         when the model's backlog x service rate exceeds its SLO
         deadline (or the hard queue-row cap), else forwards to the
-        resident engine.  A concurrent eviction racing this call is
-        absorbed by one transparent reload+retry."""
+        resident engine.  Concurrent evictions racing this call are
+        absorbed by transparent reload+retry (time-bounded)."""
         ent = self._entry(name)
-        for attempt in (0, 1):
+        # the retry window is bounded by the model's OWN deadline when
+        # it has one ("fast typed error over slow useless answer" —
+        # a 20ms tenant must not spin load/evict cycles for 30s while
+        # holding an HTTP inflight slot), else by a fixed cap
+        budget = 30.0
+        if ent.slo.deadline_ms:
+            budget = min(budget, ent.slo.deadline_ms / 1e3)
+        deadline = time.monotonic() + budget
+        while True:
             eng = self.engine(name)
             self._admit(ent, eng)
             try:
                 return eng.infer(*pos_inputs, **named_inputs)
             except MXNetError as e:
-                # eviction race: the engine closed between our engine()
-                # and the enqueue — reload once; anything else is real
-                if attempt == 0 and getattr(eng, 'closed', False) and \
+                # eviction race: the engine closed between our
+                # engine() and the enqueue — reload and retry.  The
+                # bound is TIME, not attempts: under a two-model
+                # thrash against a one-model budget each reload can
+                # lose the race again (the other side's PRE-load
+                # enforcement closes it), but every loss needs the
+                # close to land in a sub-ms window, so retries
+                # converge; a registry-closed error raises from
+                # engine() itself and is never retried
+                if time.monotonic() < deadline and \
+                        getattr(eng, 'closed', False) and \
                         'closed' in str(e):
                     continue
                 raise
@@ -455,6 +630,8 @@ class ModelRegistry(object):
             out = {
                 'budget_bytes': self.budget_bytes,
                 'resident_bytes': self._resident_bytes,
+                'peak_resident_bytes': self._peak_resident_bytes,
+                'strict_budget': _strict_budget(),
                 'loads': self._n_loads,
                 'evictions': self._n_evictions,
                 'shed_requests': self._n_shed,
@@ -958,6 +1135,20 @@ class _FleetHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: profiler counts us
         pass
 
+    def _read_body(self):
+        """Drain and return the request body.  MUST run before ANY
+        reply on these HTTP/1.1 keep-alive connections: unread body
+        bytes left in rfile would be parsed as the NEXT request line
+        on the persistent connection, corrupting every subsequent
+        request from that client.  Shared by every handler subclass
+        (replica admin ops, the fleet router) so the invariant lives
+        in one place."""
+        try:
+            n = int(self.headers.get('Content-Length', 0) or 0)
+        except ValueError:
+            n = 0
+        return self.rfile.read(n) if n > 0 else b''
+
     def _reply(self, code, payload, retry_after_ms=None):
         body = json.dumps(payload).encode()
         self.send_response(code)
@@ -990,16 +1181,7 @@ class _FleetHandler(BaseHTTPRequestHandler):
         front = self.server.front
         profiler.add_fleet_stats(http_requests=1)
         front.note_request()
-        # drain the request body BEFORE any reply: these are HTTP/1.1
-        # keep-alive connections, and an early 404/429 sent while
-        # unread body bytes sit in rfile would leave them to be parsed
-        # as the NEXT request line on the persistent connection —
-        # corrupting every subsequent request from that client
-        try:
-            n = int(self.headers.get('Content-Length', 0) or 0)
-        except ValueError:
-            n = 0
-        raw = self.rfile.read(n) if n > 0 else b''
+        raw = self._read_body()         # drain-before-reply contract
         name = _predict_model(self.path)
         if name is None:
             self._reply(404, {'error': 'not found', 'path': self.path})
@@ -1022,6 +1204,12 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 return
             try:
                 outs = front.registry.infer(name, *pos, **named)
+            except BudgetExceeded as e:
+                self._reply(507, {'error': 'insufficient storage',
+                                  'model': name,
+                                  'need_bytes': e.need_bytes,
+                                  'budget_bytes': e.budget_bytes})
+                return
             except Overloaded as e:
                 profiler.add_fleet_stats(http_429=1)
                 front.note_429()
@@ -1102,7 +1290,8 @@ class HttpFront(object):
     """
 
     def __init__(self, registry, host='127.0.0.1', port=None,
-                 max_inflight=None, priority_reserve=None):
+                 max_inflight=None, priority_reserve=None,
+                 handler_cls=None):
         self.registry = registry
         self.max_inflight = int(
             max_inflight if max_inflight is not None else
@@ -1118,7 +1307,8 @@ class HttpFront(object):
         self._closed = False
         port = int(port if port is not None else
                    _env_int('MXNET_TPU_SERVE_HTTP_PORT', 8000))
-        self._server = _FleetHTTPServer((host, port), _FleetHandler)
+        self._server = _FleetHTTPServer((host, port),
+                                        handler_cls or _FleetHandler)
         self._server.front = self
         self._thread = None
 

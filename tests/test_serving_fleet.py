@@ -23,7 +23,8 @@ from mxnet_tpu import exec_cache, model as model_mod, nd, profiler, sym
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.predictor import Predictor
 from mxnet_tpu.serving import InferenceEngine
-from mxnet_tpu.serving_fleet import (SLO, ContinuousEngine, HttpFront,
+from mxnet_tpu.serving_fleet import (SLO, BudgetExceeded,
+                                     ContinuousEngine, HttpFront,
                                      ModelRegistry, Overloaded)
 
 DIM = 6
@@ -179,6 +180,119 @@ def test_registry_prefix_loader_from_checkpoint(tmp_path):
     with pytest.raises(MXNetError, match='exactly one of'):
         ModelRegistry().register('bad', prefix=prefix,
                                  loader=_loader(1))
+
+
+def test_registry_unregister_removes_and_frees():
+    x = _x(1)
+    with ModelRegistry() as reg:
+        reg.register('m', loader=_loader(1), max_batch=2,
+                     max_wait_us=0)
+        reg.infer('m', x)
+        assert reg.stats()['resident_bytes'] > 0
+        reg.unregister('m')
+        assert reg.stats()['resident_bytes'] == 0
+        with pytest.raises(MXNetError, match='unknown model'):
+            reg.infer('m', x)
+        with pytest.raises(MXNetError, match='unknown model'):
+            reg.unregister('m')
+        # the name is free for a new registration (version hot-swap)
+        reg.register('m', loader=_loader(2), max_batch=2,
+                     max_wait_us=0)
+        np.testing.assert_allclose(reg.infer('m', x)[0], _ref(2, x),
+                                   rtol=2e-6, atol=1e-6)
+        # unregister applies to pinned models too: it is explicit
+        # destruction, unlike budget eviction
+        reg.register('pinned', source=_loader(1)(), max_batch=2,
+                     max_wait_us=0)
+        reg.infer('pinned', x)
+        reg.unregister('pinned')
+        assert 'pinned' not in reg.models()
+
+
+def test_registry_strict_budget_refuses_typed(monkeypatch):
+    # budget fits one model; the other is PINNED so nothing is
+    # evictable: non-strict overshoots transiently (documented PR-10
+    # behavior), strict refuses with the typed error and undoes the
+    # load
+    x = _x(1)
+    monkeypatch.delenv('MXNET_TPU_SERVE_STRICT_BUDGET', raising=False)
+    with ModelRegistry(budget_bytes=400) as reg:
+        reg.register('pinned', source=_loader(1)(), max_batch=2,
+                     max_wait_us=0)
+        reg.register('extra', loader=_loader(2), max_batch=2,
+                     max_wait_us=0)
+        reg.infer('pinned', x)
+        reg.infer('extra', x)            # non-strict: overshoot stands
+        assert reg.stats()['resident_bytes'] > 400
+    monkeypatch.setenv('MXNET_TPU_SERVE_STRICT_BUDGET', '1')
+    with ModelRegistry(budget_bytes=400) as reg:
+        reg.register('pinned', source=_loader(1)(), max_batch=2,
+                     max_wait_us=0)
+        reg.register('extra', loader=_loader(2), max_batch=2,
+                     max_wait_us=0)
+        reg.infer('pinned', x)
+        with pytest.raises(BudgetExceeded) as ei:
+            reg.infer('extra', x)
+        assert isinstance(ei.value, MXNetError)   # typed AND catchable
+        assert ei.value.budget_bytes == 400
+        st = reg.stats()
+        assert st['strict_budget'] is True
+        assert not st['models']['extra']['resident']  # load undone
+        assert st['resident_bytes'] <= 400
+        # the pinned tenant keeps serving
+        np.testing.assert_allclose(reg.infer('pinned', x)[0],
+                                   _ref(1, x), rtol=2e-6, atol=1e-6)
+
+
+def test_registry_strict_budget_preload_refusal(monkeypatch, tmp_path):
+    # a prefix model carries a size estimate (the params file): under
+    # strict budget an unsatisfiable load is refused BEFORE the load
+    # spends memory — the loads counter must not move
+    monkeypatch.setenv('MXNET_TPU_SERVE_STRICT_BUDGET', '1')
+    prefix = str(tmp_path / 'big')
+    model_mod.save_checkpoint(prefix, 0, _mlp(), _params(3), {})
+    with ModelRegistry(budget_bytes=100) as reg:   # < params bytes
+        reg.register('big', prefix=prefix, epoch=0,
+                     input_shapes={'data': (1, DIM)}, max_batch=2,
+                     max_wait_us=0)
+        with pytest.raises(BudgetExceeded):
+            reg.infer('big', _x(1))
+        st = reg.stats()
+        assert st['loads'] == 0          # refused before loading
+        assert st['resident_bytes'] == 0
+
+
+def test_registry_preload_eviction_keeps_peak_under_budget(tmp_path):
+    # with a known size estimate the budget is enforced BEFORE the
+    # load: the colder model pages out first and the resident
+    # high-water mark never overshoots (the PR-10 "transient
+    # overshoot" caveat, closed when the estimate exists).  The
+    # budget must sit ABOVE one model's ESTIMATE (params file ~588
+    # bytes here) — an over-budget estimate skips pre-eviction
+    # entirely (hopeless loads must not destroy resident tenants)
+    # — and below two models' actual bytes so paging happens.
+    prefix = str(tmp_path / 'est')
+    model_mod.save_checkpoint(prefix, 0, _mlp(), _params(4), {})
+    x = _x(1)
+    with ModelRegistry(budget_bytes=620) as reg:
+        reg.register('a', prefix=prefix, epoch=0,
+                     input_shapes={'data': (1, DIM)}, max_batch=2,
+                     max_wait_us=0)
+        reg.register('b', prefix=prefix, epoch=0,
+                     input_shapes={'data': (1, DIM)}, max_batch=2,
+                     max_wait_us=0)
+        reg.infer('a', x)
+        reg.infer('b', x)                # evicts 'a' BEFORE loading
+        reg.infer('a', x)                # and back again
+        st = reg.stats()
+        assert st['evictions'] >= 2
+        assert st['peak_resident_bytes'] <= 620
+        # and the hopeless-load guard: an estimate OVER the whole
+        # budget skips pre-eviction (no point destroying resident
+        # tenants) but non-strict still serves via the post-load path
+        reg.budget_bytes = 200
+        out = reg.infer('b', x)
+        assert out[0].shape == (1, OUT)
 
 
 # ---------------------------------------------------------------------------

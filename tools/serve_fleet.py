@@ -1,0 +1,110 @@
+"""Self-healing fleet CLI: supervise N localhost serving replicas
+behind a routing front with health-checked restarts, optional
+autoscaling, and canary hot-swap.
+
+  python tools/serve_fleet.py \\
+      --model mnist=/ckpt/mnist:0:data=1x784 \\
+      --deadline-ms mnist=20 --priority mnist=1 \\
+      --replicas 3 --port 8000 [--autoscale] [--budget-mb 512]
+
+Same model-spec grammar as tools/serve_http.py
+(name=prefix:epoch:input=BxD[,input2=...]); the supervisor spawns
+`--replicas` replica processes (each a ModelRegistry + HTTP front
+that warms from the persistent/exec cache), spreads
+`POST /v1/models/<name>:predict` across them with
+retry-on-replica-death, restarts crashed or wedged replicas with
+exponential backoff under a restart budget, and serves GET /healthz +
+/statsz (replica table, canary state, fleet_supervisor_* counters) on
+the router port.
+
+Canary pushes are an API (`FleetSupervisor.push(name, prefix, epoch)`)
+— see docs/SERVING.md for the localhost dryrun recipe, knob table and
+the restart state machine.
+
+  python tools/serve_fleet.py --replica
+runs ONE replica from the MXNET_TPU_FLEET_REPLICA_CONFIG /
+_REPLICA_INDEX env contract (what the supervisor spawns; exposed for
+debugging a replica by hand).
+"""
+import argparse
+import os
+import signal
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                '..'))
+
+from serve_http import parse_kv, parse_model_spec  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument('--replica', action='store_true',
+                   help='run one replica from the env contract '
+                        '(internal: what the supervisor spawns)')
+    p.add_argument('--model', action='append',
+                   help='name=prefix:epoch:input=BxD[,...] '
+                        '(repeatable)')
+    p.add_argument('--deadline-ms', action='append', metavar='NAME=MS')
+    p.add_argument('--priority', action='append', metavar='NAME=N')
+    p.add_argument('--max-batch', type=int, default=None)
+    p.add_argument('--budget-mb', type=float, default=0,
+                   help='per-replica registry budget (0 = env/unbounded)')
+    p.add_argument('--replicas', type=int, default=2)
+    p.add_argument('--min-replicas', type=int, default=None)
+    p.add_argument('--max-replicas', type=int, default=None)
+    p.add_argument('--autoscale', action='store_true',
+                   help='spawn/retire from the counter windows')
+    p.add_argument('--host', default='127.0.0.1')
+    p.add_argument('--port', type=int, default=8000,
+                   help='router (public) port')
+    args = p.parse_args()
+
+    if args.replica:
+        from mxnet_tpu.fleet_supervisor import _replica_main
+        _replica_main()
+        return
+
+    if not args.model:
+        p.error('--model is required (or --replica)')
+    from mxnet_tpu.fleet_supervisor import FleetSupervisor
+
+    deadlines = parse_kv(args.deadline_ms, float)
+    priorities = parse_kv(args.priority, int)
+    models = []
+    for spec in args.model:
+        name, prefix, epoch, shapes = parse_model_spec(spec)
+        m = {'name': name, 'prefix': prefix, 'epoch': epoch,
+             'input_shapes': {k: list(v) for k, v in shapes.items()},
+             'deadline_ms': deadlines.get(name),
+             'priority': priorities.get(name, 0)}
+        if args.max_batch:
+            m['max_batch'] = args.max_batch
+        models.append(m)
+    budget = int(args.budget_mb * (1 << 20)) if args.budget_mb else None
+
+    sup = FleetSupervisor(models, replicas=args.replicas,
+                          host=args.host, router_port=args.port,
+                          budget_bytes=budget,
+                          autoscale=args.autoscale,
+                          min_replicas=args.min_replicas,
+                          max_replicas=args.max_replicas)
+    sup.start()
+    sup.wait_healthy()
+    host, port = sup.router.address
+    print('fleet of %d replica(s) serving %s on http://%s:%d '
+          '(autoscale=%s)' % (sup.live_replicas(),
+                              [m['name'] for m in models], host, port,
+                              args.autoscale), flush=True)
+
+    stop = threading.Event()
+    for s in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(s, lambda *_: stop.set())
+    stop.wait()
+    print('shutting down fleet', flush=True)
+    sup.stop()
+
+
+if __name__ == '__main__':
+    main()
