@@ -47,6 +47,10 @@ BENCH_BUCKET=1 (dynamic-shape training mode: legacy 3-dispatch
 per-bucket loop vs the AOT-warmed fused bucket ladder vs the
 bucket-major bulked ladder on a synthetic length-mixed workload —
 see bucket_bench() for the BENCH_BUCKET_* knobs),
+BENCH_PIPE=1 (dp×pipe GPipe training mode A/B: dp-only vs dp×pipe vs
+dp×pipe+ZeRO on a self-spawned virtual mesh, parity-gated, per-device
+param+optimizer-state residency — see pipe_bench() for the
+BENCH_PIPE_* knobs),
 BENCH_CKPT=1 (elastic-checkpoint overhead A/B: no-checkpoint vs
 async cadence vs blocking cadence, ckpt_* counters + bit-parity
 gate — see ckpt_bench() for the BENCH_CKPT_* knobs),
@@ -472,6 +476,184 @@ def gluon_bench():
         'gluon_fused_dispatches': gf['gluon_fused_dispatches'],
         'total_compile_s': round(cache['total_compile_s'], 3),
         'exec_cache_misses': cache['exec_cache_misses'],
+        'parity_max_abs_diff': max_diff,
+        'parity_ok': bool(max_diff < 1e-5),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# BENCH_PIPE=1: dp-only vs dp×pipe vs dp×pipe+ZeRO (GPipe fill-drain)
+# ---------------------------------------------------------------------------
+
+def pipe_bench():
+    """BENCH_PIPE=1: measure the dp×pipe GPipe training mode (round
+    16) in three arms on one device set and emit ONE JSON line:
+
+      * dp    — plain data parallelism over all BENCH_PIPE_DEVICES
+        devices (every device holds every weight + momentum).
+      * pipe  — the same net through fuse_step(pipeline=(S, M)): 2D
+        {data: dp, pipe: S} mesh, stage weights stacked P('pipe')
+        (each device holds ~1/S of the stage-body weights), GPipe
+        fill-drain over M microbatches inside the same single donated
+        dispatch.
+      * pipe+zero — plus ZeRO-1: momentum buckets sharded over the dp
+        axis on top of the stage split (per-device optimizer state
+        ~1/(dp·S) of the replicated baseline).
+
+    All arms train the SAME weights on the SAME batches; a parity
+    gate asserts the final parameters agree (the schedule reorders
+    float sums — tolerance 1e-5).  The JSON reports best-of-
+    BENCH_PIPE_PASSES steps/s per arm (this rig's cpu-shares throttle
+    swings single passes ~2x) plus the measured per-device
+    param/optimizer-state bytes per arm and the analytic bubble
+    fraction (S-1)/(M+S-1).  NOTE on reading CPU numbers: virtual
+    host devices share the same cores, so the pipeline cannot
+    shorten wall-clock the way real per-stage chips do — treat the
+    arm as a schedule-correctness + residency smoke; the speedup
+    story needs real chips.
+
+    Needs >= BENCH_PIPE_DEVICES devices: when the process has fewer
+    (no TPU pod on this rig), re-execs itself on a virtual CPU mesh
+    (same technique as dryrun_multichip).
+
+    Knobs: BENCH_PIPE_DEVICES (8), BENCH_PIPE_STAGES (2),
+    BENCH_PIPE_MICRO (4), BENCH_PIPE_BATCH (64), BENCH_PIPE_DIM (32),
+    BENCH_PIPE_UNITS (64), BENCH_PIPE_BODY (4 — body layers, must
+    divide by stages), BENCH_PIPE_STEPS (16 per pass),
+    BENCH_PIPE_PASSES (5)."""
+    ndev = int(os.environ.get('BENCH_PIPE_DEVICES', 8))
+    import jax
+    try:
+        have = jax.device_count()
+    except Exception:
+        have = 0
+    if have < ndev:
+        if os.environ.get('BENCH_PIPE_SPAWNED') == '1':
+            raise RuntimeError('spawned pipe bench still has %d < %d '
+                               'devices' % (have, ndev))
+        env = dict(os.environ, BENCH_PIPE='1', BENCH_PIPE_SPAWNED='1',
+                   JAX_PLATFORMS='cpu')
+        flags = [f for f in env.get('XLA_FLAGS', '').split()
+                 if 'xla_force_host_platform_device_count' not in f]
+        flags.append('--xla_force_host_platform_device_count=%d'
+                     % ndev)
+        env['XLA_FLAGS'] = ' '.join(flags)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('pipe bench child failed (rc=%d)'
+                               % proc.returncode)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('pipe bench child produced no output')
+        print(lines[-1], flush=True)
+        return
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, profiler
+    from mxnet_tpu.gluon import nn
+
+    stages = int(os.environ.get('BENCH_PIPE_STAGES', 2))
+    micro = int(os.environ.get('BENCH_PIPE_MICRO', 4))
+    batch = int(os.environ.get('BENCH_PIPE_BATCH', 64))
+    dim = int(os.environ.get('BENCH_PIPE_DIM', 32))
+    units = int(os.environ.get('BENCH_PIPE_UNITS', 64))
+    body = int(os.environ.get('BENCH_PIPE_BODY', 4))
+    steps = int(os.environ.get('BENCH_PIPE_STEPS', 16))
+    passes = max(1, int(os.environ.get('BENCH_PIPE_PASSES', 5)))
+    classes = 10
+    ctxs = [mx.cpu(i) for i in range(ndev)]
+    opt_params = {'learning_rate': 0.05, 'momentum': 0.9, 'wd': 1e-4}
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.rand(batch, dim).astype(np.float32))
+    y = mx.nd.array((rs.rand(batch) * classes).astype(np.float32))
+
+    def make_arm(pipeline=None, zero=None):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(units, activation='relu', in_units=dim))
+            for _ in range(body):
+                net.add(nn.Dense(units, activation='tanh',
+                                 in_units=units))
+            net.add(nn.Dense(classes, in_units=units))
+        net.initialize(ctx=ctxs)
+        prs = np.random.RandomState(7)
+        for _, p in sorted(net.collect_params().items()):
+            p.set_data(mx.nd.array(
+                (prs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2))
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           dict(opt_params))
+        return net, gluon.fuse_step(net, loss_fn, tr,
+                                    pipeline=pipeline, zero=zero)
+
+    arms = {
+        'dp': make_arm(),
+        'pipe': make_arm(pipeline=(stages, micro)),
+        'pipe_zero': make_arm(pipeline=(stages, micro), zero=1),
+    }
+
+    def run_steps(fs, n):
+        for _ in range(n):
+            l = fs(x, y)
+        l.asnumpy()
+
+    for _, fs in arms.values():
+        run_steps(fs, 2)
+    best = {name: 0.0 for name in arms}
+    profiler.clear()
+    profiler.profiler_set_state('run')
+    try:
+        for _ in range(passes):
+            for name, (_, fs) in arms.items():
+                tic = time.time()
+                run_steps(fs, steps)
+                best[name] = max(best[name],
+                                 steps / (time.time() - tic))
+    finally:
+        profiler.profiler_set_state('stop')
+
+    # parity: same seeds + same batches on every arm
+    def pvals(net):
+        return [p.list_data()[0].asnumpy()
+                for _, p in sorted(net.collect_params().items())]
+
+    ref = pvals(arms['dp'][0])
+    max_diff = max(
+        float(np.abs(a - b).max())
+        for name in ('pipe', 'pipe_zero')
+        for a, b in zip(ref, pvals(arms[name][0])))
+
+    # per-device residency: the dp arm replicates everything; the
+    # pipe arms report the engine's own accounting
+    dp_param_b = sum(
+        int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+        for _, p in sorted(arms['dp'][0].collect_params().items()))
+    pipe_param_b, pipe_state_b = \
+        arms['pipe'][1]._pipe_state_accounting()
+    _, zero_state_b = arms['pipe_zero'][1]._pipe_state_accounting()
+    pi = profiler.pipe_stats()
+    print(json.dumps({
+        'metric': 'pipe_train',
+        'value': round(best['pipe'], 2),
+        'unit': 'steps/sec',
+        'dp_sps': round(best['dp'], 2),
+        'pipe_zero_sps': round(best['pipe_zero'], 2),
+        'devices': ndev, 'stages': stages, 'num_micro': micro,
+        'dp_width': ndev // stages,
+        'batch': batch, 'dim': dim, 'units': units,
+        'body_layers': body,
+        'bubble_frac': round(pi['pipe_bubble_frac'], 4),
+        'dp_param_bytes_per_device': dp_param_b,
+        'dp_state_bytes_per_device': dp_param_b,
+        'pipe_param_bytes_per_device': pipe_param_b,
+        'pipe_state_bytes_per_device': pipe_state_b,
+        'pipe_zero_state_bytes_per_device': zero_state_b,
+        'pipe_microbatches': pi['pipe_microbatches'],
+        'steps_per_pass': steps, 'passes': passes,
         'parity_max_abs_diff': max_diff,
         'parity_ok': bool(max_diff < 1e-5),
     }))
@@ -1766,6 +1948,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_BUCKET', '') == '1':
         bucket_bench()   # fused bucket ladder vs legacy per-bucket loop
+        return
+    if os.environ.get('BENCH_PIPE', '') == '1':
+        pipe_bench()   # dp-only vs dp×pipe vs dp×pipe+ZeRO
         return
     if os.environ.get('BENCH_CKPT', '') == '1':
         ckpt_bench()   # async elastic checkpoint overhead A/B

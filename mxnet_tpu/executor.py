@@ -1013,8 +1013,9 @@ class Executor:
 
     def _default_head_grads(self, out_grads):
         """No head grads: all-ones.  Loss outputs (SoftmaxOutput & co)
-        ignore head grads via their custom VJPs, so ones reproduces
-        reference backward() exactly.  For multi-output graphs whose
+        scale their custom-VJP gradient by the head cotangent —
+        identity under ones — so ones reproduces reference backward()
+        exactly.  For multi-output graphs whose
         outputs are NOT loss ops, ones-head backward computes
         d(sum(outputs)) — the reference errors there instead; we warn
         once so silent sum-gradients don't masquerade as per-output

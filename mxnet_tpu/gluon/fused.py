@@ -59,6 +59,8 @@ import hashlib
 import re
 import time
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 import jax.tree_util as jtu
@@ -76,7 +78,8 @@ from . import block as block_mod
 
 
 def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
-              ema_decay=None, interleave=None, checkpoint=None):
+              ema_decay=None, interleave=None, checkpoint=None,
+              pipeline=None):
     """Build (and register on `trainer`) a FusedStep compiling the
     whole train step for `net` into one donated XLA dispatch.
 
@@ -118,8 +121,36 @@ def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
     the caller's to restore (`checkpoint.last_resume.step` says how
     many optimizer steps already ran).
 
+    pipeline: optional (num_stages, num_micro) — or None to defer to
+    MXNET_TPU_PIPE='stages,micro' — switches to the dp×pipe 2D-mesh
+    GPipe training mode (PipelinedStep): the net's children partition
+    into `num_stages` architecturally identical stages (plus an
+    optional input stem and output head), each stage's parameters live
+    ONLY on its pipe row of the mesh, and every step runs the
+    fill-drain microbatch schedule inside the same single donated XLA
+    dispatch — composing with ZeRO-1 sharding of the optimizer state
+    over the dp axis (zero=1: per-device state ~1/(dp·pipe) of the
+    replicated single-device baseline).  Requires a Sequential-style
+    net and trainer contexts divisible by num_stages; device-resident
+    metrics, EMA, and elastic checkpoints are not yet composed with
+    the pipelined mode (pass them only without `pipeline`).  Call the
+    returned step's `sync_params()` before imperative eval/predict —
+    stage weights live only on their pipe row during training (see
+    PipelinedStep.sync_params).
+
     After this call `trainer.step_fused(batch_size, *args)` also runs
     the fused step."""
+    from ..parallel import pipeline as pipe_mod
+    spec = pipe_mod.pipe_spec(pipeline)
+    if spec is not None:
+        for bad, name in ((metric, 'metric'), (ema_decay, 'ema_decay'),
+                          (checkpoint, 'checkpoint'), (mesh, 'mesh'),
+                          (interleave, 'interleave')):
+            if bad is not None:
+                raise ValueError(
+                    'fuse_step: %s= does not compose with the '
+                    'pipelined mode yet (pipeline=%r)' % (name, spec))
+        return PipelinedStep(net, loss, trainer, spec, zero=zero)
     return FusedStep(net, loss, trainer, mesh=mesh, zero=zero,
                      metric=metric, ema_decay=ema_decay,
                      interleave=interleave, checkpoint=checkpoint)
@@ -305,11 +336,14 @@ class FusedStep:
         (empty otherwise — the backward never sees extra residuals)."""
         tps, aps, fps = self._params, self._aux_params, \
             self._frozen_params
+        from .nn import moe as moe_mod
         sub = {p: nd.NDArray(v) for p, v in zip(tps, ws)}
         sub.update({p: nd.NDArray(v) for p, v in zip(aps, auxs)})
         sub.update({p: nd.NDArray(v) for p, v in zip(fps, frozen)})
         mouts = ()
-        with block_mod.param_trace(sub, rng, train_mode=True):
+        moe_aux = []
+        with block_mod.param_trace(sub, rng, train_mode=True), \
+                moe_mod.aux_loss_scope(moe_aux):
             in_nd = [nd.NDArray(v) for v in ins]
             if self._loss is not None:
                 out = self._net(*in_nd[:-1])
@@ -331,6 +365,11 @@ class FusedStep:
         for x in loss_leaves:
             s = jnp.sum(x).astype(jnp.float32)
             total = s if total is None else total + s
+        # MoE load-balancing auxiliary losses (weighted by each block)
+        # fold into the differentiated total but NOT the reported
+        # per-sample loss leaves
+        for a in moe_aux:
+            total = total + jnp.sum(a).astype(jnp.float32)
         new_aux = tuple(sub[p]._data for p in aps)
         return total, (loss_leaves, new_aux, mouts)
 
@@ -454,7 +493,10 @@ class FusedStep:
         sds = jtu.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
             if hasattr(a, 'shape') else a, args)
-        jaxpr = jax.make_jaxpr(step_fn)(*sds)
+        # mesh-aware layers (gluon.nn.MoE) read the active mesh during
+        # tracing to place their sharding constraints
+        with pmesh.use_mesh(self._mesh):
+            jaxpr = jax.make_jaxpr(step_fn)(*sds)
         # the pretty-printer leaks object identities into some eqn
         # params (custom_jvp thunks print as '<function ... at 0x...>');
         # scrub addresses so equal programs fingerprint equally
@@ -467,8 +509,10 @@ class FusedStep:
             fn = exec_cache.get(key, count=True)
             if fn is not None:
                 return fn
-        lowered = jax.jit(step_fn,
-                          donate_argnums=(0, 1, 2, 3, 4, 5)).lower(*args)
+        with pmesh.use_mesh(self._mesh):
+            lowered = jax.jit(step_fn,
+                              donate_argnums=(0, 1, 2, 3, 4, 5)
+                              ).lower(*args)
         fn = exec_cache.timed_compile(lowered)
         if exec_cache.enabled():
             exec_cache.put(key, fn)
@@ -622,6 +666,14 @@ class FusedStep:
                  self._full_step_key(fkey))
         auxs = [self._gather_param(p) for p in self._aux_params]
         frozen = [self._gather_param(p) for p in self._frozen_params]
+        # MoE routing counters: snapshot the cumulative aux counts
+        # BEFORE the dispatch donates them (profiler-on dispatches are
+        # synchronized anyway — see dt_ms below)
+        moe_idx = [(i, p._moe_counter)
+                   for i, p in enumerate(self._aux_params)
+                   if getattr(p, '_moe_counter', None)]
+        moe_pre = {i: np.asarray(auxs[i]) for i, _ in moe_idx} \
+            if moe_idx and profiler.is_running() else None
         prog = self._programs.get(local)
         if prog is None:
             prog = self._get_program(
@@ -647,6 +699,8 @@ class FusedStep:
             self._writeback_param(p, w)
         for p, a in zip(self._aux_params, new_aux):
             self._writeback_param(p, a)
+        if moe_pre is not None:
+            self._note_moe_counters(moe_idx, moe_pre, new_aux)
         fu.commit(new_moms, new_masters)
         if self._ema_decay is not None:
             self._ema_state = list(new_emas)
@@ -689,6 +743,19 @@ class FusedStep:
             buckets, self._interleave, k, dt_ms=dt_ms,
             metric_steps=k if self._metric_fold is not None else 0)
 
+    @staticmethod
+    def _note_moe_counters(moe_idx, pre, new_aux):
+        """Feed the profiler's moe_* counters from the per-dispatch
+        deltas of the MoE blocks' cumulative routed/dropped aux counts
+        (per-expert tables sum across blocks by expert index)."""
+        totals = {'routed': 0.0, 'dropped': 0.0}
+        for i, kind in moe_idx:
+            delta = np.asarray(new_aux[i]) - pre[i]
+            totals[kind] += float(delta.sum())
+            profiler.add_moe_stats(**{'per_expert_%s' % kind: delta})
+        profiler.add_moe_stats(routed=totals['routed'],
+                               dropped=totals['dropped'], dispatches=1)
+
     def ema(self):
         """Snapshot of the weight-EMA arm as {parameter name:
         NDArray}, aligned with the trainable parameters.  Before the
@@ -705,3 +772,503 @@ class FusedStep:
         ctx = self._ctxs[0]
         return {p.name: nd.NDArray(v, ctx)
                 for p, v in zip(self._params, vals)}
+
+
+# ---------------------------------------------------------------------------
+# dp×pipe pipelined mode
+# ---------------------------------------------------------------------------
+
+def _child_struct_sig(block):
+    """Structural identity of one child block for stage partitioning:
+    class name, its parameters' (relative name, shape, dtype, grad_req)
+    in traversal order, and the child subtree's signatures.  Two
+    children with equal signatures are stacking-compatible stage
+    material (the traced-jaxpr equality check at program build time is
+    the definitive functional test — this one only decides the
+    partition)."""
+    plist = sorted(block._collect_params_with_prefix().items())
+    psig = tuple((name, tuple(p.shape) if p.shape else None,
+                  str(np.dtype(p.dtype)) if p.dtype else None,
+                  p.grad_req) for name, p in plist)
+    return (type(block).__name__, psig)
+
+
+def _partition_pipeline_children(net, num_stages):
+    """Partition a Sequential-style net's children into
+    (stem_children, [stage_children...], head_children): the longest
+    run of consecutive structurally identical children forms the stage
+    body (run length must divide by num_stages); the prefix before it
+    is the stem (applied by stage 0), the suffix after it the head
+    (applied with the loss by the last stage)."""
+    children = list(getattr(net, '_children', ()))
+    if len(children) < num_stages:
+        raise ValueError(
+            'fuse_step(pipeline=(%d, ...)): net has %d children; the '
+            'pipelined mode partitions a Sequential of repeated '
+            'blocks — need at least one block per stage'
+            % (num_stages, len(children)))
+    sigs = [_child_struct_sig(c) for c in children]
+    best_start, best_len = 0, 1
+    start = 0
+    for i in range(1, len(sigs) + 1):
+        if i == len(sigs) or sigs[i] != sigs[start]:
+            if i - start > best_len:
+                best_start, best_len = start, i - start
+            start = i
+    if best_len % num_stages:
+        raise ValueError(
+            'fuse_step(pipeline): the longest run of identical '
+            'children has length %d, not divisible into %d stages — '
+            'stack a multiple of %d identical blocks'
+            % (best_len, num_stages, num_stages))
+    per = best_len // num_stages
+    stages = [children[best_start + s * per:best_start + (s + 1) * per]
+              for s in range(num_stages)]
+    return (children[:best_start], stages,
+            children[best_start + best_len:])
+
+
+def _ordered_child_params(children):
+    """The parameters of a run of children in structural order
+    (per-child relative-name order — aligned across identically
+    structured stages regardless of auto-prefix counters)."""
+    out = []
+    for c in children:
+        out.extend(p for _, p in
+                   sorted(c._collect_params_with_prefix().items()))
+    return out
+
+
+class PipelinedStep(FusedStep):
+    """GPipe dp×pipe training as ONE donated XLA dispatch (the
+    pipeline=(num_stages, num_micro) mode of fuse_step).
+
+    The net's children partition into an optional stem, `num_stages`
+    architecturally identical stages, and an optional head (see
+    _partition_pipeline_children).  Stage parameters stack on a
+    leading stage dim sharded over the 'pipe' axis of a 2D
+    {'data': dp, 'pipe': S} mesh — each device holds ONLY its stage's
+    weights (1/S of the stage-body parameters) — while stem/head
+    parameters replicate.  Every training step runs the fill-drain
+    microbatch schedule (parallel/pipeline.make_pipe_step_fn) with the
+    batch sharded over dp, gradients psum'd over dp (or
+    psum_scatter'd under ZeRO-1, which also shards the momentum
+    buckets over dp: per-device optimizer state ~1/(dp·S) of the
+    single-device replicated baseline), and the SGD/NAG update fused
+    into the same program.  `bulk` scans K steps on-device exactly
+    like FusedStep.bulk.  Programs resolve through the process-wide
+    exec_cache keyed on the abstract-jaxpr fingerprint + mesh
+    fingerprint + stage/bucket layout, so an equivalent re-created
+    net/Trainer performs ZERO new XLA compilations."""
+
+    def __init__(self, net, loss, trainer, pipeline, zero=None):
+        from ..parallel import pipeline as pipe_mod
+        self._pipe_mod = pipe_mod
+        spec = pipe_mod.pipe_spec(pipeline)
+        self._pipe_s, self._pipe_m = spec
+        if loss is None:
+            raise ValueError(
+                'fuse_step(pipeline): loss=None nets are not '
+                'supported — the pipelined head needs an explicit '
+                'loss on the last stage')
+        ctxs = list(trainer._contexts)
+        if len(ctxs) < self._pipe_s or len(ctxs) % self._pipe_s:
+            raise ValueError(
+                'fuse_step(pipeline=(%d, %d)): %d trainer contexts do '
+                'not divide into %d pipeline stages'
+                % (self._pipe_s, self._pipe_m, len(ctxs), self._pipe_s))
+        devices = [c.jax_device() for c in ctxs]
+        if len(set(devices)) != len(devices):
+            raise ValueError('duplicate devices in the trainer '
+                             'contexts: %s' % (ctxs,))
+        mesh = pipe_mod.make_pipe_mesh(devices, self._pipe_s)
+        super().__init__(net, loss, trainer, mesh=mesh, zero=zero)
+        if bool(getattr(trainer._optimizer, 'multi_precision', False)):
+            raise ValueError(
+                'fuse_step(pipeline): multi_precision is not composed '
+                'with the pipelined update yet')
+        self._dp = int(mesh.shape['data'])
+        self._partitioned = False
+        self._stage_children = None
+        self._stem_children = None
+        self._head_children = None
+        self._stage_groups = None    # leaf j -> [param_s0, ..., param_S-1]
+        self._stem_params2 = None
+        self._head_params2 = None
+        self._group_tr_idx = None    # leaf j -> trainer indices
+        self._stage_state = {}       # leaf j -> (stacked, slot datas)
+        self._pipe_opt = None
+        self._pipe_layout = None
+        self._baked_rescale = None
+        self._homog_checked = False
+
+    # -- partitioning ------------------------------------------------------
+    def _partition(self):
+        if self._partitioned:
+            return
+        stem, stages, head = _partition_pipeline_children(
+            self._net, self._pipe_s)
+        stage_plists = [_ordered_child_params(cs) for cs in stages]
+        n_leaf = len(stage_plists[0])
+        for s, pl in enumerate(stage_plists):
+            if len(pl) != n_leaf:
+                raise ValueError('pipeline stage %d has %d parameters, '
+                                 'stage 0 has %d' % (s, len(pl), n_leaf))
+        groups = []
+        for j in range(n_leaf):
+            group = [stage_plists[s][j] for s in range(self._pipe_s)]
+            shapes = {tuple(p.shape) for p in group}
+            dts = {str(np.dtype(p.dtype)) for p in group}
+            if len(shapes) != 1 or len(dts) != 1:
+                raise ValueError(
+                    'pipeline stages are not stacking-compatible: '
+                    'leaf %d has shapes %s dtypes %s'
+                    % (j, sorted(shapes), sorted(dts)))
+            groups.append(group)
+        stem_params = _ordered_child_params(stem)
+        head_params = _ordered_child_params(head)
+        allp = ([p for g in groups for p in g] + stem_params +
+                head_params)
+        if any(p.grad_req == 'null' for p in allp):
+            raise ValueError(
+                'fuse_step(pipeline): grad_req=null (aux) parameters '
+                '(BatchNorm running stats, MoE counters) are not '
+                'composed with the pipelined schedule yet')
+        if hasattr(self._loss, 'collect_params') and \
+                list(self._loss.collect_params().items()):
+            raise ValueError('fuse_step(pipeline): losses with their '
+                             'own parameters are not supported')
+        trainable = {id(p) for p in self._trainer._params}
+        missing = [p.name for p in allp if id(p) not in trainable]
+        extra = len(self._trainer._params) != len(allp)
+        if missing or extra:
+            raise ValueError(
+                'fuse_step(pipeline): the trainer must own exactly '
+                "the net's parameters (missing from trainer: %s; "
+                'trainer has %d params, net has %d)'
+                % (missing, len(self._trainer._params), len(allp)))
+        tr_idx = {id(p): i for i, p in
+                  enumerate(self._trainer._params)}
+        self._stem_children, self._stage_children, \
+            self._head_children = stem, stages, head
+        self._stage_groups = groups
+        self._stem_params2 = stem_params
+        self._head_params2 = head_params
+        self._group_tr_idx = (
+            [[tr_idx[id(p)] for p in g] for g in groups] +
+            [[tr_idx[id(p)]] for p in stem_params] +
+            [[tr_idx[id(p)]] for p in head_params])
+        self._partitioned = True
+
+    # -- traced stage/stem/head bodies -------------------------------------
+    def _seq_forward(self, children, params, values, x_data, rng):
+        """Apply a run of children sequentially as a pure function of
+        (param values, input) — the param_trace substitution the
+        whole-step trace rides on."""
+        sub = {p: nd.NDArray(v) for p, v in zip(params, values)}
+        with block_mod.param_trace(sub, rng, train_mode=True):
+            x = nd.NDArray(x_data)
+            for c in children:
+                x = c(x)
+        return x._data
+
+    def _make_fns(self):
+        stage0 = self._stage_children[0]
+        stage0_params = _ordered_child_params(stage0)
+        stem_children = self._stem_children
+        stem_params = self._stem_params2
+        head_children = self._head_children
+        head_params = self._head_params2
+        loss = self._loss
+        seq = self._seq_forward
+        outer = self
+
+        def stem_fn(ws, mb, rng):
+            if not stem_children:
+                return mb
+            return seq(stem_children, stem_params, ws, mb, rng)
+
+        def stage_fn(ws, act, rng):
+            return seq(stage0, stage0_params, ws, act, rng)
+
+        def head_fn(ws, acts, label, rng):
+            sub = {p: nd.NDArray(v) for p, v in zip(head_params, ws)}
+            with block_mod.param_trace(sub, rng, train_mode=True):
+                out = nd.NDArray(acts)
+                for c in head_children:
+                    out = c(out)
+                l = loss(out, nd.NDArray(label))
+            leaves, treedef = jtu.tree_flatten(
+                l, is_leaf=lambda a: isinstance(a, nd.NDArray))
+            outer._loss_treedef = treedef
+            leaves = tuple(x._data for x in leaves)
+            total = None
+            for x in leaves:
+                s = jnp.sum(x).astype(jnp.float32)
+                total = s if total is None else total + s
+            return leaves, total
+
+        return stem_fn, stage_fn, head_fn
+
+    def _check_stage_homogeneity(self, act_sds, rng_sds):
+        """Traced-jaxpr stage equality (the partition's structural
+        equality is necessary, not sufficient) — one shared check,
+        parallel/pipeline.check_stage_homogeneity."""
+        if self._homog_checked:
+            return
+
+        def trace(children):
+            params = _ordered_child_params(children)
+            sds = [jax.ShapeDtypeStruct(tuple(p.shape),
+                                        np.dtype(p.dtype))
+                   for p in params]
+
+            def fn(ws, x, k, _c=children, _p=params):
+                return self._seq_forward(_c, _p, ws, x, k)
+
+            return (fn, sds, act_sds, rng_sds)
+
+        self._pipe_mod.check_stage_homogeneity(
+            [trace(c) for c in self._stage_children],
+            lambda s: ValueError(
+                'fuse_step(pipeline): stage %d traces a different '
+                'computation than stage 0 — pipeline stages must '
+                'be architecturally identical (same layer types, '
+                'activations and shapes)' % s))
+        self._homog_checked = True
+
+    # -- placement ---------------------------------------------------------
+    def _gather_stage_leaf(self, j):
+        """The stacked (S, ...) device value of stage-leaf group j —
+        re-stacked from the per-parameter slots when any member was
+        replaced by user code (set_data / load_params), else the
+        cached donated output of the last step."""
+        from ..parallel import mesh as pmesh
+        group = self._stage_groups[j]
+        slots = tuple(p.list_data()[0]._data for p in group)
+        ent = self._stage_state.get(j)
+        # identity against LIVE row references (not id()s of possibly
+        # freed arrays — address reuse could spuriously match and
+        # silently ignore a user's load_params/set_data)
+        if ent is not None and len(ent[1]) == len(slots) and \
+                all(a is b for a, b in zip(ent[1], slots)):
+            return ent[0]
+        stacked = jax.device_put(
+            jnp.stack([jnp.asarray(s) for s in slots]),
+            jax.sharding.NamedSharding(self._mesh,
+                                       jax.sharding.PartitionSpec('pipe')))
+        self._writeback_stage_leaf(j, stacked)
+        return stacked
+
+    def _writeback_stage_leaf(self, j, stacked):
+        """Hand every stage parameter its row VIEW of the stacked
+        leaf; the row identity doubles as the staleness check."""
+        rows = [stacked[s] for s in range(self._pipe_s)]
+        for p, row in zip(self._stage_groups[j], rows):
+            p._rebind_all_ctx(row)
+        self._stage_state[j] = (stacked, tuple(rows))
+
+    def _pipe_schedules(self, k, n_leaf):
+        """(k, n_leaf) float32 lr/wd schedule rows in leaf order
+        [stage-groups..., stem..., head...] — one shared builder,
+        parallel/pipeline.grouped_schedule_rows."""
+        return self._pipe_mod.grouped_schedule_rows(
+            self._trainer._optimizer, len(self._trainer._params),
+            self._group_tr_idx, k,
+            lambda lrs, wds: ValueError(
+                'fuse_step(pipeline): stage parameters of one '
+                'stacked group have diverging lr/wd (%s / %s) '
+                '— per-stage lr_mult does not compose with '
+                'stacked stages' % (lrs, wds)))
+
+    def _pipe_hyper(self, batch_size):
+        tr = self._trainer
+        opt = tr._optimizer
+        rescale = float(tr._scale / batch_size)
+        opt.rescale_grad = rescale
+        clip = opt.clip_gradient
+        return {'momentum': float(opt.momentum),
+                'rescale': rescale,
+                'clip': None if clip is None else float(clip),
+                'nesterov': isinstance(opt, opt_mod.NAG)}
+
+    def _pipe_state_accounting(self):
+        """(param_bytes, opt_state_bytes) resident PER DEVICE — one
+        shared model, parallel/pipeline.pipe_residency."""
+        leaves = ([g[0] for g in self._stage_groups] +
+                  self._stem_params2 + self._head_params2)
+        return self._pipe_mod.pipe_residency(
+            [tuple(p.shape) for p in leaves],
+            [np.dtype(p.dtype) for p in leaves], self._pipe_layout)
+
+    # -- execution ---------------------------------------------------------
+    def _run(self, args, bulk, batch_size):
+        if len(args) != 2:
+            raise ValueError(
+                'pipelined fused step takes exactly (data, label); '
+                'got %d argument(s)' % len(args))
+        arrays = tuple(a._data if isinstance(a, nd.NDArray)
+                       else jnp.asarray(a) for a in args)
+        k = int(arrays[0].shape[0]) if bulk else 1
+        if bulk and k == 0:
+            raise ValueError('bulk: stacked inputs have K=0 steps')
+        if batch_size is None:
+            batch_size = int(arrays[0].shape[1 if bulk else 0])
+        B = int(arrays[0].shape[1 if bulk else 0])
+        S, M, dp = self._pipe_s, self._pipe_m, self._dp
+        if B % (dp * M):
+            raise ValueError(
+                'fuse_step(pipeline=(%d, %d)): batch %d must divide '
+                'by dp*num_micro = %d' % (S, M, B, dp * M))
+        self._collect_params()
+        self._finish_deferred(arrays, bulk)
+        self._partition()
+        from ..parallel import mesh as pmesh
+        if not self._placed:
+            self._rng = jax.device_put(_random.next_key(),
+                                       pmesh.replicated(self._mesh))
+            self._placed = True
+        hyper = self._pipe_hyper(batch_size)
+        stage_ws = [self._gather_stage_leaf(j)
+                    for j in range(len(self._stage_groups))]
+        stem_ws = [self._gather_param(p) for p in self._stem_params2]
+        head_ws = [self._gather_param(p) for p in self._head_params2]
+        local_shapes = ([tuple(w.shape[1:]) for w in stage_ws] +
+                        [tuple(w.shape) for w in stem_ws + head_ws])
+        local_dts = [np.dtype(w.dtype) for w in
+                     stage_ws + stem_ws + head_ws]
+        if self._zero and self._pipe_layout is None:
+            self._pipe_layout = zero_mod.ZeroBucketLayout(
+                local_shapes, local_dts, [False] * len(local_dts), dp)
+        self._ensure_pipe_opt(stage_ws, stem_ws, head_ws)
+        n_leaf = len(local_shapes)
+        lr_rows, wd_rows = self._pipe_schedules(k, n_leaf)
+        repl = pmesh.replicated(self._mesh)
+        if bulk:
+            lrs = jax.device_put(jnp.asarray(lr_rows), repl)
+            wds = jax.device_put(jnp.asarray(wd_rows), repl)
+        else:
+            lrs = [float(v) for v in lr_rows[0]]
+            wds = [float(v) for v in wd_rows[0]]
+        arrays = tuple(pmesh.shard_batch(self._mesh, a,
+                                         dim=1 if bulk else 0)
+                       for a in arrays)
+        shapes = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        local = ('pipe', 'bulk' if bulk else 'step', k, shapes,
+                 self._pipe_step_key(hyper))
+        prog = self._programs.get(local)
+        if prog is None:
+            prog = self._get_pipe_program(
+                hyper, bulk, k,
+                (stage_ws, stem_ws, head_ws, self._pipe_opt,
+                 self._rng, arrays[0], arrays[1], lrs, wds))
+            self._programs[local] = prog
+        t0 = time.perf_counter()
+        synced = profiler.is_running()
+        with profiler.scope('gluon_pipe_%s' % ('bulk' if bulk
+                                               else 'step'),
+                            'gluon_fused'):
+            (loss_out, new_stage, new_stem, new_head, self._pipe_opt,
+             self._rng) = prog(stage_ws, stem_ws, head_ws,
+                               self._pipe_opt, self._rng, arrays[0],
+                               arrays[1], lrs, wds)
+            if synced:
+                jax.block_until_ready(loss_out)
+        dt_ms = (time.perf_counter() - t0) * 1e3 if synced else 0.0
+        for j, stacked in enumerate(new_stage):
+            self._writeback_stage_leaf(j, stacked)
+        for p, w in zip(self._stem_params2, new_stem):
+            self._writeback_param(p, w)
+        for p, w in zip(self._head_params2, new_head):
+            self._writeback_param(p, w)
+        self._trainer._last_update_mode = 'fused'
+        self._note_pipe_counters(k, dt_ms)
+        ctx = self._ctxs[0]
+        out = [nd.NDArray(v, ctx) for v in loss_out]
+        return jtu.tree_unflatten(self._loss_treedef, out)
+
+    def _ensure_pipe_opt(self, stage_ws, stem_ws, head_ws):
+        if self._pipe_opt is not None:
+            return
+        self._pipe_opt = self._pipe_mod.init_pipe_opt_state(
+            self._mesh, self._pipe_layout, self._pipe_s, stage_ws,
+            stem_ws, head_ws)
+
+    def _pipe_step_key(self, hyper):
+        return ('pipe', self._pipe_s, self._pipe_m, self._zero,
+                self._pipe_layout.key if self._pipe_layout is not None
+                else None,
+                tuple(sorted(hyper.items(),
+                             key=lambda kv: kv[0])))
+
+    def _placement_fp(self):
+        from ..parallel import mesh as pmesh
+        return ('pipemesh', self._pipe_s,
+                ) + pmesh.mesh_fingerprint(self._mesh)
+
+    def _get_pipe_program(self, hyper, bulk, k, pargs):
+        """Resolve the compiled pipelined step through the process-wide
+        exec_cache (one shared discipline,
+        parallel/pipeline.resolve_pipe_program)."""
+        stem_fn, stage_fn, head_fn = self._make_fns()
+        data = pargs[5]
+        b_local = data.shape[1 if bulk else 0] // self._dp
+        mb_sds = jax.ShapeDtypeStruct(
+            (b_local // self._pipe_m,) + tuple(
+                data.shape[2 if bulk else 1:]),
+            np.dtype(data.dtype))
+        key_sds = jax.ShapeDtypeStruct(self._rng.shape,
+                                       self._rng.dtype)
+        if self._stem_children:
+            stem_sds = [jax.ShapeDtypeStruct(tuple(p.shape),
+                                             np.dtype(p.dtype))
+                        for p in self._stem_params2]
+            act_sds = jax.eval_shape(stem_fn, stem_sds, mb_sds,
+                                     key_sds)
+        else:
+            act_sds = mb_sds
+        self._check_stage_homogeneity(act_sds, key_sds)
+        step_fn = self._pipe_mod.make_pipe_step_fn(
+            self._mesh, self._pipe_s, self._pipe_m, stem_fn, stage_fn,
+            head_fn, hyper, layout=self._pipe_layout, bulk=bulk)
+        return self._pipe_mod.resolve_pipe_program(
+            step_fn, pargs, self._pipe_step_key(hyper),
+            'pipe_bulk' if bulk else 'pipe_step', k,
+            self._placement_fp())
+
+    def _note_pipe_counters(self, k, dt_ms):
+        param_b, state_b = self._pipe_state_accounting()
+        profiler.add_gluon_fused_stats(steps=k, dispatches=1)
+        self._pipe_mod.note_pipe_counters(
+            self._pipe_s, self._pipe_m, k, self._pipe_layout, self._dp,
+            param_b, state_b)
+
+    def sync_params(self):
+        """Materialize the trained weights as ordinary per-context
+        arrays for imperative use (eval/predict/save outside the
+        fused step).  During pipelined training each stage's weights
+        live ONLY on their pipe row of the mesh — that is the memory
+        win — so the per-step writeback hands the parameters row
+        VIEWS of the stacked mesh arrays: `.asnumpy()` reads are
+        always current, but eager forward math mixing them with a
+        single-device input raises jax's incompatible-devices error.
+        This performs ONE host round-trip per stage leaf and rewrites
+        every context copy (Parameter.set_data); the next fused step
+        re-places the rows through the same staleness path user
+        set_data takes (one re-stack, ZERO recompiles).  Stem/head
+        copies are per-device views of replicated parents and are
+        already eager-usable."""
+        self._collect_params()
+        if not self._partitioned:
+            return
+        for j, group in enumerate(self._stage_groups):
+            ent = self._stage_state.pop(j, None)
+            if ent is None:
+                continue
+            rows = np.asarray(ent[0])
+            for s, p in enumerate(group):
+                p.set_data(nd.array(rows[s]))
+
+    # pipelined mode does not carry an EMA arm
+    def ema(self):
+        raise ValueError('fuse_step(pipeline) has no EMA arm')

@@ -8,6 +8,7 @@ a hybridized network compiles to a single fused XLA module.
 from .basic_layers import (Sequential, HybridSequential, Dense, Activation,
                            Dropout, BatchNorm, LeakyReLU, Embedding, Flatten,
                            Lambda, HybridLambda)
+from .moe import MoE
 from .conv_layers import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,
                           Conv2DTranspose, Conv3DTranspose,
                           MaxPool1D, MaxPool2D, MaxPool3D,

@@ -1,0 +1,164 @@
+"""Expert-parallel mixture-of-experts layer for the fused Gluon path.
+
+`MoE` is the user-facing face of parallel/moe.py's switch-routing data
+path (SURVEY §2.4 lists expert parallelism as absent from the
+reference — §7-step-9 new-design extension): tokens are top-1 routed
+(Switch Transformer style) to `num_experts` two-matmul FFN experts
+with a static per-expert capacity (capacity_factor · T / E — static so
+the XLA program never depends on the routing), overflow tokens pass
+through the residual connection, and a load-balancing auxiliary loss
+rides a trace-scoped side channel into the fused step's total.
+
+Expert parallelism composes with the fused step's GSPMD design instead
+of shard_map: the dispatched token tensor (E, C, D) carries a
+`collectives.expert_shard` sharding constraint over the dp axis of the
+active mesh, so XLA's partitioner places each device's expert slice
+locally and inserts the token all_to_alls itself — the Switch-style
+"expert axis aliases the data axis" layout (weights stay replicated;
+ZeRO-1 shards their optimizer state like every other parameter's).
+
+Observability: every MoE holds `routed_count` / `dropped_count`
+aux parameters ((E,) float32 cumulative token counts, grad_req='null'
+— threaded through the fused dispatch exactly like BatchNorm moving
+stats), and the fused step feeds their per-dispatch deltas to the
+profiler's moe_* counter family (summary(), dump_profile) — capacity
+overflow is otherwise silent.
+
+Training the block imperatively with autograd.record is NOT supported
+(the routing math is raw jnp, invisible to the tape); train through
+`gluon.fuse_step`, which traces it into the whole-step program.
+"""
+from contextlib import contextmanager
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ... import autograd
+from ... import ndarray as nd
+from ...parallel import collectives
+from ...parallel.moe import capacity_for, switch_route
+from ..block import HybridBlock
+
+# trace-scoped collector for the load-balancing auxiliary losses: the
+# fused step (gluon/fused.py _forward_loss) opens a scope around the
+# net's forward and folds the collected scalars into the loss total
+_AUX_STACK = []
+
+
+@contextmanager
+def aux_loss_scope(collector):
+    """Collect every MoE auxiliary loss noted while the scope is
+    active into `collector` (a list)."""
+    _AUX_STACK.append(collector)
+    try:
+        yield collector
+    finally:
+        _AUX_STACK.pop()
+
+
+def _note_aux_loss(value):
+    if _AUX_STACK:
+        _AUX_STACK[-1].append(value)
+
+
+class MoE(HybridBlock):
+    """Switch-routed mixture-of-experts FFN with residual.
+
+    units: token feature dim (input == output — the residual needs it).
+    hidden: per-expert FFN hidden dim.
+    num_experts: total expert count.
+    capacity_factor: static per-expert capacity = ceil(cf * T / E)
+    tokens per forward; overflow tokens are dropped from the expert
+    path and pass through the residual (standard switch behavior).
+    aux_loss_weight: weight of the Switch load-balancing auxiliary
+    loss folded into the fused step's total (0 disables).
+
+    Input (B, units) or (B, T, units); output the same shape
+    (x + expert_ffn(x), gate-weighted)."""
+
+    def __init__(self, units, hidden, num_experts, capacity_factor=1.0,
+                 aux_loss_weight=0.01, weight_initializer=None,
+                 **kwargs):
+        super(MoE, self).__init__(**kwargs)
+        self._units = int(units)
+        self._hidden = int(hidden)
+        self._num_experts = int(num_experts)
+        self._capacity_factor = float(capacity_factor)
+        self._aux_loss_weight = float(aux_loss_weight)
+        with self.name_scope():
+            # names end in 'weight' so the initializer name-pattern
+            # dispatch (initializer.Initializer.__call__) treats them
+            # as weights without an explicit init
+            self.router = self.params.get(
+                'router_weight', shape=(units, num_experts),
+                init=weight_initializer)
+            self.expert_w1 = self.params.get(
+                'expert1_weight', shape=(num_experts, units, hidden),
+                init=weight_initializer)
+            self.expert_w2 = self.params.get(
+                'expert2_weight', shape=(num_experts, hidden, units),
+                init=weight_initializer)
+            self.routed_count = self.params.get(
+                'routed_count', shape=(num_experts,), grad_req='null',
+                init='zeros', differentiable=False)
+            self.dropped_count = self.params.get(
+                'dropped_count', shape=(num_experts,), grad_req='null',
+                init='zeros', differentiable=False)
+        # the fused step identifies these aux params to feed the
+        # profiler's moe_* counters from their per-dispatch deltas
+        self.routed_count._moe_counter = 'routed'
+        self.dropped_count._moe_counter = 'dropped'
+
+    def forward(self, x):
+        if not isinstance(x, nd.NDArray):
+            raise ValueError('MoE forward input must be NDArray, '
+                             'got %s' % type(x))
+        ctx = x.context
+        router = self.router.data(ctx)
+        w1 = self.expert_w1.data(ctx)
+        w2 = self.expert_w2.data(ctx)
+        # expert weights stay REPLICATED (only their optimizer state
+        # shards, under ZeRO): pin them — and via the constraint's
+        # transpose their gradients — so the expert-sharded dispatch
+        # layout below cannot propagate into the donated weight
+        # outputs and invalidate the compiled program's input
+        # shardings on the next dispatch
+        w1d = collectives.replicate_constraint(w1._data)
+        w2d = collectives.replicate_constraint(w2._data)
+        xd = x._data
+        if xd.shape[-1] != self._units:
+            raise ValueError('MoE(units=%d) got input feature dim %d'
+                             % (self._units, xd.shape[-1]))
+        tok = xd.reshape(-1, self._units)
+        E = self._num_experts
+        C = capacity_for(tok.shape[0], E, self._capacity_factor)
+        disp, combine, aux, (routed, dropped) = switch_route(
+            tok, router._data, E, C, with_counts=True)
+        # expert-parallel placement: each device computes its expert
+        # slice of the dispatched buckets (identity off-mesh)
+        disp = collectives.expert_shard(disp)
+        h = jnp.einsum('ecd,edh->ech', disp, w1d)
+        h = jax.nn.relu(h)
+        y = jnp.einsum('ech,ehd->ecd', h, w2d)
+        y = collectives.expert_shard(y)
+        out = jnp.einsum('tec,ecd->td', combine, y)
+        out = (tok + out).reshape(xd.shape)
+        if autograd.is_training():
+            # cumulative device-resident counts, threaded through the
+            # step like BatchNorm stats (the substituted NDArray's
+            # _data IS the traced aux output)
+            rc = self.routed_count.data(ctx)
+            rc._data = rc._data + routed.astype(rc._data.dtype)
+            dc = self.dropped_count.data(ctx)
+            dc._data = dc._data + dropped.astype(dc._data.dtype)
+            if self._aux_loss_weight:
+                _note_aux_loss(aux * self._aux_loss_weight)
+        return nd.NDArray(out, ctx)
+
+    def __repr__(self):
+        return ('MoE(units=%d, hidden=%d, experts=%d, '
+                'capacity_factor=%g)'
+                % (self._units, self._hidden, self._num_experts,
+                   self._capacity_factor))

@@ -108,7 +108,14 @@ class Parameter(object):
             data = nd.zeros(self.shape, dtype=self.dtype, ctx=cpu())
             initr = initializer if initializer is not None \
                 else (self.init if self.init is not None else default_init)
-            init.create(initr)(init.InitDesc(self.name), data)
+            # a parameter-specific init bypasses the name-pattern
+            # dispatch via the InitDesc __init__ attr (reference
+            # semantics: explicit init wins regardless of the name —
+            # aux params like MoE's routed_count have no pattern)
+            attrs = {}
+            if initializer is not None or self.init is not None:
+                attrs['__init__'] = init.create(initr).dumps()
+            init.create(initr)(init.InitDesc(self.name, attrs), data)
             self._data = {c: data.copyto(c) for c in ctx_list}
         if self._grad_req != 'null':
             self._init_grad()

@@ -150,8 +150,21 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, bulk=None, checkpoint=None):
+            monitor=None, bulk=None, checkpoint=None, pipeline=None):
         """The training loop (reference base_module.py:376).
+
+        pipeline: optional (num_stages, num_micro) — or None to defer
+        to MXNET_TPU_PIPE='stages,micro' — switches to the dp×pipe
+        2D-mesh GPipe training mode (module/pipeline_fit.py): the
+        symbol's layer chain partitions into `num_stages`
+        architecturally identical stages, each stage's parameters live
+        only on its pipe row of the mesh, and every step runs the
+        fill-drain microbatch schedule inside one donated XLA dispatch
+        — composing with ZeRO-1 optimizer-state sharding over the dp
+        axis (MXNET_TPU_ZERO=1) and with bulk=K (K steps per dispatch
+        through the same lax.scan).  Requires a Module over a
+        chain-style symbol and contexts divisible by num_stages;
+        monitor/checkpoint do not compose with the pipelined mode.
 
         bulk: optional K > 1 — run the epoch in K-step fused
         dispatches (Module.bulk_step) with the metric accumulating
@@ -185,6 +198,20 @@ class BaseModule:
         validation_metric = validation_metric or eval_metric
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
+        from ..parallel import pipeline as pipe_mod
+        pipe_spec = pipe_mod.pipe_spec(pipeline)
+        if pipe_spec is not None:
+            for bad, name in ((monitor, 'monitor'),
+                              (checkpoint, 'checkpoint')):
+                if bad is not None:
+                    raise ValueError(
+                        'fit(pipeline=%r): %s= does not compose with '
+                        'the pipelined mode yet' % (pipe_spec, name))
+            return self._fit_pipeline(
+                train_data, pipe_spec, eval_data, eval_metric,
+                validation_metric, epoch_end_callback,
+                batch_end_callback, eval_end_callback,
+                eval_batch_end_callback, begin_epoch, num_epoch, bulk)
         use_bulk = bulk is not None and int(bulk) > 1 and \
             hasattr(self, 'bulk_step') and monitor is None
         if use_bulk and metric_mod.device_fold(eval_metric) is None:
@@ -356,6 +383,19 @@ class BaseModule:
         if checkpoint is not None:
             checkpoint.wait()   # drain pending async commits
 
+    def _fit_pipeline(self, train_data, spec, eval_data, eval_metric,
+                      validation_metric, epoch_end_callback,
+                      batch_end_callback, eval_end_callback,
+                      eval_batch_end_callback, begin_epoch, num_epoch,
+                      bulk):
+        """The dp×pipe GPipe training loop (fit(pipeline=...)).
+        Module implements it (module/pipeline_fit.py); other module
+        types do not partition into pipeline stages."""
+        raise NotImplementedError(
+            'fit(pipeline=...) is only supported on Module '
+            '(%s does not partition into pipeline stages)'
+            % type(self).__name__)
+
     @staticmethod
     def _peer_death_preempt(checkpoint, step_cb, nbatch, epoch):
         """Convert a cross-host step failure caused by a
@@ -378,54 +418,80 @@ class BaseModule:
     def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
                         batch_end_callback, epoch, step_cb=None,
                         nbatch0=0, checkpoint=None):
-        """One fit epoch in K-step fused dispatches: consecutive
-        batches group into bulk_step calls (device-side lax.scan,
-        device-resident metric accumulation, per-step lr schedules);
-        the trailing partial group runs as a smaller dispatch.
+        """One fit epoch in K-step fused dispatches — ONE loop for
+        Module AND BucketingModule (the PR-9 `checkpoint=` kwarg had
+        to be patched into two near-identical copies; new kwargs now
+        land here once).  Subclasses customize through two hooks:
+        `_bulk_group_key(batch)` — consecutive batches group only
+        while the key is stable (the bucket ladder returns the rung;
+        the default None never splits) — and
+        `_bulk_dispatch_group(group, bulk, eval_metric)` — how a
+        flushed group executes (bulk_step vs the per-step fallback).
+
         Callbacks fire once per dispatch with nbatch at the group's
         last batch — the values a per-batch loop would show there.
         step_cb(nbatch_done, steps, epoch): elastic checkpoint hook,
         fired once per dispatch.  nbatch0: batch counter start (the
-        resumed epoch's consumed-batch watermark)."""
-        nbatch = int(nbatch0)
-        it = iter(train_data)
+        resumed epoch's consumed-batch watermark).  checkpoint:
+        elastic manager — a dispatch failing on a heartbeat-detected
+        peer death converts to a coordinated preemption
+        (_peer_death_preempt); nbatch counts only COMPLETED
+        dispatches, the consistent state the final checkpoint must
+        record."""
+        state = {'nbatch': int(nbatch0)}
         group = []
-        while True:
-            data_batch = next(it, None)
-            if data_batch is not None:
-                group.append(data_batch)
-                if len(group) < bulk:
-                    continue
+        group_key = [None]
+
+        def flush():
             if not group:
-                break
+                return
             try:
-                if len(group) == 1:
-                    self.forward_backward(group[0])
-                    self.update()
-                    self.update_metric(eval_metric, group[0].label)
-                else:
-                    self.bulk_step(batches=group,
-                                   eval_metric=eval_metric)
+                self._bulk_dispatch_group(list(group), bulk,
+                                          eval_metric)
             except MXNetError:
-                # peer death mid-dispatch: same conversion as the
-                # per-batch loop — nbatch still counts only COMPLETED
-                # dispatches, the consistent state the final
-                # checkpoint must record
-                self._peer_death_preempt(checkpoint, step_cb, nbatch,
-                                         epoch)
+                self._peer_death_preempt(checkpoint, step_cb,
+                                         state['nbatch'], epoch)
                 raise
             k = len(group)
-            nbatch += k
+            state['nbatch'] += k
+            del group[:]
             if batch_end_callback is not None:
                 _fire(batch_end_callback,
-                      BatchEndParam(epoch=epoch, nbatch=nbatch - 1,
+                      BatchEndParam(epoch=epoch,
+                                    nbatch=state['nbatch'] - 1,
                                     eval_metric=eval_metric,
                                     locals=locals()))
             if step_cb is not None:
-                step_cb(nbatch, k, epoch)
-            group = []
-            if data_batch is None:
-                break
+                step_cb(state['nbatch'], k, epoch)
+
+        for data_batch in train_data:
+            key = self._bulk_group_key(data_batch)
+            if group and key != group_key[0]:
+                flush()
+            group_key[0] = key
+            group.append(data_batch)
+            if len(group) >= bulk:
+                flush()
+        flush()
+
+    def _bulk_group_key(self, data_batch):
+        """Group-compatibility key for _fit_epoch_bulk: consecutive
+        batches join one dispatch only while it is stable.  The base
+        key never splits; BucketingModule returns the ladder rung."""
+        return None
+
+    def _bulk_dispatch_group(self, group, bulk, eval_metric):
+        """Execute one flushed _fit_epoch_bulk group.  Base policy: a
+        single batch runs per-step (a K=1 scan program would be a
+        pointless extra compile); anything larger is one bulk_step
+        dispatch (trailing partial groups included — the smaller scan
+        program compiles once and epochs reuse it)."""
+        if len(group) == 1:
+            self.forward_backward(group[0])
+            self.update()
+            self.update_metric(eval_metric, group[0].label)
+        else:
+            self.bulk_step(batches=group, eval_metric=eval_metric)
 
     def _wrap_train_iter(self, train_data):
         """Hook for subclasses to decorate the training iterator (e.g.

@@ -42,7 +42,7 @@ from .. import profiler
 from ..base import MXNetError
 from ..initializer import Uniform
 from ..io import DataBatch, DataDesc
-from .base_module import BaseModule, BatchEndParam, _fire
+from .base_module import BaseModule
 from .module import Module
 
 
@@ -532,67 +532,25 @@ class BucketingModule(BaseModule):
                                     eval_metric=eval_metric)
         self._note_rung_dispatch(steps=len(mapped))
 
-    def _fit_epoch_bulk(self, train_data, bulk, eval_metric,
-                        batch_end_callback, epoch, step_cb=None,
-                        nbatch0=0, checkpoint=None):
-        """Bucket-aware K-step grouping for fit(bulk=K): consecutive
-        batches mapping to the SAME ladder rung group into one
-        bulk_step dispatch; a rung change flushes the group.
-        BucketSentenceIter(bucket_major=True) orders epochs
-        bucket-by-bucket so groups reach the full K even on mixed
-        data.  step_cb(nbatch_done, steps, epoch): elastic checkpoint
-        hook, fired once per flushed group.  nbatch0: batch counter
-        start (the resumed epoch's consumed-batch watermark).
-        checkpoint: elastic manager — a dispatch failing on a
-        heartbeat-detected peer death converts to a coordinated
-        preemption (base class _peer_death_preempt)."""
-        state = {'nbatch': int(nbatch0)}
-        group = []
-        group_rung = [None]
+    # fit(bulk=K) epoch loop: ONE shared implementation in BaseModule
+    # (_fit_epoch_bulk); the ladder customizes only the two hooks —
+    # grouping (rung identity) and group dispatch (partial groups run
+    # per-step: only the K=bulk scan program is AOT-warmed via
+    # _warmup_for_fit, and a fresh XLA compile for a trailing group's
+    # K would cost far more than the few per-step dispatches it
+    # saves).  BucketSentenceIter(bucket_major=True) orders epochs
+    # bucket-by-bucket so groups reach the full K even on mixed data.
+    def _bulk_group_key(self, data_batch):
+        return self._rung_for(data_batch.bucket_key)
 
-        def flush():
-            if not group:
-                return
-            try:
-                if len(group) >= bulk:
-                    self.bulk_step(batches=list(group),
-                                   eval_metric=eval_metric)
-                else:
-                    # partial trailing group (rung change / epoch
-                    # end): run per-step through the warmed
-                    # single-step program — only the K=bulk scan
-                    # program is AOT-warmed, and a fresh XLA compile
-                    # for this group's K would cost far more than the
-                    # few per-step dispatches it saves
-                    for b in group:
-                        self.forward_backward(b)
-                        self.update()
-                        self.update_metric(eval_metric, b.label)
-            except MXNetError:
-                self._peer_death_preempt(checkpoint, step_cb,
-                                         state['nbatch'], epoch)
-                raise
-            k = len(group)
-            state['nbatch'] += k
-            del group[:]
-            if batch_end_callback is not None:
-                _fire(batch_end_callback,
-                      BatchEndParam(epoch=epoch,
-                                    nbatch=state['nbatch'] - 1,
-                                    eval_metric=eval_metric,
-                                    locals=locals()))
-            if step_cb is not None:
-                step_cb(state['nbatch'], k, epoch)
-
-        for data_batch in train_data:
-            rung = self._rung_for(data_batch.bucket_key)
-            if group and rung != group_rung[0]:
-                flush()
-            group_rung[0] = rung
-            group.append(data_batch)
-            if len(group) >= bulk:
-                flush()
-        flush()
+    def _bulk_dispatch_group(self, group, bulk, eval_metric):
+        if len(group) >= bulk:
+            self.bulk_step(batches=group, eval_metric=eval_metric)
+        else:
+            for b in group:
+                self.forward_backward(b)
+                self.update()
+                self.update_metric(eval_metric, b.label)
 
     def get_outputs(self, merge_multi_context=True):
         """Outputs of the LAST forward.  Ladder caveat: a batch that

@@ -802,6 +802,22 @@ class Module(BaseModule):
         self.forward_backward(data_batch)
         self.update()
 
+    def _fit_pipeline(self, train_data, spec, eval_data, eval_metric,
+                      validation_metric, epoch_end_callback,
+                      batch_end_callback, eval_end_callback,
+                      eval_batch_end_callback, begin_epoch, num_epoch,
+                      bulk):
+        """fit(pipeline=(S, M)): the dp×pipe GPipe training mode —
+        symbol chain partitioned into stages, fill-drain microbatch
+        schedule + gradient reduction + SGD/NAG update as ONE donated
+        XLA dispatch per step group (module/pipeline_fit.py)."""
+        from .pipeline_fit import fit_pipeline
+        return fit_pipeline(
+            self, train_data, spec, eval_data, eval_metric,
+            validation_metric, epoch_end_callback, batch_end_callback,
+            eval_end_callback, eval_batch_end_callback, begin_epoch,
+            num_epoch, bulk)
+
     def update(self):
         """Reference module.py:615."""
         assert self.binded and self.params_initialized and \

@@ -45,7 +45,10 @@ def _svm_bwd(params, res, g):
             (margin + data - score_y)
     gy = -gj.sum(axis=-1, keepdims=True)
     grad = gj + onehot * gy
-    return grad, jnp.zeros_like(label)
+    # cotangent scale: identity under the executor's all-ones seed,
+    # zero under the pipelined engine's last-stage loss masking (see
+    # ops/nn.py _softmax_output_bwd)
+    return grad * g, jnp.zeros_like(label)
 
 
 _svm_output_fn.defvjp(
@@ -265,9 +268,12 @@ def _lsoftmax(attrs, inputs, auxs, op_ctx):
 
 
 def _reg_loss_like(name, fwd_fn, grad_fn, hint):
-    """Loss-style op: forward is elementwise, backward IGNORES the head
-    gradient (reference OperatorProperty loss ops — the gradient is a
-    function of (out, label) only)."""
+    """Loss-style op: forward is elementwise, backward is a function
+    of (out, label) only, SCALED by the head cotangent (reference
+    OperatorProperty loss ops ignore head grads; the executor seeds
+    ones, so the scale is identity there, while the pipelined engine's
+    last-stage loss masking relies on zero cotangents zeroing the
+    gradient — see ops/nn.py _softmax_output_bwd)."""
     @functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
     def fn(params, data, label):
         return fwd_fn(data)
@@ -278,7 +284,8 @@ def _reg_loss_like(name, fwd_fn, grad_fn, hint):
 
     def bwd_rule(params, res, g):
         out, label = res
-        return (grad_fn(params, out, label), jnp.zeros_like(label))
+        return (grad_fn(params, out, label) * g,
+                jnp.zeros_like(label))
 
     fn.defvjp(fwd_rule, bwd_rule)
     return fn

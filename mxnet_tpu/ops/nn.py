@@ -186,7 +186,14 @@ def _softmax_output_bwd(params, res, g):
     elif normalization == 'valid':
         n = valid if valid is not None else float(np.prod(lab.shape))
         grad = grad / n
-    return grad, jnp.zeros_like(label)
+    # scale by the incoming cotangent: the executor always seeds loss
+    # ops with ones (reference "ignores head grads" semantics —
+    # executor._default_head_grads), so this is identity there, while
+    # a ZERO cotangent — the pipelined engine masking the loss total
+    # to the last pipe stage (parallel/pipeline.make_pipe_step_fn) —
+    # correctly kills the gradient instead of leaking (p - y) from
+    # every stage's garbage activations
+    return grad * g, jnp.zeros_like(label)
 
 
 _softmax_output_fn.defvjp(
@@ -229,8 +236,12 @@ def _make_regression(name, fwd, grad):
         out, data, label = res
         lab = label.reshape(out.shape)
         # no batch normalization here — the optimizer's rescale_grad
-        # (1/batch) carries it, as in the reference convention
-        return (grad(out, data, lab) * grad_scale, jnp.zeros_like(label))
+        # (1/batch) carries it, as in the reference convention.  The
+        # cotangent scale is identity under the executor's all-ones
+        # seed and zeroes the gradient under the pipelined engine's
+        # last-stage loss masking (see _softmax_output_bwd)
+        return (grad(out, data, lab) * grad_scale * g,
+                jnp.zeros_like(label))
 
     fn.defvjp(fwd_rule, bwd_rule)
 

@@ -91,6 +91,48 @@ def allreduce_bucket(x, mesh):
     return allgather_bucket(x, mesh)
 
 
+def expert_shard(x, dim=0, axis='data'):
+    """GSPMD expert-parallel constraint for plain-jit fused code
+    (gluon.nn.MoE): shard `x`'s expert dimension over the ACTIVE
+    mesh's dp axis (mesh.current_mesh — set by the fused trace paths
+    via mesh.use_mesh), so XLA's partitioner places each device's
+    expert slice locally and inserts the token all_to_alls itself —
+    the Switch-style "expert axis aliases the data axis" layout.
+    Identity when no mesh is active (single device, or a manual-axes
+    shard_map trace) or when the expert count does not divide the
+    axis."""
+    from .mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is None or axis not in mesh.axis_names:
+        return x
+    n = int(mesh.shape[axis])
+    if n <= 1 or x.shape[dim] % n:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(*([None] * dim + [axis] + [None] * (x.ndim - dim - 1)))
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec))
+
+
+def replicate_constraint(x):
+    """Pin `x` fully replicated on the ACTIVE mesh (identity when no
+    mesh is active).  with_sharding_constraint is its own transpose,
+    so this also pins the COTANGENT replicated — gluon.nn.MoE uses it
+    on the expert weights so their gradients (and therefore the
+    donated new-weight outputs) do not inherit the expert-sharded
+    dispatch layout and drift the compiled program's input shardings
+    between dispatches."""
+    from .mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P()))
+
+
 def interleave_reduce_enabled(explicit=None):
     """Resolve the gradient-reduction schedule: an explicit API value
     wins, else MXNET_TPU_INTERLEAVE_REDUCE (default on — interleaved

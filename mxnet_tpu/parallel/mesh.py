@@ -47,6 +47,27 @@ def set_current_mesh(mesh):
     _state.mesh = mesh
 
 
+class use_mesh:
+    """Scoped current-mesh context: the fused GSPMD trace paths set it
+    around tracing so mesh-aware layers (gluon.nn.MoE's expert-dim
+    sharding constraint — collectives.expert_shard) can constrain
+    shardings without threading the mesh through every forward
+    signature.  Manual-axes (shard_map) traces deliberately do NOT set
+    it: with_sharding_constraint has no meaning inside them."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = current_mesh()
+        set_current_mesh(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        set_current_mesh(self._prev)
+
+
 def data_sharding(mesh, ndim=None, axis='data'):
     """Batch-dim sharding: first axis over the data axis."""
     return NamedSharding(mesh, P(axis))

@@ -17,13 +17,26 @@ from ._compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 
-def switch_route(x, router_w, num_experts, capacity):
+def capacity_for(num_tokens, num_experts, capacity_factor=1.0):
+    """Static per-expert token capacity from a capacity factor
+    (Switch Transformer eq. 3): ceil(cf * T / E), at least 1.  Static
+    so the dispatch shapes — and therefore the XLA program — do not
+    depend on the routing."""
+    import math
+    return max(1, int(math.ceil(
+        int(num_tokens) * float(capacity_factor) / int(num_experts))))
+
+
+def switch_route(x, router_w, num_experts, capacity, with_counts=False):
     """Top-1 routing with per-expert capacity.
 
     x (T, D) local tokens -> (dispatch (E, C, D), combine (T, E, C),
     aux_loss scalar).  dispatch holds the tokens bucketed per expert;
     combine scatters expert outputs back to token positions weighted by
-    the router gate.
+    the router gate.  with_counts=True appends (routed (E,),
+    dropped (E,)) int32 per-expert token counts — capacity overflow is
+    otherwise SILENT (dropped tokens ride the caller's residual), so
+    these feed the profiler's moe_* counter family.
     """
     T, D = x.shape
     logits = x @ router_w                        # (T, E)
@@ -51,6 +64,11 @@ def switch_route(x, router_w, num_experts, capacity):
     combine = combine.at[jnp.arange(T), expert,
                          jnp.clip(pos, 0, capacity - 1)].set(
         jnp.where(keep, gate, 0.0))
+    if with_counts:
+        assigned = jnp.sum(onehot, axis=0)                    # (E,)
+        routed = jnp.sum(onehot * keep[:, None].astype(jnp.int32),
+                         axis=0)
+        return disp, combine, aux, (routed, assigned - routed)
     return disp, combine, aux
 
 

@@ -166,7 +166,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
 
 
 def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False,
-                        use_flash=False):
+                        scale=None, use_flash=False):
     """Wrapper: full [B, H, T, D] arrays, T sharded over `seq_axis`.
     use_flash routes each hop through the Pallas kernel (Pallas calls
     carry no vma metadata, so the flash path disables shard_map's vma
@@ -176,7 +176,8 @@ def ring_self_attention(q, k, v, mesh, seq_axis='sp', causal=False,
     kwargs = {'check_vma': False} if use_flash else {}
     fn = shard_map(
         functools.partial(ring_attention, axis_name=seq_axis,
-                          causal=causal, use_flash=use_flash),
+                          causal=causal, scale=scale,
+                          use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, **kwargs)
     return fn(q, k, v)
 

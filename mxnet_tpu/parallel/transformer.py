@@ -23,7 +23,51 @@ from jax import lax
 from ._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .ring_attention import ring_attention
+from .ring_attention import (ring_attention, ring_self_attention,
+                             full_attention)
+
+
+def attention(q, k, v, causal=False, scale=None, impl='auto',
+              seq_axis='sp', use_flash=False):
+    """Attention dispatch for the FUSED (GSPMD plain-jit) path: pick
+    the ring-attention sequence-parallel implementation when the
+    ACTIVE mesh (parallel.mesh.current_mesh — set by the fused trace
+    paths via mesh.use_mesh) has a `seq_axis` dimension the sequence
+    divides over, else single-device full_attention.
+
+    q, k, v: GLOBAL [B, H, T, D] arrays (self-attention shapes — the
+    ring path has no cross-attention form).  impl: 'auto' (ring when
+    the active mesh can carry it), 'ring' (require it — raise when the
+    mesh can't), 'full' (force the dense path).  The ring path wraps
+    ring_self_attention's shard_map over the active mesh, so it nests
+    inside an outer jit exactly like the fused step's other mesh-aware
+    layers (gluon.nn.MoE) — XLA sees the K/V ppermute ring explicitly
+    and overlaps it with the block attention compute; numerics match
+    full_attention to ulp-level (the online-softmax merge is exact).
+    """
+    if impl not in ('auto', 'ring', 'full'):
+        raise ValueError("attention impl must be 'auto', 'ring' or "
+                         "'full', got %r" % (impl,))
+    from .mesh import current_mesh
+    mesh = current_mesh()
+    n = 0
+    if mesh is not None and seq_axis in mesh.axis_names:
+        n = int(mesh.shape[seq_axis])
+    can_ring = (n > 1 and q.ndim == 4 and q.shape == k.shape
+                and k.shape == v.shape and q.shape[-2] % n == 0)
+    if impl == 'ring' and not can_ring:
+        raise ValueError(
+            "attention(impl='ring'): needs an active mesh with a "
+            "'%s' axis > 1 dividing T, and identical 4-D q/k/v; got "
+            "mesh=%r q=%s k=%s v=%s"
+            % (seq_axis, None if mesh is None else dict(mesh.shape),
+               q.shape, k.shape, v.shape))
+    if impl == 'full' or not can_ring:
+        return full_attention(q, k, v, causal=causal, scale=scale,
+                              use_flash=use_flash)
+    return ring_self_attention(q, k, v, mesh, seq_axis=seq_axis,
+                               causal=causal, scale=scale,
+                               use_flash=use_flash)
 
 
 def lm_config(vocab=64, dim=32, heads=4, layers=2, mlp_mult=4,

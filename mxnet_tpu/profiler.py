@@ -85,6 +85,92 @@ def note_reduce_dispatch(buckets, interleave, k, dt_ms=0.0,
                          metric_steps=metric_steps)
 
 
+# pipeline-parallel counters (round 16: the dp×pipe GPipe training
+# mode — gluon/fused.PipelinedStep and module/pipeline_fit.py feed one
+# call per fused dispatch).  stages/num_micro/bubble_frac and the
+# per-device param/optimizer-state residency are GAUGES (the last
+# dispatch's configuration); the rest accumulate.  bubble_frac is the
+# schedule's analytic fill-drain bubble (S-1)/(M+S-1) — the fraction
+# of pipeline ticks below full stage occupancy.
+_PIPE = {
+    'pipe_dispatches': 0,
+    'pipe_steps': 0,
+    'pipe_microbatches': 0,
+    'pipe_stages': 0,
+    'pipe_num_micro': 0,
+    'pipe_bubble_frac': 0.0,
+    'pipe_param_bytes_per_device': 0,
+    'pipe_state_bytes_per_device': 0,
+}
+
+
+def note_pipe_dispatch(stages, micro, k, bubble_frac, param_bytes=0,
+                       state_bytes=0):
+    """ONE counter model for a pipelined fused dispatch of k steps,
+    shared by the gluon and Module dp×pipe paths."""
+    with _STATE['lock']:
+        _PIPE['pipe_dispatches'] += 1
+        _PIPE['pipe_steps'] += int(k)
+        _PIPE['pipe_microbatches'] += int(micro) * int(k)
+        _PIPE['pipe_stages'] = int(stages)
+        _PIPE['pipe_num_micro'] = int(micro)
+        _PIPE['pipe_bubble_frac'] = float(bubble_frac)
+        if param_bytes:
+            _PIPE['pipe_param_bytes_per_device'] = int(param_bytes)
+        if state_bytes:
+            _PIPE['pipe_state_bytes_per_device'] = int(state_bytes)
+
+
+def pipe_stats():
+    """Snapshot of the pipeline-parallel counters (also merged into
+    summary() and dump_profile's 'pipeline' metadata lane)."""
+    with _STATE['lock']:
+        return dict(_PIPE)
+
+
+# expert-parallel MoE counters (gluon.nn.MoE through the fused step):
+# tokens routed to experts vs dropped at capacity (overflow is
+# otherwise SILENT — the residual passes them through), plus the
+# per-expert table for load-balance reading
+_MOE = {
+    'moe_routed_tokens': 0,
+    'moe_dropped_tokens': 0,
+    'moe_dispatches': 0,
+}
+_MOE_EXPERTS = {}       # 'e<i>' -> {'routed': n, 'dropped': n}
+
+
+def add_moe_stats(routed=0, dropped=0, per_expert_routed=None,
+                  per_expert_dropped=None, dispatches=0):
+    """Accumulate MoE routing counters (the fused step feeds one call
+    per dispatch from the block's device-resident count deltas)."""
+    with _STATE['lock']:
+        _MOE['moe_routed_tokens'] += int(routed)
+        _MOE['moe_dropped_tokens'] += int(dropped)
+        _MOE['moe_dispatches'] += int(dispatches)
+        for key, vals in (('routed', per_expert_routed),
+                          ('dropped', per_expert_dropped)):
+            if vals is None:
+                continue
+            for i, v in enumerate(vals):
+                e = _MOE_EXPERTS.setdefault('e%d' % i,
+                                            {'routed': 0, 'dropped': 0})
+                e[key] += int(v)
+
+
+def moe_stats():
+    """Snapshot of the MoE routing counters plus the derived drop
+    fraction and the per-expert table."""
+    with _STATE['lock']:
+        out = dict(_MOE)
+        out['moe_experts'] = {k: dict(v)
+                              for k, v in _MOE_EXPERTS.items()}
+    total = out['moe_routed_tokens'] + out['moe_dropped_tokens']
+    out['moe_drop_frac'] = \
+        out['moe_dropped_tokens'] / total if total else 0.0
+    return out
+
+
 # host input-pipeline counters (parallel decode pool + device prefetch):
 # decode work done by the workers, time the consumer waited on the pool,
 # ready-chunk queue depth observations, and training-loop-visible input
@@ -545,6 +631,10 @@ def dump_profile():
                    'args': gluon_fused_stats()})
     events.append({'ph': 'M', 'name': 'bucketing', 'pid': 0,
                    'args': bucketing_stats()})
+    events.append({'ph': 'M', 'name': 'pipeline', 'pid': 0,
+                   'args': pipe_stats()})
+    events.append({'ph': 'M', 'name': 'moe', 'pid': 0,
+                   'args': moe_stats()})
     events.append({'ph': 'M', 'name': 'checkpoint', 'pid': 0,
                    'args': ckpt_stats()})
     events.append({'ph': 'M', 'name': 'dist', 'pid': 0,
@@ -665,6 +755,27 @@ def summary(print_out=True):
                  % (gf['gluon_fused_steps'],
                     gf['gluon_fused_dispatches'],
                     gf['gluon_fused_steps_per_dispatch']))
+    pi = pipe_stats()
+    lines.append('  pipe_dispatches=%d pipe_steps=%d '
+                 'pipe_microbatches=%d pipe_stages=%d '
+                 'pipe_num_micro=%d pipe_bubble_frac=%.3f '
+                 'pipe_param_bytes_per_device=%d '
+                 'pipe_state_bytes_per_device=%d'
+                 % (pi['pipe_dispatches'], pi['pipe_steps'],
+                    pi['pipe_microbatches'], pi['pipe_stages'],
+                    pi['pipe_num_micro'], pi['pipe_bubble_frac'],
+                    pi['pipe_param_bytes_per_device'],
+                    pi['pipe_state_bytes_per_device']))
+    mo = moe_stats()
+    lines.append('  moe_routed_tokens=%d moe_dropped_tokens=%d '
+                 'moe_drop_frac=%.3f moe_dispatches=%d'
+                 % (mo['moe_routed_tokens'], mo['moe_dropped_tokens'],
+                    mo['moe_drop_frac'], mo['moe_dispatches']))
+    for ek in sorted(mo['moe_experts'],
+                     key=lambda s: int(s[1:])):
+        e = mo['moe_experts'][ek]
+        lines.append('    expert %-4s routed=%d dropped=%d'
+                     % (ek, e['routed'], e['dropped']))
     bk = bucketing_stats()
     lines.append('  train_bucket_switches=%d train_pad_waste_rows=%d '
                  'train_pad_waste_frac=%.3f'
@@ -767,6 +878,11 @@ def clear():
             _GLUON_FUSED[k] = 0
         for k in _BUCKET:
             _BUCKET[k] = 0
+        for k in _PIPE:
+            _PIPE[k] = type(_PIPE[k])()
+        for k in _MOE:
+            _MOE[k] = 0
+        _MOE_EXPERTS.clear()
         for k in _CKPT:
             _CKPT[k] = type(_CKPT[k])()
         for k in _DIST:

@@ -172,8 +172,15 @@ print('WORKER_OK rank=%d' % rank)
 '''
 
 
+@pytest.mark.slow
 def test_launch_local_multiprocess(tmp_path):
-    """Real multi-process dist_sync through tools/launch.py (the
+    """slow (~10s, round-16 headroom): the launcher-spawned dist_sync
+    E2E also runs in dryrun phase (f); the PS protocol and sync-SGD
+    arithmetic stay tier-1 via the in-process tests in this file, and
+    launch.py process semantics via test_dist_runtime's launcher
+    tests.
+
+    Real multi-process dist_sync through tools/launch.py (the
     reference's `launch.py -n 2 --launcher local` nightly pattern)."""
     script = tmp_path / 'worker.py'
     script.write_text(_WORKER_SCRIPT)

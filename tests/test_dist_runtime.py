@@ -366,8 +366,16 @@ def test_launcher_forwards_sigterm_to_children(tmp_path):
     assert (tmp_path / 'term_1').exists(), 'worker 1 never got SIGTERM'
 
 
+@pytest.mark.slow
 def test_kill_one_of_two_workers_coordinated_restart(tmp_path):
-    """The acceptance-criteria path end to end: launcher-spawned
+    """slow (~26s, round-16 headroom): the launcher-spawned E2E also
+    runs in dryrun phase (i); the pieces stay tier-1 via
+    test_heartbeat_loss_preempts_with_dead_rank_set (death detection
+    -> Preempted), test_launcher_fail_fast_kills_siblings_and_names_rank
+    and test_launcher_forwards_sigterm_to_children (launcher
+    semantics), and test_elastic's kill/resume parity tests.
+
+    The acceptance-criteria path end to end: launcher-spawned
     workers, SIGKILL of rank 1 mid-epoch detected by heartbeat loss,
     survivor commits a final checkpoint + exits PREEMPTED_EXIT, the
     --elastic supervisor relaunches at reduced world size, and the

@@ -434,8 +434,15 @@ def test_sigterm_commits_final_checkpoint(tmp_path):
         pm['fc1_weight'].asnumpy())
 
 
+@pytest.mark.slow
 def test_fit_sigkill_subprocess_resume(tmp_path):
-    """The real preemption path: a fit() child is SIGKILLed mid-epoch
+    """slow (~20s, round-16 headroom): the subprocess SIGKILL E2E also
+    runs in dryrun phase (h); kill/resume bit-parity and the final
+    commit stay tier-1 via test_module_kill_resume_parity,
+    test_fit_preempt_resume_bit_parity and
+    test_sigterm_commits_final_checkpoint.
+
+    The real preemption path: a fit() child is SIGKILLed mid-epoch
     by MXNET_TPU_FAULT_KILL_AT_STEP (no warning, no cleanup), a second
     child resumes from the cadence checkpoint, and the final weights
     match an uninterrupted child bit-exactly."""

@@ -20,7 +20,11 @@ def _load(relpath, name):
     return mod
 
 
+@pytest.mark.slow
 def test_fgsm_adversary():
+    # slow (~7s, round-16 headroom): executor gradient access (the
+    # attack's input-grad read) stays tier-1 via test_executor and
+    # test_autograd; classifier training via test_multi_task
     mod = _load('examples/adversary/fgsm.py', 'ex_fgsm')
     clean, adv = mod.main(quick=True)
     assert clean > 0.9, clean
@@ -62,7 +66,11 @@ def test_bi_lstm_sort():
     assert acc > 0.8, acc
 
 
+@pytest.mark.slow
 def test_autoencoder():
+    # slow (~6s, round-16 headroom): regression-objective MLP training
+    # stays tier-1 via test_csv_tabular and
+    # test_matrix_factorization (reconstruction-style objectives)
     mod = _load('examples/autoencoder/autoencoder.py', 'ex_ae')
     mse, var = mod.main(quick=True)
     assert mse < 0.05 * var, (mse, var)
@@ -81,7 +89,12 @@ def test_multi_task():
     assert scores['rmse'] < 0.5, scores
 
 
+@pytest.mark.slow
 def test_sgld_regression():
+    # slow (~7s, round-16 headroom): custom-optimizer registration +
+    # update math stay tier-1 via test_dsd_training's optimizer
+    # subclass and test_train's optimizer round-trips; regression
+    # training via test_csv_tabular
     mod = _load('examples/bayesian_methods/sgld_regression.py', 'ex_sgld')
     mu_err, sd, ratio = mod.main(quick=True)
     assert mu_err < 6 * sd, (mu_err, sd)

@@ -26,12 +26,20 @@ def test_resnet18_v2_thumbnail():
     _smoke(net)
 
 
+@pytest.mark.slow
 def test_resnet50_v1_structure():
+    # slow (~6s, round-16 headroom): the bottleneck-block resnet zoo
+    # path stays tier-1 via test_resnet18_v1_thumbnail (same builder,
+    # basic block) and test_train's resnet mixed-precision bind
     net = model_zoo.vision.get_resnet(1, 50, classes=10, thumbnail=True)
     _smoke(net)
 
 
+@pytest.mark.slow
 def test_squeezenet():
+    # slow (~6s, round-16 headroom): concat-branch zoo structures stay
+    # tier-1 via test_densenet_small; plain conv stacks via
+    # test_alexnet/test_vgg11
     net = model_zoo.vision.squeezenet1_1(classes=10)
     _smoke(net, shape=(1, 3, 64, 64))
 

@@ -45,6 +45,13 @@ def main():
                         'smoke (legacy per-bucket loop vs fused '
                         'bucket ladder vs bulked ladder; one bench.py '
                         'child) instead of the model-family sweep')
+    p.add_argument('--pipe', action='store_true',
+                   help='run the BENCH_PIPE dp×pipe GPipe training '
+                        'A/B (dp-only vs dp×pipe vs dp×pipe+ZeRO; '
+                        'parity-gated, per-device param+state '
+                        'residency; one bench.py child that spawns '
+                        'its own virtual CPU mesh when needed) '
+                        'instead of the model-family sweep')
     p.add_argument('--ckpt', action='store_true',
                    help='run the BENCH_CKPT elastic-checkpoint '
                         'overhead A/B (no-checkpoint vs async cadence '
@@ -61,11 +68,12 @@ def main():
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
-    if args.gluon or args.overlap or args.bucket or args.ckpt or \
-            args.serve_fleet:
+    if args.gluon or args.overlap or args.bucket or args.pipe or \
+            args.ckpt or args.serve_fleet:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
                      else ('bucket', 'BENCH_BUCKET') if args.bucket
+                     else ('pipe', 'BENCH_PIPE') if args.pipe
                      else ('ckpt', 'BENCH_CKPT') if args.ckpt
                      else ('serve-fleet', 'BENCH_FLEET'))
         env = dict(os.environ, **{var: '1'})
