@@ -64,11 +64,33 @@ import socket
 import threading
 import time
 import weakref
+from collections import OrderedDict
 
 import numpy as np
 
 from .base import MXNetError
 from .kvstore_server import _recv_msg, _send_msg, _tune_sock_bufs
+
+# bound on live wire-codec streams per endpoint: each stream pins
+# gradient-sized float32 error-feedback residuals, and a long-lived
+# process whose allreduce signatures change over time (incremental
+# key registration, rebinds) must not leak every stale stream's
+# buffers forever — LRU-evicted past the cap (an evicted stream just
+# restarts its error feedback, nothing corrupts)
+_WIRE_CODEC_CAP = 32
+
+
+def _wire_codec(cache, key, wire):
+    """Fetch-or-create the LRU-bounded WireCodec for one stream
+    (caller holds the lock guarding `cache`)."""
+    from .quantization import WireCodec
+    codec = cache.get(key)
+    if codec is None:
+        codec = cache[key] = WireCodec(wire)
+    cache.move_to_end(key)
+    while len(cache) > _WIRE_CODEC_CAP:
+        cache.popitem(last=False)
+    return codec
 
 # exit code a preempted worker should use so a supervising
 # tools/launch.py --elastic treats it as restartable (EX_TEMPFAIL)
@@ -136,6 +158,13 @@ class Coordinator(object):
         self._dead = set()            # sticky
         self._barriers = {}           # name -> {'gen': int, 'arrived': set}
         self._reduces = {}            # (name, round) -> round state
+        # downstream wire codecs: one per compressed-allreduce stream,
+        # carrying the RESULT quantization's error-feedback residual
+        # (the rank-side codecs carry the contribution residuals) —
+        # only ever touched by a round's single summer, which rounds
+        # of one stream serialize (ranks block fetching round n before
+        # contributing n+1).  LRU-bounded (_WIRE_CODEC_CAP).
+        self._wire_codecs = OrderedDict()
         self._stopped = False
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -259,25 +288,60 @@ class Coordinator(object):
                                          len(members)))
                 self._cv.wait(min(0.2, deadline - now))
 
-    def _handle_allreduce(self, name, rnd, rank, values, timeout):
+    def _handle_allreduce(self, name, rnd, rank, values, timeout,
+                          wire='fp32', scales=None):
         """Host-level sum over live ranks: each rank contributes a
         tuple of arrays for (name, round); the last contributor sums
         (deterministic rank order — every rank receives IDENTICAL
         bytes) and all waiters are released with the result.  A rank
-        dying mid-round fails the round with an actionable error."""
+        dying mid-round fails the round with an actionable error.
+
+        Compressed rounds (`wire` 'int8'/'bf16'; docs/DIST.md wire
+        format): contributions arrive as codes + per-bucket scales,
+        are dequantized and summed in float32 (still rank order), and
+        the RESULT is re-quantized through a per-stream coordinator
+        codec whose error-feedback residual carries the downstream
+        quantization error into the next round — every rank receives
+        the identical compressed bytes, so per-mode determinism
+        holds."""
         rank = int(rank)
         key = (str(name), int(rnd))
         deadline = time.monotonic() + float(timeout)
+        wire = str(wire or 'fp32')
         values = tuple(np.ascontiguousarray(v) for v in values)
         with self._cv:
             ent = self._reduces.setdefault(
                 key, {'parts': {}, 'result': None, 'error': None,
-                      'summing': False, 'fetched': set()})
-            ent['parts'][rank] = values
+                      'summing': False, 'fetched': set(),
+                      'wire': wire})
+            if ent['wire'] != wire:
+                # fail the WHOLE round, not just this rank: peers
+                # that already contributed wake and get the
+                # actionable error now, and the entry stays as a
+                # TOMBSTONE (parts freed, error set) so ranks
+                # arriving even later fail fast with the real cause
+                # instead of timing out on a fresh entry that can
+                # never complete.  Tombstones are tiny; prune old
+                # ones if a retry loop accumulates them.
+                msg = ('allreduce %r: rank %d sent wire dtype %r but '
+                       'the round opened with %r — every rank must '
+                       'resolve the same MXNET_TPU_DIST_WIRE_DTYPE'
+                       % (name, rank, wire, ent['wire']))
+                ent['error'] = msg
+                ent['parts'] = {}
+                if len(self._reduces) > 256:
+                    stale = [k for k, e in self._reduces.items()
+                             if e.get('error') and k != key][:128]
+                    for k in stale:
+                        self._reduces.pop(k, None)
+                self._cv.notify_all()
+                return ('err', msg)
+            ent['parts'][rank] = (values, scales)
             self._last_seen[rank] = time.monotonic()
             self._cv.notify_all()
             while ent['result'] is None:
                 if ent['error'] is not None:
+                    ent['parts'] = {}   # dead round: free any arrays
                     return ('err', ent['error'])
                 self._scan_dead_locked()
                 members = self._members_locked(live_only=False)
@@ -300,15 +364,9 @@ class Coordinator(object):
                     ent['members'] = set(ent['parts'])
                     parts = ent['parts']
                     self._cv.release()
-                    err = sums = None
+                    err = result = None
                     try:
-                        ranks = sorted(parts)
-                        sums = []
-                        for i in range(len(parts[ranks[0]])):
-                            acc = parts[ranks[0]][i].copy()
-                            for r in ranks[1:]:
-                                acc += parts[r][i]
-                            sums.append(acc)
+                        result = self._sum_parts(name, wire, parts)
                     except Exception as e:   # mismatched shapes etc.
                         err = ('allreduce %r failed to sum: %s'
                                % (name, e))
@@ -318,7 +376,7 @@ class Coordinator(object):
                         ent['error'] = err
                         self._cv.notify_all()
                         return ('err', err)
-                    ent['result'] = tuple(sums)
+                    ent['result'] = result
                     ent['parts'] = {}    # free the per-rank copies
                     self._cv.notify_all()
                     break
@@ -336,6 +394,41 @@ class Coordinator(object):
                 self._reduces.pop(key, None)
             return ('ok', result)
 
+    def _sum_parts(self, name, wire, parts):
+        """Rank-order sum of one round's contributions (runs OUTSIDE
+        the condition variable — see the summing block).  fp32 rounds
+        sum raw arrays; compressed rounds dequantize each rank's
+        codes first, sum in float32, and re-quantize the result
+        through the stream's coordinator-side error-feedback codec."""
+        ranks = sorted(parts)
+        if wire == 'fp32':
+            sums = []
+            for i in range(len(parts[ranks[0]][0])):
+                acc = parts[ranks[0]][0][i].copy()
+                for r in ranks[1:]:
+                    acc += parts[r][0][i]
+                sums.append(acc)
+            return tuple(sums)
+        from .quantization import WireCodec
+        dec = WireCodec(wire, error_feedback=False)
+        n = len(parts[ranks[0]][0])
+        dtypes = [np.float32] * n
+        sums = None
+        for r in ranks:
+            vals, scs = parts[r]
+            d = dec.decode(vals, scs, dtypes)
+            if sums is None:
+                sums = d
+            else:
+                for i in range(n):
+                    sums[i] = sums[i] + d[i]
+        ckey = (str(name), wire,
+                tuple(tuple(s.shape) for s in sums))
+        with self._cv:      # dict access only; encode stays outside
+            codec = _wire_codec(self._wire_codecs, ckey, wire)
+        payloads, out_scales = codec.encode(sums)
+        return (tuple(payloads), out_scales)
+
     # -- connection loop ---------------------------------------------------
     def _serve_conn(self, conn):
         try:
@@ -352,9 +445,11 @@ class Coordinator(object):
                     reply = self._handle_barrier(msg[1], msg[2], msg[3],
                                                  bool(msg[4]))
                 elif op == 'allreduce':
+                    # 6-field frames are legacy fp32 rounds; 8-field
+                    # frames carry (wire, scales) for compressed ones
                     reply = self._handle_allreduce(msg[1], msg[2],
                                                    msg[3], msg[4],
-                                                   msg[5])
+                                                   msg[5], *msg[6:8])
                 elif op == 'bye':
                     reply = self._handle_bye(msg[1])
                 elif op == 'stop':
@@ -444,6 +539,8 @@ class DistRuntime(object):
         self._dead_lock = threading.Lock()
         self._watched = weakref.WeakSet()
         self._round = {}              # allreduce name -> round counter
+        self._wire_codecs = OrderedDict()   # (name, wire, shapes) ->
+        self._wire_lock = threading.Lock()  # codec; LRU-bounded
         self._hb_interval = heartbeat_interval_s() if hb_interval is None \
             else float(hb_interval)
         self._dead_after = dead_after_s() if dead_after is None \
@@ -717,25 +814,73 @@ class DistRuntime(object):
                 barrier_wait_ms=(time.perf_counter() - t0) * 1e3)
 
     # -- host-level allreduce (the DCN dp leg) -----------------------------
-    def allreduce(self, arrays, name='grad', timeout=None):
+    def allreduce(self, arrays, name='grad', timeout=None, wire=None):
         """Sum `arrays` (list of np.ndarray) across all ranks through
         the coordinator; every rank receives bit-identical results.
         Identity at world 1.  Raises (naming ranks) on death/timeout
-        instead of hanging."""
+        instead of hanging.
+
+        `wire` ('int8'/'bf16'; default MXNET_TPU_DIST_WIRE_DTYPE, else
+        fp32) compresses the round both directions: contributions go
+        up as int8 codes + per-bucket scales (~1/4 the bytes), the
+        coordinator dequantizes, sums in float32 in rank order, and
+        re-quantizes the result down.  The quantization error is NOT
+        lost: this rank's contribution error and the coordinator's
+        result error each carry forward as error-feedback residuals
+        into the next round of the same stream (same name + shapes),
+        so a training run's gradient bias cancels over steps instead
+        of accumulating (docs/DIST.md).  Per mode the results are
+        bitwise-deterministic — every rank decodes the identical
+        compressed bytes.  dist_allreduce_bytes counts the ACTUAL
+        wire payload; quant_wire_bytes_saved and
+        quant_error_feedback_norm land in profiler.quant_stats()."""
         from . import profiler
+        from .quantization import WireCodec, wire_dtype_from_env
         arrays = [np.asarray(a) for a in arrays]
         if self.world <= 1:
             return arrays
+        wire = wire_dtype_from_env(wire)
         timeout = barrier_timeout_s() if timeout is None else \
             float(timeout)
         rnd = self._round[name] = self._round.get(name, 0) + 1
+        if wire == 'fp32':
+            out = self._rpc('allreduce', str(name), rnd, self.rank,
+                            tuple(arrays), float(timeout),
+                            timeout=timeout + 15.0)
+            # actual wire payload BOTH directions (contribution up +
+            # result down), so the compressed modes' byte counters
+            # A/B against this one like-for-like
+            profiler.add_dist_stats(
+                allreduce_rounds=1,
+                allreduce_bytes=2 * sum(a.nbytes for a in arrays))
+            return [np.asarray(v) for v in out]
+        ckey = (str(name), wire,
+                tuple((tuple(a.shape), np.dtype(a.dtype).str)
+                      for a in arrays))
+        with self._wire_lock:       # dict access only
+            codec = _wire_codec(self._wire_codecs, ckey, wire)
+        # the multi-MB encode serializes per STREAM (codec.lock —
+        # encode mutates that stream's residual), never across
+        # streams; decode is stateless and runs lock-free
+        with codec.lock:
+            payloads, scales = codec.encode(arrays)
+        up = WireCodec.wire_nbytes(payloads, scales)
         out = self._rpc('allreduce', str(name), rnd, self.rank,
-                        tuple(arrays), float(timeout),
+                        tuple(payloads), float(timeout), wire, scales,
                         timeout=timeout + 15.0)
-        profiler.add_dist_stats(
-            allreduce_rounds=1,
-            allreduce_bytes=sum(a.nbytes for a in arrays))
-        return [np.asarray(v) for v in out]
+        r_payloads, r_scales = out
+        down = WireCodec.wire_nbytes(r_payloads, np.asarray(r_scales))
+        dec = codec.decode(r_payloads, r_scales,
+                           [a.dtype for a in arrays])
+        with codec.lock:
+            ef = codec.residual_norm()
+        fp_bytes = sum(a.nbytes for a in arrays)
+        profiler.add_dist_stats(allreduce_rounds=1,
+                                allreduce_bytes=up + down)
+        profiler.add_quant_stats(
+            wire_bytes_saved=max(0, 2 * fp_bytes - up - down),
+            error_feedback_norm=ef)
+        return dec
 
     # -- teardown ----------------------------------------------------------
     def shutdown(self):
@@ -868,10 +1013,13 @@ def barrier(name='user', timeout=None):
     _RUNTIME.barrier(name, timeout=timeout)
 
 
-def allreduce(arrays, name='grad'):
+def allreduce(arrays, name='grad', wire=None):
+    """Cross-rank sum (identity before initialize()).  `wire` opts
+    into the compressed int8/bf16 bucket wire format (default
+    MXNET_TPU_DIST_WIRE_DTYPE) — see DistRuntime.allreduce."""
     if _RUNTIME is None:
         return [np.asarray(a) for a in arrays]
-    return _RUNTIME.allreduce(arrays, name=name)
+    return _RUNTIME.allreduce(arrays, name=name, wire=wire)
 
 
 def host_span_active():

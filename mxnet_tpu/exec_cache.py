@@ -289,7 +289,7 @@ def ladder_rung(ladder, key):
     return None
 
 
-def serve_step_key(sig, input_names=()):
+def serve_step_key(sig, input_names=(), quant=None):
     """Cache key of one bucket rung's donated serve program (the
     forward-only jit serving.py dispatches).  `sig` is the bucket
     executor's graph signature — shape-distinct per rung, so rungs
@@ -298,8 +298,14 @@ def serve_step_key(sig, input_names=()):
     deliberately alpha-renames variable names away, but the serve
     closure bakes the data_vals->argument mapping in, so engines over
     the same graph with differently-ordered data_names must not share
-    a program (they'd silently swap inputs)."""
-    return (sig, 'serve_step', tuple(input_names))
+    a program (they'd silently swap inputs).  `quant` is the
+    quantized engine's config token (QuantConfig.key + the quantized
+    weight positions): the quantized serve program takes int8 codes +
+    scale arguments and bakes the dequant math in, so it must never
+    alias the fp program — nor a program quantizing a different
+    weight subset."""
+    return (sig, 'serve_step', tuple(input_names)) + \
+        (() if quant is None else (quant,))
 
 
 def gluon_step_key(fingerprint, step_key, mode, k, placement):

@@ -742,27 +742,60 @@ def _count_sketch(attrs, data, h, s):
 
 
 # ---------------------------------------------------------------------------
-# quantize / dequantize — reference contrib/quantize.cc (uint8 affine)
+# quantize / dequantize — reference contrib/quantize.cc.  uint8 is the
+# AFFINE map of [min_range, max_range] onto [0, 255]; out_type='int8'
+# is the reference's SYMMETRIC signed mode: real_range =
+# max(|min|, |max|) maps onto ±127 (code -128 never produced), with
+# min/max_output reported as ∓real_range.  Both modes route through
+# mxnet_tpu/quantization.py — the one definition the serving, paging
+# and wire arms share — and both guard the zero-range edge (min ==
+# max == 0 quantizes to code 0 and round-trips exact zeros instead of
+# dividing by zero).
 # ---------------------------------------------------------------------------
+
+def _quantize_infer_dtype(attrs, in_dtypes):
+    # the default inference propagates ONE dtype everywhere, but here
+    # the ranges are always float32 and the output dtype comes from
+    # out_type — an int8 data/result must not narrow the range inputs
+    # (a float range truncated to int8 silently rescales everything)
+    out_type = str(parse_attr_value(attrs.get('out_type', 'uint8')))
+    f32 = np.dtype(np.float32)
+    ins = [in_dtypes[0] or f32, f32, f32]
+    return ins, [np.dtype(out_type), f32, f32]
+
+
+def _dequantize_infer_dtype(attrs, in_dtypes):
+    out_type = str(parse_attr_value(attrs.get('out_type', 'float32')))
+    f32 = np.dtype(np.float32)
+    ins = [in_dtypes[0] or np.dtype(np.uint8), f32, f32]
+    return ins, [np.dtype(out_type)]
+
 
 @register('quantize', input_names=('data', 'min_range', 'max_range'),
           num_outputs=3, aliases=('_contrib_quantize',),
           output_names=('output', 'min_output', 'max_output'),
+          infer_dtype=_quantize_infer_dtype,
           hint='quantize')
 def _quantize(attrs, data, min_range, max_range):
+    from .. import quantization as Q
     out_type = str(parse_attr_value(attrs.get('out_type', 'uint8')))
-    qmin, qmax = (0.0, 255.0) if out_type == 'uint8' else (-127.0, 127.0)
-    scale = (qmax - qmin) / (max_range - min_range)
-    q = jnp.clip(jnp.round((data - min_range) * scale + qmin), qmin, qmax)
-    return (q.astype(jnp.uint8 if out_type == 'uint8' else jnp.int8),
+    if out_type == 'int8':
+        real_range = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        q = Q.quantize_int8_math(data, real_range / Q.INT8_RANGE)
+        return q, -real_range, real_range
+    return (Q.quantize_uint8_math(data, min_range, max_range),
             min_range, max_range)
 
 
 @register('dequantize', input_names=('data', 'min_range', 'max_range'),
-          aliases=('_contrib_dequantize',), hint='dequantize')
+          aliases=('_contrib_dequantize',),
+          infer_dtype=_dequantize_infer_dtype, hint='dequantize')
 def _dequantize(attrs, data, min_range, max_range):
+    from .. import quantization as Q
     out_type = str(parse_attr_value(attrs.get('out_type', 'float32')))
-    qmin, qmax = (0.0, 255.0) if data.dtype == jnp.uint8 else (-127.0, 127.0)
-    scale = (max_range - min_range) / (qmax - qmin)
-    return ((data.astype(jnp.float32) - qmin) * scale +
-            min_range).astype(out_type)
+    if data.dtype == jnp.int8:
+        real_range = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+        out = Q.dequantize_int8_math(data, real_range / Q.INT8_RANGE)
+    else:
+        out = Q.dequantize_uint8_math(data, min_range, max_range)
+    return out.astype(out_type)

@@ -35,6 +35,20 @@ math.
 Env knobs (documented in docs/PERF.md round 7):
   MXNET_TPU_ZERO=1            enable the sharded update (default 0)
   MXNET_TPU_ZERO_BUCKET_MB=N  bucket fill target in MiB (default 32)
+
+Wire formats (PERF round 17): the gradient buckets here already run
+the narrowest wire the GSPMD layer can express — multi-precision
+buckets all-gather updated params in the bf16 WEIGHT dtype (half the
+fp32 bytes, see sharded_sgd_step).  An int8 bucket wire is NOT
+expressible from this layer: the reduce-scatter is a sharding
+constraint whose per-device partial sums exist only inside XLA's
+partitioner, and quantization is nonlinear, so it cannot cross the
+implicit sum (collectives.quantized_allreduce documents the
+argument).  Compressed int8 gradient wire with per-bucket scales and
+error-feedback therefore lives on the legs where per-device values
+are explicit: `dist.allreduce(wire='int8')` for the cross-host DCN
+leg (the ps-lite-era bandwidth cliff this attacks), and
+`collectives.quantized_allreduce` for shard_map regions.
 """
 import os
 

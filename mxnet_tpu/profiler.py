@@ -513,6 +513,52 @@ def fleet_stats():
     return out
 
 
+# low-precision counters (PERF round 17: the int8 stack's three arms —
+# serving.InferenceEngine(quantize=), the registry's quantized
+# residency/paging, and the dist.allreduce wire format).  Gauges:
+# quant_models_resident (registry-resident engines serving quantized
+# weights), quant_paged_bytes (host bytes held by quantized page-out
+# images), quant_error_feedback_norm (L2 of the wire codec's carried
+# residual after the last round).  The rest accumulate:
+# quant_int8_rungs_warmed (ladder rungs compiled/warmed in quantized
+# mode), quant_wire_bytes_saved (fp32 bytes minus actual wire bytes
+# across compressed allreduce rounds, both directions), quant_page_ins
+# (models re-warmed from a quantized host image instead of their
+# loader/disk).
+_QUANT = {
+    'quant_models_resident': 0,         # gauge
+    'quant_int8_rungs_warmed': 0,
+    'quant_wire_bytes_saved': 0,
+    'quant_error_feedback_norm': 0.0,   # gauge
+    'quant_page_ins': 0,
+    'quant_paged_bytes': 0,             # gauge
+}
+
+
+def add_quant_stats(models_resident=None, error_feedback_norm=None,
+                    paged_bytes=None, **deltas):
+    """Accumulate low-precision counters (the three gauge keyword
+    args SET; everything else adds — keys arrive without the quant_
+    prefix: int8_rungs_warmed=1, wire_bytes_saved=n, page_ins=1)."""
+    with _STATE['lock']:
+        for k, v in deltas.items():
+            _QUANT['quant_' + k] += int(v)
+        if models_resident is not None:
+            _QUANT['quant_models_resident'] = int(models_resident)
+        if error_feedback_norm is not None:
+            _QUANT['quant_error_feedback_norm'] = \
+                float(error_feedback_norm)
+        if paged_bytes is not None:
+            _QUANT['quant_paged_bytes'] = int(paged_bytes)
+
+
+def quant_stats():
+    """Snapshot of the low-precision counters (also merged into
+    summary() and dump_profile's 'quant' metadata lane)."""
+    with _STATE['lock']:
+        return dict(_QUANT)
+
+
 # self-healing fleet-supervisor counters (fleet_supervisor.FleetRouter +
 # FleetSupervisor): replica lifecycle (spawn/restart/retire + the live
 # gauge), router retry/fast-503 behavior under replica death, and
@@ -643,6 +689,8 @@ def dump_profile():
                    'args': fleet_stats()})
     events.append({'ph': 'M', 'name': 'fleet_supervisor', 'pid': 0,
                    'args': fleet_supervisor_stats()})
+    events.append({'ph': 'M', 'name': 'quant', 'pid': 0,
+                   'args': quant_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -842,6 +890,16 @@ def summary(print_out=True):
                     fs['fleet_supervisor_canary_rollbacks'],
                     fs['fleet_supervisor_shadow_requests'],
                     fs['fleet_supervisor_shadow_divergences']))
+    qt = quant_stats()
+    lines.append('  quant_models_resident=%d quant_int8_rungs_warmed=%d '
+                 'quant_wire_bytes_saved=%d '
+                 'quant_error_feedback_norm=%.6f quant_page_ins=%d '
+                 'quant_paged_bytes=%d'
+                 % (qt['quant_models_resident'],
+                    qt['quant_int8_rungs_warmed'],
+                    qt['quant_wire_bytes_saved'],
+                    qt['quant_error_feedback_norm'],
+                    qt['quant_page_ins'], qt['quant_paged_bytes']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -891,6 +949,8 @@ def clear():
             _FLEET[k] = 0
         for k in _FLEET_SUP:
             _FLEET_SUP[k] = 0
+        for k in _QUANT:
+            _QUANT[k] = type(_QUANT[k])()
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

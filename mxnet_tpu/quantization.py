@@ -1,0 +1,478 @@
+"""Shared low-precision core: symmetric int8, uint8-affine contrib
+semantics, calibration, and the error-feedback wire format.
+
+The reference ships `quantize`/`dequantize` contrib ops
+(src/operator/contrib/quantize-inl.h, SURVEY.md §2.3): uint8 is an
+AFFINE map of [min_range, max_range] onto [0, 255]; int8 is SYMMETRIC —
+the representable range is ±max(|min|, |max|) mapped onto ±127 (the
+-128 code is never produced, so negation stays exact).  This module is
+the ONE definition of that math, consumed by three arms:
+
+  * `ops/contrib_ops.py` quantize/dequantize (capability parity with
+    the reference, including the signed `out_type='int8'` mode);
+  * `serving.InferenceEngine(quantize=...)` — weight-storage int8 for
+    the serving bucket ladder and the registry's residency budget
+    (serving.py / serving_fleet.py);
+  * the collective wire format — `dist.allreduce` int8/bf16 bucket
+    wire with per-bucket scales and error-feedback residual carry
+    (dist.py / parallel/collectives.py).
+
+Everything here is numpy/jax-polymorphic where noted: the `*_math`
+helpers take and return whatever array module their input came from
+(np for the host wire/paging paths, jnp inside traced programs).
+
+Determinism: quantization is round-half-away-from-zero on exact
+arithmetic — the same input bytes always produce the same quantized
+bytes, which is what makes the wire format bitwise-deterministic per
+mode (docs/DIST.md).
+"""
+import numpy as np
+
+from .base import MXNetError
+
+# int8 symmetric code range: ±127 (the reference's MinAbs(int8 min,
+# max) — -128 is never produced so |deq(q)| <= real_range exactly)
+INT8_RANGE = 127.0
+UINT8_RANGE = 255.0
+
+# documented estimate of a model's resident-byte ratio after weight
+# quantization, used to pre-size registry budget enforcement BEFORE
+# the first load measures exactly (biases/aux/scales stay fp, so the
+# honest ratio sits above the raw dtype ratio; measured bytes replace
+# the estimate after the first residency — serving_fleet._load)
+EST_BYTES_RATIO = {'int8': 0.30, 'bf16': 0.55}
+
+
+def _xp(a):
+    """Array module of `a` (numpy for host arrays, jax.numpy for
+    traced/jax values) — keeps one math definition for both worlds."""
+    if isinstance(a, np.ndarray) or np.isscalar(a):
+        return np
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# symmetric int8 (the reference's signed quantize mode)
+# ---------------------------------------------------------------------------
+
+def symmetric_scale(a, axis=None, percentile=None):
+    """Per-tensor (axis=None) or per-channel (axis=int) symmetric
+    dequantization scale: real_range / 127, where real_range is the
+    max-abs over the reduced axes.  A zero range (all-zero input)
+    yields scale 0.0 — quantize maps it to code 0 and dequantize
+    returns exact zeros, so the zero-range edge needs no epsilon and
+    round-trips bit-exactly.  `percentile` (e.g. 99.99) clips the
+    range at that percentile of |a| instead of the max — outliers
+    saturate to ±127 rather than widening every other value's
+    quantization step (host/np path only)."""
+    xp = _xp(a)
+    if percentile is not None and xp is np:
+        if axis is None:
+            amax = np.percentile(np.abs(a), float(percentile))
+        else:
+            red = tuple(i for i in range(a.ndim) if i != axis)
+            amax = np.percentile(np.abs(a), float(percentile), axis=red)
+        return np.asarray(amax / INT8_RANGE, np.float32)
+    if axis is None:
+        amax = xp.max(xp.abs(a))
+    else:
+        red = tuple(i for i in range(a.ndim) if i != axis)
+        amax = xp.max(xp.abs(a), axis=red)
+    return (amax / INT8_RANGE).astype(np.float32)
+
+
+def quantize_int8_math(a, scale):
+    """x -> int8 codes under symmetric `scale` (broadcastable).
+    Round-half-away-from-zero like the reference (Sign(x) *
+    Min(|x| * 127/range + 0.5, 127)), saturating at ±127."""
+    xp = _xp(a)
+    inv = xp.where(scale > 0, 1.0 / xp.where(scale > 0, scale, 1.0),
+                   0.0).astype(np.float32)
+    q = xp.sign(a) * xp.minimum(
+        xp.floor(xp.abs(a) * inv + 0.5), INT8_RANGE)
+    return q.astype(np.int8)
+
+
+def dequantize_int8_math(q, scale):
+    """int8 codes -> float32 under symmetric `scale` (np or jnp)."""
+    return q.astype(np.float32) * scale
+
+
+def quantize_int8(a, axis=None, percentile=None):
+    """(codes, scale) pair for one array; `axis` selects per-channel
+    scales (the weight convention: axis 0 = output channels);
+    `percentile` clips the range (see symmetric_scale) — outliers
+    saturate instead of widening every step."""
+    s = symmetric_scale(a, axis=axis, percentile=percentile)
+    if axis is None:
+        return quantize_int8_math(a, s), s
+    shape = [1] * a.ndim
+    shape[axis] = -1
+    sb = s.reshape(shape)
+    return quantize_int8_math(a, sb), s
+
+
+def dequantize_int8(q, scale, axis=None, dtype=np.float32):
+    """Invert quantize_int8 (scale in the same per-tensor/per-channel
+    form it returned)."""
+    if axis is not None and getattr(scale, 'ndim', 0) == 1:
+        shape = [1] * q.ndim
+        shape[axis] = -1
+        scale = scale.reshape(shape)
+    out = dequantize_int8_math(q, scale)
+    return out.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# uint8 affine (the reference's default contrib mode)
+# ---------------------------------------------------------------------------
+
+def quantize_uint8_math(a, min_range, max_range):
+    """Affine [min_range, max_range] -> [0, 255] (contrib/quantize.cc
+    semantics).  A zero range maps everything to code 0 instead of
+    dividing by zero."""
+    xp = _xp(a)
+    span = max_range - min_range
+    scale = xp.where(span > 0, UINT8_RANGE /
+                     xp.where(span > 0, span, 1.0), 0.0)
+    q = xp.clip(xp.floor((a - min_range) * scale + 0.5), 0.0,
+                UINT8_RANGE)
+    return q.astype(np.uint8)
+
+
+def dequantize_uint8_math(q, min_range, max_range):
+    scale = (max_range - min_range) / UINT8_RANGE
+    return q.astype(np.float32) * scale + min_range
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+def calibrate(batches, mode='minmax', percentile=99.99):
+    """Observed (min, max) range over a sequence of host batches
+    (np arrays, or anything np.asarray accepts).
+
+    mode='minmax'      exact observed extremes (the reference's
+                       calibration default);
+    mode='percentile'  clip outliers: the range covering `percentile`
+                       percent of the magnitude mass — robust to a few
+                       extreme activations blowing up the scale (the
+                       classic post-training-quantization fix).
+    Returns (min, max) as python floats."""
+    if mode not in ('minmax', 'percentile'):
+        raise MXNetError("calibrate: mode must be 'minmax' or "
+                         "'percentile', got %r" % (mode,))
+    batches = list(batches)
+    if not batches:
+        raise MXNetError('calibrate: no batches given')
+    if mode == 'minmax':
+        lo = min(float(np.min(np.asarray(b))) for b in batches)
+        hi = max(float(np.max(np.asarray(b))) for b in batches)
+        return lo, hi
+    flat = np.concatenate([np.asarray(b, np.float32).reshape(-1)
+                           for b in batches])
+    p = float(percentile)
+    lo = float(np.percentile(flat, 100.0 - p))
+    hi = float(np.percentile(flat, p))
+    if hi < lo:
+        lo, hi = hi, lo
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+class QuantConfig(object):
+    """Weight-quantization policy for the serving/paging arms.
+
+    dtype : 'int8' or 'bf16'
+        Storage dtype of quantized weights.  int8 carries symmetric
+        scales; bf16 is a plain cast (no scales).
+    per_channel : bool
+        int8 scales per output channel (axis 0 — the FC (hidden, in) /
+        Conv (filters, C, H, W) convention) instead of per tensor.
+        Per-channel is the accuracy default: one hot filter no longer
+        widens every other filter's quantization step.
+    min_size / min_ndim : int
+        Only arrays with >= min_size elements AND >= min_ndim dims are
+        quantized (matmul/conv weights); biases, BN gammas and other
+        small vectors stay fp — their bytes are noise and their
+        precision is not.
+    parity_tol : float
+        The engine-build parity gate (serving.py): max |fp - quant|
+        output difference, relative to the fp output's spread, that a
+        calibration batch may show before the engine REFUSES to build
+        (QuantParityError).  Relative form so logits-scale models and
+        probability-scale models gate alike.
+    calibration / percentile :
+        Range estimation for calibrate-then-requantize input
+        quantization (serving.py calibrate=).
+    """
+
+    def __init__(self, dtype='int8', per_channel=True, min_size=1024,
+                 min_ndim=2, parity_tol=0.05, calibration='minmax',
+                 percentile=99.99):
+        if dtype not in ('int8', 'bf16'):
+            raise MXNetError("QuantConfig: dtype must be 'int8' or "
+                             "'bf16', got %r" % (dtype,))
+        self.dtype = dtype
+        self.per_channel = bool(per_channel)
+        self.min_size = int(min_size)
+        self.min_ndim = int(min_ndim)
+        self.parity_tol = float(parity_tol)
+        self.calibration = calibration
+        self.percentile = float(percentile)
+
+    # env-knob spellings that mean "no quantization" (mirrors the
+    # wire knob's fp32/0 convention) — an operator disabling the
+    # fleet default must not crash every engine build
+    OFF_VALUES = ('', '0', 'off', 'none', 'fp32', 'false')
+
+    @classmethod
+    def resolve(cls, value):
+        """Normalize a user value: None -> None, a QuantConfig passes
+        through, 'int8'/'bf16' build a default config."""
+        if value is None or isinstance(value, cls):
+            return value
+        if isinstance(value, str):
+            return cls(dtype=value)
+        raise MXNetError('quantize= expects a QuantConfig or '
+                         "'int8'/'bf16', got %r" % (value,))
+
+    @classmethod
+    def from_env(cls, env='MXNET_TPU_SERVE_QUANTIZE'):
+        """The env-default config, or None when unset/disabled
+        (OFF_VALUES)."""
+        import os
+        v = os.environ.get(env, '').strip().lower()
+        if v in cls.OFF_VALUES:
+            return None
+        return cls.resolve(v)
+
+    def wants(self, shape, dtype):
+        """Should an array of (shape, dtype) be quantized under this
+        config?  Only float32 sources — a bf16 or integer parameter is
+        already narrow."""
+        size = int(np.prod(shape)) if len(shape) else 1
+        return (np.dtype(dtype) == np.float32 and
+                len(shape) >= self.min_ndim and size >= self.min_size)
+
+    def est_ratio(self):
+        """Documented resident-byte ratio estimate vs fp32 (see
+        EST_BYTES_RATIO) for budget pre-enforcement."""
+        return EST_BYTES_RATIO[self.dtype]
+
+    def key(self, names=()):
+        """Hashable identity for compiled-program cache keys: two
+        engines over the same graph with different quantization must
+        never share a serve program (the dequant math is baked in)."""
+        return ('quant', self.dtype, self.per_channel, tuple(names))
+
+    def describe(self):
+        return {'dtype': self.dtype, 'per_channel': self.per_channel,
+                'min_size': self.min_size,
+                'parity_tol': self.parity_tol}
+
+
+class QuantParityError(MXNetError):
+    """The fp-vs-quantized parity gate at engine build failed: the
+    quantized outputs diverge from the fp outputs beyond
+    QuantConfig.parity_tol on the calibration batch.  The engine is
+    NOT built — a model this sensitive to weight quantization must
+    serve fp (or recalibrate / go per-channel / raise the tol
+    deliberately)."""
+
+    def __init__(self, model, measured, tol):
+        self.measured = float(measured)
+        self.tol = float(tol)
+        super(QuantParityError, self).__init__(
+            'int8 parity gate failed for %s: relative output '
+            'difference %.4g > parity_tol %.4g on the calibration '
+            'batch — serve this model fp, or loosen '
+            'QuantConfig(parity_tol=) deliberately'
+            % (model, self.measured, self.tol))
+
+
+# ---------------------------------------------------------------------------
+# weight-dict helpers (serving + registry paging share these)
+# ---------------------------------------------------------------------------
+
+def quantize_weights(arrays, config):
+    """Split a {name: np.ndarray} dict by config.wants: returns
+    (quantized, passthrough_names) where quantized maps name ->
+    (codes, scale, orig_dtype_str); scale is None for bf16, else
+    per-tensor scalar or per-channel 1-D (axis 0) honoring the
+    config's calibration mode.  THE one weight-quantization policy —
+    the serving engine and the registry's page-out both route through
+    here, so a policy change (new dtype, channel axis, calibration)
+    lands everywhere at once.  Input arrays are host np arrays
+    (callers asnumpy first)."""
+    out = {}
+    passthrough = []
+    percentile = config.percentile \
+        if config.calibration == 'percentile' else None
+    for name, a in arrays.items():
+        a = np.asarray(a)
+        if not config.wants(a.shape, a.dtype):
+            passthrough.append(name)
+            continue
+        if config.dtype == 'bf16':
+            import ml_dtypes
+            out[name] = (a.astype(ml_dtypes.bfloat16), None,
+                         np.dtype(a.dtype).str)
+        else:
+            axis = 0 if config.per_channel else None
+            q, s = quantize_int8(a, axis=axis, percentile=percentile)
+            out[name] = (q, s, np.dtype(a.dtype).str)
+    return out, passthrough
+
+
+def dequantize_weight(q, scale, config, dtype=np.float32):
+    """Invert one quantize_weights entry back to a host fp array."""
+    if config.dtype == 'bf16':
+        return np.asarray(q).astype(dtype)
+    axis = 0 if config.per_channel else None
+    return dequantize_int8(np.asarray(q), np.asarray(scale),
+                           axis=axis, dtype=dtype)
+
+
+def quantized_nbytes(quantized, passthrough_arrays=()):
+    """Byte footprint of a quantize_weights result (codes + scales),
+    plus any passthrough arrays — the honest unit the registry budget
+    accounts for a paged/quantized model."""
+    total = 0
+    for q, s, _dt in quantized.values():
+        total += q.nbytes + (0 if s is None else np.asarray(s).nbytes)
+    for a in passthrough_arrays:
+        total += int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+    return total
+
+
+# ---------------------------------------------------------------------------
+# collective wire format (dist.allreduce int8/bf16 buckets)
+# ---------------------------------------------------------------------------
+
+WIRE_DTYPES = ('fp32', 'bf16', 'int8')
+
+
+def wire_dtype_from_env(explicit=None, env='MXNET_TPU_DIST_WIRE_DTYPE'):
+    """Resolve a wire dtype: explicit API value wins, else the env
+    knob, else fp32 (identity)."""
+    import os
+    v = explicit if explicit is not None else \
+        os.environ.get(env, '').strip().lower()
+    if v in ('', 'fp32', 'float32', '0'):
+        return 'fp32'
+    if v in ('bf16', 'bfloat16'):
+        return 'bf16'
+    if v in ('int8', 'i8'):
+        return 'int8'
+    raise MXNetError('wire dtype must be fp32/bf16/int8, got %r' % (v,))
+
+
+class WireCodec(object):
+    """Stateful encoder for one logical allreduce stream (one `name`):
+    packs a list of float arrays into compressed wire payloads with
+    per-BUCKET scales, carrying the quantization error forward as an
+    error-feedback residual (EF-SGD, Seide et al. 2014; Karimireddy et
+    al. 2019): the error made compressing step t's contribution is
+    added to step t+1's before compressing, so the bias cancels over
+    steps instead of accumulating in the model.
+
+    One bucket == one array of the stream (the kvstore batches every
+    key's gradient into one round, so the arrays ARE the buckets; a
+    caller that pre-concatenates gets one scale per flat bucket).
+    Residual state is keyed positionally and RESETS when the stream's
+    shapes change (a rebound model is a new stream).
+
+    int8:  payload int8 codes + one float32 scale per bucket (wire
+           bytes ~1/4 of fp32 + 4 per bucket).
+    bf16:  plain cast, no scales (~1/2), residual still carried.
+    fp32:  identity (no residual, no scales).
+    """
+
+    def __init__(self, wire='int8', error_feedback=True):
+        if wire not in WIRE_DTYPES:
+            raise MXNetError('WireCodec: wire must be one of %s'
+                             % (WIRE_DTYPES,))
+        self.wire = wire
+        self.error_feedback = bool(error_feedback) and wire != 'fp32'
+        self._residual = None
+        self._shapes = None
+        # per-STREAM lock: encode mutates the residual, so concurrent
+        # callers of one stream serialize — but two different streams
+        # (two codecs) never contend on a shared lock
+        import threading
+        self.lock = threading.Lock()
+
+    def _reset_if_changed(self, arrays):
+        shapes = tuple((tuple(a.shape), np.dtype(a.dtype).str)
+                       for a in arrays)
+        if shapes != self._shapes:
+            self._shapes = shapes
+            self._residual = [np.zeros(a.shape, np.float32)
+                              for a in arrays] \
+                if self.error_feedback else None
+
+    def encode(self, arrays):
+        """arrays (list of np float arrays) -> (payloads, scales).
+        payloads is the list to put on the wire; scales is a float32
+        np vector (one per bucket; empty for bf16/fp32).  Mutates the
+        residual state."""
+        arrays = [np.asarray(a) for a in arrays]
+        if self.wire == 'fp32':
+            return arrays, np.zeros((0,), np.float32)
+        self._reset_if_changed(arrays)
+        payloads, scales = [], []
+        for i, a in enumerate(arrays):
+            x = a.astype(np.float32)
+            if self.error_feedback:
+                x = x + self._residual[i]
+            if self.wire == 'bf16':
+                import ml_dtypes
+                q = x.astype(ml_dtypes.bfloat16)
+                deq = q.astype(np.float32)
+            else:
+                s = symmetric_scale(x)
+                q = quantize_int8_math(x, s)
+                deq = dequantize_int8_math(q, s)
+                scales.append(float(s))
+            if self.error_feedback:
+                self._residual[i] = x - deq
+            payloads.append(q)
+        return payloads, np.asarray(scales, np.float32)
+
+    def decode(self, payloads, scales, dtypes):
+        """Invert encode (scales as produced by the peer; `dtypes` the
+        original array dtypes to cast back to)."""
+        if self.wire == 'fp32':
+            return [np.asarray(p) for p in payloads]
+        out = []
+        for i, p in enumerate(payloads):
+            p = np.asarray(p)
+            if self.wire == 'bf16':
+                v = p.astype(np.float32)
+            else:
+                v = dequantize_int8_math(p, np.float32(scales[i]))
+            out.append(v.astype(dtypes[i]))
+        return out
+
+    def residual_norm(self):
+        """L2 norm of the carried residual (0.0 before traffic or for
+        fp32) — the profiler's quant_error_feedback_norm gauge."""
+        if not self._residual:
+            return 0.0
+        return float(np.sqrt(sum(float(np.vdot(r, r))
+                                 for r in self._residual)))
+
+    @staticmethod
+    def wire_nbytes(payloads, scales):
+        return sum(np.asarray(p).nbytes for p in payloads) + \
+            np.asarray(scales).nbytes
+
+    @staticmethod
+    def fp32_nbytes(arrays):
+        return sum(int(np.prod(a.shape)) * 4 for a in arrays)

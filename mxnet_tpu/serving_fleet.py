@@ -51,6 +51,10 @@ Env knobs (docs/SERVING.md has the full table):
   MXNET_TPU_SERVE_MAX_QUEUE_ROWS   hard backlog cap per model (4096)
   MXNET_TPU_SERVE_HTTP_INFLIGHT    bounded HTTP admission (64)
   MXNET_TPU_SERVE_HTTP_PORT        default front port (8000)
+  MXNET_TPU_SERVE_QUANTIZE         default engine weight quantization
+                                   ('int8'/'bf16'; see serving.py)
+  MXNET_TPU_SERVE_PAGED_BYTES      host budget for page_dtype images
+                                   (0 = unbounded)
 """
 import json
 import os
@@ -62,7 +66,9 @@ import numpy as np
 
 from . import exec_cache
 from . import profiler
+from . import quantization
 from .base import MXNetError
+from .quantization import QuantConfig
 from .serving import InferenceEngine, _env_int
 
 __all__ = ['Overloaded', 'BudgetExceeded', 'SLO', 'ModelRegistry',
@@ -193,15 +199,20 @@ class SLO(object):
 class _ModelEntry(object):
     __slots__ = ('name', 'loader', 'slo', 'engine_kwargs', 'pinned',
                  'lock', 'engine', 'holder', 'bytes', 'last_used',
-                 'est_bytes', 'dead')
+                 'est_bytes', 'dead', 'quantize', 'page_dtype',
+                 'paged', 'paged_bytes')
 
     def __init__(self, name, loader, slo, engine_kwargs, pinned,
-                 est_bytes=None):
+                 est_bytes=None, quantize=None, page_dtype=None):
         self.name = name
         self.loader = loader
         self.slo = slo
         self.engine_kwargs = engine_kwargs
         self.pinned = pinned
+        self.quantize = quantize        # QuantConfig (live int8 engine)
+        self.page_dtype = page_dtype    # QuantConfig (evicted image)
+        self.paged = None               # quantized host weight image
+        self.paged_bytes = 0
         self.lock = threading.Lock()    # serializes load vs evict
         self.engine = None              # engine-like (resident only)
         self.holder = None              # the Predictor (weight owner)
@@ -274,15 +285,20 @@ class ModelRegistry(object):
         self._peak_resident_bytes = 0   # high-water mark: with known
                                         # estimates the pre-load
                                         # enforcement keeps it <= budget
+        self._paged_bytes = 0           # host bytes held by quantized
+                                        # page-out images (page_dtype)
         self._n_loads = 0
         self._n_evictions = 0
         self._n_shed = 0
+        self._n_page_ins = 0
+        self._n_page_drops = 0
         self._closed = False
 
     # -- registration ---------------------------------------------------
     def register(self, name, loader=None, prefix=None, epoch=0,
                  input_shapes=None, source=None, slo=None,
-                 est_bytes=None, **engine_kwargs):
+                 est_bytes=None, quantize=None, page_dtype=None,
+                 **engine_kwargs):
         """Register a model spec (nothing loads until first use).
         Exactly one of `loader` / `prefix` / `source`.  `engine_kwargs`
         forward to InferenceEngine (max_batch, batch_buckets,
@@ -290,11 +306,55 @@ class ModelRegistry(object):
         deadline-derived hold instead of the global knob.  `est_bytes`
         pre-sizes the model for budget enforcement BEFORE its first
         load (prefix= models default to the checkpoint param-file
-        size); after the first load the measured bytes take over."""
+        size).  est_bytes is the FP32-EQUIVALENT size: with quantize=
+        it is scaled by the documented EST_BYTES_RATIO before
+        enforcement.  After the first load the measured bytes take
+        over.
+
+        `quantize` (QuantConfig or 'int8'/'bf16') serves the model
+        through a weight-quantized engine: its RESIDENT bytes drop
+        ~4x (int8), so the byte-budgeted LRU fits that many more
+        models live — the pre-load estimate is scaled by the
+        documented quantization.EST_BYTES_RATIO so strict-budget
+        enforcement and the peak_resident_bytes gauge account the
+        QUANTIZED representation, not the fp32 param-file size, and
+        the first load's measured bytes take over exactly.
+
+        `page_dtype` ('int8'/'bf16' or a QuantConfig; prefix= models
+        only, and exclusive with `quantize`) keeps a HOST-side
+        quantized weight image when the model is paged out: page-in
+        dequantizes from the image instead of re-reading the
+        checkpoint, still at zero XLA compiles (programs bind
+        run_graph, not weight buffers).  Image bytes are tracked in
+        stats()['paged_bytes'] and bounded by
+        MXNET_TPU_SERVE_PAGED_BYTES (0 = unbounded): over it, the
+        oldest images drop and those models page in from disk
+        again."""
         given = [x is not None for x in (loader, prefix, source)]
         if sum(given) != 1:
             raise MXNetError('register(%r): exactly one of loader= / '
                              'prefix= / source= required' % name)
+        quantize = QuantConfig.resolve(quantize)
+        page_dtype = QuantConfig.resolve(page_dtype)
+        if quantize is None and page_dtype is None:
+            # resolve the fleet-wide env default HERE, not engine-side:
+            # the exclusivity guard, the est_bytes scaling, and the
+            # stats()/gauge attribution below must all see it — an
+            # engine-side-only resolution would silently int8-swap a
+            # page_dtype model's holder weights out from under the
+            # page-out snapshot
+            quantize = QuantConfig.from_env()
+        if page_dtype is not None:
+            if prefix is None:
+                raise MXNetError(
+                    'register(%r): page_dtype= needs a prefix= model '
+                    '(page-in rebuilds from the checkpoint symbol + '
+                    'input shapes)' % name)
+            if quantize is not None:
+                raise MXNetError(
+                    'register(%r): page_dtype= and quantize= are '
+                    'exclusive — a quantize= engine is already its '
+                    'own compressed representation' % name)
         pinned = False
         if prefix is not None:
             if input_shapes is None:
@@ -322,9 +382,24 @@ class ModelRegistry(object):
 
             def loader(_src=source):
                 return _src
+        if est_bytes is not None and quantize is not None:
+            # est_bytes is the FP32-EQUIVALENT size (param file or
+            # caller estimate); the model will be RESIDENT in its
+            # quantized form, so pre-enforcing the budget against the
+            # fp32 number would evict colder tenants (or 507 under
+            # the strict knob) for ~4x the bytes the load takes —
+            # applied uniformly to prefix-file AND caller estimates;
+            # the first load's measured bytes replace it exactly
+            est_bytes = max(1, int(est_bytes * quantize.est_ratio()))
+        # quantize=False is the engine's explicit OFF: a page_dtype
+        # model must not be env-quantized behind the registry's back
+        engine_kwargs = dict(engine_kwargs,
+                             quantize=quantize if quantize is not None
+                             else False)
         entry = _ModelEntry(name, loader, slo or SLO(),
                             dict(engine_kwargs), pinned,
-                            est_bytes=est_bytes)
+                            est_bytes=est_bytes, quantize=quantize,
+                            page_dtype=page_dtype)
         with self._lock:
             if self._closed:
                 raise MXNetError('ModelRegistry is closed')
@@ -383,7 +458,9 @@ class ModelRegistry(object):
                                  % ent.name)
             if ent.engine is not None and not ent.engine.closed:
                 return ent.engine
-            obj = ent.loader()
+            obj = self._page_in(ent)    # quantized host image, if any
+            if obj is None:
+                obj = ent.loader()
             if hasattr(obj, 'infer'):   # engine-like (ContinuousEngine
                 eng, holder = obj, obj  # or a pre-built engine)
                 nbytes = int(obj.resident_bytes()) \
@@ -396,7 +473,12 @@ class ModelRegistry(object):
                         kwargs['max_wait_us'] = w
                 eng = InferenceEngine(obj, **kwargs)
                 holder = obj
-                nbytes = _weight_bytes(obj._executor)
+                # the engine's own accounting: excludes input staging
+                # and counts a quantize= engine's int8 codes + scales
+                # — the HONEST unit the budget/peak gauge enforce
+                nbytes = eng.resident_bytes() \
+                    if hasattr(eng, 'resident_bytes') else \
+                    _weight_bytes(obj._executor)
             ent.engine, ent.holder, ent.bytes = eng, holder, nbytes
             ent.est_bytes = nbytes or ent.est_bytes
             with self._lock:
@@ -406,6 +488,7 @@ class ModelRegistry(object):
                 self._n_loads += 1
             profiler.add_fleet_stats(
                 loads=1, resident_bytes=self._resident_bytes)
+            self._note_quant_gauges()
         # budget enforcement after the load backstops the estimate
         # (the measured bytes may exceed it, or no estimate existed):
         # colder models are paged out immediately (never the one just
@@ -498,11 +581,18 @@ class ModelRegistry(object):
         """Page one model out: reject-new + drain its engine (close),
         drop the weight holder, free the byte ledger.  The compiled
         rung programs stay in exec_cache (host-side graph code, no
-        weight buffers) so a later re-warm compiles nothing."""
+        weight buffers) so a later re-warm compiles nothing.  With
+        page_dtype a quantized HOST image of the weights is kept so
+        the next page-in skips the checkpoint read entirely."""
         with ent.lock:
             eng = ent.engine
             if eng is None:
                 return
+            image = None
+            if ent.page_dtype is not None and not ent.pinned and \
+                    not ent.dead and not self._closed and \
+                    hasattr(ent.holder, '_symbol'):
+                image = self._page_out(ent)
             eng.close()
             ent.engine = None
             ent.holder = None
@@ -510,8 +600,110 @@ class ModelRegistry(object):
             with self._lock:
                 self._resident_bytes -= freed
                 self._n_evictions += 1
+            if image is not None:
+                self._store_page(ent, image)
             profiler.add_fleet_stats(
                 evictions=1, resident_bytes=self._resident_bytes)
+            self._note_quant_gauges()
+
+    # -- quantized page-out images (page_dtype=) ------------------------
+    def _page_out(self, ent):
+        """Snapshot the holder Predictor's weights as a quantized host
+        image (called under ent.lock, before the engine closes).
+        Never raises — a model that cannot be imaged just pages in
+        from disk like before."""
+        try:
+            holder = ent.holder
+            ex = holder._executor
+            input_names = set(holder._input_names)
+            shapes = {n: tuple(ex.arg_dict[n].shape)
+                      for n in holder._input_names}
+            args = {n: a.asnumpy() for n, a in ex.arg_dict.items()
+                    if n not in input_names}
+            aux = {n: a.asnumpy() for n, a in ex.aux_dict.items()}
+            quantized, passthrough = quantization.quantize_weights(
+                args, ent.page_dtype)
+            keep = {n: args[n] for n in passthrough}
+            nbytes = quantization.quantized_nbytes(
+                quantized, list(keep.values()) + list(aux.values()))
+            return {'symbol': holder._symbol, 'shapes': shapes,
+                    'quantized': quantized, 'passthrough': keep,
+                    'aux': aux, 'nbytes': nbytes}
+        except Exception as e:          # pragma: no cover - safety net
+            import warnings
+            warnings.warn('page_dtype image of %r failed (%s); will '
+                          'page in from the checkpoint instead'
+                          % (ent.name, e))
+            return None
+
+    def _store_page(self, ent, image):
+        """Commit an image to the host page store, dropping the
+        OLDEST other images past MXNET_TPU_SERVE_PAGED_BYTES."""
+        with self._lock:
+            ent.paged = image
+            ent.paged_bytes = int(image['nbytes'])
+            self._paged_bytes += ent.paged_bytes
+            budget = _env_int('MXNET_TPU_SERVE_PAGED_BYTES', 0)
+            if budget > 0:
+                victims = sorted(
+                    (e for e in self._entries.values()
+                     if e.paged is not None and e is not ent),
+                    key=lambda e: e.last_used)
+                while self._paged_bytes > budget and victims:
+                    v = victims.pop(0)
+                    self._paged_bytes -= v.paged_bytes
+                    v.paged, v.paged_bytes = None, 0
+                    self._n_page_drops += 1
+                if self._paged_bytes > budget:
+                    self._paged_bytes -= ent.paged_bytes
+                    ent.paged, ent.paged_bytes = None, 0
+                    self._n_page_drops += 1
+
+    def _page_in(self, ent):
+        """Rebuild a Predictor from the entry's quantized host image
+        (dequantize-on-page-in: no checkpoint read; the rung programs
+        are still warm in exec_cache, so the whole page-in performs
+        zero XLA compiles).  Consumes the image.  Returns None when
+        there is none (or the rebuild fails — loader fallback)."""
+        with self._lock:
+            image, ent.paged = ent.paged, None
+            self._paged_bytes -= ent.paged_bytes
+            ent.paged_bytes = 0
+        if image is None:
+            return None
+        try:
+            from . import ndarray as nd
+            from .predictor import Predictor
+            cfg = ent.page_dtype
+            args = {n: nd.array(quantization.dequantize_weight(
+                        q, s, cfg, dtype=np.dtype(dt)))
+                    for n, (q, s, dt) in image['quantized'].items()}
+            for n, a in image['passthrough'].items():
+                args[n] = nd.array(a)
+            aux = {n: nd.array(a) for n, a in image['aux'].items()}
+            pred = Predictor(symbol=image['symbol'], arg_params=args,
+                             aux_params=aux,
+                             input_shapes=image['shapes'],
+                             ctx=self._ctx)
+            with self._lock:
+                self._n_page_ins += 1
+            profiler.add_quant_stats(page_ins=1)
+            self._note_quant_gauges()
+            return pred
+        except Exception as e:          # pragma: no cover - safety net
+            import warnings
+            warnings.warn('page-in of %r from its quantized image '
+                          'failed (%s); falling back to the loader'
+                          % (ent.name, e))
+            return None
+
+    def _note_quant_gauges(self):
+        with self._lock:
+            n = sum(1 for e in self._entries.values()
+                    if e.engine is not None and not e.engine.closed and
+                    getattr(e.engine, '_quant_live', False))
+            pb = self._paged_bytes
+        profiler.add_quant_stats(models_resident=n, paged_bytes=pb)
 
     def evict(self, name):
         """Manually page a model out (no-op when not resident).
@@ -542,6 +734,11 @@ class ModelRegistry(object):
         with ent.lock:                  # serialize with an in-flight
             ent.dead = True             # _load: it must not resurrect
         self._evict_one(ent)            # an unreachable engine
+        with self._lock:                # and drop any page-out image
+            if ent.paged is not None:
+                self._paged_bytes -= ent.paged_bytes
+                ent.paged, ent.paged_bytes = None, 0
+        self._note_quant_gauges()
         return self
 
     # -- serving --------------------------------------------------------
@@ -631,10 +828,13 @@ class ModelRegistry(object):
                 'budget_bytes': self.budget_bytes,
                 'resident_bytes': self._resident_bytes,
                 'peak_resident_bytes': self._peak_resident_bytes,
+                'paged_bytes': self._paged_bytes,
                 'strict_budget': _strict_budget(),
                 'loads': self._n_loads,
                 'evictions': self._n_evictions,
                 'shed_requests': self._n_shed,
+                'page_ins': self._n_page_ins,
+                'page_drops': self._n_page_drops,
             }
         models = {}
         for ent in entries:
@@ -642,6 +842,12 @@ class ModelRegistry(object):
             m = {'resident': eng is not None and not eng.closed,
                  'pinned': ent.pinned,
                  'bytes': ent.bytes}
+            if ent.quantize is not None:
+                m['quantize'] = ent.quantize.describe()
+            if ent.page_dtype is not None:
+                m['page_dtype'] = ent.page_dtype.dtype
+                m['paged'] = ent.paged is not None
+                m['paged_bytes'] = ent.paged_bytes
             m.update(ent.slo.describe())
             if m['resident'] and hasattr(eng, 'stats'):
                 m['engine'] = eng.stats()

@@ -531,3 +531,85 @@ def test_op_conformance(op_name):
             np.testing.assert_allclose(
                 got, o32, rtol=0.06, atol=0.06,
                 err_msg='%s: bf16 vs f32 forward diverged' % op_name)
+
+
+# ---------------------------------------------------------------------------
+# contrib quantize/dequantize: the signed int8 mode's edge semantics
+# (reference contrib/quantize-inl.h — symmetric ±max(|min|,|max|) onto
+# ±127, round half away from zero, code -128 never produced) and the
+# zero-range guard both modes share (PERF round 17 satellite)
+# ---------------------------------------------------------------------------
+
+def _run_quantize(data, lo, hi, **attrs):
+    d = sym.Variable('data')
+    mn = sym.Variable('mn')
+    mx_ = sym.Variable('mx')
+    net = sym.quantize(d, mn, mx_, **attrs)
+    ex = net.simple_bind(mx.cpu(), grad_req='null',
+                         data=data.shape, mn=(1,), mx=(1,))
+    ex.forward(is_train=False, data=data,
+               mn=np.asarray([lo], np.float32),
+               mx=np.asarray([hi], np.float32))
+    return [o.asnumpy() for o in ex.outputs]
+
+
+def _run_dequantize(q, lo, hi):
+    d = sym.Variable('data')
+    mn = sym.Variable('mn')
+    mx_ = sym.Variable('mx')
+    net = sym.dequantize(d, mn, mx_)
+    ex = net.simple_bind(mx.cpu(), grad_req='null',
+                         data=q.shape, mn=(1,), mx=(1,),
+                         type_dict={'data': q.dtype})
+    ex.forward(is_train=False, data=q,
+               mn=np.asarray([lo], np.float32),
+               mx=np.asarray([hi], np.float32))
+    return ex.outputs[0].asnumpy()
+
+
+def test_quantize_int8_symmetric_edges():
+    # exact ±range lands on ±127; the asymmetric min widens nothing
+    data = np.array([[2.0, -2.0, 1.0, -1.0, 0.0, 1.999]], np.float32)
+    q, mn, mx_ = _run_quantize(data, -1.0, 2.0, out_type='int8')
+    assert q.dtype == np.int8
+    np.testing.assert_array_equal(
+        q[0], [127, -127, 64, -64, 0, 127])   # 1.999*127/2 -> 126.9 + .5
+    # symmetric range reported: ∓max(|min|,|max|)
+    assert mn[0] == -2.0 and mx_[0] == 2.0
+    # beyond-range inputs SATURATE at ±127 (never wrap to -128)
+    wild = np.array([[50.0, -50.0]], np.float32)
+    q, _, _ = _run_quantize(wild, -1.0, 1.0, out_type='int8')
+    np.testing.assert_array_equal(q[0], [127, -127])
+
+
+def test_quantize_int8_rounding_half_away_from_zero():
+    # codes at exactly x.5 round AWAY from zero (reference std::round),
+    # not to even: 0.5/127ths -> 1, -0.5/127ths -> -1
+    step = 1.0 / 127.0
+    data = np.array([[0.5 * step, -0.5 * step, 1.5 * step]], np.float32)
+    q, _, _ = _run_quantize(data, -1.0, 1.0, out_type='int8')
+    np.testing.assert_array_equal(q[0], [1, -1, 2])
+
+
+def test_quantize_zero_range_inputs():
+    # min == max == 0 (an all-zero tensor's calibrated range): both
+    # modes map to code 0 and dequantize back to exact zeros — no
+    # division by zero, no NaNs
+    zeros = np.zeros((2, 3), np.float32)
+    for out_type in ('uint8', 'int8'):
+        q, mn, mx_ = _run_quantize(zeros, 0.0, 0.0, out_type=out_type)
+        assert np.isfinite(q.astype(np.float32)).all()
+        np.testing.assert_array_equal(q, np.zeros((2, 3)))
+        back = _run_dequantize(q, float(mn[0]), float(mx_[0]))
+        np.testing.assert_array_equal(back, zeros)
+
+
+def test_quantize_int8_round_trip():
+    # quantize -> dequantize round trip error bounded by half a step
+    rng = np.random.RandomState(7)
+    data = rng.uniform(-3, 3, (4, 5)).astype(np.float32)
+    q, mn, mx_ = _run_quantize(data, float(data.min()),
+                               float(data.max()), out_type='int8')
+    back = _run_dequantize(q, float(mn[0]), float(mx_[0]))
+    step = max(abs(data.min()), abs(data.max())) / 127.0
+    assert np.abs(back - data).max() <= step / 2 + 1e-7
