@@ -55,6 +55,9 @@ BENCH_INT8=1 (low-precision stack A/B: fp vs int8 serving with parity
     gate + quantized-registry residency/thrash, and the 2-worker
     allreduce wire-format A/B with loss-curve parity and per-mode
     determinism; BENCH_INT8_* knobs),
+BENCH_LOOP=1 (diurnal autoscale drill: open-loop diurnal trace through
+    a real autoscaling localhost fleet — scale-up lag, scale-down flap
+    count, peak shed rate; see loop_bench() for the BENCH_LOOP_* knobs),
 BENCH_CKPT=1 (elastic-checkpoint overhead A/B: no-checkpoint vs
 async cadence vs blocking cadence, ckpt_* counters + bit-parity
 gate — see ckpt_bench() for the BENCH_CKPT_* knobs),
@@ -1881,6 +1884,218 @@ def fleet_supervisor_bench():
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def loop_bench():
+    """BENCH_LOOP=1 (tools/bench_family.py --loop): the diurnal
+    autoscale drill (ISSUE-14 / PERF round 18) — replay an OPEN-LOOP
+    diurnal request trace through a REAL localhost fleet under
+    ScalePolicy autoscaling, measuring what the tier-1 synthetic
+    ScalePolicy tests cannot:
+
+      * **scale-up lag** — seconds from load onset (morning-ramp
+        start) to the first live-replica increase, paid in real
+        replica boot time (subprocess spawn + model warm);
+      * **scale-down flap count** — direction changes of the
+        live-replica timeline beyond the ideal one-up-one-down cycle
+        (the hysteresis knobs exist to keep this 0);
+      * **peak shed rate** — the fraction of peak-phase requests
+        answered 429/503/transport-failure.  Open loop: requests fire
+        on schedule regardless of completion — the arrival process
+        does not slow down because the fleet is saturated, which is
+        exactly what makes shedding measurable.
+
+    Trace: night (base rps) -> morning ramp (base->peak) -> midday
+    peak -> evening ramp (peak->base) -> night (idle, so the
+    scale-down path runs).  Knobs: BENCH_LOOP_BASE_RPS (3),
+    BENCH_LOOP_PEAK_RPS (40), BENCH_LOOP_PHASE_S (8; peak runs 1.5x,
+    final night 2x), BENCH_LOOP_REPLICAS (1 initial; max 3),
+    BENCH_LOOP_POOL (24 client threads).
+    """
+    import shutil
+    import threading
+    from queue import Queue, Empty
+
+    from mxnet_tpu import nd
+    from mxnet_tpu import model as model_mod
+    from mxnet_tpu import fleet_supervisor as fsup
+    from mxnet_tpu.fleet_supervisor import FleetSupervisor, ScalePolicy
+
+    sys.setswitchinterval(0.001)
+    base_rps = float(os.environ.get('BENCH_LOOP_BASE_RPS', 3))
+    peak_rps = float(os.environ.get('BENCH_LOOP_PEAK_RPS', 40))
+    phase_s = float(os.environ.get('BENCH_LOOP_PHASE_S', 8))
+    replicas = int(os.environ.get('BENCH_LOOP_REPLICAS', 1))
+    pool_n = int(os.environ.get('BENCH_LOOP_POOL', 24))
+    dim, hidden, out_dim = 32, 32, 8
+    rng = np.random.RandomState(7)
+
+    tmp = tempfile.mkdtemp(prefix='mxnet_tpu_loop_')
+    sup = None
+    try:
+        net = _serve_symbol(hidden, out_dim, dim)
+        import mxnet_tpu as mx
+        probe = net.simple_bind(mx.cpu(), grad_req='null',
+                                data=(1, dim))
+        args = {k: nd.array(rng.randn(*v.shape).astype(np.float32)
+                            * .1)
+                for k, v in probe.arg_dict.items() if k != 'data'}
+        prefix = os.path.join(tmp, 'diurnal_m')
+        model_mod.save_checkpoint(prefix, 0, net, args, {})
+
+        os.environ['MXNET_TPU_FLEET_HEARTBEAT_S'] = '0.25'
+        os.environ['MXNET_TPU_FLEET_DEAD_AFTER_S'] = '1.5'
+        sup = FleetSupervisor(
+            models=[{'name': 'm', 'prefix': prefix, 'epoch': 0,
+                     'input_shapes': {'data': [1, dim]},
+                     'max_batch': 8, 'max_wait_us': 0,
+                     'deadline_ms': 60}],
+            replicas=replicas, min_replicas=replicas, max_replicas=3,
+            autoscale=True,
+            scale_policy=ScalePolicy(up_after=2, down_after=8,
+                                     backlog_hot=16),
+            env={'JAX_PLATFORMS': 'cpu'})
+        t0 = time.time()
+        sup.start()
+        sup.wait_healthy()
+        boot_s = time.time() - t0
+        host, port = sup.router.address
+        x = rng.randn(1, dim).astype(np.float32).tolist()
+        payload = {'instances': x}
+
+        # live-replica timeline sampler (0.25s cadence)
+        timeline = []
+        stop_sampling = threading.Event()
+
+        def sampler():
+            while not stop_sampling.is_set():
+                timeline.append((time.monotonic(),
+                                 sup.live_replicas()))
+                stop_sampling.wait(0.25)
+
+        smp = threading.Thread(target=sampler, daemon=True)
+        smp.start()
+
+        # open-loop firing through a bounded worker pool; per-phase
+        # outcome buckets
+        results = {}            # phase -> {'ok': n, 'shed': n}
+        res_lock = threading.Lock()
+        jobs = Queue()
+        done_firing = threading.Event()
+
+        def worker():
+            while not (done_firing.is_set() and jobs.empty()):
+                try:
+                    phase = jobs.get(timeout=0.2)
+                except Empty:
+                    continue
+                try:
+                    status, _h, _b = fsup._http_json(
+                        'POST', host, port, '/v1/models/m:predict',
+                        payload, timeout=3.0)
+                    ok = status == 200
+                except Exception:
+                    ok = False
+                with res_lock:
+                    d = results.setdefault(phase,
+                                           {'ok': 0, 'shed': 0})
+                    d['ok' if ok else 'shed'] += 1
+
+        workers = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(pool_n)]
+        for w in workers:
+            w.start()
+
+        def rate_at(phase, frac):
+            if phase == 'night':
+                return base_rps
+            if phase == 'ramp_up':
+                return base_rps + frac * (peak_rps - base_rps)
+            if phase == 'peak':
+                return peak_rps
+            if phase == 'ramp_down':
+                return peak_rps - frac * (peak_rps - base_rps)
+            return 0.0                  # night2: idle -> scale-down
+
+        phases = [('night', phase_s), ('ramp_up', phase_s),
+                  ('peak', 1.5 * phase_s), ('ramp_down', phase_s),
+                  ('night2', 2.0 * phase_s)]
+        marks = {}
+        for phase, dur in phases:
+            marks[phase] = time.monotonic()
+            t_phase0 = time.monotonic()
+            while True:
+                el = time.monotonic() - t_phase0
+                if el >= dur:
+                    break
+                r = rate_at(phase, el / dur)
+                if r <= 0:
+                    time.sleep(min(0.25, dur - el))
+                    continue
+                jobs.put(phase)
+                time.sleep(1.0 / r)
+        marks['end'] = time.monotonic()
+        done_firing.set()
+        for w in workers:
+            w.join(timeout=30)
+        stop_sampling.set()
+        smp.join(timeout=5)
+
+        # scale-up lag: load onset (ramp start) -> first live increase
+        scale_up_lag = None
+        for t, n in timeline:
+            if t >= marks['ramp_up'] and n > replicas:
+                scale_up_lag = t - marks['ramp_up']
+                break
+        # flaps: direction changes of the replica-count series beyond
+        # the ideal single up-then-down cycle
+        deltas = [b[1] - a[1] for a, b in zip(timeline, timeline[1:])
+                  if b[1] != a[1]]
+        changes = 1 if deltas else 0
+        for a, b in zip(deltas, deltas[1:]):
+            if (a > 0) != (b > 0):
+                changes += 1
+        flaps = max(0, changes - 2)
+        peak = results.get('peak', {'ok': 0, 'shed': 0})
+        peak_total = peak['ok'] + peak['shed']
+        shed_rate = peak['shed'] / peak_total if peak_total else None
+        sup_stats = sup.stats()
+        max_live = max((n for _t, n in timeline), default=replicas)
+        final_live = timeline[-1][1] if timeline else replicas
+        sup.stop()
+
+        print(json.dumps({
+            'metric': 'loop_autoscale_drill',
+            'value': round(scale_up_lag, 3)
+            if scale_up_lag is not None else None,
+            'unit': 's_scale_up_lag',
+            'boot_s': round(boot_s, 3),
+            'trace': {'base_rps': base_rps, 'peak_rps': peak_rps,
+                      'phase_s': phase_s},
+            'replicas_initial': replicas,
+            'replicas_peak': max_live,
+            'replicas_final': final_live,
+            'scale_down_flaps': flaps,
+            'peak_requests': peak_total,
+            'peak_shed_rate': round(shed_rate, 4)
+            if shed_rate is not None else None,
+            'per_phase': {p: results.get(p, {'ok': 0, 'shed': 0})
+                          for p, _d in phases},
+            'retired': sup_stats['retired'],
+            'survived': bool(scale_up_lag is not None and
+                             max_live > replicas),
+        }))
+        if scale_up_lag is None or max_live <= replicas:
+            raise SystemExit('loop autoscale drill FAILED: fleet '
+                             'never scaled up under the peak '
+                             '(timeline %r)' % timeline[-10:])
+    finally:
+        if sup is not None:
+            try:
+                sup.stop()              # idempotent
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # ---------------------------------------------------------------------------
 # BENCH_INT8=1: the low-precision stack (PERF round 17) — int8 serving,
 # quantized registry residency, allreduce wire-format A/B
@@ -2236,6 +2451,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_INFER', '') == 'serve':
         serve_bench()   # dynamic-batching inference engine bench
+        return
+    if os.environ.get('BENCH_LOOP', '') == '1':
+        loop_bench()   # diurnal autoscale drill (train->serve loop)
         return
     if os.environ.get('BENCH_FLEET', '') == '1':
         if os.environ.get('BENCH_FLEET_SUPERVISOR', '') == '1':

@@ -819,11 +819,24 @@ class CheckpointManager(object):
     jax.process_index()/count().  A world > process count (virtual
     hosts) splits the local entries round-robin into per-rank files —
     the dryrun/test harness for multi-host layouts on one process.
+
+    on_commit: optional callable(step_dir, manifest) fired on the LEAD
+    rank after a checkpoint's manifest commits (from the writer thread
+    for async saves — the training thread is never blocked by the
+    hook).  This is the trainer-side half of the train->serve loop:
+    wire `fleet_supervisor.CheckpointPusher(...).attach(mgr)` and every
+    commit pushes into a live fleet as a canary; the canary VERDICT
+    flows back as a typed PushVerdict — step_end() logs each one, and
+    the pusher's consecutive-rollback stop arrives via request_stop()
+    (raised at the next step boundary, Preempted-style).  A hook that
+    raises is logged and training continues (a broken push path must
+    never take the training run down with it).  docs/ELASTIC.md has
+    the commit->push->canary->verdict state machine.
     """
 
     def __init__(self, directory, every_n_steps=None, every_n_secs=None,
                  keep=3, async_=True, rank=None, world=None,
-                 deadline=30.0):
+                 deadline=30.0, on_commit=None):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.every_n_steps = every_n_steps
@@ -864,6 +877,8 @@ class CheckpointManager(object):
         self._writer_err = None
         self._resumed = None
         self._lock = threading.Lock()
+        self.on_commit = on_commit
+        self._stop_exc = None
 
     # -- target ------------------------------------------------------------
     def attach(self, target):
@@ -940,6 +955,19 @@ class CheckpointManager(object):
         for signal-driven ones)."""
         return self._preempt_dead
 
+    def request_stop(self, reason):
+        """Ask the training loop to stop at the next step boundary —
+        the Preempted-style unwind for NON-preemption stop conditions
+        (e.g. the train->serve pusher's consecutive-rollback limit: a
+        diverging run must stop burning fleet pushes).  `reason` is
+        the exception instance step_end() will raise (e.g.
+        fleet_supervisor.RollbackStop), or a string wrapped in
+        MXNetError.  Unlike a preemption, no extra final checkpoint is
+        committed — every state this run produced is already on disk
+        (the commits are what triggered the verdicts)."""
+        self._stop_exc = reason if isinstance(reason, BaseException) \
+            else MXNetError(str(reason))
+
     # -- cadence -----------------------------------------------------------
     def _due(self):
         if self.every_n_steps is not None and \
@@ -976,6 +1004,21 @@ class CheckpointManager(object):
                             self._step, self.rank)
             os.kill(os.getpid(), signal.SIGKILL)
         samples = int(batches_in_epoch) * int(batch_size)
+        # train->serve loop feedback: verdicts the push hook collected
+        # since the last boundary surface in the TRAINING loop's log
+        # stream (ordered with its step/epoch lines) — the typed
+        # PushVerdict objects stay readable on the pusher itself
+        poll = getattr(self.on_commit, 'poll_verdicts', None)
+        if poll is not None:
+            try:
+                for v in poll():
+                    logging.log(
+                        logging.WARNING
+                        if getattr(v, 'kind', '') == 'rolled_back'
+                        else logging.INFO,
+                        'elastic: train->serve push verdict: %s', v)
+            except Exception:
+                logging.exception('elastic: verdict poll failed')
         if self._preempt.is_set():
             ckpt = self.save(epoch=epoch,
                              batches_in_epoch=batches_in_epoch,
@@ -983,6 +1026,9 @@ class CheckpointManager(object):
                              rung=rung, target=target, sync=True)
             raise Preempted(self._step, ckpt,
                             dead_ranks=self._preempt_dead)
+        if self._stop_exc is not None:
+            exc, self._stop_exc = self._stop_exc, None
+            raise exc
         if self._due():
             self.save(epoch=epoch, batches_in_epoch=batches_in_epoch,
                       batch_size=batch_size, metric=metric, rung=rung,
@@ -1243,6 +1289,21 @@ class CheckpointManager(object):
             # shared directory is pure noise (the lead also wrote the
             # manifest, so its view of "newest" is authoritative)
             self._prune()
+            hook = self.on_commit
+            if hook is not None:
+                # the train->serve push hook: fired AFTER the manifest
+                # commit (the checkpoint is durable — a push must never
+                # advertise a prefix a crash could leave torn) and only
+                # on the lead rank (one fleet push per commit, not one
+                # per rank).  Runs on the writer thread for async
+                # saves; a raising hook is contained — a broken push
+                # path must never fail the checkpoint or the run
+                try:
+                    hook(step_dir, dict(manifest))
+                except Exception:
+                    logging.exception(
+                        'elastic: on_commit hook failed for %s '
+                        '(training continues)', step_dir)
 
     def _prune(self):
         steps = list_checkpoints(self.directory)

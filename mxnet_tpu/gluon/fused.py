@@ -119,7 +119,12 @@ def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
     a SIGTERM mid-loop commits a final checkpoint and raises
     elastic.Preempted out of the fused call.  The DATA position is
     the caller's to restore (`checkpoint.last_resume.step` says how
-    many optimizer steps already ran).
+    many optimizer steps already ran).  A manager wired with an
+    on_commit push hook (fleet_supervisor.CheckpointPusher.attach)
+    additionally closes the train->serve loop: each commit pushes
+    into a live fleet as a canary, verdicts log at the next fused
+    step boundary, and N consecutive rollbacks raise RollbackStop
+    out of the fused call (docs/ELASTIC.md).
 
     pipeline: optional (num_stages, num_micro) — or None to defer to
     MXNET_TPU_PIPE='stages,micro' — switches to the dp×pipe 2D-mesh

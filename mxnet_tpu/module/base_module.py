@@ -183,7 +183,13 @@ class BaseModule:
         bit-identical to the uninterrupted run); each step feeds the
         cadence (async non-blocking snapshots); SIGTERM/SIGINT drains
         the in-flight dispatch, commits a final checkpoint and raises
-        elastic.Preempted.  See docs/ELASTIC.md."""
+        elastic.Preempted.  A manager wired with an on_commit push
+        hook (fleet_supervisor.CheckpointPusher.attach(mgr)) closes
+        the train->serve loop: every commit pushes into a live fleet
+        as a canary, the verdicts log at the next step boundary, and
+        N consecutive rollbacks raise the pusher's RollbackStop out
+        of fit — a diverging run stops burning fleet pushes.  See
+        docs/ELASTIC.md."""
         assert num_epoch is not None, 'please specify number of epochs'
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,

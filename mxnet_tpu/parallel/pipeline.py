@@ -349,6 +349,20 @@ def make_pipe_step_fn(mesh, num_stages, num_micro, stem_fn, stage_fn,
     replicated optimizer state).  `bulk`: K-step lax.scan mode (inputs
     gain a leading K axis; lr/wd arrive as (K, n) schedule rows).
 
+    Wire compression (PERF round 18 stretch): with
+    MXNET_TPU_DIST_WIRE_DTYPE=int8|bf16 set at BUILD time, the
+    replicated-mode data-axis gradient reduction rides a narrow wire —
+    int8 through collectives.quantized_allreduce (per-device scales,
+    bitwise-deterministic per mode), bf16 through a cast-psum-cast.
+    shard_map's manual axes make the per-device partials explicit, so
+    unlike the GSPMD fused paths the wire genuinely compresses here
+    (see quantized_allreduce's docstring).  The mode is baked into the
+    traced program, so the jaxpr fingerprint keys int8/bf16/fp32
+    programs separately in exec_cache.  ZeRO mode keeps its f32
+    psum_scatter (quantize is nonlinear — it cannot ride a scatter
+    that must sum in transit); the pipe-axis stem/head shares stay f32
+    (correctness shares, not the dp wire).
+
     Gradient semantics (mirrors make_pipeline_train_step): the loss
     total is masked to the last stage and NOT psum'd inside the
     differentiated function — per-device cotangent seeds of 1 plus the
@@ -365,6 +379,8 @@ def make_pipe_step_fn(mesh, num_stages, num_micro, stem_fn, stage_fn,
     (replicated mode) or the per-bucket (S, padded)-global momentum
     buffers sharded P(pipe, data) (ZeRO mode)."""
     from ..optimizer import sgd_update_math
+    from ..quantization import wire_dtype_from_env
+    from .collectives import quantized_allreduce
 
     S = int(num_stages)
     M = int(num_micro)
@@ -373,6 +389,18 @@ def make_pipe_step_fn(mesh, num_stages, num_micro, stem_fn, stage_fn,
     rescale = hyper['rescale']
     clip = hyper['clip']
     nesterov = hyper['nesterov']
+    # dp-reduction wire dtype, resolved once at build and BAKED into
+    # the traced program (the jaxpr fingerprint separates the modes)
+    wire = wire_dtype_from_env(None) if dp > 1 and layout is None \
+        else None
+
+    def dp_reduce(g):
+        if wire == 'int8':
+            return quantized_allreduce(g, data_axis)
+        if wire == 'bf16':
+            return lax.psum(g.astype(jnp.bfloat16),
+                            data_axis).astype(g.dtype)
+        return lax.psum(g, data_axis)
 
     def one_step(stage_ws, stem_ws, head_ws, opt, rng, data, label,
                  lrs, wds):
@@ -408,9 +436,9 @@ def make_pipe_step_fn(mesh, num_stages, num_micro, stem_fn, stage_fn,
         n_stem = len(stem_ws)
         if layout is None:
             smoms, stem_moms, head_moms = opt
-            g_stage = [lax.psum(g, data_axis) for g in g_stage]
-            g_stem = [lax.psum(g, data_axis) for g in g_stem]
-            g_head = [lax.psum(g, data_axis) for g in g_head]
+            g_stage = [dp_reduce(g) for g in g_stage]
+            g_stem = [dp_reduce(g) for g in g_stem]
+            g_head = [dp_reduce(g) for g in g_head]
 
             def upd(w, g, m, lr, wd):
                 return sgd_update_math(

@@ -559,6 +559,54 @@ def quant_stats():
         return dict(_QUANT)
 
 
+# train->serve loop counters (PERF round 18): the elastic on_commit ->
+# FleetSupervisor.push canary -> PushVerdict feedback pipeline
+# (fleet_supervisor.CheckpointPusher) and mid-flight sequence migration
+# across ContinuousEngine hot-swaps.  loop_pushes counts candidates that
+# reached the fleet; loop_push_failures counts pushes that raised
+# (BudgetExceeded, dead fleet, injected MXNET_TPU_FAULT_PUSH_FAIL);
+# loop_push_queue_skipped counts commits dropped because a push was
+# still in flight / the bounded queue was full (training never stalls —
+# the checkpoint-writer skip discipline).  Verdicts count by kind;
+# loop_consecutive_rollbacks is a GAUGE of the pusher's current
+# rollback streak (the divergence-stop signal).  Swap counters:
+# migrated = in-flight slots re-admitted into a replacement engine,
+# dropped = slots whose exported state was lost (replayed from t=0,
+# MXNET_TPU_FAULT_SWAP_DROP_STATE), divergent = slots migrated across a
+# MODEL change (their remaining steps run under different weights).
+_LOOP = {
+    'loop_pushes': 0,
+    'loop_push_failures': 0,
+    'loop_push_queue_skipped': 0,
+    'loop_verdicts_promoted': 0,
+    'loop_verdicts_rolled_back': 0,
+    'loop_consecutive_rollbacks': 0,    # gauge
+    'loop_swap_migrated_slots': 0,
+    'loop_swap_dropped_slots': 0,
+    'loop_swap_divergent_slots': 0,
+}
+
+
+def add_loop_stats(consecutive_rollbacks=None, **deltas):
+    """Accumulate train->serve loop counters (consecutive_rollbacks is
+    a GAUGE — set, not added; everything else adds).  Keys arrive
+    without the loop_ prefix (pushes=1, verdicts_promoted=1,
+    swap_migrated_slots=n, ...)."""
+    with _STATE['lock']:
+        for k, v in deltas.items():
+            _LOOP['loop_' + k] += int(v)
+        if consecutive_rollbacks is not None:
+            _LOOP['loop_consecutive_rollbacks'] = \
+                int(consecutive_rollbacks)
+
+
+def loop_stats():
+    """Snapshot of the train->serve loop counters (also merged into
+    summary() and dump_profile's 'loop' metadata lane)."""
+    with _STATE['lock']:
+        return dict(_LOOP)
+
+
 # self-healing fleet-supervisor counters (fleet_supervisor.FleetRouter +
 # FleetSupervisor): replica lifecycle (spawn/restart/retire + the live
 # gauge), router retry/fast-503 behavior under replica death, and
@@ -691,6 +739,8 @@ def dump_profile():
                    'args': fleet_supervisor_stats()})
     events.append({'ph': 'M', 'name': 'quant', 'pid': 0,
                    'args': quant_stats()})
+    events.append({'ph': 'M', 'name': 'loop', 'pid': 0,
+                   'args': loop_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -900,6 +950,23 @@ def summary(print_out=True):
                     qt['quant_wire_bytes_saved'],
                     qt['quant_error_feedback_norm'],
                     qt['quant_page_ins'], qt['quant_paged_bytes']))
+    lp = loop_stats()
+    lines.append('  loop_pushes=%d loop_push_failures=%d '
+                 'loop_push_queue_skipped=%d '
+                 'loop_verdicts_promoted=%d '
+                 'loop_verdicts_rolled_back=%d '
+                 'loop_consecutive_rollbacks=%d'
+                 % (lp['loop_pushes'], lp['loop_push_failures'],
+                    lp['loop_push_queue_skipped'],
+                    lp['loop_verdicts_promoted'],
+                    lp['loop_verdicts_rolled_back'],
+                    lp['loop_consecutive_rollbacks']))
+    lines.append('  loop_swap_migrated_slots=%d '
+                 'loop_swap_dropped_slots=%d '
+                 'loop_swap_divergent_slots=%d'
+                 % (lp['loop_swap_migrated_slots'],
+                    lp['loop_swap_dropped_slots'],
+                    lp['loop_swap_divergent_slots']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -951,6 +1018,8 @@ def clear():
             _FLEET_SUP[k] = 0
         for k in _QUANT:
             _QUANT[k] = type(_QUANT[k])()
+        for k in _LOOP:
+            _LOOP[k] = 0
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

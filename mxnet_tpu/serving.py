@@ -1220,3 +1220,45 @@ def _slice_out(out, off, req, prog, mirror):
                           d == h and w < h)
                       else slice(None))
     return out[tuple(sl)].copy()
+
+
+def export_serving_checkpoint(step_dir, symbol, prefix, epoch=0):
+    """Convert ONE committed elastic checkpoint dir (elastic.py's
+    step-NNNNNNNN layout: self-checksummed shard files + manifest)
+    into the reference `save_checkpoint` serving format the fleet's
+    replicas load ('<prefix>-symbol.json' + '<prefix>-%04d.params') —
+    the format bridge of the train->serve loop
+    (fleet_supervisor.CheckpointPusher exports each freshly committed
+    checkpoint through here before FleetSupervisor.push()).
+
+    Entry mapping: Module commits ('param:NAME' / 'aux:NAME') map
+    directly onto the symbol's argument/aux names; gluon commits
+    ('gparam:i:NAME' / 'gaux:i:NAME' / 'gfrozen:i:NAME') map by the
+    parameter NAME — the serving `symbol`'s argument names must match
+    the net's parameter names for that to bind.  Optimizer state, RNG
+    keys and ZeRO momentum shards are dropped: serving needs weights
+    only.  The source checkpoint validates end-to-end (checksums,
+    manifest) before anything is written.  Returns `prefix`."""
+    from .elastic import _load_one
+    from .model import save_checkpoint
+    from . import ndarray as nd
+    _manifest, arrays = _load_one(step_dir)
+    args, auxs = {}, {}
+    for key, v in arrays.items():
+        if key.startswith('param:'):
+            args[key[len('param:'):]] = nd.array(np.asarray(v))
+        elif key.startswith('aux:'):
+            auxs[key[len('aux:'):]] = nd.array(np.asarray(v))
+        elif key.startswith(('gparam:', 'gaux:')):
+            kind, _i, name = key.split(':', 2)
+            dest = auxs if kind == 'gaux' else args
+            dest[name] = nd.array(np.asarray(v))
+        elif key.startswith('gfrozen:'):
+            _k, _i, name = key.split(':', 2)
+            args[name] = nd.array(np.asarray(v))
+    if not args:
+        raise MXNetError(
+            'export_serving_checkpoint: %s holds no parameter entries '
+            '(is it an elastic checkpoint dir?)' % step_dir)
+    save_checkpoint(prefix, int(epoch), symbol, args, auxs)
+    return prefix

@@ -899,7 +899,7 @@ class ModelRegistry(object):
 
 class _ContRequest(object):
     __slots__ = ('seq', 'length', 't', 'ys', 'event', 'outputs',
-                 'error', 't_enq')
+                 'error', 't_enq', 'mig_state')
 
     def __init__(self, seq):
         self.seq = seq
@@ -910,6 +910,7 @@ class _ContRequest(object):
         self.outputs = None
         self.error = None
         self.t_enq = time.perf_counter()
+        self.mig_state = None           # migrated cell state (hot-swap)
 
 
 class ContinuousEngine(object):
@@ -939,6 +940,15 @@ class ContinuousEngine(object):
     `convoy=True` is the baseline the bench A/Bs against: admission
     only into an EMPTY batch, everyone runs to the longest admitted
     length (what a naive sequence batcher does).
+
+    **Hot-swap sequence migration** (PERF round 18): `export_state()`
+    halts the tick loop at a boundary and hands every accepted
+    request — in-flight slot state + positions + partial outputs, and
+    the waiting queue — to a replacement engine's `admit_state()`, so
+    an engine swap completes all accepted sequences (bit-identical to
+    an unswapped run when the model is unchanged; counted divergence
+    when it isn't — profiler loop_swap_* counters, and the
+    MXNET_TPU_FAULT_SWAP_DROP_STATE drill for the state-loss path).
 
     Parameters
     ----------
@@ -1038,6 +1048,7 @@ class ContinuousEngine(object):
         self._queue = deque()
         self._active = [None] * self.slots
         self._closed = False
+        self._halt = False              # export_state tick-loop stop
         # engine-local counters
         self._lock = threading.Lock()
         self._ticks = 0
@@ -1141,6 +1152,131 @@ class ContinuousEngine(object):
     def resident_bytes(self):
         return _weight_bytes(self._ex)
 
+    # -- hot-swap sequence migration (PERF round 18) --------------------
+    def export_state(self, timeout=30):
+        """Halt the tick loop at a tick boundary and export EVERY
+        accepted request — in-flight slots (cell state rows + position
+        + partial outputs) and the waiting queue — for re-admission
+        into a replacement engine (`admit_state`).  This engine is
+        closed afterwards (new submits are rejected; the blocked
+        infer() callers stay blocked and are completed by the engine
+        the requests migrate INTO), so an engine hot-swap loses zero
+        accepted sequence requests.
+
+        When the model is unchanged the migrated run is BIT-IDENTICAL
+        to an unswapped one: the exported state rows are exactly the
+        post-tick device values (float round-trips host<->device are
+        bitwise), the new engine writes them into its slot buffers
+        instead of the in-graph reset, and positions/partial outputs
+        continue where they stopped.  MXNET_TPU_FAULT_SWAP_DROP_STATE
+        drops the exported slot state (the degradation drill): those
+        requests REPLAY from t=0 on re-admission — still zero lost
+        requests, paid in recomputation (loop_swap_dropped_slots)."""
+        from .elastic import fault_knob
+        with self._cond:
+            if self._closed:
+                raise MXNetError('ContinuousEngine is closed')
+            self._closed = True         # reject new submits
+            self._halt = True
+            self._cond.notify_all()
+        if self._started:
+            self._loop.join(timeout=timeout)
+            if self._loop.is_alive():
+                # the halt did not land (a wedged tick): UNDO it so
+                # the engine keeps serving its accepted requests —
+                # leaving the flags set would strand every in-flight
+                # caller blocked forever with no recovery path
+                with self._cond:
+                    self._halt = False
+                    self._closed = False
+                    self._cond.notify_all()
+                self._loop.join(timeout=1.0)
+                if not self._loop.is_alive():
+                    # the loop observed the halt in the undo window
+                    # and exited: restart it (state is intact — it
+                    # parks/resumes at tick boundaries)
+                    self._loop = threading.Thread(
+                        target=self._tick_loop,
+                        name='mxtpu-cont-batch', daemon=True)
+                    self._loop.start()
+                raise MXNetError('export_state: tick loop did not '
+                                 'halt within %ss (engine kept '
+                                 'serving; retry the swap)' % timeout)
+            self._started = False
+        drop = fault_knob('SWAP_DROP_STATE') is not None
+        states_np = [np.asarray(s) for s in self._states]
+        requests = []
+        n_dropped = 0
+        with self._cond:
+            for i, r in enumerate(self._active):
+                if r is None:
+                    continue
+                if drop:
+                    # injected state loss: replay from the start — the
+                    # request still completes (deterministic cell), at
+                    # recompute cost
+                    r.mig_state = None
+                    r.t = 0
+                    r.ys = [[] for _ in self._y_idx]
+                    n_dropped += 1
+                else:
+                    r.mig_state = {
+                        n: states_np[k][i].copy()
+                        for k, n in enumerate(self._state_names)}
+                requests.append(r)
+                self._active[i] = None
+            requests.extend(self._queue)
+            self._queue.clear()
+        if n_dropped:
+            profiler.add_loop_stats(swap_dropped_slots=n_dropped)
+        return {'requests': requests,
+                'data_shape': self._data_shape,
+                'state_names': tuple(self._state_names),
+                'n_outputs': len(self._y_idx),
+                'dropped': n_dropped}
+
+    def admit_state(self, exported, model_changed=False):
+        """Re-admit another engine's `export_state()` payload into
+        THIS engine: in-flight requests resume from their exported
+        cell state + position (their original infer() callers wake
+        when the sequences finish HERE), queued ones join the queue.
+        Admission bypasses max_queue — these requests were already
+        ACCEPTED by the fleet and must not be shed by the swap.
+
+        `model_changed=True` declares that this engine's weights
+        differ from the exporting engine's (a hot-swap promotion):
+        migrated in-flight slots finish their remaining steps under
+        the NEW weights — and in-flight slots whose state was DROPPED
+        (SWAP_DROP_STATE) replay entirely under them — so their
+        outputs diverge from an unswapped run; both are counted
+        (loop_swap_divergent_slots), never hidden.  Returns the
+        number of migrated in-flight slots."""
+        if tuple(exported['data_shape']) != self._data_shape or \
+                tuple(exported['state_names']) != \
+                tuple(self._state_names) or \
+                int(exported.get('n_outputs', len(self._y_idx))) != \
+                len(self._y_idx):
+            raise MXNetError(
+                'admit_state: incompatible engines (data_shape %r vs '
+                '%r, states %r vs %r, outputs %s vs %d)'
+                % (tuple(exported['data_shape']), self._data_shape,
+                   tuple(exported['state_names']),
+                   tuple(self._state_names),
+                   exported.get('n_outputs'), len(self._y_idx)))
+        reqs = list(exported['requests'])
+        migrated = sum(1 for r in reqs if r.mig_state is not None)
+        with self._cond:
+            if self._closed:
+                raise MXNetError('ContinuousEngine is closed')
+            self._queue.extend(reqs)
+            self._cond.notify_all()
+        profiler.add_loop_stats(
+            swap_migrated_slots=migrated,
+            swap_divergent_slots=(migrated +
+                                  int(exported.get('dropped', 0)))
+            if model_changed else 0)
+        return migrated
+
     # -- tick loop ------------------------------------------------------
     def _tick_loop(self):
         import jax
@@ -1148,9 +1284,15 @@ class ContinuousEngine(object):
         while True:
             admitted = []
             with self._cond:
-                while not self._closed and not self._queue and \
+                while not self._closed and not self._halt and \
+                        not self._queue and \
                         all(s is None for s in self._active):
                     self._cond.wait()
+                if self._halt:
+                    # export_state(): stop at the tick boundary and
+                    # leave queue + in-flight slots INTACT for the
+                    # handover (close() drains them instead)
+                    break
                 if self._closed and not self._queue and \
                         all(s is None for s in self._active):
                     break
@@ -1165,7 +1307,8 @@ class ContinuousEngine(object):
                     for i in range(self.slots):
                         if self._active[i] is None and self._queue:
                             req = self._queue.popleft()
-                            req.ys = [[] for _ in self._y_idx]
+                            if req.ys is None:
+                                req.ys = [[] for _ in self._y_idx]
                             self._active[i] = req
                             admitted.append(i)
             active = [(i, r) for i, r in enumerate(self._active)
@@ -1174,8 +1317,24 @@ class ContinuousEngine(object):
                 continue
             x = np.zeros((self.slots,) + self._data_shape, self._dtype)
             reset = np.zeros((self.slots,), np.bool_)
+            mig = []
             for i in admitted:
-                reset[i] = True
+                r = self._active[i]
+                if r is not None and r.mig_state is not None:
+                    # migrated mid-flight slot (hot-swap re-admission):
+                    # its cell state is the EXPORTED rows, not the
+                    # fresh-sequence init — written into the state
+                    # buffers below instead of the in-graph reset
+                    mig.append((i, r.mig_state))
+                    r.mig_state = None
+                else:
+                    reset[i] = True
+            if mig:
+                bufs = [np.array(s) for s in self._states]
+                for i, st in mig:
+                    for k, n in enumerate(self._state_names):
+                        bufs[k][i] = st[n]
+                self._states = tuple(jnp.asarray(b) for b in bufs)
             for i, r in active:
                 x[i] = r.seq[r.t]
             try:

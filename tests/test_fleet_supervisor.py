@@ -413,6 +413,34 @@ def test_canary_auto_promote_when_healthy(monkeypatch):
         r2.close()
 
 
+def test_canary_served_nowhere_rolls_back_and_serves_stable(
+        monkeypatch):
+    """A candidate arm that NO replica serves (its loaders all died /
+    never converged): clients still get 200s (the router falls back
+    to the stable arm per request), the all-backends-404 misses
+    accumulate as candidate failures, and the canary ROLLS BACK
+    instead of staying pending forever (which would silently wedge
+    the train->serve pusher)."""
+    monkeypatch.delenv('MXNET_TPU_FAULT_CANARY_DEGRADE_MS',
+                       raising=False)
+    monkeypatch.setenv('MXNET_TPU_FLEET_CANARY_MIN_SAMPLES', '4')
+    r1, r2, router = _two_replica_router()
+    try:
+        router.start_canary('m', 'm@ghost', frac=1.0)   # served nowhere
+        for i in range(16):
+            assert _post_router(router, seed=i).status == 200
+            if router.canary_report('m')['state'] != 'running':
+                break
+        rep = router.canary_report('m')
+        assert rep['state'] == 'rolled_back'
+        assert rep['cand_err_frac'] == 1.0
+        assert router.stable_arm('m') == 'm'
+    finally:
+        router.close()
+        r1.close()
+        r2.close()
+
+
 def test_shadow_tee_counts_divergences(monkeypatch):
     profiler.clear()
     monkeypatch.delenv('MXNET_TPU_FAULT_CANARY_DEGRADE_MS',
@@ -510,8 +538,17 @@ def test_fault_knob_parsers(monkeypatch):
     assert not fs.replica_wedged(0, 6.0)
     monkeypatch.setenv('MXNET_TPU_FAULT_CANARY_DEGRADE_MS', '80')
     assert fs.canary_degrade_ms() == 80.0
+    assert fs.canary_degrade_ms('m@v1') == 80.0    # bare MS: any arm
+    monkeypatch.setenv('MXNET_TPU_FAULT_CANARY_DEGRADE_MS', '@v1:90')
+    assert fs.canary_degrade_ms('m@v1') == 90.0
+    assert fs.canary_degrade_ms('m@v2') == 0.0     # other arms healthy
+    assert fs.canary_degrade_ms() == 0.0           # no name: no match
     monkeypatch.delenv('MXNET_TPU_FAULT_CANARY_DEGRADE_MS')
     assert fs.canary_degrade_ms() == 0.0
+    monkeypatch.setenv('MXNET_TPU_FAULT_PUSH_FAIL', '2')
+    assert fs.push_fail_n() == 2
+    monkeypatch.delenv('MXNET_TPU_FAULT_PUSH_FAIL')
+    assert fs.push_fail_n() is None
 
 
 # ---------------------------------------------------------------------------
@@ -590,6 +627,105 @@ def test_supervisor_restart_budget_abandons_slot(monkeypatch,
         assert sup.stats()['abandoned_slots'] == 1
     finally:
         sup.router.close()
+
+
+# ---------------------------------------------------------------------------
+# push vs replica death/respawn (ISSUE-14 satellite: the reconcile fix)
+# ---------------------------------------------------------------------------
+
+def _ckpt_prefix(tmp_path, tag, seed):
+    prefix = str(tmp_path / tag)
+    model_mod.save_checkpoint(prefix, 0, _mlp(), _params(seed), {})
+    return prefix
+
+
+def _push_spec(prefix):
+    return {'name': 'm', 'prefix': prefix, 'epoch': 0,
+            'input_shapes': {'data': [1, DIM]},
+            'max_batch': 4, 'max_wait_us': 0}
+
+
+def _fake_rep(index, host, port):
+    rep = fs._Replica(index)
+    rep.host, rep.port = host, port
+    return rep
+
+
+def test_push_survives_dead_replica_mid_fanout(tmp_path):
+    """A replica that died before/while the push fans out must NOT
+    abort the push: the live replicas get the candidate, the canary
+    opens, and the pending set keeps the candidate so the dead slot's
+    respawn reconciles to it.  (Previously one OSError unwound the
+    whole push.)"""
+    prefix_a = _ckpt_prefix(tmp_path, 'stable', 1)
+    prefix_b = _ckpt_prefix(tmp_path, 'cand', 2)
+    live = ReplicaServer(models=[_push_spec(prefix_a)], index=0).start()
+    sup = FleetSupervisor(models=[_push_spec(prefix_a)], replicas=2)
+    try:
+        sup._replicas = [
+            _fake_rep(0, '127.0.0.1', _refused_port()),   # dead first
+            _fake_rep(1, *live.address)]
+        cand = sup.push('m', prefix_b, epoch=0, frac=0.5)
+        assert cand in live.registry.models()
+        assert sup.push_active('m')
+        assert prefix_b in sup.active_prefixes('m')
+        rep = sup.router.canary_report('m')
+        assert rep is not None and rep['state'] == 'running'
+    finally:
+        sup.router.close()
+        live.close()
+
+
+def test_push_refused_by_live_replica_still_unwinds(tmp_path,
+                                                    monkeypatch):
+    """A REFUSAL (not a transport failure) keeps the abort semantics:
+    the fleet must never route to an arm only some replicas serve."""
+    monkeypatch.setenv('MXNET_TPU_SERVE_STRICT_BUDGET', '1')
+    prefix_a = _ckpt_prefix(tmp_path, 'stable2', 1)
+    prefix_b = _ckpt_prefix(tmp_path, 'cand2', 2)
+    live = ReplicaServer(models=[], index=0,
+                         budget_bytes=1).start()   # any load -> 507
+    sup = FleetSupervisor(models=[_push_spec(prefix_a)], replicas=1)
+    try:
+        sup._replicas = [_fake_rep(0, *live.address)]
+        with pytest.raises(MXNetError, match='refused'):
+            sup.push('m', prefix_b, epoch=0)
+        assert not sup.push_active('m')      # pending unwound
+    finally:
+        sup.router.close()
+        live.close()
+
+
+def test_respawn_reconciles_to_pushed_and_promoted_model(tmp_path):
+    """The respawn-vs-push race closer: a replica that rejoins with
+    the PRE-push arm set baked into its spawn config converges to the
+    fleet's intended model set — the pending candidate while a push is
+    judged, and the promoted arm (old stable dropped) afterwards."""
+    prefix_a = _ckpt_prefix(tmp_path, 'stable3', 1)
+    prefix_b = _ckpt_prefix(tmp_path, 'cand3', 2)
+    live = ReplicaServer(models=[_push_spec(prefix_a)], index=0).start()
+    sup = FleetSupervisor(models=[_push_spec(prefix_a)], replicas=1)
+    try:
+        sup._replicas = [_fake_rep(0, *live.address)]
+        cand = sup.push('m', prefix_b, epoch=0, frac=0.5)
+        # a "respawned" replica that booted from the pre-push config
+        rejoin = ReplicaServer(models=[_push_spec(prefix_a)],
+                               index=1).start()
+        try:
+            sup._reconcile(*rejoin.address, cfg_names=('m',))
+            assert set(rejoin.registry.models()) == {'m', cand}
+            # the push promotes: desired set flips to the candidate
+            sup._on_router_event('promote', 'm',
+                                 {'candidate': cand, 'report': None})
+            assert not sup.push_active('m')
+            assert sup.active_prefixes('m') == {prefix_b}
+            sup._reconcile(*rejoin.address, cfg_names=('m', cand))
+            assert set(rejoin.registry.models()) == {cand}
+        finally:
+            rejoin.close()
+    finally:
+        sup.router.close()
+        live.close()
 
 
 # ---------------------------------------------------------------------------

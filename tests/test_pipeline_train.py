@@ -170,6 +170,32 @@ def test_gluon_pipe_sync_params_enables_eager_eval():
         st0['total_compile_s']
 
 
+def test_gluon_pipe_int8_wire_parity_and_determinism(monkeypatch,
+                                                     baseline):
+    """MXNET_TPU_DIST_WIRE_DTYPE=int8|bf16 compresses the pipe
+    trainer's dp gradient reduction (shard_map manual axes — the one
+    fused path whose wire CAN compress in-graph).  Parity gate: the
+    quantized-wire run tracks the fp32 single-device baseline at
+    wire-noise tolerance, each mode is bitwise-deterministic across
+    runs, and the modes produce genuinely different programs."""
+    fp_net, _ = _train_gluon(_ctxs(4), pipeline=(2, 2))
+    fp_p = _pvals(fp_net)
+    monkeypatch.setenv('MXNET_TPU_DIST_WIRE_DTYPE', 'int8')
+    n1, _ = _train_gluon(_ctxs(4), pipeline=(2, 2))
+    n2, _ = _train_gluon(_ctxs(4), pipeline=(2, 2))
+    p1, p2 = _pvals(n1), _pvals(n2)
+    for a, b in zip(p1, p2):
+        np.testing.assert_array_equal(a, b)     # per-mode bitwise
+    assert not all(np.array_equal(a, b) for a, b in zip(fp_p, p1)), \
+        'int8 wire produced the fp32 program (knob not baked in?)'
+    for a, b in zip(baseline, p1):              # parity gate vs fp32
+        np.testing.assert_allclose(a, b, atol=1e-3, rtol=1e-2)
+    monkeypatch.setenv('MXNET_TPU_DIST_WIRE_DTYPE', 'bf16')
+    nb, _ = _train_gluon(_ctxs(4), pipeline=(2, 2))
+    for a, b in zip(baseline, _pvals(nb)):
+        np.testing.assert_allclose(a, b, atol=1e-3, rtol=1e-2)
+
+
 def test_gluon_pipe_env_knob(monkeypatch):
     monkeypatch.setenv('MXNET_TPU_PIPE', '2,2')
     net = _make_net(ctx=_ctxs(4))

@@ -1,0 +1,522 @@
+"""Train->serve loop tests (ISSUE 14): the elastic on_commit ->
+CheckpointPusher -> FleetSupervisor.push -> PushVerdict feedback
+pipeline, and ContinuousEngine sequence-state migration across an
+engine hot-swap.
+
+The pusher's robustness contract runs against an in-process STUB
+supervisor (scripted push behavior: accept / refuse typed / wedge
+forever) so every failure shape is exact and fast; the verdict channel
+is the same `on_push_verdict` registration the real FleetSupervisor
+serves.  The real-supervisor halves (push fan-out racing a dead
+replica, respawn reconcile) live in test_fleet_supervisor.py next to
+the raw-socket stubs; the full closed-loop drill (live 2-replica
+fleet, injected rollback, SIGKILL mid-push) is dryrun_multichip phase
+(k).
+
+ContinuousEngine migration: bit-identical completion across a swap
+when the model is unchanged, replay-from-zero under the injected
+MXNET_TPU_FAULT_SWAP_DROP_STATE, counted divergence when the model
+changed, queued-request migration, and incompatible-engine rejection.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic, model as model_mod, nd, profiler
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.fleet_supervisor import (CheckpointPusher, PushVerdict,
+                                        RollbackStop)
+from mxnet_tpu.serving import export_serving_checkpoint
+from mxnet_tpu.serving_fleet import ContinuousEngine
+from mxnet_tpu.serving_fleet import BudgetExceeded
+
+DIM, HID, OUT = 6, 8, 3
+
+
+def _head():
+    data = sym.Variable('data')
+    fc1 = sym.FullyConnected(data, num_hidden=HID, name='fc1')
+    act = sym.Activation(fc1, act_type='relu')
+    return sym.FullyConnected(act, num_hidden=OUT, name='fc2')
+
+
+def _module(seed=3):
+    net = sym.SoftmaxOutput(_head(), name='softmax')
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (4, DIM))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (4,))])
+    mx.random.seed(seed)
+    mod.init_params(initializer=mx.init.Xavier())
+    return mod
+
+
+class _StubSupervisor(object):
+    """Scripted fleet: push() accepts / raises / wedges; verdicts are
+    fired on demand through the same on_push_verdict channel the real
+    FleetSupervisor serves."""
+
+    def __init__(self, fail=None, block=None):
+        self.fail = fail                # exception each push raises
+        self.block = block              # Event a push waits on (wedge)
+        self.pushes = []                # (name, prefix, cand)
+        self._cbs = []
+        self._seq = 0
+        self._active = set()
+
+    def on_push_verdict(self, cb):
+        self._cbs.append(cb)
+        return self
+
+    def push_active(self, name):
+        return name in self._active
+
+    def active_prefixes(self, name):
+        return set()
+
+    def push(self, name, prefix, epoch=0, frac=None, mode='canary',
+             tag=None):
+        if self.block is not None:
+            self.block.wait()
+        if self.fail is not None:
+            raise self.fail
+        self._seq += 1
+        cand = '%s@v%d' % (name, self._seq)
+        self.pushes.append((name, prefix, cand))
+        self._active.add(name)
+        self.tags = getattr(self, 'tags', {})
+        self.tags[cand] = tag
+        return cand
+
+    def decide(self, kind, cand, model='m', report=None):
+        self._active.discard(model)
+        v = PushVerdict(kind, model, cand, report=report)
+        for cb in self._cbs:
+            cb(v)
+        return v
+
+
+def _wait(pred, timeout=30, msg='condition'):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError('timed out waiting for %s' % msg)
+
+
+def _mgr_with_pusher(tmp_path, sup, **pk):
+    pusher = CheckpointPusher(sup, 'm', symbol=_head(),
+                              push_dir=str(tmp_path / 'push'), **pk)
+    mgr = pusher.attach(elastic.CheckpointManager(
+        str(tmp_path / 'ck'), every_n_steps=1))
+    mgr.attach(_module())
+    return mgr, pusher
+
+
+# ---------------------------------------------------------------------------
+# commit hook + export + promote feedback
+# ---------------------------------------------------------------------------
+
+def test_on_commit_fires_after_manifest_commit(tmp_path):
+    mod = _module()
+    seen = []
+
+    def hook(step_dir, manifest):
+        # the manifest must already be DURABLE when the hook fires (a
+        # push must never advertise an uncommitted prefix)
+        assert os.path.isfile(os.path.join(step_dir, 'manifest.json'))
+        seen.append((step_dir, manifest['step']))
+
+    mgr = elastic.CheckpointManager(str(tmp_path / 'ck'),
+                                    on_commit=hook)
+    mgr.attach(mod)
+    mgr._step = 5
+    mgr.save(sync=True)
+    assert seen and seen[0][1] == 5
+    # a RAISING hook is contained: the commit (and training) survive
+    mgr.on_commit = lambda *_a: 1 / 0
+    mgr._step = 6
+    mgr.save(sync=True)
+    assert elastic.list_checkpoints(str(tmp_path / 'ck')) == [6, 5]
+    # pusher.attach CHAINS a pre-existing hook instead of dropping it
+    mgr.on_commit = hook
+    pusher = CheckpointPusher(_StubSupervisor(), 'm', symbol=_head(),
+                              push_dir=str(tmp_path / 'push'))
+    pusher.attach(mgr)
+    mgr._step = 7
+    mgr.save(sync=True)
+    assert seen[-1][1] == 7             # user hook still fired
+    _wait(lambda: len(pusher.supervisor.pushes) == 1,
+          msg='chained push')
+    pusher.close()
+    mgr.close()
+
+
+def test_pusher_promote_verdict_flows_back(tmp_path):
+    profiler.clear()
+    sup = _StubSupervisor()
+    mgr, pusher = _mgr_with_pusher(tmp_path, sup)
+    mod = mgr._target
+    mgr.step_end()                       # step 1: commit -> push
+    mgr.wait()
+    _wait(lambda: len(sup.pushes) == 1, msg='push')
+    name, prefix, cand = sup.pushes[0]
+    assert name == 'm'
+    # the exported prefix is a REAL serving checkpoint: weights equal
+    # the module's, loadable by the replica-side registry machinery
+    _s, args, _aux = model_mod.load_checkpoint(prefix, 0)
+    want, _ = mod.get_params()
+    for n in ('fc1_weight', 'fc1_bias', 'fc2_weight', 'fc2_bias'):
+        np.testing.assert_array_equal(args[n].asnumpy(),
+                                      want[n].asnumpy())
+    # the verdict flows BACK, correlated to the committing train step
+    sup.decide('promoted', cand,
+               report={'cand_p50_ms': 1.0, 'stable_p50_ms': 1.0,
+                       'cand_err_frac': 0.0})
+    _wait(lambda: pusher.last_verdict is not None, msg='verdict')
+    v = pusher.last_verdict
+    assert v.kind == 'promoted' and v.candidate == cand
+    assert v.step == 1
+    assert pusher.consecutive_rollbacks == 0
+    # step_end drains poll_verdicts into the training log stream
+    mgr.step_end()
+    assert pusher.poll_verdicts() == []  # drained by step_end
+    assert pusher.verdicts()[-1] is v    # history kept
+    st = profiler.loop_stats()
+    assert st['loop_pushes'] == 1
+    assert st['loop_verdicts_promoted'] == 1
+    pusher.close()
+    mgr.close()
+
+
+def test_export_serving_checkpoint_validates_and_serves(tmp_path):
+    mod = _module(seed=9)
+    mgr = elastic.CheckpointManager(str(tmp_path / 'ck'))
+    mgr.attach(mod)
+    mgr._step = 3
+    step_dir = mgr.save(sync=True)
+    prefix = str(tmp_path / 'serve_m')
+    export_serving_checkpoint(step_dir, _head(), prefix)
+    from mxnet_tpu.predictor import Predictor
+    _s, args, auxs = model_mod.load_checkpoint(prefix, 0)
+    pred = Predictor(symbol=_head(), arg_params=args, aux_params=auxs,
+                     input_shapes={'data': (1, DIM)})
+    x = np.random.RandomState(0).randn(1, DIM).astype(np.float32)
+    out = pred.forward(data=nd.array(x))[0].asnumpy()
+    assert out.shape == (1, OUT) and np.isfinite(out).all()
+    # a non-checkpoint dir is refused with a typed error
+    with pytest.raises(MXNetError):
+        export_serving_checkpoint(str(tmp_path), _head(),
+                                  str(tmp_path / 'bad'))
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# rollback feedback: consecutive-rollback stop
+# ---------------------------------------------------------------------------
+
+def test_consecutive_rollbacks_stop_training(tmp_path):
+    profiler.clear()
+    sup = _StubSupervisor()
+    mgr, pusher = _mgr_with_pusher(tmp_path, sup,
+                                   max_consecutive_rollbacks=3)
+    for i in range(3):
+        mgr.step_end()                  # commit -> push
+        mgr.wait()
+        _wait(lambda: len(sup.pushes) == i + 1, msg='push %d' % i)
+        sup.decide('rolled_back', sup.pushes[-1][2])
+        _wait(lambda: len(pusher.verdicts()) == i + 1, msg='verdict')
+    assert pusher.consecutive_rollbacks == 3
+    assert profiler.loop_stats()['loop_consecutive_rollbacks'] == 3
+    # the stop lands Preempted-style at the NEXT step boundary
+    with pytest.raises(RollbackStop) as ei:
+        mgr.step_end()
+    assert ei.value.model == 'm'
+    assert len(ei.value.verdicts) == 3
+    assert all(v.kind == 'rolled_back' for v in ei.value.verdicts)
+    pusher.close()
+    mgr.close()
+
+
+def test_promote_resets_rollback_streak(tmp_path):
+    sup = _StubSupervisor()
+    mgr, pusher = _mgr_with_pusher(tmp_path, sup,
+                                   max_consecutive_rollbacks=2)
+    for i, kind in enumerate(('rolled_back', 'promoted',
+                              'rolled_back')):
+        mgr.step_end()
+        mgr.wait()
+        _wait(lambda: len(sup.pushes) == i + 1, msg='push %d' % i)
+        sup.decide(kind, sup.pushes[-1][2])
+        _wait(lambda: len(pusher.verdicts()) == i + 1,
+              msg='verdict %d' % i)
+    assert pusher.consecutive_rollbacks == 1    # reset by the promote
+    mgr.step_end()                               # no stop raised
+    pusher.close()
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# degradation: wedged fleet, typed failures, fault knob
+# ---------------------------------------------------------------------------
+
+def test_wedged_fleet_never_stalls_training(tmp_path):
+    profiler.clear()
+    release = threading.Event()
+    sup = _StubSupervisor(block=release)     # push wedges forever
+    mgr, pusher = _mgr_with_pusher(tmp_path, sup)
+    t0 = time.monotonic()
+    for _ in range(6):
+        mgr.step_end()                  # cadence commit every step
+        mgr.wait()                      # all 6 commits really land
+    dt = time.monotonic() - t0
+    # six commits against a WEDGED fleet: one push blocks on its
+    # worker thread, one queues, the rest skip with a counter —
+    # nothing ever blocks the training thread
+    assert dt < 20.0, 'training stalled on a wedged fleet (%.1fs)' % dt
+    assert elastic.list_checkpoints(str(tmp_path / 'ck'))
+    _wait(lambda: profiler.loop_stats()['loop_push_queue_skipped'] >= 3,
+          msg='skip counter')
+    release.set()                       # unwedge so the worker exits
+    pusher.close()
+    mgr.close()
+
+
+def test_push_failure_is_typed_not_fatal(tmp_path):
+    profiler.clear()
+    sup = _StubSupervisor(fail=BudgetExceeded('m', 100, 10, 0))
+    mgr, pusher = _mgr_with_pusher(tmp_path, sup)
+    mgr.step_end()
+    mgr.wait()
+    _wait(lambda: pusher.last_verdict is not None, msg='failed verdict')
+    v = pusher.last_verdict
+    assert v.kind == 'failed' and v.error
+    assert pusher.consecutive_rollbacks == 0    # failures != rollbacks
+    assert profiler.loop_stats()['loop_push_failures'] == 1
+    mgr.step_end()                      # training continues
+    pusher.close()
+    mgr.close()
+
+
+def test_fault_push_fail_knob(tmp_path, monkeypatch):
+    profiler.clear()
+    monkeypatch.setenv('MXNET_TPU_FAULT_PUSH_FAIL', '2')
+    sup = _StubSupervisor()
+    mgr, pusher = _mgr_with_pusher(tmp_path, sup)
+    mgr.step_end()
+    mgr.wait()
+    _wait(lambda: len(sup.pushes) == 1, msg='push 1')
+    sup.decide('promoted', sup.pushes[-1][2])
+    mgr.step_end()
+    mgr.wait()
+    _wait(lambda: any(v.kind == 'failed' and 'PUSH_FAIL' in v.error
+                      for v in pusher.verdicts()),
+          msg='injected failure')
+    assert len(sup.pushes) == 1         # the 2nd attempt never landed
+    sup.decide('promoted', 'unused')    # noop for correlation
+    mgr.step_end()                      # 3rd attempt goes through
+    mgr.wait()
+    _wait(lambda: len(sup.pushes) == 2, msg='push 3')
+    pusher.close()
+    mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# ContinuousEngine: sequence migration across a hot-swap
+# ---------------------------------------------------------------------------
+
+CDIM, CHID, COUT = 5, 4, 2
+
+
+def _cell():
+    data = sym.Variable('data')
+    h_in = sym.Variable('h')
+    pre = sym.FullyConnected(data, num_hidden=CHID, name='ix') + \
+        sym.FullyConnected(h_in, num_hidden=CHID, no_bias=True,
+                           name='hh')
+    h_new = sym.Activation(pre, act_type='tanh')
+    head = sym.FullyConnected(h_new, num_hidden=COUT, name='out')
+    return sym.Group([head, h_new])
+
+
+def _cell_params(seed=3):
+    rs = np.random.RandomState(seed)
+    return {
+        'ix_weight': nd.array(rs.randn(CHID, CDIM).astype(np.float32)
+                              * .3),
+        'ix_bias': nd.array(np.zeros(CHID, np.float32)),
+        'hh_weight': nd.array(rs.randn(CHID, CHID).astype(np.float32)
+                              * .3),
+        'out_weight': nd.array(rs.randn(COUT, CHID).astype(np.float32)
+                               * .3),
+        'out_bias': nd.array(np.zeros(COUT, np.float32)),
+    }
+
+
+def _cont(slots=2, seed=3, **kw):
+    return ContinuousEngine(_cell(), arg_params=_cell_params(seed),
+                            data_shape=(CDIM,),
+                            state_shapes={'h': (CHID,)},
+                            state_outputs={'h': 1}, slots=slots, **kw)
+
+
+def _seqs(lens, seed=0):
+    rs = np.random.RandomState(seed)
+    return [rs.randn(L, CDIM).astype(np.float32) for L in lens]
+
+
+def _swap_run(seqs, drop=False, new_seed=3, min_ticks=4):
+    """Submit `seqs` to engine A, hot-swap mid-flight into a fresh
+    engine (seeded `new_seed`), return the completed outputs + the
+    export payload."""
+    eng_a = _cont(slots=2)
+    res = [None] * len(seqs)
+    ts = [threading.Thread(target=lambda i=i:
+                           res.__setitem__(i, eng_a.infer(seqs[i])))
+          for i in range(len(seqs))]
+    for t in ts:
+        t.start()
+    _wait(lambda: eng_a.stats()['ticks'] >= min_ticks and
+          eng_a.stats()['admitted'] >= 1, msg='mid-flight')
+    if drop:
+        os.environ['MXNET_TPU_FAULT_SWAP_DROP_STATE'] = '1'
+    try:
+        exported = eng_a.export_state()
+    finally:
+        os.environ.pop('MXNET_TPU_FAULT_SWAP_DROP_STATE', None)
+    eng_b = _cont(slots=2, seed=new_seed)
+    migrated = eng_b.admit_state(exported,
+                                 model_changed=new_seed != 3)
+    for t in ts:
+        t.join(timeout=60)
+    assert all(r is not None for r in res), 'a request was lost'
+    eng_a.close()
+    eng_b.close()
+    return res, exported, migrated
+
+
+def test_swap_mid_flight_bit_identical_same_model():
+    profiler.clear()
+    # long sequences: the export must reliably land MID-flight (a
+    # short one can finish on engine A between the tick check and the
+    # halt — the tick loop runs ~1ms/tick on this rig)
+    seqs = _seqs([400, 250], seed=4)
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, migrated = _swap_run(seqs)
+    assert migrated >= 1
+    for i in range(len(seqs)):
+        for a, b in zip(res[i], solo[i]):
+            assert np.array_equal(a, b), \
+                'sequence %d diverged across the swap' % i
+    st = profiler.loop_stats()
+    assert st['loop_swap_migrated_slots'] >= 1
+    assert st['loop_swap_divergent_slots'] == 0
+    assert st['loop_swap_dropped_slots'] == 0
+
+
+def test_swap_dropped_state_replays_and_counts():
+    profiler.clear()
+    seqs = _seqs([400], seed=7)         # long: export lands mid-flight
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, migrated = _swap_run(seqs, drop=True, min_ticks=2)
+    assert migrated == 0                # state lost: replayed instead
+    assert exported['dropped'] >= 1
+    for a, b in zip(res[0], solo[0]):   # deterministic cell: replay
+        assert np.array_equal(a, b)     # still answers correctly
+    assert profiler.loop_stats()['loop_swap_dropped_slots'] >= 1
+
+
+def test_swap_model_changed_counts_divergence():
+    profiler.clear()
+    # long sequence so the export reliably lands MID-flight (a short
+    # one can finish on engine A between the tick check and the halt)
+    seqs = _seqs([400], seed=5)
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, migrated = _swap_run(seqs, new_seed=11,
+                                        min_ticks=2)
+    assert migrated >= 1, \
+        'sequence finished before the swap (exported %d requests)' \
+        % len(exported['requests'])
+    # the migrated tail ran under DIFFERENT weights: outputs diverge
+    # from the unswapped run — visible, and counted, never hidden
+    assert not all(np.array_equal(a, b)
+                   for a, b in zip(res[0], solo[0]))
+    assert profiler.loop_stats()['loop_swap_divergent_slots'] >= 1
+
+
+def test_swap_migrates_queued_requests_too():
+    # 2 slots + 3 requests: the third waits in the queue at export
+    # time (the slots are busy with long sequences); all three
+    # complete on the new engine
+    seqs = _seqs([400, 400, 20], seed=8)
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, _m = _swap_run(seqs, min_ticks=2)
+    assert len(exported['requests']) == 3
+    for i in range(3):
+        for a, b in zip(res[i], solo[i]):
+            assert np.array_equal(a, b)
+
+
+def test_swap_rejects_incompatible_engine_and_closed_source():
+    eng_a = _cont(slots=2)
+    exported = eng_a.export_state()     # idle engine: empty payload
+    assert exported['requests'] == []
+    with pytest.raises(MXNetError, match='closed'):
+        eng_a.export_state()            # already exported/closed
+    with pytest.raises(MXNetError, match='closed'):
+        eng_a.infer(_seqs([2])[0])      # rejects new submits
+    bad = ContinuousEngine(_cell(), arg_params=_cell_params(),
+                           data_shape=(CDIM,),
+                           state_shapes={'h': (CHID,)},
+                           state_outputs={'h': 1}, slots=2,
+                           convoy=True)
+    try:
+        exported['data_shape'] = (CDIM + 1,)
+        with pytest.raises(MXNetError, match='incompatible'):
+            bad.admit_state(exported)
+    finally:
+        bad.close()
+    eng_a.close()
+
+
+# ---------------------------------------------------------------------------
+# profiler family
+# ---------------------------------------------------------------------------
+
+def test_loop_counters_in_summary_and_dump(tmp_path):
+    profiler.clear()
+    profiler.add_loop_stats(pushes=2, push_failures=1,
+                            push_queue_skipped=3, verdicts_promoted=1,
+                            verdicts_rolled_back=2,
+                            swap_migrated_slots=4,
+                            swap_dropped_slots=1,
+                            swap_divergent_slots=2,
+                            consecutive_rollbacks=2)
+    st = profiler.loop_stats()
+    assert st['loop_pushes'] == 2
+    assert st['loop_consecutive_rollbacks'] == 2    # gauge
+    profiler.add_loop_stats(consecutive_rollbacks=0)
+    assert profiler.loop_stats()['loop_consecutive_rollbacks'] == 0
+    text = profiler.summary(print_out=False)
+    for key in ('loop_pushes', 'loop_push_queue_skipped',
+                'loop_verdicts_rolled_back',
+                'loop_swap_migrated_slots'):
+        assert key in text
+    out = tmp_path / 'loop_profile.json'
+    profiler.profiler_set_config(filename=str(out))
+    profiler.dump_profile()
+    events = json.loads(out.read_text())['traceEvents']
+    meta = [e for e in events if e.get('name') == 'loop']
+    assert meta and meta[0]['args']['loop_pushes'] == 2
+    profiler.clear()
+    assert profiler.loop_stats()['loop_pushes'] == 0

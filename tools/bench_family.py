@@ -64,6 +64,13 @@ def main():
                         'batching, registry evict/re-warm zero-compile '
                         'check; one bench.py child) instead of the '
                         'model-family sweep')
+    p.add_argument('--loop', action='store_true',
+                   help='run the BENCH_LOOP diurnal autoscale drill '
+                        '(open-loop diurnal request trace through a '
+                        'real autoscaling localhost fleet: scale-up '
+                        'lag, scale-down flap count, peak shed rate; '
+                        'one bench.py child) instead of the '
+                        'model-family sweep')
     p.add_argument('--int8', action='store_true',
                    help='run the BENCH_INT8 low-precision smoke (fp '
                         'vs int8 serving throughput with parity gate '
@@ -76,13 +83,14 @@ def main():
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
     if args.gluon or args.overlap or args.bucket or args.pipe or \
-            args.ckpt or args.serve_fleet or args.int8:
+            args.ckpt or args.serve_fleet or args.int8 or args.loop:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
                      else ('bucket', 'BENCH_BUCKET') if args.bucket
                      else ('pipe', 'BENCH_PIPE') if args.pipe
                      else ('ckpt', 'BENCH_CKPT') if args.ckpt
                      else ('int8', 'BENCH_INT8') if args.int8
+                     else ('loop', 'BENCH_LOOP') if args.loop
                      else ('serve-fleet', 'BENCH_FLEET'))
         env = dict(os.environ, **{var: '1'})
         proc = subprocess.run([sys.executable, bench_py], env=env,
