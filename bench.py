@@ -58,6 +58,10 @@ BENCH_INT8=1 (low-precision stack A/B: fp vs int8 serving with parity
 BENCH_LOOP=1 (diurnal autoscale drill: open-loop diurnal trace through
     a real autoscaling localhost fleet — scale-up lag, scale-down flap
     count, peak shed rate; see loop_bench() for the BENCH_LOOP_* knobs),
+BENCH_EMBED=1 (sparse embedding A/B: dense vs touched-rows-only
+    gradients/updates across uniform/zipf/repeat id distributions,
+    parity- and zero-recompile-gated, with a 2x-virtual-device table
+    sharding child — see embed_bench() for the BENCH_EMBED_* knobs),
 BENCH_CKPT=1 (elastic-checkpoint overhead A/B: no-checkpoint vs
 async cadence vs blocking cadence, ckpt_* counters + bit-parity
 gate — see ckpt_bench() for the BENCH_CKPT_* knobs),
@@ -821,6 +825,220 @@ def ckpt_bench():
     }))
     for d in ckdirs.values():
         shutil.rmtree(d, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# BENCH_EMBED=1: dense vs sparse (touched-rows-only) embedding training
+# ---------------------------------------------------------------------------
+
+def embed_bench():
+    """BENCH_EMBED=1: measure the sparse embedding-gradient path
+    (parallel/embedding.py: dedup'd touched-rows-only backward +
+    rows-only FusedSGD update inside the single donated gluon fused
+    dispatch) against the identical model trained dense
+    (sparse_grad=False: full (vocab, dim) gradient + full-table
+    update), and emit ONE JSON line with per-distribution arms —
+    uniform (ids ~ U[0, vocab)), zipf (heavy head, the
+    recommendation-workload shape), repeat (a hot pool of
+    BENCH_EMBED_HOT ids — the steady-feature case) — each carrying
+    dense/sparse steps/s, the speedup, the sparse arm's
+    touched-bytes/step vs the dense-equivalent bytes from the
+    profiler's embed_* plan accounting, and the max ladder rung in
+    effect.
+
+    Two gates ride along: a parity gate (fresh dense + sparse nets
+    from identical init, plain SGD wd=0 — the rows-only update must be
+    BITWISE equal to dense; lazy momentum/wd are documented
+    divergences so the gate pins them to zero) and a zero-recompile
+    gate (exec_cache misses + total_compile_s deltas across every
+    measured pass must be ZERO once the warmup has visited each
+    distribution's ladder rungs — re-bucketing between distributions
+    is a cache hit, not a compile).  A 2x-virtual-device child
+    (BENCH_EMBED_DRYRUN=1 re-exec with
+    --xla_force_host_platform_device_count=2) reports the sparse
+    table's addressable-shard bytes: per-device ~ 1/dp of the table
+    proves the rows really stripe over the dp mesh axis.
+
+    Arms run best-of-BENCH_EMBED_PASSES interleaved (rig note: single
+    passes swing ~2x).  Knobs: BENCH_EMBED_VOCAB (100000),
+    BENCH_EMBED_DIM (64), BENCH_EMBED_BATCH (512), BENCH_EMBED_HOT
+    (256), BENCH_EMBED_STEPS (10 per pass), BENCH_EMBED_PASSES (4),
+    BENCH_EMBED_SHARD_DEVICES (2; 0 skips the child)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import exec_cache, gluon, nd, profiler
+    from mxnet_tpu.gluon import nn
+
+    vocab = int(os.environ.get('BENCH_EMBED_VOCAB', 100000))
+    dim = int(os.environ.get('BENCH_EMBED_DIM', 64))
+    batch = int(os.environ.get('BENCH_EMBED_BATCH', 512))
+    hot = int(os.environ.get('BENCH_EMBED_HOT', 256))
+    steps = int(os.environ.get('BENCH_EMBED_STEPS', 10))
+    passes = max(1, int(os.environ.get('BENCH_EMBED_PASSES', 4)))
+    shard_dev = int(os.environ.get('BENCH_EMBED_SHARD_DEVICES', 2))
+
+    def make_net(sparse, seed=3, ctxs=None):
+        net = nn.HybridSequential()
+        net.add(nn.Embedding(vocab, dim, sparse_grad=sparse))
+        net.add(nn.Dense(16, flatten=False, in_units=dim))
+        net.initialize(force_reinit=True, ctx=ctxs)
+        rs = np.random.RandomState(seed)
+        for _, p in sorted(net.collect_params().items()):
+            p.set_data(nd.array(
+                (rs.rand(*p.shape).astype(np.float32) - 0.5) * 0.1))
+        return net
+
+    def make_fused(sparse, seed=3, ctxs=None):
+        net = make_net(sparse, seed, ctxs)
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           {'learning_rate': 0.1, 'wd': 0.0})
+        return net, gluon.fuse_step(
+            net, gluon.loss.L2Loss(), tr), tr
+
+    if os.environ.get('BENCH_EMBED_DRYRUN') == '1':
+        # 2x-virtual-device child: train a few sparse steps on the dp
+        # mesh and report the table's real per-device shard bytes
+        import jax
+        ndev = jax.device_count()
+        ctxs = [mx.cpu(i) for i in range(ndev)]
+        net, fused, tr = make_fused(True, ctxs=ctxs)
+        rs = np.random.RandomState(0)
+        for _ in range(3):
+            x = nd.array(rs.randint(0, vocab, size=(batch,))
+                         .astype(np.float32))
+            y = nd.array(rs.randn(batch, 16).astype(np.float32))
+            fused(x, y).asnumpy()
+        p = next(p for p in tr._params
+                 if getattr(p, 'sparse_grad', False))
+        ent = fused._repl.get(id(p))
+        arr = ent[0] if ent else p.list_data()[0]._data
+        total = int(np.prod(arr.shape)) * arr.dtype.itemsize
+        per_dev = max(int(np.prod(s.data.shape)) * arr.dtype.itemsize
+                      for s in arr.addressable_shards)
+        print(json.dumps({
+            'devices': ndev, 'table_bytes': total,
+            'per_device_bytes': per_dev,
+            'per_device_frac': round(per_dev / total, 4)}))
+        return
+
+    rs = np.random.RandomState(0)
+    nb = 4                       # distinct batches cycled per pass
+
+    def id_batches(dist):
+        out = []
+        for _ in range(nb):
+            if dist == 'uniform':
+                ids = rs.randint(0, vocab, size=(batch,))
+            elif dist == 'zipf':
+                ids = np.minimum(rs.zipf(1.3, size=(batch,)) - 1,
+                                 vocab - 1)
+            else:                # repeat-heavy hot pool
+                ids = rs.randint(0, hot, size=(batch,))
+            out.append((nd.array(ids.astype(np.float32)),
+                        nd.array(rs.randn(batch, 16)
+                                 .astype(np.float32))))
+        return out
+
+    dists = {d: id_batches(d) for d in ('uniform', 'zipf', 'repeat')}
+    _, fused_d, _ = make_fused(False)
+    _, fused_s, _ = make_fused(True)
+
+    def run(fused, bs, n):
+        for i in range(n):
+            x, y = bs[i % nb]
+            l = fused(x, y)
+        l.asnumpy()              # host-fetch barrier
+
+    # warmup: visit every distribution's ladder rungs off the clock
+    for bs in dists.values():
+        run(fused_d, bs, nb)
+        run(fused_s, bs, nb)
+    cache0 = exec_cache.stats()
+    c0_s, c0_m = cache0['total_compile_s'], cache0['misses']
+
+    results = {}
+    for dist, bs in dists.items():
+        best = {'dense': 0.0, 'sparse': 0.0}
+        # embed_max_rung is a running max — without a reset it would
+        # report the warmup's one-shot discovery trace (rung == vocab)
+        # instead of this distribution's steady-state ladder rung
+        profiler.clear()
+        e0 = profiler.embed_stats()
+        for _ in range(passes):
+            for name, f in (('dense', fused_d), ('sparse', fused_s)):
+                tic = time.time()
+                run(f, bs, steps)
+                best[name] = max(best[name],
+                                 steps / (time.time() - tic))
+        e1 = profiler.embed_stats()
+        es = passes * steps      # sparse steps measured in this dist
+        results[dist] = {
+            'dense_sps': round(best['dense'], 2),
+            'sparse_sps': round(best['sparse'], 2),
+            'speedup': round(best['sparse'] /
+                             max(best['dense'], 1e-9), 3),
+            'touched_bytes_per_step': (
+                e1['embed_touched_bytes'] -
+                e0['embed_touched_bytes']) // es,
+            'dense_equiv_bytes_per_step': (
+                e1['embed_dense_equiv_bytes'] -
+                e0['embed_dense_equiv_bytes']) // es,
+            'max_rung': e1['embed_max_rung'],
+        }
+    cache1 = exec_cache.stats()
+    steady_compile_s = cache1['total_compile_s'] - c0_s
+    steady_misses = cache1['misses'] - c0_m
+
+    # parity gate: fresh nets, identical init, same batches; plain SGD
+    # wd=0 makes the rows-only update bitwise equal to dense
+    net_pd, fp_d, _ = make_fused(False, seed=7)
+    net_ps, fp_s, _ = make_fused(True, seed=7)
+    for x, y in dists['uniform'][:3]:
+        fp_d(x, y)
+        fp_s(x, y)
+    max_diff = max(
+        float(np.abs(a.list_data()[0].asnumpy() -
+                     b.list_data()[0].asnumpy()).max())
+        for (_, a), (_, b) in zip(
+            sorted(net_pd.collect_params().items()),
+            sorted(net_ps.collect_params().items())))
+
+    shard = None
+    if shard_dev > 0:
+        env = dict(os.environ, BENCH_EMBED='1', BENCH_EMBED_DRYRUN='1',
+                   JAX_PLATFORMS='cpu')
+        flags = [f for f in env.get('XLA_FLAGS', '').split()
+                 if 'xla_force_host_platform_device_count' not in f]
+        flags.append('--xla_force_host_platform_device_count=%d'
+                     % shard_dev)
+        env['XLA_FLAGS'] = ' '.join(flags)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('embed shard child failed (rc=%d)'
+                               % proc.returncode)
+        lines = proc.stdout.strip().splitlines()
+        if not lines:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('embed shard child produced no output')
+        shard = json.loads(lines[-1])
+
+    uni = results['uniform']
+    print(json.dumps({
+        'metric': 'sparse_embed_train',
+        'value': uni['sparse_sps'],
+        'unit': 'steps/sec',
+        'vocab': vocab, 'dim': dim, 'batch': batch, 'hot': hot,
+        'steps_per_pass': steps, 'passes': passes,
+        'dists': results,
+        'steady_state_compile_s': round(steady_compile_s, 3),
+        'steady_state_misses': steady_misses,
+        'zero_recompiles_ok': bool(steady_misses == 0),
+        'parity_max_abs_diff': max_diff,
+        'parity_ok': bool(max_diff == 0.0),
+        'shard': shard,
+    }))
 
 
 # ---------------------------------------------------------------------------
@@ -2475,6 +2693,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_CKPT', '') == '1':
         ckpt_bench()   # async elastic checkpoint overhead A/B
+        return
+    if os.environ.get('BENCH_EMBED', '') == '1':
+        embed_bench()   # dense vs touched-rows-only embedding training
         return
     model_env = os.environ.get('BENCH_MODEL', 'resnet-50')
     batches = [int(os.environ['BENCH_BATCH'])] if 'BENCH_BATCH' in os.environ \

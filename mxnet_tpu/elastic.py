@@ -314,11 +314,29 @@ def _device_snap(x):
 
 
 def _local_full(arr):
-    """One full local copy of a (possibly mesh-replicated) array."""
+    """One full local copy of a (possibly mesh-replicated or
+    mesh-SHARDED) array.  Replicated arrays snap shard 0 (a full
+    copy); a sharded array — a row-striped sparse embedding table or
+    its momentum (parallel/embedding.row_sharding) — is assembled
+    host-side from every addressable shard by its index, so the
+    checkpoint entry is the FULL table regardless of the dp width
+    that produced it (what makes restore dp-width-portable: the
+    restoring run re-shards on device_put).  Shard 0 alone would
+    silently truncate the table to its first 1/dp rows."""
     shards = getattr(arr, 'addressable_shards', None)
-    if shards:
-        return _device_snap(shards[0].data)
-    return _device_snap(arr)
+    if not shards:
+        return _device_snap(arr)
+    first = shards[0]
+    idx = getattr(first, 'index', ())
+    full0 = not idx or all(
+        (sl.start in (None, 0)) and (sl.stop is None or sl.stop == d)
+        for sl, d in zip(idx, arr.shape))
+    if full0:
+        return _device_snap(first.data)
+    out = np.zeros(tuple(arr.shape), np.dtype(arr.dtype))
+    for s in shards:
+        out[s.index] = np.asarray(s.data)
+    return out
 
 
 def _local_bucket_shards(arr):
@@ -510,6 +528,15 @@ def _capture_optimizer(target):
                         entries.append(
                             ('zmaster:%d:%d:%d' % (b.index, lo, hi),
                              piece))
+            # sparse-table momenta live OUTSIDE the flat buckets even
+            # under ZeRO (row-sharded per-param tables, optimizer.py
+            # _make_zero_sparse_step) — captured as per-param entries,
+            # assembled from their row shards by _local_full
+            for i in fu.sparse_idx:
+                n = fu.param_names[i]
+                v = fu.states.get(n)
+                if v is not None:
+                    entries.append(('mom:%s' % n, _local_full(v)))
             return entries, meta
         meta['mode'] = 'replicated'
         for n in fu.param_names:
@@ -547,6 +574,13 @@ def _assemble_optimizer(meta, arrays):
             elif key.startswith('master:'):
                 masters[key[7:]] = v
     else:                                        # 'zero'
+        # per-param 'mom:' entries alongside the buckets are sparse-
+        # table momenta (captured outside the flat buckets)
+        for key, v in arrays.items():
+            if key.startswith('mom:'):
+                moms[key[4:]] = v
+            elif key.startswith('master:'):
+                masters[key[7:]] = v
         for b in meta['zero_buckets']:
             for kind, dest in (('zmom', moms), ('zmaster', masters)):
                 pieces = []

@@ -289,7 +289,25 @@ def ladder_rung(ladder, key):
     return None
 
 
-def serve_step_key(sig, input_names=(), quant=None):
+def embed_plan_key(positions, vocabs, dims, rungs=None):
+    """Hashable identity of a sparse-embedding plan as it joins a
+    compiled-program cache key: which parameter slots are sparse
+    tables, their (vocab, dim) geometry, and — when rung-resolved —
+    the unique-count ladder rungs this program was traced at.  The
+    rungs change the traced shapes (so the jaxpr fingerprint would
+    differ anyway), but joining them explicitly keeps ladder programs
+    from ever aliasing through a fingerprint subtlety, mirroring how
+    the ZeRO bucket layout key joins FusedSGD.cache_key.  A row-shard
+    layout needs no extra token here: the mesh/placement fingerprint
+    every fused key already carries covers it."""
+    key = ('embed', tuple(int(p) for p in positions),
+           tuple(int(v) for v in vocabs), tuple(int(d) for d in dims))
+    if rungs is not None:
+        key += (tuple(int(r) for r in rungs),)
+    return key
+
+
+def serve_step_key(sig, input_names=(), quant=None, embed=None):
     """Cache key of one bucket rung's donated serve program (the
     forward-only jit serving.py dispatches).  `sig` is the bucket
     executor's graph signature — shape-distinct per rung, so rungs
@@ -303,9 +321,14 @@ def serve_step_key(sig, input_names=(), quant=None):
     weight positions): the quantized serve program takes int8 codes +
     scale arguments and bakes the dequant math in, so it must never
     alias the fp program — nor a program quantizing a different
-    weight subset."""
+    weight subset.  `embed` is the hot-row-cached engine's token
+    (per-table (weight name, capacity) pairs): a hot engine's serve
+    program gathers from the (C, dim) hot buffer with host-remapped
+    slot ids — it must never alias the full-table program, nor a
+    different capacity's."""
     return (sig, 'serve_step', tuple(input_names)) + \
-        (() if quant is None else (quant,))
+        (() if quant is None else (quant,)) + \
+        (() if embed is None else (('hotrow',) + tuple(embed),))
 
 
 def gluon_step_key(fingerprint, step_key, mode, k, placement):

@@ -490,6 +490,85 @@ class Executor:
             run_graph(arg_vals, aux_vals, rng, True)
 
     # ------------------------------------------------------------------
+    def sparse_diff_positions(self):
+        """Positions (in self._diff_names order) of sparse_grad
+        Embedding tables.  Module's init_optimizer passes these to
+        create_fused_updater (rows-only update math) and its
+        GradReducePlan is built over the dense complement — the COO
+        (unique_ids, rows) gradients skip the bucketed all-reduce;
+        GSPMD schedules their reduction from the gather/scatter
+        shardings itself."""
+        return tuple(e['dpos'] for e in self._sparse_embed_entries())
+
+    def _sparse_embed_entries(self):
+        """Module-path sparse-embedding plan, derived from the bound
+        symbol (parallel/embedding.find_symbol_tables) and the bound
+        arg shapes.  One entry per sparse_grad table that is a
+        differentiable arg; lookups of the same table are grouped (the
+        COO gradient dedups across all of them).
+
+        Unlike the gluon path (fused.py), the rung is STATIC:
+        min(vocab, total bound id slots).  A Module executor's arg
+        shapes are fixed per bind/bucket, so the worst case is known at
+        trace time and the program never recompiles on id-distribution
+        shifts — the bucket ladder exists to solve a problem this path
+        does not have.  Pad-heavy batches cost gather/scatter width,
+        never correctness (padded uids are inert under clip/drop).
+
+        Refuses (typed MXNetError) the configurations the two-pass
+        capture/override rewrite cannot express here:
+          * graph-DERIVED ids (the lookup input is not a bound
+            variable) — pass-2 would need the pass-1 trace's
+            intermediate values;
+          * ids that are themselves differentiable args — integer ids
+            carry no gradient, so a diff ids arg means a miswired
+            graph.
+        Frozen sparse tables (not in _diff_names) fall back to the
+        plain dense forward gather — nothing to do."""
+        if getattr(self, '_sparse_entries', None) is not None:
+            return self._sparse_entries
+        entries = []
+        if self._symbol is not None and not self._grouped:
+            from .parallel import embedding as embed_mod
+            diff_set = set(self._diff_names)
+            dpos = {n: j for j, n in enumerate(self._diff_names)}
+            by_w = OrderedDict()
+            for t in embed_mod.find_symbol_tables(self._symbol,
+                                                  sparse_only=True):
+                if t['weight'] not in diff_set:
+                    continue
+                if t['ids_input'] is None:
+                    raise MXNetError(
+                        'sparse embedding (Module path): table %r is '
+                        'looked up with graph-derived ids; the fused '
+                        'sparse rewrite needs the ids as a bound input '
+                        'variable. Feed the ids directly or set '
+                        'sparse_grad=False on this table.' % t['weight'])
+                if t['ids_input'] in diff_set:
+                    raise MXNetError(
+                        'sparse embedding (Module path): ids input %r '
+                        'of table %r is a differentiable arg — integer '
+                        'ids carry no gradient; rebind it with '
+                        "grad_req='null'." % (t['ids_input'],
+                                              t['weight']))
+                by_w.setdefault(t['weight'], []).append(t)
+            for w, ts in by_w.items():
+                slots = sum(
+                    max(1, int(np.prod(self.arg_dict[t['ids_input']]
+                                       .shape)))
+                    for t in ts)
+                entries.append({
+                    'weight': w,
+                    'dpos': dpos[w],
+                    'arg_i': self._arg_pos[w],
+                    'ids': [t['ids_input'] for t in ts],
+                    'vocab': int(ts[0]['vocab']),
+                    'dim': int(ts[0]['dim']),
+                    'rung': min(int(ts[0]['vocab']), slots),
+                })
+        self._sparse_entries = entries
+        return entries
+
     def make_fused_train_step(self, step_math, step_key=None,
                               grad_reduce=None):
         """Compile forward + backward + optimizer update into ONE donated
@@ -580,13 +659,36 @@ class Executor:
         # the top of each step so the graph sees its declared inputs
         scan_dt = [self.arg_dict[self._arg_names[i]]._data.dtype
                    for i in scan_idx]
+        # row-sparse embedding tier (docs/SPARSE.md): tables whose
+        # backward produces (unique_ids, rows) COO pairs instead of a
+        # dense (vocab, dim) cotangent.  Resolved here, once per trace.
+        sparse_rt = self._sparse_embed_entries()
+        embed_mod = None
+        sparse_dset = frozenset()
+        if sparse_rt:
+            from .parallel import embedding as embed_mod
+            scan_pos = {i: p for p, i in enumerate(scan_idx)}
+            inv_pos = {i: p for p, i in enumerate(inv_idx)}
+            # ('scan'|'inv', position) per lookup — where run_one finds
+            # each table's traced id values without threading them
+            # through the differentiated region
+            sparse_src = [[('scan', scan_pos[self._arg_pos[n]])
+                           if self._arg_pos[n] in scan_pos
+                           else ('inv', inv_pos[self._arg_pos[n]])
+                           for n in e['ids']]
+                          for e in sparse_rt]
+            sparse_dset = frozenset(e['dpos'] for e in sparse_rt)
         cache_key = None
         if self._sig is not None and step_key is not None:
             # step_key stays the LAST component (tests and tools key
-            # off it positionally)
+            # off it positionally); the embed token slots in before it.
+            # (The token is belt-and-braces: weight names/attrs live in
+            # _sig and the updater's sparse_idx in step_key already.)
+            embed_tok = tuple((e['weight'], e['rung'])
+                              for e in sparse_rt) if sparse_rt else None
             cache_key = (self._sig, 'multistep', tuple(scan_idx), repeat,
                          tuple(str(d) for d in scan_dt),
-                         bool(lr_stacked), step_key)
+                         bool(lr_stacked), embed_tok, step_key)
             fn = exec_cache.get(cache_key)
             if fn is not None:
                 return fn
@@ -601,7 +703,7 @@ class Executor:
                     wd_t = [wd_t[j] for j in range(len(diff_idx))]
                 key, sub = jax.random.split(key)
 
-                def f(dv):
+                def merge(dv):
                     merged = [None] * n_args
                     for i, v in zip(diff_idx, dv):
                         merged[i] = v
@@ -609,18 +711,78 @@ class Executor:
                         merged[i] = v if v.dtype == dt else v.astype(dt)
                     for i, v in zip(inv_idx, inv_vals):
                         merged[i] = v
-                    outs, new_aux = run_graph(tuple(merged), aux_vals,
-                                              sub, True)
-                    return outs, new_aux
+                    return merged
 
-                f = _maybe_remat(f, remat_mode)
-                outs, vjp_fn, new_aux = jax.vjp(f, tuple(diff_vals),
-                                                has_aux=True)
-                heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
-                grads, = vjp_fn(heads)
-                grads = list(grads)
-                if grad_reduce is not None:
-                    grads = grad_reduce(grads)
+                if sparse_rt:
+                    # Pre-pass (outside the differentiated region):
+                    # dedup each sparse table's ids to a static rung
+                    # and gather its touched rows.  The rewrite then
+                    # serves every lookup as rows[inverse] — the vjp of
+                    # that gather IS the segment-sum, so the cotangent
+                    # arriving at `rows` is the per-unique-id summed
+                    # row-gradient, (rung, dim).
+                    uids_l, rows_l, invs_l = [], [], []
+                    for e, src in zip(sparse_rt, sparse_src):
+                        ids_vals = [sv[p] if cat == 'scan'
+                                    else inv_vals[p] for cat, p in src]
+                        uids, invs = embed_mod.dedup_ids(
+                            ids_vals, e['rung'], e['vocab'])
+                        rows = embed_mod.gather_rows(
+                            diff_vals[e['dpos']], uids)
+                        uids_l.append(uids)
+                        rows_l.append(rows)
+                        invs_l.append(invs)
+
+                    def f(dv, rv):
+                        merged = merge(dv)
+                        # the full tables stay in dv so donation and
+                        # the carry signature are unchanged; their
+                        # lookups are overridden, so their dense
+                        # cotangent is zero and XLA DCEs it
+                        ov = {id(merged[e['arg_i']]):
+                              embed_mod._Override(r, iv, e['dim'])
+                              for e, r, iv in zip(sparse_rt, rv,
+                                                  invs_l)}
+                        with embed_mod.override_scope(ov):
+                            outs, new_aux = run_graph(
+                                tuple(merged), aux_vals, sub, True)
+                        return outs, new_aux
+
+                    f = _maybe_remat(f, remat_mode)
+                    outs, vjp_fn, new_aux = jax.vjp(
+                        f, tuple(diff_vals), tuple(rows_l),
+                        has_aux=True)
+                    heads = tuple(jnp.ones(o.shape, o.dtype)
+                                  for o in outs)
+                    grads, rgrads = vjp_fn(heads)
+                    grads = list(grads)
+                    for e, uids, dr in zip(sparse_rt, uids_l, rgrads):
+                        grads[e['dpos']] = (uids, dr)
+                    if grad_reduce is not None:
+                        # COO grads skip the bucketed all-reduce: the
+                        # plan was built over the dense complement
+                        # (module._ensure_reduce_plan); GSPMD schedules
+                        # the sparse reduction itself
+                        didx = [j for j in range(len(grads))
+                                if j not in sparse_dset]
+                        red = grad_reduce([grads[j] for j in didx])
+                        for j, g in zip(didx, red):
+                            grads[j] = g
+                else:
+                    def f(dv):
+                        outs, new_aux = run_graph(tuple(merge(dv)),
+                                                  aux_vals, sub, True)
+                        return outs, new_aux
+
+                    f = _maybe_remat(f, remat_mode)
+                    outs, vjp_fn, new_aux = jax.vjp(f, tuple(diff_vals),
+                                                    has_aux=True)
+                    heads = tuple(jnp.ones(o.shape, o.dtype)
+                                  for o in outs)
+                    grads, = vjp_fn(heads)
+                    grads = list(grads)
+                    if grad_reduce is not None:
+                        grads = grad_reduce(grads)
                 new_ws, new_moms, new_masters = step_math(
                     list(diff_vals), grads, moms, masters, lr_t, wd_t)
                 if metric is not None:

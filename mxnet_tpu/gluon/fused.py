@@ -71,7 +71,9 @@ from .. import ndarray as nd
 from .. import optimizer as opt_mod
 from .. import profiler
 from .. import random as _random
+from ..base import MXNetError
 from ..parallel import collectives
+from ..parallel import embedding as embed_mod
 from ..parallel import mesh as pmesh
 from ..parallel import zero as zero_mod
 from . import block as block_mod
@@ -227,6 +229,8 @@ class FusedStep:
         self._params = None          # trainable, trainer order
         self._aux_params = None      # grad_req='null' (BatchNorm stats)
         self._frozen_params = None   # in the net but not the trainer
+        self._splan = None           # sparse embedding plan (or None)
+        self._sparse_pids = set()
         self._programs = {}          # local key -> compiled step fn
         self._loss_treedef = None
         self._rng = None
@@ -264,6 +268,21 @@ class FusedStep:
         self._params = list(self._trainer._params)
         self._aux_params = aux
         self._frozen_params = frozen
+        # sparse embedding tier (Embedding(sparse_grad=True)): host plan
+        # over the tables' positions; the step trace captures their ids,
+        # dedups, and routes (unique_ids, rows) COO grads to the updater
+        self._splan = embed_mod.gluon_sparse_plan(self._params)
+        self._sparse_pids = {id(self._params[i])
+                             for i in self._splan.positions} \
+            if self._splan else set()
+        if self._splan and self._ema_decay is not None:
+            raise MXNetError(
+                'fuse_step: ema_decay does not compose with '
+                'sparse_grad embedding tables — the EMA arm '
+                '(ema <- d*ema + (1-d)*w) reads and writes every table '
+                'row every step, densifying exactly the traffic the '
+                'sparse tier removes; drop ema_decay or set '
+                'sparse_grad=False')
 
     def _finish_deferred(self, arrays, bulk):
         """Deferred-shape params complete on a real (eager, paused)
@@ -303,17 +322,33 @@ class FusedStep:
                 else key
         self._placed = True
 
+    def _param_sharding(self, p):
+        """Persistent placement of one parameter on the mesh:
+        replicated, except sparse_grad embedding tables, which
+        row-stripe over the dp axis (each device persistently holds
+        ~1/dp of the rows — the EncodeKey big-array split)."""
+        if id(p) in self._sparse_pids and \
+                'data' in self._mesh.axis_names and \
+                int(self._mesh.shape['data']) > 1:
+            return embed_mod.row_sharding(self._mesh)
+        return pmesh.replicated(self._mesh)
+
     def _gather_param(self, p):
         """The parameter's value as the step program sees it: the
         mesh-replicated parent when current, re-replicated from the
-        ctx0 slot when user code replaced it (set_data, load_params)."""
+        ctx0 slot when user code replaced it (set_data, load_params).
+        Sparse tables place row-sharded instead of replicated; their
+        ctx slots then hold shard VIEWS (a row range per device), so
+        eager per-context reads see only local rows — use the trainer
+        checkpoint path (or the fused step's writeback parents) for
+        full-table access."""
         cur = p.list_data()[0]._data
         if self._mesh is None:
             return cur
         ent = self._repl.get(id(p))
         if ent is not None and ent[1] is cur:
             return ent[0]
-        repl = jax.device_put(cur, pmesh.replicated(self._mesh))
+        repl = jax.device_put(cur, self._param_sharding(p))
         self._writeback_param(p, repl)
         return repl
 
@@ -378,13 +413,81 @@ class FusedStep:
         new_aux = tuple(sub[p]._data for p in aps)
         return total, (loss_leaves, new_aux, mouts)
 
-    def _make_step_fn(self, fu, bulk, k):
+    def _make_step_fn(self, fu, bulk, k, rungs=None):
         mesh, zero = self._mesh, self._zero
         step_math = fu.step_math
         forward_loss = self._forward_loss
         plan = self._reduce_plan
         fold = self._metric_fold
         decay = self._ema_decay
+        splan = self._splan
+        sparse_set = frozenset(splan.positions) if splan else frozenset()
+        dense_idx = [j for j in range(len(self._params))
+                     if j not in sparse_set]
+
+        def sparse_grads(ws, auxs, frozen, ins, sub):
+            """The sparse two-pass backward.  Pass 1 re-traces the
+            forward under a capture scope recording each sparse
+            table's traced id arrays (outputs discarded — everything
+            downstream is dead code XLA eliminates; the pass costs
+            trace time only).  The ids then dedup to a ladder-padded
+            unique set, the touched rows gather OUTSIDE the
+            differentiated region, and pass 2 differentiates the
+            forward with every sparse lookup overridden to
+            rows[inverse]: the cotangent arriving at `rows` IS the
+            per-unique-id summed row-gradient (the segment-sum), so
+            sparse positions get (unique_ids, d_rows) COO pairs and
+            the (vocab, dim) table never enters the backward."""
+            watch = {id(ws[p]): p for p in sparse_set}
+            ins_map = {id(a): j for j, a in enumerate(ins)}
+            with embed_mod.capture_scope(watch, ins_map,
+                                         splan.note_source) as cs:
+                forward_loss(list(ws), auxs, frozen, ins, sub)
+            uids_list, rows_list, invs_list = [], [], []
+            for e, req in zip(splan.entries, rungs):
+                pos = e['pos']
+                ids = cs.records.get(pos)
+                if not ids:
+                    raise MXNetError(
+                        'sparse embedding: table %s (sparse_grad=True) '
+                        'was never looked up in the traced forward — '
+                        'unused sparse tables cannot ride the fused '
+                        'step; set sparse_grad=False or remove it from '
+                        'the trainer' % e['name'])
+                splan.note_slots(pos, sum(
+                    int(np.prod(a.shape)) for a in ids))
+                # the host-requested rung and the trace-observed
+                # capacity each cover the step's unique count (the
+                # host counts exactly when it sees the ids; capacity
+                # = min(id slots, vocab) bounds it always), so their
+                # min covers too — and keeps first-trace padding sane
+                eff = min(int(req), splan.capacity(e))
+                uids, invs = embed_mod.dedup_ids(ids, eff, e['vocab'])
+                rows = embed_mod.gather_rows(ws[pos], uids)
+                uids_list.append(uids)
+                rows_list.append(rows)
+                invs_list.append(invs)
+
+            def f(dense_vals, rows_vals):
+                full = list(ws)
+                for j, v in zip(dense_idx, dense_vals):
+                    full[j] = v
+                ov = {id(full[e['pos']]):
+                      embed_mod._Override(r, iv, e['dim'])
+                      for e, r, iv in zip(splan.entries, rows_vals,
+                                          invs_list)}
+                with embed_mod.override_scope(ov):
+                    return forward_loss(full, auxs, frozen, ins, sub)
+
+            (out, (dg, rg)) = jax.value_and_grad(
+                f, argnums=(0, 1), has_aux=True)(
+                    tuple(ws[j] for j in dense_idx), tuple(rows_list))
+            grads = [None] * len(ws)
+            for j, g in zip(dense_idx, dg):
+                grads[j] = g
+            for e, uids, dr in zip(splan.entries, uids_list, rg):
+                grads[e['pos']] = (uids, dr)
+            return out, grads
 
         def one_step(ws, auxs, moms, masters, emas, rng, mcarry,
                      frozen, ins, lrs, wds):
@@ -393,18 +496,30 @@ class FusedStep:
                 lrs = [lrs[j] for j in range(len(ws))]
                 wds = [wds[j] for j in range(len(ws))]
             rng, sub = jax.random.split(rng)
-            f = lambda w: forward_loss(w, auxs, frozen, ins, sub)
-            ((_, (loss_leaves, new_aux, mouts)),
-             grads) = jax.value_and_grad(f, has_aux=True)(tuple(ws))
-            grads = list(grads)
+            if splan:
+                ((_, (loss_leaves, new_aux, mouts)),
+                 grads) = sparse_grads(ws, auxs, frozen, ins, sub)
+            else:
+                f = lambda w: forward_loss(w, auxs, frozen, ins, sub)
+                ((_, (loss_leaves, new_aux, mouts)),
+                 grads) = jax.value_and_grad(f, has_aux=True)(tuple(ws))
+                grads = list(grads)
             if mesh is not None and not zero:
                 # bucket-by-bucket all-reduce in backward-availability
                 # order — each bucket's collective issues as soon as
                 # its wgrads exist, overlapping the remaining backward
                 # (the kvstore push/pull role; end-of-backward mode
                 # barriers first; under ZeRO the sharded step_math
-                # reduce-scatters its own buckets instead)
-                grads = plan.apply(grads, mesh)
+                # reduce-scatters its own buckets instead).  Sparse COO
+                # grads skip the plan: their reduction is GSPMD's to
+                # schedule (the constraint-bucketing only guides dense
+                # wgrads)
+                if sparse_set:
+                    dg = plan.apply([grads[j] for j in dense_idx], mesh)
+                    for j, g in zip(dense_idx, dg):
+                        grads[j] = g
+                else:
+                    grads = plan.apply(grads, mesh)
             new_ws, new_moms, new_masters = step_math(
                 list(ws), grads, moms, masters, lrs, wds)
             if decay is not None:
@@ -449,9 +564,16 @@ class FusedStep:
                 # dp-sharded layout for the scan carry (observed under
                 # ZeRO — the in-body all-gather constraint doesn't bind
                 # the carry), and the writeback hands each context its
-                # device's shard view, which must be the FULL value
-                ws = tuple(collectives.allgather_bucket(w, mesh)
-                           for w in ws)
+                # device's shard view, which must be the FULL value.
+                # Sparse tables are the exception: they LIVE row-sharded
+                # (that is the point — all-gathering one would
+                # materialize the full vocab per device), so their carry
+                # pins to the row stripe instead
+                ws = tuple(
+                    collectives.row_shard_constraint(w, mesh)
+                    if j in sparse_set
+                    else collectives.allgather_bucket(w, mesh)
+                    for j, w in enumerate(ws))
                 auxs = tuple(collectives.allgather_bucket(a, mesh)
                              for a in auxs)
                 emas = tuple(collectives.allgather_bucket(e, mesh)
@@ -460,19 +582,23 @@ class FusedStep:
 
         return step_fn
 
-    def _full_step_key(self, fkey):
+    def _full_step_key(self, fkey, rungs=None):
         """FusedSGD.cache_key extended with the epoch-fusion carry
         signature and reduction plan: EMA decay, the metric fold's
         identity, and the gradient-bucket layout/schedule all bake
         into the traced program, so they join the cache key (the jaxpr
         fingerprint reflects them too — this makes aliasing impossible
-        even across a printing subtlety)."""
+        even across a printing subtlety).  Sparse plans key on table
+        positions/shapes plus this dispatch's ladder rungs — the rung
+        is a static shape of the traced program."""
         return (fkey,
                 ('ema', self._ema_decay),
                 ('metric', self._metric_fold.key
                  if self._metric_fold is not None else None),
                 ('reduce', self._reduce_plan.key
-                 if self._reduce_plan is not None else None))
+                 if self._reduce_plan is not None else None),
+                ('embed', self._splan.key(rungs)
+                 if self._splan else None))
 
     def _placement_fp(self):
         """Device identity for the program cache: AOT compilation
@@ -484,7 +610,7 @@ class FusedStep:
             return ('dev', str(self._ctxs[0].jax_device()))
         return ('dev', 'default')
 
-    def _get_program(self, fu, fkey, bulk, k, args):
+    def _get_program(self, fu, fkey, bulk, k, args, rungs=None):
         """Resolve the compiled step through the process-wide
         exec_cache: the key is the blake2b fingerprint of the step
         function's ABSTRACT jaxpr (name-free: auto-prefixes and
@@ -494,7 +620,7 @@ class FusedStep:
         fingerprint trace itself compiles nothing).  The cached value
         is the AOT-COMPILED executable: it holds no Python closure,
         so a cache entry never pins a discarded net's weights."""
-        step_fn = self._make_step_fn(fu, bulk, k)
+        step_fn = self._make_step_fn(fu, bulk, k, rungs)
         sds = jtu.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
             if hasattr(a, 'shape') else a, args)
@@ -507,7 +633,8 @@ class FusedStep:
         # scrub addresses so equal programs fingerprint equally
         canon = re.sub(r'0x[0-9a-f]+', '0x', str(jaxpr))
         fp = hashlib.blake2b(canon.encode(), digest_size=16).hexdigest()
-        key = exec_cache.gluon_step_key(fp, self._full_step_key(fkey),
+        key = exec_cache.gluon_step_key(fp,
+                                        self._full_step_key(fkey, rungs),
                                         'bulk' if bulk else 'step', k,
                                         self._placement_fp())
         if exec_cache.enabled():
@@ -542,7 +669,9 @@ class FusedStep:
         new = opt_mod.create_fused_updater(
             tr._optimizer, list(range(len(self._params))),
             zero=self._zero, mesh=self._mesh,
-            interleave=self._interleave)
+            interleave=self._interleave,
+            sparse_idx=tuple(self._splan.positions)
+            if self._splan else ())
         if new is None:
             raise ValueError(
                 'fuse_step: optimizer %s has no fused whole-model '
@@ -555,6 +684,46 @@ class FusedStep:
             tr._pending_fused_states = None
         tr._fused_updater = new
         return new
+
+    # -- sparse embedding plumbing -----------------------------------------
+    def _sparse_pos_set(self):
+        return frozenset(self._splan.positions) if self._splan \
+            else frozenset()
+
+    def _dispatch_rungs(self, arrays, shapes, bulk):
+        """Per-table ladder rungs for one dispatch: bind the plan to
+        this dispatch's shape signature, adopt previously published
+        trace facts from the exec_cache (a re-created trainer lands on
+        the steady-state rungs — and the cached program — without a
+        discovery trace), then count host uniques for every table
+        whose id source input is known."""
+        plan = self._splan
+        plan.set_sig(shapes)
+        if exec_cache.enabled() and not plan.src:
+            facts = exec_cache.get(plan.facts_key())
+            if facts is not None:
+                plan.src.update(facts[0])
+                plan.slots.update(facts[1])
+        host_ids = {}
+        for kidx in set(plan.src.values()):
+            if kidx is not None and kidx < len(arrays):
+                host_ids[kidx] = np.asarray(arrays[kidx])
+        return plan.pick_rungs(host_ids, bulk=bulk)
+
+    def _note_embed_counters(self, fu, k, rungs):
+        """Feed the profiler's embed_* family after a sparse dispatch:
+        k steps' lookups, padded unique rows, optimizer-touched bytes
+        vs the dense-equivalent, and the ladder rungs in effect."""
+        mom = bool(float(getattr(self._trainer._optimizer, 'momentum',
+                                 0.0) or 0.0))
+        plan = self._splan
+        profiler.add_embed_stats(
+            steps=k, dispatches=1,
+            lookups=k * len(plan.entries),
+            unique_rows=k * sum(rungs),
+            touched_bytes=k * plan.touched_bytes(rungs, mom),
+            dense_equiv_bytes=k * plan.dense_equiv_bytes(mom),
+            max_rung=max(rungs))
 
     # -- execution ---------------------------------------------------------
     def __call__(self, *args, batch_size=None):
@@ -623,9 +792,15 @@ class FusedStep:
         ws = [self._gather_param(p) for p in self._params]
         if self._reduce_plan is None:
             # reverse-availability bucketing over the trainable grads
-            # (static: shapes/dtypes are fixed once params are known)
+            # (static: shapes/dtypes are fixed once params are known).
+            # Sparse tables stay out: their grads are COO pairs the
+            # bucketing constraints cannot express (and must not — a
+            # bucketed all-reduce would densify them)
+            didx = [j for j in range(len(ws))
+                    if j not in self._sparse_pos_set()]
             self._reduce_plan = collectives.GradReducePlan(
-                [w.shape for w in ws], [w.dtype for w in ws],
+                [ws[j].shape for j in didx],
+                [ws[j].dtype for j in didx],
                 interleave=self._interleave)
         if self._ema_decay is not None and self._ema_state is None:
             # EMA starts as a COPY of the current weights (jnp.add
@@ -667,8 +842,10 @@ class FusedStep:
             arrays = tuple(jax.device_put(a, dev) for a in arrays)
         fkey = fu.cache_key()
         shapes = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        rungs = self._dispatch_rungs(arrays, shapes, bulk) \
+            if self._splan else None
         local = ('bulk' if bulk else 'step', k, shapes,
-                 self._full_step_key(fkey))
+                 self._full_step_key(fkey, rungs))
         auxs = [self._gather_param(p) for p in self._aux_params]
         frozen = [self._gather_param(p) for p in self._frozen_params]
         # MoE routing counters: snapshot the cumulative aux counts
@@ -684,8 +861,15 @@ class FusedStep:
             prog = self._get_program(
                 fu, fkey, bulk, k,
                 (ws, auxs, moms, masters, emas, self._rng, frozen,
-                 arrays, lrs, wds))
+                 arrays, lrs, wds), rungs)
             self._programs[local] = prog
+            if self._splan is not None and exec_cache.enabled():
+                # publish the trace-discovered plan facts so an
+                # equivalent re-created net/trainer picks steady-state
+                # rungs up front (see SparseEmbedPlan.facts_key)
+                exec_cache.put(self._splan.facts_key(),
+                               (dict(self._splan.src),
+                                dict(self._splan.slots)))
         t0 = time.perf_counter()
         synced = profiler.is_running()
         with profiler.scope('gluon_fused_%s' % ('bulk' if bulk
@@ -716,6 +900,8 @@ class FusedStep:
         self._trainer._last_update_mode = 'fused'
         profiler.add_gluon_fused_stats(steps=k, dispatches=1)
         self._note_reduce_counters(fu, k, dt_ms)
+        if self._splan is not None:
+            self._note_embed_counters(fu, k, rungs)
         rs, ag = fu.comm_bytes_per_step()
         if rs or ag:
             profiler.add_comm_bytes(reduce_scattered=rs * k,
@@ -892,6 +1078,13 @@ class PipelinedStep(FusedStep):
             raise ValueError(
                 'fuse_step(pipeline): multi_precision is not composed '
                 'with the pipelined update yet')
+        if any(getattr(p, 'sparse_grad', False)
+               for p in trainer._params):
+            raise MXNetError(
+                'fuse_step(pipeline): sparse_grad embedding tables '
+                'are not composed with the pipelined schedule yet — '
+                'keep sparse tables on the plain fused step '
+                '(dp mesh), or set sparse_grad=False here')
         self._dp = int(mesh.shape['data'])
         self._partitioned = False
         self._stage_children = None

@@ -184,15 +184,25 @@ class LeakyReLU(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Index -> dense vector lookup (op Embedding)."""
+    """Index -> dense vector lookup (op Embedding).
+
+    sparse_grad=True opts the table into the row-sparse tier
+    (parallel/embedding.py): the fused train step's backward produces
+    (unique_ids, rows) COO pairs instead of a dense (input_dim,
+    output_dim) cotangent, the optimizer updates only the touched rows
+    (lazy momentum/wd semantics, docs/SPARSE.md), and under a mesh the
+    table plus its momentum are row-striped over the dp axis.  Serving
+    is unaffected (forward lookups are already row-gathers); the
+    InferenceEngine hot-row cache works with either setting."""
 
     def __init__(self, input_dim, output_dim, dtype=np.float32,
-                 weight_initializer=None, **kwargs):
+                 weight_initializer=None, sparse_grad=False, **kwargs):
         super(Embedding, self).__init__(**kwargs)
-        self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim}
+        self._kwargs = {'input_dim': input_dim, 'output_dim': output_dim,
+                        'sparse_grad': bool(sparse_grad)}
         self.weight = self.params.get(
             'weight', shape=(input_dim, output_dim), dtype=dtype,
-            init=weight_initializer)
+            init=weight_initializer, sparse_grad=bool(sparse_grad))
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, **self._kwargs)

@@ -36,7 +36,7 @@ class Parameter(object):
 
     def __init__(self, name, grad_req='write', shape=None, dtype=np.float32,
                  lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
-                 differentiable=True):
+                 differentiable=True, sparse_grad=False):
         self.name = name
         self.shape = tuple(shape) if shape is not None else None
         self.dtype = dtype
@@ -44,6 +44,10 @@ class Parameter(object):
         self.wd_mult = wd_mult
         self.init = init
         self.allow_deferred_init = allow_deferred_init
+        # row-sparse gradient opt-in (reference stype='row_sparse'):
+        # the fused step updates only the rows a batch touches and,
+        # under a mesh, row-stripes the table (parallel/embedding.py)
+        self.sparse_grad = bool(sparse_grad)
         if not differentiable:
             grad_req = 'null'
         self._grad_req = grad_req

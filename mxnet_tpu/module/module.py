@@ -333,9 +333,17 @@ class Module(BaseModule):
             # and the dist-runtime host-allreduce mode
             # (dist.host_span_active) routes through the store so each
             # step's mesh-reduced gradients cross hosts once.
+            # sparse_grad Embedding tables take the rows-only update
+            # (COO (unique_ids, rows) grads from the fused step —
+            # executor._sparse_embed_entries); positions are in the
+            # executor's diff order, which is the order the step hands
+            # weights to step_math
+            ex = self._exec_group.executor
+            sparse_idx = () if ex is None or ex._grouped \
+                else ex.sparse_diff_positions()
             self._fused_updater = opt_mod.create_fused_updater(
                 optimizer, self._param_names, zero=zero,
-                mesh=self._exec_group.mesh)
+                mesh=self._exec_group.mesh, sparse_idx=sparse_idx)
         if zero and self._fused_updater is None:
             if isinstance(kvstore, kvs_mod.KVStoreDistPS):
                 reason = ('the parameter-server kvstore runs updates '
@@ -446,9 +454,15 @@ class Module(BaseModule):
         if self._exec_group.mesh is None or fu.zero:
             return None
         import numpy as np
-        shapes = tuple(tuple(ex.arg_dict[n].shape) for n in fnames)
+        # COO sparse-embedding grads never enter the bucketed
+        # all-reduce (GSPMD reduces them from the gather/scatter
+        # shardings); the plan covers the dense complement, matching
+        # the sublist the fused step feeds through grad_reduce
+        sp = set(fu.sparse_idx)
+        dnames = [n for j, n in enumerate(fnames) if j not in sp]
+        shapes = tuple(tuple(ex.arg_dict[n].shape) for n in dnames)
         dtypes = tuple(np.dtype(ex.arg_dict[n].dtype).str
-                       for n in fnames)
+                       for n in dnames)
         if getattr(self, '_reduce_plan_inputs', None) != (shapes,
                                                          dtypes):
             from ..parallel import collectives

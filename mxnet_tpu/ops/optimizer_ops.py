@@ -17,7 +17,7 @@ compose update steps manually.
 import numpy as np
 import jax.numpy as jnp
 
-from .registry import register, asfloat
+from .registry import register, asbool, asfloat
 
 
 def _opt_infer_shape(attrs, in_shapes):
@@ -99,6 +99,51 @@ def _mp_sgd_mom_update(attrs, inputs, auxs, op_ctx):
     new_mom = momentum * mom - lr * wd * weight32 - lr * g
     w = weight32 + new_mom
     return [w.astype(weight.dtype)], [new_mom, w]
+
+
+@register('sparse_sgd_update', input_names=('weight', 'uids', 'grad_rows'),
+          hint='sparse_sgd_update')
+def _sparse_sgd_update(attrs, weight, uids, grad_rows):
+    """Rows-only SGD (docs/SPARSE.md): `uids` are the touched row ids
+    (UNIQUE, as parallel.embedding.dedup_ids produces; padded entries
+    == vocab are dropped, duplicates would last-win not accumulate),
+    `grad_rows` the per-unique summed row gradients — the COO pair the
+    fused sparse backward produces.  Touched bytes scale with len(uids), not vocab.  Same
+    rescale/clip/wd core as sgd_update (one math definition:
+    optimizer.sgd_update_math via parallel.embedding
+    .sparse_row_update)."""
+    from ..parallel.embedding import sparse_row_update
+    clip = asfloat(attrs.get('clip_gradient', -1.0))
+    new_w, _m = sparse_row_update(
+        weight, weight, uids.astype(jnp.int32), grad_rows,
+        asfloat(attrs['lr']), asfloat(attrs.get('wd', 0.0)),
+        momentum=0.0, rescale=asfloat(attrs.get('rescale_grad', 1.0)),
+        clip=clip if clip >= 0.0 else None)
+    return new_w
+
+
+@register('sparse_sgd_mom_update',
+          input_names=('weight', 'uids', 'grad_rows', 'mom'),
+          num_aux=1, mutable_aux=True, aux_always=True, simple=False,
+          hint='sparse_sgd_mom_update')
+def _sparse_sgd_mom_update(attrs, inputs, auxs, op_ctx):
+    """Rows-only momentum SGD with LAZY semantics (docs/SPARSE.md):
+    momentum decay and weight decay apply only to the touched rows —
+    an untouched row's momentum is frozen, not decayed, so results
+    match dense sgd_mom_update bitwise only when every row is touched
+    every step."""
+    from ..parallel.embedding import sparse_row_update
+    weight, uids, grad_rows = inputs
+    mom, = auxs
+    clip = asfloat(attrs.get('clip_gradient', -1.0))
+    new_w, new_m = sparse_row_update(
+        weight, mom, uids.astype(jnp.int32), grad_rows,
+        asfloat(attrs['lr']), asfloat(attrs.get('wd', 0.0)),
+        momentum=asfloat(attrs.get('momentum', 0.0)),
+        rescale=asfloat(attrs.get('rescale_grad', 1.0)),
+        clip=clip if clip >= 0.0 else None,
+        nesterov=asbool(attrs.get('nesterov', False)))
+    return [new_w], [new_m]
 
 
 @register('adam_update', input_names=('weight', 'grad', 'mean', 'var'),

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from .registry import (register, astuple, asbool, asint, asfloat,
                        normalize_axis)
-from ..base import parse_attr_value
+from ..base import parse_attr_value, MXNetError
 
 
 def _dtype(attrs, default=np.float32):
@@ -557,20 +557,43 @@ def _embedding_infer_shape(attrs, in_shapes):
     return in_shapes
 
 
+# Sparse-embedding interception point, bound by parallel/embedding.py at
+# import (the same late-binding pattern parameter.py uses for
+# _lookup_param_substitution): inside a capture/override scope the hook
+# records the traced ids or serves the deduped-rows lookup; outside any
+# scope it returns None and the dense gather below runs.  No scope can
+# exist before parallel.embedding is imported, so the default None never
+# misses one.
+_embed_hook = None
+
+
 @register('Embedding', input_names=('data', 'weight'),
           infer_shape=_embedding_infer_shape)
 def _embedding(attrs, data, weight):
+    if _embed_hook is not None:
+        out = _embed_hook(attrs, data, weight)
+        if out is not None:
+            return out
     idx = data.astype(jnp.int32)
-    return jnp.take(weight, idx, axis=0)
+    # reference EmbeddingOpForward clips out-of-range ids (negative or
+    # >= input_dim) to the table edge; jnp.take's default 'fill' mode
+    # would return zeros/NaN-adjacent garbage instead
+    return jnp.take(weight, idx, axis=0, mode='clip')
 
 
 @register('take', input_names=('a', 'indices'))
 def _take(attrs, a, indices):
     axis = asint(attrs.get('axis', 0))
     mode = str(parse_attr_value(attrs.get('mode', 'clip')))
+    if mode not in ('clip', 'wrap'):
+        # 'raise' (and any typo) used to silently degrade to clip —
+        # out-of-range ids then read the table edge with no signal
+        raise MXNetError(
+            "take: unsupported mode %r — this backend implements "
+            "'clip' and 'wrap'; 'raise' needs a host-synchronous "
+            "bounds check that a jitted program cannot express" % mode)
     idx = indices.astype(jnp.int32)
-    return jnp.take(a, idx, axis=axis,
-                    mode={'clip': 'clip', 'wrap': 'wrap'}.get(mode, 'clip'))
+    return jnp.take(a, idx, axis=axis, mode=mode)
 
 
 @register('batch_take', input_names=('a', 'indices'))
@@ -629,6 +652,20 @@ def _scatter_nd(attrs, data, indices):
     m = idx.shape[0]
     out = jnp.zeros(shape, dtype=data.dtype)
     return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register('_backward_gather_nd', input_names=('data', 'indices'),
+          aliases=('scatter_nd_acc',))
+def _backward_gather_nd(attrs, data, indices):
+    """Accumulating scatter (the reference's gather_nd gradient,
+    indexing_op.cc GatherNDBackward): duplicate indices ADD instead of
+    scatter_nd's undefined last-wins — the semantics a sparse gradient
+    path needs, where several batch positions hit the same row."""
+    shape = astuple(attrs['shape'])
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
 
 
 # ---------------------------------------------------------------------------

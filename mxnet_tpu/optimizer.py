@@ -599,12 +599,24 @@ class FusedSGD:
     collective bytes on the wire."""
 
     def __init__(self, optimizer, param_names, zero=0, mesh=None,
-                 interleave=None):
+                 interleave=None, sparse_idx=()):
         import jax
         import jax.numpy as jnp
         assert type(optimizer) in (SGD, NAG)
         self.optimizer = optimizer
         self.param_names = list(param_names)
+        # positions (into param_names) updated ROWS-ONLY from COO
+        # gradients (parallel/embedding.py): the fused step hands
+        # gs[pos] = (unique_ids, row_grads) instead of a dense array
+        self.sparse_idx = tuple(sorted(set(int(i) for i in sparse_idx)))
+        if self.sparse_idx and bool(getattr(optimizer, 'multi_precision',
+                                            False)):
+            from .base import MXNetError
+            raise MXNetError(
+                'sparse_grad embedding tables do not compose with '
+                'multi_precision: a row-sliced fp32 master would need '
+                'its own lazy-materialization scheme — keep sparse '
+                'tables fp32 (their update already touches only rows)')
         self.states = {}
         self.masters = {}     # fp32 master copies for low-precision params
         self.zero = int(zero or 0)
@@ -643,10 +655,27 @@ class FusedSGD:
                        'clip': None if clip is None else float(clip),
                        'nesterov': nesterov}
 
+        sparse_set = frozenset(self.sparse_idx)
+        sgd_mesh = mesh
+
         def step(ws, gs, moms, masters, lrs, wds):
+            from .parallel.embedding import sparse_row_update
             new_ws, new_moms, new_masters = [], [], []
-            for w, g, m, mw, lr, wd in zip(ws, gs, moms, masters, lrs,
-                                           wds):
+            for j, (w, g, m, mw, lr, wd) in enumerate(
+                    zip(ws, gs, moms, masters, lrs, wds)):
+                if j in sparse_set:
+                    # rows-only update from the (unique_ids, row_grads)
+                    # COO pair — same sgd_update_math core on the row
+                    # slices, lazy momentum/wd (parallel/embedding.py)
+                    uids, d_rows = g
+                    nw, nm = sparse_row_update(
+                        w, m, uids, d_rows, lr, wd, momentum=momentum,
+                        rescale=rescale, clip=clip, nesterov=nesterov,
+                        mesh=sgd_mesh)
+                    new_ws.append(nw)
+                    new_moms.append(nm)
+                    new_masters.append(None)
+                    continue
                 # with multi_precision, math runs on the fp32 master and
                 # the low-precision weight is a cast of it (reference
                 # mp_sgd_update, src/operator/optimizer_op-inl.h)
@@ -699,6 +728,8 @@ class FusedSGD:
         key = ('FusedSGD', type(self.optimizer).__name__,
                b['momentum'], b['rescale'], b['clip'],
                self.multi_precision)
+        if self.sparse_idx:
+            key += (('sparse', self.sparse_idx),)
         if self.zero:
             key += (('zero', self.zero,
                      self._layout.key if self._layout is not None
@@ -819,6 +850,14 @@ class FusedSGD:
         import jax.numpy as jnp
         zm = self._zero_mod
         names = list(self.param_names)
+        # sparse tables stay OUT of the flat buckets: their update is a
+        # rows-only scatter (COO gradient), which cannot ride a
+        # concatenated 1-D bucket; their momenta live as row-sharded
+        # full tables in self.states and are appended after the bucket
+        # shards in the moms list the step math receives
+        sparse_idx = list(self.sparse_idx)
+        sparse_set = set(sparse_idx)
+        dense_idx = [i for i in range(len(names)) if i not in sparse_set]
         # degree = the 'data' AXIS size, not the whole device count:
         # the bucket sharding spans only that axis, and padding /
         # per-device accounting must match it on multi-axis meshes
@@ -829,26 +868,34 @@ class FusedSGD:
         inputs_key = (tuple(tuple(w.shape) for w in weights),
                       tuple(str(np.dtype(w.dtype)) for w in weights),
                       tuple(self._is_mp(w) for w in weights),
-                      dp, zm.bucket_bytes(), tuple(names))
+                      dp, zm.bucket_bytes(), tuple(names),
+                      tuple(sparse_idx))
         if getattr(self, '_layout_inputs', None) != inputs_key:
             layout = zm.ZeroBucketLayout(
-                [tuple(w.shape) for w in weights],
-                [np.dtype(w.dtype) for w in weights],
-                [self._is_mp(w) for w in weights], dp)
+                [tuple(weights[i].shape) for i in dense_idx],
+                [np.dtype(weights[i].dtype) for i in dense_idx],
+                [self._is_mp(weights[i]) for i in dense_idx], dp)
             if self._zero_moms is not None:
                 # param list changed under us: preserve existing state
                 # by name, re-bucketed below under the new layout
                 self._stage_current()
             self._layout = layout
             self._layout_inputs = inputs_key
-            self._layout_names = names
+            self._layout_names = [names[i] for i in dense_idx]
             self._zero_moms = None
             self._zero_masters = None
             # rebind the step math with the NEW layout captured by
             # value (see __init__: a cached/compiled step must never
-            # observe a later layout through this object)
-            self.step_math = zm.make_sharded_sgd_step(
-                layout, self.mesh, self._zero_hyper)
+            # observe a later layout through this object).  With sparse
+            # tables the sharded bucket step runs on the dense subset
+            # and the rows-only updates run beside it in the same
+            # traced program.
+            if not sparse_idx:
+                self.step_math = zm.make_sharded_sgd_step(
+                    layout, self.mesh, self._zero_hyper)
+            else:
+                self.step_math = self._make_zero_sparse_step(
+                    layout, dense_idx, sparse_idx)
             self._jit_step = jax.jit(self.step_math,
                                      donate_argnums=(0, 2, 3))
         if self._zero_moms is None:
@@ -865,7 +912,7 @@ class FusedSGD:
                 # cast/pad/concat invariant — zero.py pack)
                 vals = []
                 for i, n in zip(b.param_idx, b.sizes):
-                    v = per_name.get(names[i])
+                    v = per_name.get(self._layout_names[i])
                     vals.append(fallback(i, n) if v is None
                                 else jnp.asarray(v))
                 buf = self._layout.pack(b, vals)
@@ -878,11 +925,65 @@ class FusedSGD:
                 for b in self._layout.buckets]
             self._zero_masters = [
                 build(b, staged_masters,
-                      lambda i, n: weights[i]._data.reshape(-1)
-                      .astype(np.float32))
+                      lambda i, n: weights[dense_idx[i]]._data
+                      .reshape(-1).astype(np.float32))
                 if b.mp else None
                 for b in self._layout.buckets]
-        return self._zero_moms, self._zero_masters
+            # sparse momenta: staged values (restored checkpoint) fold
+            # into self.states; lazily created below
+            for i in sparse_idx:
+                v = staged_moms.get(names[i])
+                if v is not None:
+                    self.states[names[i]] = jnp.asarray(v)
+        # sparse momenta ride self.states in zero mode too: full
+        # (vocab, dim) tables committed to the WEIGHT's sharding (row
+        # -striped under a mesh — the "row-sharded momenta" half of
+        # zero=1 composition; the rows-only update touches rung rows)
+        sparse_moms = []
+        for i in sparse_idx:
+            n, w = names[i], weights[i]
+            if n not in self.states:
+                sharding = getattr(w._data, 'sharding', None)
+                zeros = jnp.zeros(w.shape, dtype=w.dtype)
+                self.states[n] = jax.device_put(zeros, sharding) \
+                    if sharding is not None else zeros
+            sparse_moms.append(self.states[n])
+        return list(self._zero_moms) + sparse_moms, self._zero_masters
+
+    def _make_zero_sparse_step(self, layout, dense_idx, sparse_idx):
+        """ZeRO-1 step math with sparse tables beside the buckets, all
+        captured BY VALUE (same contract as make_sharded_sgd_step).
+        moms arrives as [bucket shards...] + [sparse momentum
+        tables...]; returns new_ws aligned with the FULL param list and
+        the moms list in the same layered order."""
+        zm = self._zero_mod
+        mesh = self.mesh
+        hyper = dict(self._zero_hyper)
+        nb = len(layout.buckets)
+
+        def step_math(ws, gs, moms, masters, lrs, wds):
+            from .parallel.embedding import sparse_row_update
+            d_new, new_bmoms, new_masters = zm.sharded_sgd_step(
+                layout, mesh, hyper,
+                [ws[i] for i in dense_idx], [gs[i] for i in dense_idx],
+                list(moms[:nb]), masters,
+                [lrs[i] for i in dense_idx], [wds[i] for i in dense_idx])
+            new_ws = list(ws)
+            for k, i in enumerate(dense_idx):
+                new_ws[i] = d_new[k]
+            new_smoms = []
+            for k, i in enumerate(sparse_idx):
+                uids, d_rows = gs[i]
+                nw, nm = sparse_row_update(
+                    ws[i], moms[nb + k], uids, d_rows, lrs[i], wds[i],
+                    momentum=hyper['momentum'], rescale=hyper['rescale'],
+                    clip=hyper['clip'], nesterov=hyper['nesterov'],
+                    mesh=mesh)
+                new_ws[i] = nw
+                new_smoms.append(nm)
+            return new_ws, list(new_bmoms) + new_smoms, new_masters
+
+        return step_math
 
     def _stage_current(self):
         """Unpack the current ZeRO buckets into per-param staged values
@@ -908,8 +1009,17 @@ class FusedSGD:
         Replicated mode holds the full state everywhere; ZeRO mode
         holds the 1/dp bucket shards."""
         if self.zero:
-            return self._layout.state_bytes_per_device() \
+            total = self._layout.state_bytes_per_device() \
                 if self._layout is not None else 0
+            # sparse momentum tables: row-striped under a mesh, so each
+            # device holds ~1/dp of the rows
+            dp = 1 if self.mesh is None else int(self.mesh.shape['data'])
+            for i in self.sparse_idx:
+                v = self.states.get(self.param_names[i])
+                if v is not None:
+                    total += -(-int(v.size) *
+                               np.dtype(v.dtype).itemsize // dp)
+            return total
         total = 0
         for n in self.param_names:
             v = self.states.get(n)
@@ -930,10 +1040,15 @@ class FusedSGD:
 
     def commit(self, new_moms, new_masters):
         """Write back optimizer state returned by a step execution.
-        In ZeRO mode the lists are per-bucket dp-sharded buffers."""
+        In ZeRO mode the lists are per-bucket dp-sharded buffers,
+        with sparse momentum tables appended after the buckets."""
         if self.zero:
-            self._zero_moms = list(new_moms)
+            nb = len(self._layout.buckets) if self._layout is not None \
+                else len(new_moms) - len(self.sparse_idx)
+            self._zero_moms = list(new_moms[:nb])
             self._zero_masters = list(new_masters)
+            for k, i in enumerate(self.sparse_idx):
+                self.states[self.param_names[i]] = new_moms[nb + k]
             return
         for n, nm, nmw in zip(self.param_names, new_moms, new_masters):
             self.states[n] = nm
@@ -942,6 +1057,12 @@ class FusedSGD:
     def __call__(self, weights, grads):
         """weights/grads: lists of NDArray aligned with param_names.
         Updates weights in place (rebinding device buffers)."""
+        if self.sparse_idx:
+            from .base import MXNetError
+            raise MXNetError(
+                'a sparse-table FusedSGD only runs inside the fused '
+                'train step (its sparse gradients are COO pairs the '
+                'step constructs in-trace, not standalone arrays)')
         moms, masters, lrs, wds = self.host_prep(weights)
         ws = [w._data for w in weights]
         gs = [g._data for g in grads]
@@ -1028,6 +1149,15 @@ class FusedSGD:
                                       self._layout.unpack(
                                           b, np.asarray(mas))):
                         masters[names[i]] = seg
+            # sparse momentum tables live beside the buckets in
+            # self.states — without this merge a zero=1 sparse run's
+            # checkpoint would silently reset every table's momentum
+            for i in self.sparse_idx:
+                n = self.param_names[i]
+                v = self.states.get(n)
+                if v is not None:
+                    states[n] = np.asarray(v)
+                    masters.setdefault(n, None)
             return pickle.dumps(
                 (states, dict(self.optimizer._index_update_count),
                  masters))
@@ -1072,15 +1202,21 @@ class FusedSGD:
 
 
 def create_fused_updater(optimizer, param_names, zero=0, mesh=None,
-                         interleave=None):
+                         interleave=None, sparse_idx=()):
     """Return a fused whole-model updater when the optimizer supports it,
     else None (caller falls back to the per-key Updater).  FusedSGD
     handles multi_precision natively (fp32 masters inside the jitted
     step, reference mp_sgd_update).  zero=1 selects the ZeRO stage-1
     sharded update over `mesh`'s data axis (parallel/zero.py);
     interleave overrides the gradient-reduction schedule the sharded
-    step bakes in (None = MXNET_TPU_INTERLEAVE_REDUCE)."""
+    step bakes in (None = MXNET_TPU_INTERLEAVE_REDUCE).  sparse_idx
+    marks the positions whose gradients arrive as (unique_ids,
+    row_grads) COO pairs for the rows-only update
+    (parallel/embedding.py).  Sparse tables need the fused SGD/NAG
+    path: with a non-SGD optimizer this returns None and the caller's
+    fallback would feed dense grads to a per-key Updater, so callers
+    with sparse params must treat None as an error."""
     if type(optimizer) in (SGD, NAG):
         return FusedSGD(optimizer, param_names, zero=zero, mesh=mesh,
-                        interleave=interleave)
+                        interleave=interleave, sparse_idx=sparse_idx)
     return None

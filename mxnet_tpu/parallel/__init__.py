@@ -16,3 +16,4 @@ from . import collectives
 from . import pipeline
 from . import moe
 from . import zero
+from . import embedding

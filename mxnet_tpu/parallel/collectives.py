@@ -91,6 +91,26 @@ def allreduce_bucket(x, mesh):
     return allgather_bucket(x, mesh)
 
 
+def row_shard_constraint(x, mesh, axis='data'):
+    """GSPMD row-striping constraint for big 2-D tables under plain
+    `jax.jit`: pin dim 0 (the vocabulary rows) SHARDED over the dp
+    axis so each device persistently holds ~1/N of the rows — the
+    EncodeKey big-array striping of the reference's parameter server
+    (SURVEY §2.4), expressed as a sharding constraint instead of
+    key-chunking.  GSPMD handles a row count that does not divide the
+    axis (last shard is short).  Identity when no mesh is active.
+    parallel/embedding.py uses this on embedding tables and their
+    momenta; like every constraint here it is its own transpose, so a
+    table passing through it keeps its cotangent row-sharded too."""
+    if mesh is None or axis not in mesh.axis_names or \
+            int(mesh.shape[axis]) <= 1:
+        return x
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    spec = P(*([axis] + [None] * (x.ndim - 1)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def expert_shard(x, dim=0, axis='data'):
     """GSPMD expert-parallel constraint for plain-jit fused code
     (gluon.nn.MoE): shard `x`'s expert dimension over the ACTIVE

@@ -171,6 +171,61 @@ def moe_stats():
     return out
 
 
+# sparse embedding counters (Embedding(sparse_grad=True) through the
+# fused step, plus the serving hot-row cache): the touched-bytes
+# ledger is THE quantity this tier exists to shrink — the dense
+# equivalent is what the same steps would have paid at vocab rows
+_EMBED = {
+    'embed_steps': 0,
+    'embed_dispatches': 0,
+    'embed_lookups': 0,
+    'embed_unique_rows': 0,          # ladder-padded rows updated
+    'embed_touched_bytes': 0,        # optimizer-touched (rows-only)
+    'embed_dense_equiv_bytes': 0,    # dense-path equivalent
+    'embed_max_rung': 0,             # largest ladder rung seen
+    'hotrow_hits': 0,
+    'hotrow_misses': 0,
+    'hotrow_evictions': 0,
+    'hotrow_resident_bytes': 0,      # gauge, not cumulative
+}
+
+
+def add_embed_stats(steps=0, dispatches=0, lookups=0, unique_rows=0,
+                    touched_bytes=0, dense_equiv_bytes=0, max_rung=0,
+                    hits=0, misses=0, evictions=0, resident_bytes=None):
+    """Accumulate sparse-embedding counters (the fused step feeds one
+    call per sparse dispatch; the serving hot-row cache feeds
+    hits/misses/evictions per batch and the resident-bytes gauge)."""
+    with _STATE['lock']:
+        _EMBED['embed_steps'] += int(steps)
+        _EMBED['embed_dispatches'] += int(dispatches)
+        _EMBED['embed_lookups'] += int(lookups)
+        _EMBED['embed_unique_rows'] += int(unique_rows)
+        _EMBED['embed_touched_bytes'] += int(touched_bytes)
+        _EMBED['embed_dense_equiv_bytes'] += int(dense_equiv_bytes)
+        _EMBED['embed_max_rung'] = max(_EMBED['embed_max_rung'],
+                                       int(max_rung))
+        _EMBED['hotrow_hits'] += int(hits)
+        _EMBED['hotrow_misses'] += int(misses)
+        _EMBED['hotrow_evictions'] += int(evictions)
+        if resident_bytes is not None:
+            _EMBED['hotrow_resident_bytes'] = int(resident_bytes)
+
+
+def embed_stats():
+    """Snapshot of the sparse-embedding counters plus the derived
+    touched-bytes saving factor and hot-row hit rate."""
+    with _STATE['lock']:
+        out = dict(_EMBED)
+    out['embed_touched_frac'] = (
+        out['embed_touched_bytes'] / out['embed_dense_equiv_bytes']
+        if out['embed_dense_equiv_bytes'] else 0.0)
+    lookups = out['hotrow_hits'] + out['hotrow_misses']
+    out['hotrow_hit_rate'] = \
+        out['hotrow_hits'] / lookups if lookups else 0.0
+    return out
+
+
 # host input-pipeline counters (parallel decode pool + device prefetch):
 # decode work done by the workers, time the consumer waited on the pool,
 # ready-chunk queue depth observations, and training-loop-visible input
@@ -729,6 +784,8 @@ def dump_profile():
                    'args': pipe_stats()})
     events.append({'ph': 'M', 'name': 'moe', 'pid': 0,
                    'args': moe_stats()})
+    events.append({'ph': 'M', 'name': 'embed', 'pid': 0,
+                   'args': embed_stats()})
     events.append({'ph': 'M', 'name': 'checkpoint', 'pid': 0,
                    'args': ckpt_stats()})
     events.append({'ph': 'M', 'name': 'dist', 'pid': 0,
@@ -874,6 +931,21 @@ def summary(print_out=True):
         e = mo['moe_experts'][ek]
         lines.append('    expert %-4s routed=%d dropped=%d'
                      % (ek, e['routed'], e['dropped']))
+    em = embed_stats()
+    lines.append('  embed_steps=%d embed_dispatches=%d '
+                 'embed_unique_rows=%d embed_touched_bytes=%d '
+                 'embed_dense_equiv_bytes=%d embed_touched_frac=%.4f '
+                 'embed_max_rung=%d'
+                 % (em['embed_steps'], em['embed_dispatches'],
+                    em['embed_unique_rows'], em['embed_touched_bytes'],
+                    em['embed_dense_equiv_bytes'],
+                    em['embed_touched_frac'], em['embed_max_rung']))
+    lines.append('  hotrow_hits=%d hotrow_misses=%d '
+                 'hotrow_hit_rate=%.3f hotrow_evictions=%d '
+                 'hotrow_resident_bytes=%d'
+                 % (em['hotrow_hits'], em['hotrow_misses'],
+                    em['hotrow_hit_rate'], em['hotrow_evictions'],
+                    em['hotrow_resident_bytes']))
     bk = bucketing_stats()
     lines.append('  train_bucket_switches=%d train_pad_waste_rows=%d '
                  'train_pad_waste_frac=%.3f'
@@ -1008,6 +1080,8 @@ def clear():
         for k in _MOE:
             _MOE[k] = 0
         _MOE_EXPERTS.clear()
+        for k in _EMBED:
+            _EMBED[k] = 0
         for k in _CKPT:
             _CKPT[k] = type(_CKPT[k])()
         for k in _DIST:

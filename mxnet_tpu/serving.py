@@ -47,6 +47,7 @@ Typical use::
 Env knobs (docs/PERF.md round 9):
   MXNET_TPU_SERVE_MAX_BATCH     default max_batch (8)
   MXNET_TPU_SERVE_WAIT_US       default max_wait_us (2000)
+  MXNET_TPU_SERVE_HOT_ROWS      default hot_rows capacity (0 = off)
 """
 import contextlib
 import os
@@ -192,11 +193,32 @@ class InferenceEngine(object):
         input names).  Real traffic samples make the gate
         representative; without them a unit-gaussian batch at the top
         rung's shape is used.
+    hot_rows : int or dict, optional
+        Hot-row embedding cache (docs/SPARSE.md; default off, unset
+        resolves MXNET_TPU_SERVE_HOT_ROWS).  For each Embedding table
+        whose ids arrive as an engine INPUT, only a (C, dim)
+        device-resident hot buffer is kept; the full (vocab, dim)
+        table moves to HOST memory and the dispatcher remaps each
+        batch's ids onto cache slots, paging missed rows host->device
+        before the dispatch (LRU eviction, hit/miss/eviction counters
+        in stats()['hot_rows']).  Device weight residency for the
+        table drops vocab/C-fold — the serving-side complement of the
+        training tier's touched-rows-only updates.  An int caches
+        every eligible table at that capacity; a dict {weight_name:
+        C} picks tables (each named table must be eligible).  C is
+        clamped to vocab and must cover the worst-case ids per
+        dispatch (max_batch x the ids input's largest free bucket) so
+        one coalesced batch always fits — refused otherwise.  Like
+        quantize=, the swap takes ownership of the source's table
+        array (a plain Predictor.forward on the source would gather
+        from the truncated buffer); quantized tables are refused —
+        exclude them via the dict form or quantize=False.
     """
 
     def __init__(self, source, max_batch=None, batch_buckets=None,
                  max_wait_us=None, free_dim_buckets=None, pad_value=0.0,
-                 warmup=True, depth=2, quantize=None, calibrate=None):
+                 warmup=True, depth=2, quantize=None, calibrate=None,
+                 hot_rows=None):
         ex, symbol, ctx, input_names = _source_parts(source)
         if not input_names:
             raise MXNetError('InferenceEngine: source has no data inputs')
@@ -327,8 +349,17 @@ class InferenceEngine(object):
         self._quant_orig_dtype = {}     # name -> np dtype str
         self._quant_live = False        # serve fns take codes+scales
         self._quant_parity = None       # measured gate difference
+        self._hotrows = OrderedDict()   # weight name -> _HotRowTable
+        self._hotrow_shapes = {}        # weight name -> (C, dim)
         if self._quant is not None:
             self._setup_quantization(calibrate)
+        # hot-row cache setup runs after quantization: eligibility
+        # checks see the post-swap dtypes, and the quant parity gate
+        # must run against the full fp table
+        if hot_rows is None:
+            hot_rows = _env_int('MXNET_TPU_SERVE_HOT_ROWS', 0) or None
+        if hot_rows:
+            self._setup_hotrows(hot_rows)
         if warmup:
             self.warmup()
         self._dispatcher = threading.Thread(
@@ -389,11 +420,20 @@ class InferenceEngine(object):
                 return prog
             shapes = {n: (batch,) + f
                       for n, f in zip(self._input_names, free_entry)}
+            # hot-row tables bind at their (C, dim) cache shape —
+            # infer_shape keeps provided arg shapes, and shared_exec
+            # shares arrays only on an exact shape match, so the rung
+            # gathers from the SAME hot buffer NDArray the dispatcher
+            # pages into
+            shapes.update(self._hotrow_shapes)
             ex = self._symbol.simple_bind(self._ctx, grad_req='null',
                                           shared_exec=self._base_ex,
                                           **shapes)
+            embed_tok = tuple((n, st.capacity)
+                              for n, st in self._hotrows.items()) or None
             prog = _Program(ex, _make_serve_fn(ex, self._input_names,
-                                               quant=self._quant_info()),
+                                               quant=self._quant_info(),
+                                               embed=embed_tok),
                             [n for n in ex.arg_dict
                              if n not in self._input_names],
                             batch, free_entry)
@@ -533,6 +573,190 @@ class InferenceEngine(object):
         self._quant_scales = {}
         self._quant_orig_dtype = {}
         self._programs.clear()
+
+    # ------------------------------------------------------------------
+    # hot-row embedding cache (docs/SPARSE.md)
+    # ------------------------------------------------------------------
+    def _setup_hotrows(self, spec):
+        """Swap each selected Embedding table to a (C, dim)
+        device-resident hot buffer: the full (vocab, dim) table moves
+        to a host copy, every rung executor shares the hot buffer via
+        shared_exec, and the dispatcher remaps/pages per batch
+        (_hotrow_remap).  Runs before any rung exists (or clears
+        them), so no program ever binds the full-table shape."""
+        import jax
+        from .parallel import embedding as embed_mod
+        if isinstance(spec, dict):
+            req = {str(k): int(v) for k, v in spec.items()}
+            blanket = None
+        else:
+            req, blanket = {}, int(spec)
+        groups = OrderedDict()          # weight -> lookup group
+        for t in embed_mod.find_symbol_tables(self._symbol,
+                                              sparse_only=False):
+            g = groups.setdefault(t['weight'], {
+                'ids': [], 'vocab': t['vocab'], 'dim': t['dim'],
+                'why': None})
+            if t['ids_input'] is None:
+                g['why'] = 'its ids are graph-derived'
+            elif t['ids_input'] not in self._input_names:
+                g['why'] = ('its ids input %r is not an engine input'
+                            % t['ids_input'])
+            else:
+                idx = self._input_names.index(t['ids_input'])
+                if idx not in g['ids']:     # same input looked up twice
+                    g['ids'].append(idx)
+        unknown = set(req) - set(groups)
+        if unknown:
+            raise MXNetError('hot_rows: %s are not Embedding weights '
+                             'of this model (tables: %s)'
+                             % (sorted(unknown), sorted(groups)))
+        for name, g in groups.items():
+            cap = req.get(name, blanket)
+            if cap is None:
+                continue
+            if g['why'] is not None:
+                if name in req:
+                    raise MXNetError(
+                        'hot_rows[%r]: table is not cacheable — %s '
+                        '(the dispatcher can only remap ids it '
+                        'receives)' % (name, g['why']))
+                continue                # blanket skips ineligible
+            if name in self._quant_names:
+                raise MXNetError(
+                    'hot_rows[%r]: table is weight-quantized; the '
+                    'hot buffer pages fp rows — exclude the table '
+                    'via the hot_rows dict form or pass '
+                    'quantize=False' % name)
+            vocab, dim = g['vocab'], g['dim']
+            cap = min(int(cap), vocab)
+            # one coalesced dispatch must always fit: worst-case
+            # distinct ids = max_batch rows x the ids input's largest
+            # free extent, summed over this table's lookups
+            worst = max(
+                sum(self.max_batch *
+                    (int(np.prod(entry[k])) if entry[k] else 1)
+                    for k in g['ids'])
+                for entry in self._free_buckets)
+            worst = min(worst, vocab)
+            if cap < worst:
+                raise MXNetError(
+                    'hot_rows[%r]: capacity %d < worst-case %d '
+                    'distinct ids per dispatch (max_batch %d x the '
+                    'ids free extent) — a single batch could not be '
+                    'served from the cache' % (name, cap, worst,
+                                               self.max_batch))
+            arg = self._base_ex.arg_dict[name]
+            host = np.ascontiguousarray(arg.asnumpy())
+            buf = jax.device_put(np.zeros((cap, dim), host.dtype),
+                                 self._ctx.jax_device())
+            arg._data = buf             # rungs share this NDArray
+            self._hotrows[name] = _HotRowTable(name, tuple(g['ids']),
+                                               vocab, dim, cap, host,
+                                               arg)
+            self._hotrow_shapes[name] = (cap, dim)
+        if not self._hotrows:
+            raise MXNetError(
+                'hot_rows: no cacheable Embedding tables (need a '
+                'table whose ids arrive as an engine input)')
+        claimed = {}
+        for st in self._hotrows.values():
+            for k in st.ids_idx:
+                if k in claimed:
+                    raise MXNetError(
+                        'hot_rows: input %r feeds both table %r and '
+                        '%r — one ids array cannot be remapped onto '
+                        'two caches; exclude one via the dict form'
+                        % (self._input_names[k], claimed[k], st.name))
+                claimed[k] = st.name
+        self._programs.clear()          # fp/full-shape rungs, if any
+
+    def _hotrow_remap(self, host):
+        """Dispatcher-thread-only (single consumer, so the LRU state
+        needs no lock): map each hot table's batch ids onto cache
+        slots, paging missed rows host->device first.  Returns a new
+        host list — the exact-fill fast path aliases the caller's
+        arrays, which must not be scribbled on.
+
+        The page-in is a FUNCTIONAL .at[].set (no donation): with
+        depth-2 double buffering the previous dispatch may still be
+        reading the old buffer, which the functional update keeps
+        alive until that dispatch drains.  Miss counts pad to the
+        next power of two (slot `capacity` is out of range ->
+        mode='drop' ignores the pad lanes), so page-in programs
+        ladder at log2(C) shapes instead of one per miss count."""
+        import jax
+        out = list(host)
+        ev_batch = miss_batch = hit_batch = 0
+        for st in self._hotrows.values():
+            per_k = []
+            for k in st.ids_idx:
+                a = np.asarray(host[k])
+                ids = a.astype(np.int64) if a.dtype.kind in 'iu' \
+                    else np.rint(a).astype(np.int64)
+                np.clip(ids, 0, st.vocab - 1, out=ids)
+                per_k.append(ids)
+            flat = np.concatenate([i.ravel() for i in per_k])
+            uniq, inv = np.unique(flat, return_inverse=True)
+            uniq_l = uniq.tolist()
+            curset = set(uniq_l)
+            missing = [u for u in uniq_l if u not in st.resident]
+            hits = len(uniq_l) - len(missing)
+            if missing:
+                victims = (u for u in list(st.resident)
+                           if u not in curset)
+                slots_new = []
+                for _u in missing:
+                    if st.free:
+                        slots_new.append(st.free.pop())
+                    else:
+                        v = next(victims)   # guaranteed: cap >= |uniq|
+                        slots_new.append(st.resident.pop(v))
+                        st.evictions += 1
+                        ev_batch += 1
+                rung = 1
+                while rung < len(missing):
+                    rung *= 2
+                pad = rung - len(missing)
+                rows = st.host[np.asarray(missing, np.int64)]
+                slots_arr = np.asarray(slots_new + [st.capacity] * pad,
+                                       np.int32)
+                if pad:
+                    rows = np.concatenate(
+                        [rows, np.zeros((pad, st.dim), rows.dtype)])
+                dev = self._ctx.jax_device()
+                st.arg._data = _page_fn()(
+                    st.arg._data, jax.device_put(slots_arr, dev),
+                    jax.device_put(rows, dev))
+            else:
+                slots_new = []
+            # LRU order: touch hits, then append the fresh rows
+            for u in uniq_l:
+                if u in st.resident:
+                    st.resident.move_to_end(u)
+            for u, s in zip(missing, slots_new):
+                st.resident[u] = s
+            st.hits += hits
+            st.misses += len(missing)
+            hit_batch += hits
+            miss_batch += len(missing)
+            # remap ids -> slots through the unique inverse and split
+            # back per input
+            slot_per_uniq = np.asarray(
+                [st.resident[u] for u in uniq_l], np.int64)
+            remapped = slot_per_uniq[inv]
+            off = 0
+            for k, ids in zip(st.ids_idx, per_k):
+                n = ids.size
+                out[k] = remapped[off:off + n].reshape(
+                    ids.shape).astype(np.asarray(host[k]).dtype)
+                off += n
+        profiler.add_embed_stats(
+            hits=hit_batch, misses=miss_batch, evictions=ev_batch,
+            resident_bytes=sum(
+                st.capacity * st.dim * st.host.dtype.itemsize
+                for st in self._hotrows.values()))
+        return out
 
     def resident_bytes(self):
         """Bytes the engine's weights/aux actually hold resident
@@ -769,6 +993,22 @@ class InferenceEngine(object):
             out['quantized']['weights'] = len(self._quant_names)
             out['quantized']['parity_measured'] = self._quant_parity
             out['resident_bytes'] = self.resident_bytes()
+        if self._hotrows:
+            hr = {}
+            for name, st in self._hotrows.items():
+                tot = st.hits + st.misses
+                item = np.dtype(st.host.dtype).itemsize
+                hr[name] = {
+                    'capacity': st.capacity,
+                    'resident': len(st.resident),
+                    'hits': st.hits,
+                    'misses': st.misses,
+                    'evictions': st.evictions,
+                    'hit_rate': st.hits / tot if tot else 0.0,
+                    'resident_bytes': st.capacity * st.dim * item,
+                    'table_bytes': st.vocab * st.dim * item,
+                }
+            out['hot_rows'] = hr
         snap = self._warm_snapshot
         if snap is not None:
             now = exec_cache.stats()
@@ -918,6 +1158,8 @@ class InferenceEngine(object):
                     buf[sl] = a
                     off += r.rows
                 host.append(buf)
+        if self._hotrows:
+            host = self._hotrow_remap(host)
         with profiler.scope('serve_stage', 'serving'):
             dvals = tuple(mxio.stage_to_device(host,
                                                device=self._ctx))
@@ -1075,6 +1317,47 @@ class InferenceEngine(object):
 # helpers
 # ---------------------------------------------------------------------------
 
+class _HotRowTable(object):
+    """Host-side state of one hot-row-cached Embedding table: the
+    full (vocab, dim) table on host, the LRU id->slot map of the
+    (capacity, dim) device buffer, and lifetime counters.  Touched
+    only by the dispatcher thread (and read by stats())."""
+    __slots__ = ('name', 'ids_idx', 'vocab', 'dim', 'capacity', 'host',
+                 'arg', 'resident', 'free', 'hits', 'misses',
+                 'evictions')
+
+    def __init__(self, name, ids_idx, vocab, dim, capacity, host, arg):
+        self.name = name
+        self.ids_idx = ids_idx          # engine-input positions
+        self.vocab = vocab
+        self.dim = dim
+        self.capacity = capacity
+        self.host = host                # full (vocab, dim) np table
+        self.arg = arg                  # NDArray holding the hot buffer
+        self.resident = OrderedDict()   # id -> slot, LRU order
+        self.free = list(range(capacity))
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+
+_PAGE_FN = None
+
+
+def _page_fn():
+    """The jitted hot-row page-in: buf.at[slots].set(rows) with
+    out-of-range pad slots dropped.  One function process-wide —
+    jax.jit's shape cache ladders it across (capacity, rung)
+    combinations."""
+    global _PAGE_FN
+    if _PAGE_FN is None:
+        import jax
+        _PAGE_FN = jax.jit(
+            lambda buf, slots, rows:
+            buf.at[slots].set(rows.astype(buf.dtype), mode='drop'))
+    return _PAGE_FN
+
+
 # warnings.catch_warnings mutates process-global filter state:
 # concurrent cold calls from DIFFERENT engines (each under its own
 # _prog_lock) must not nest it across threads
@@ -1107,7 +1390,7 @@ def _source_parts(source):
                      'Module, got %r' % (source,))
 
 
-def _make_serve_fn(ex, input_names, quant=None):
+def _make_serve_fn(ex, input_names, quant=None, embed=None):
     """The bucket's serve program: forward-only jit over (data_vals,
     weight_vals, aux_vals, rng) with the data staging buffers DONATED
     (input memory becomes XLA scratch).  Shared process-wide through
@@ -1137,7 +1420,8 @@ def _make_serve_fn(ex, input_names, quant=None):
         cfg, qnames, orig_dtype = quant
         qflags = tuple(n in qnames for n in other_names)
         token = cfg.key(tuple(i for i, f in enumerate(qflags) if f))
-    key = exec_cache.serve_step_key(ex._sig, input_names, quant=token) \
+    key = exec_cache.serve_step_key(ex._sig, input_names, quant=token,
+                                    embed=embed) \
         if ex._sig is not None else None
     if key is not None:
         fn = exec_cache.get(key)

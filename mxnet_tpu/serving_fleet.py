@@ -850,7 +850,19 @@ class ModelRegistry(object):
                 m['paged_bytes'] = ent.paged_bytes
             m.update(ent.slo.describe())
             if m['resident'] and hasattr(eng, 'stats'):
-                m['engine'] = eng.stats()
+                es = eng.stats()
+                m['engine'] = es
+                hr = es.get('hot_rows')
+                if hr:
+                    # top-level per-model signal (docs/SPARSE.md): a
+                    # cold hit rate on a hot-row model says the cache
+                    # is undersized for its id distribution — the
+                    # operator-facing cue to raise hot_rows= before
+                    # latency (page-in per batch) degrades
+                    hits = sum(t['hits'] for t in hr.values())
+                    total = hits + sum(t['misses'] for t in hr.values())
+                    m['hot_row_hit_rate'] = hits / total if total \
+                        else 0.0
             models[ent.name] = m
         out['models'] = models
         return out

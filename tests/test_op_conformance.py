@@ -226,6 +226,11 @@ CASES = {
     'scatter_nd': Case([(2,), (2, 2)],
                        attrs={'shape': (4, 3)}, grad=False,
                        int_inputs=(1,)),
+    # accumulating variant (duplicate-index ADD semantics pinned by
+    # tests/test_sparse_embed.py)
+    '_backward_gather_nd': Case([(2,), (2, 2)],
+                                attrs={'shape': (4, 3)}, grad=False,
+                                int_inputs=(1,)),
 
     # -- neural network ----------------------------------------------------
     'FullyConnected': Case([(2, 3), (4, 3), (4,)],
@@ -416,6 +421,10 @@ SKIP = {
     'sgd_mom_update': 'covered by tests/test_missing_ops.py',
     'mp_sgd_update': 'covered by tests/test_missing_ops.py',
     'mp_sgd_mom_update': 'covered by tests/test_missing_ops.py',
+    'sparse_sgd_update': 'rows-only COO update parity covered by '
+                         'tests/test_sparse_embed.py',
+    'sparse_sgd_mom_update': 'rows-only lazy-momentum parity covered '
+                             'by tests/test_sparse_embed.py',
     'adam_update': 'covered by tests/test_missing_ops.py',
     'rmsprop_update': 'covered by tests/test_missing_ops.py',
     'rmspropalex_update': 'covered by tests/test_missing_ops.py',

@@ -1,0 +1,482 @@
+"""Sparse embedding tier (parallel/embedding.py + the fused paths):
+mesh-row-striped tables, touched-rows-only COO gradients and rows-only
+optimizer updates inside the single donated dispatch (gluon fuse_step
+AND Module), the unique-count bucket ladder (zero steady-state
+recompiles, re-created trainers included), full-entry elastic
+checkpoints that restore across a dp-width change, the hot-row serving
+cache, and the satellite op contracts (Embedding clip pinning, take
+unknown-mode refusal, accumulating _backward_gather_nd, the registered
+sparse_sgd(_mom)_update ops).
+
+Parity contract under test: with plain SGD (wd or not) the rows-only
+update is BITWISE equal to the dense path whenever it touches the same
+rows; with momentum the semantics are LAZY (untouched rows keep their
+momentum frozen — optimizer_ops.py docstring), so momentum parity is
+asserted only on full-coverage id streams where lazy == dense.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import exec_cache, gluon, nd, profiler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+
+VOCAB = 64
+DIM = 8
+BATCH = 16
+_LOSS = gluon.loss.L2Loss()
+
+
+def _make_net(sparse, seed=3, ctxs=None, vocab=VOCAB, dim=DIM):
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(vocab, dim, sparse_grad=sparse))
+    net.add(nn.Dense(4, flatten=False, in_units=dim))
+    net.initialize(force_reinit=True, ctx=ctxs)
+    rs = np.random.RandomState(seed)
+    for _, p in sorted(net.collect_params().items()):
+        p.set_data(nd.array(
+            (rs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2))
+    return net
+
+
+def _batches(n=4, lo=0, hi=VOCAB, batch=BATCH, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(nd.array(rs.randint(lo, hi, size=(batch,))
+                      .astype(np.float32)),
+             nd.array(rs.randn(batch, 4).astype(np.float32)))
+            for _ in range(n)]
+
+
+def _full_coverage_batches(n=4, vocab=VOCAB, seed=0):
+    """Every table row appears in every batch — the stream on which
+    lazy momentum/wd equals dense momentum/wd."""
+    rs = np.random.RandomState(seed)
+    ids = np.arange(vocab, dtype=np.float32)
+    return [(nd.array(ids),
+             nd.array(rs.randn(vocab, 4).astype(np.float32)))
+            for _ in range(n)]
+
+
+def _pvals(net, fused=None, trainer=None):
+    """Param values in sorted-name order; a mesh-striped sparse table
+    reads through the fused step's canonical copy."""
+    out = []
+    for _, p in sorted(net.collect_params().items()):
+        arr = None
+        if fused is not None and getattr(p, 'sparse_grad', False):
+            ent = fused._repl.get(id(p))
+            if ent is not None:
+                arr = np.asarray(ent[0])
+        if arr is None:
+            arr = p.list_data()[0].asnumpy()
+        out.append(np.asarray(arr, dtype=np.float32))
+    return out
+
+
+def _train(net, opt_params, batches, **fuse_kw):
+    tr = gluon.Trainer(net.collect_params(), 'sgd', dict(opt_params))
+    fused = gluon.fuse_step(net, _LOSS, tr, **fuse_kw)
+    for x, y in batches:
+        fused(x, y)
+    return fused, tr
+
+
+# ---------------------------------------------------------------------------
+# dense vs sparse parity — gluon fused path
+# ---------------------------------------------------------------------------
+
+def test_gluon_parity_plain_sgd_bitwise():
+    batches = _batches(4)
+    nd_net = _make_net(False)
+    _train(nd_net, {'learning_rate': 0.1, 'wd': 0.0}, batches)
+    sp_net = _make_net(True)
+    fs, _ = _train(sp_net, {'learning_rate': 0.1, 'wd': 0.0}, batches)
+    for a, b in zip(_pvals(nd_net), _pvals(sp_net, fs)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_gluon_parity_momentum_full_coverage():
+    """Full-coverage ids: lazy momentum+wd degenerate to dense — the
+    two program partitions agree to float32-ulp (not bitwise; XLA
+    fuses the gather/scatter arm differently)."""
+    batches = _full_coverage_batches(4)
+    opt = {'learning_rate': 0.1, 'momentum': 0.9, 'wd': 1e-3}
+    nd_net = _make_net(False)
+    _train(nd_net, opt, batches)
+    sp_net = _make_net(True)
+    fs, _ = _train(sp_net, opt, batches)
+    for a, b in zip(_pvals(nd_net), _pvals(sp_net, fs)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_gluon_lazy_momentum_untouched_rows_frozen():
+    """Ids confined to [0, 8): rows >= 8 must be exactly untouched
+    (weight unchanged) even under momentum+wd — the touched-bytes
+    contract, not just a tolerance."""
+    batches = _batches(3, lo=0, hi=8)
+    net = _make_net(True)
+    w0 = _pvals(net)[0].copy()
+    fs, _ = _train(net, {'learning_rate': 0.1, 'momentum': 0.9,
+                         'wd': 1e-3}, batches)
+    w1 = _pvals(net, fs)[0]
+    np.testing.assert_array_equal(w0[8:], w1[8:])
+    assert np.abs(w1[:8] - w0[:8]).max() > 0
+
+
+def test_gluon_bulk_matches_single_sparse():
+    batches = _batches(3)
+    n1 = _make_net(True, seed=8)
+    f1, _ = _train(n1, {'learning_rate': 0.1}, batches)
+    nb = _make_net(True, seed=8)
+    tr = gluon.Trainer(nb.collect_params(), 'sgd',
+                       {'learning_rate': 0.1})
+    fb = gluon.fuse_step(nb, _LOSS, tr)
+    xs = nd.NDArray(jnp.stack([x._data for x, _ in batches]))
+    ys = nd.NDArray(jnp.stack([y._data for _, y in batches]))
+    losses = fb.bulk(xs, ys)
+    assert losses.shape[0] == 3
+    for a, b in zip(_pvals(n1, f1), _pvals(nb, fb)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_gluon_zero1_sparse_parity():
+    """zero=1 (row-sharded momenta) composes with the sparse tier:
+    same weights as zero=0 on the same 2-device mesh."""
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    batches = _batches(3)
+    opt = {'learning_rate': 0.1, 'momentum': 0.9}
+    outs = {}
+    for zero in (0, 1):
+        net = _make_net(True, ctxs=ctxs)
+        fs, _ = _train(net, opt, batches, zero=zero)
+        outs[zero] = _pvals(net, fs)
+    for a, b in zip(outs[0], outs[1]):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_table_stripes_one_over_dp():
+    """The sparse table's device residency really is ~1/dp of the
+    table: exact here (vocab divisible by the 4-device mesh)."""
+    ctxs = [mx.cpu(i) for i in range(4)]
+    net = _make_net(True, ctxs=ctxs)
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1})
+    fused = gluon.fuse_step(net, _LOSS, tr)
+    for x, y in _batches(2):
+        fused(x, y)
+    p = next(p for p in tr._params if getattr(p, 'sparse_grad', False))
+    ent = fused._repl.get(id(p))
+    arr = ent[0] if ent else p.list_data()[0]._data
+    total = int(np.prod(arr.shape))
+    per_dev = max(int(np.prod(s.data.shape))
+                  for s in arr.addressable_shards)
+    assert len(arr.addressable_shards) == 4
+    assert per_dev == total // 4
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder: zero steady-state recompiles
+# ---------------------------------------------------------------------------
+
+def test_ladder_zero_steady_state_compiles():
+    few = _batches(3, lo=0, hi=4, seed=1)      # tiny unique count
+    many = _batches(3, lo=0, hi=VOCAB, seed=2)  # larger rung
+    net = _make_net(True)
+    fused, _ = _train(net, {'learning_rate': 0.1}, few + many)
+    st0 = exec_cache.stats()
+    # steady state: alternate distributions — re-bucketing between
+    # rungs is a cache hit, never a compile
+    for x, y in few + many + few:
+        fused(x, y)
+    st1 = exec_cache.stats()
+    assert st1['misses'] == st0['misses']
+    assert st1['total_compile_s'] == st0['total_compile_s']
+    # a re-created net/trainer adopts the published trace facts and
+    # lands on the cached programs without a discovery trace
+    net2 = _make_net(True, seed=99)
+    fused2, _ = _train(net2, {'learning_rate': 0.1}, few + many)
+    st2 = exec_cache.stats()
+    assert st2['misses'] == st1['misses']
+    assert st2['total_compile_s'] == st1['total_compile_s']
+
+
+def test_embed_counters_flow():
+    profiler.clear()
+    net = _make_net(True)
+    _train(net, {'learning_rate': 0.1}, _batches(3))
+    st = profiler.embed_stats()
+    assert st['embed_steps'] >= 3
+    assert st['embed_dispatches'] >= 3
+    assert 0 < st['embed_touched_bytes'] < st['embed_dense_equiv_bytes']
+    assert st['embed_max_rung'] >= 1
+    assert 'embed' in profiler.summary(print_out=False)
+
+
+# ---------------------------------------------------------------------------
+# dense vs sparse parity — Module fused path
+# ---------------------------------------------------------------------------
+
+def _module(sparse, vocab=50, dim=4, seed=7):
+    s = mx.sym
+    data = s.Variable('data')
+    emb = s.Embedding(data, name='emb', input_dim=vocab, output_dim=dim,
+                      sparse_grad=sparse)
+    net = s.SoftmaxOutput(s.FullyConnected(s.Flatten(emb), name='fc',
+                                           num_hidden=3),
+                          name='softmax')
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 6))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (8,))])
+    mx.random.seed(seed)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1})
+    return mod
+
+
+def _module_batches(n=4, vocab=50, seed=0):
+    rs = np.random.RandomState(seed)
+    return [mx.io.DataBatch(
+        data=[nd.array(rs.randint(0, vocab, size=(8, 6))
+                       .astype(np.float32))],
+        label=[nd.array((rs.rand(8) * 3).astype(np.float32))])
+        for _ in range(n)]
+
+
+def test_module_parity_plain_sgd_bitwise():
+    batches = _module_batches()
+    mods = [_module(False), _module(True)]
+    for mod in mods:
+        for b in batches:
+            mod.forward_backward(b)
+            mod.update()
+    pa, _ = mods[0].get_params()
+    pb, _ = mods[1].get_params()
+    assert set(pa) == set(pb)
+    for k in pa:
+        np.testing.assert_array_equal(pa[k].asnumpy(), pb[k].asnumpy(),
+                                      err_msg=k)
+
+
+def test_module_refuses_graph_derived_ids():
+    """Sparse tables looked up with COMPUTED ids can't ride the COO
+    path (the host can't see the ids to dedup) — a typed refusal, not
+    a silent densification."""
+    s = mx.sym
+    data = s.Variable('data')
+    ids = data * 1.0                      # graph-derived, not an input
+    emb = s.Embedding(ids, name='emb', input_dim=50, output_dim=4,
+                      sparse_grad=True)
+    net = s.SoftmaxOutput(s.FullyConnected(s.Flatten(emb), name='fc',
+                                           num_hidden=3),
+                          name='softmax')
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 6))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (8,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    # the refusal fires as soon as the fused updater is planned — at
+    # init_optimizer, not at the first update
+    with pytest.raises(MXNetError, match='graph-derived|sparse_grad'):
+        mod.init_optimizer(optimizer='sgd',
+                           optimizer_params={'learning_rate': 0.1})
+
+
+# ---------------------------------------------------------------------------
+# elastic checkpoints: full-entry tables restore across a dp-width change
+# ---------------------------------------------------------------------------
+
+def _elastic_run(tmpdir, ndev, batches, ckpt_every=None, start=0,
+                 upto=None, seed=3):
+    from mxnet_tpu import elastic
+    ctxs = [mx.cpu(i) for i in range(ndev)]
+    net = _make_net(True, seed=seed, ctxs=ctxs)
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1, 'momentum': 0.9})
+    mgr = elastic.CheckpointManager(
+        str(tmpdir), async_=False,
+        **({'every_n_steps': ckpt_every} if ckpt_every else {})) \
+        if tmpdir is not None else None
+    fused = gluon.fuse_step(net, _LOSS, tr, checkpoint=mgr)
+    upto = len(batches) if upto is None else upto
+    for x, y in batches[start:upto]:
+        fused(x, y)
+    vals = _pvals(net, fused)
+    if mgr is not None:
+        mgr.close()
+    return vals, mgr
+
+
+def test_checkpoint_restores_across_dp_width_change(tmp_path):
+    """Checkpoints store the FULL row-striped table (elastic.py
+    _local_full assembles every shard) — so a 2-device run resumes on
+    a 4-device mesh, re-striping the rows, and finishes with the same
+    weights as the uninterrupted run."""
+    from mxnet_tpu import elastic
+    batches = _batches(6)
+    truth, _ = _elastic_run(None, 2, batches)
+    _elastic_run(tmp_path, 2, batches, ckpt_every=3, upto=3)
+    assert elastic.list_checkpoints(str(tmp_path)) == [3]
+    resumed, mgr2 = _elastic_run(tmp_path, 4, batches, start=3)
+    assert mgr2.last_resume is not None and mgr2.last_resume.step == 3
+    for a, b in zip(truth, resumed):
+        np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# hot-row serving cache
+# ---------------------------------------------------------------------------
+
+def _pred_module(vocab=200, dim=8, seed=11):
+    s = mx.sym
+    data = s.Variable('data')
+    emb = s.Embedding(data, name='emb', input_dim=vocab, output_dim=dim)
+    net = s.FullyConnected(s.Flatten(emb), name='fc', num_hidden=3)
+    mx.random.seed(seed)
+    mod = mx.mod.Module(net, label_names=None)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (8, 4))],
+             for_training=False)
+    mod.init_params(initializer=mx.init.Xavier(rnd_type='gaussian'))
+    return mod
+
+
+def test_hot_row_cache_parity_counters_eviction():
+    from mxnet_tpu.serving import InferenceEngine
+    vocab, dim, cap = 200, 8, 48
+    rng = np.random.RandomState(5)
+    bs = [rng.randint(0, vocab, size=(8, 4)).astype(np.float32)
+          for _ in range(6)]
+    bs.append(bs[0].copy())              # repeat tail: hits expected
+    ref = InferenceEngine(_pred_module(vocab, dim), max_batch=8,
+                          quantize=False)
+    want = [ref.predict(b) for b in bs]
+    ref.close()
+    eng = InferenceEngine(_pred_module(vocab, dim), max_batch=8,
+                          quantize=False, hot_rows=cap)
+    try:
+        got = [eng.predict(b) for b in bs]
+        for w, g in zip(want, got):
+            np.testing.assert_allclose(w, g, atol=1e-5)
+        st = eng.stats()['hot_rows']['emb_weight']
+        assert st['capacity'] == cap
+        assert st['hits'] > 0 and st['misses'] > 0
+        assert st['evictions'] > 0       # 7 batches x ~30 uniq >> 48
+        assert st['resident'] <= cap
+        assert st['resident_bytes'] == cap * dim * 4
+        assert st['table_bytes'] == vocab * dim * 4
+        # device residency really is (C, dim), not the full table
+        assert tuple(eng._hotrows['emb_weight'].arg._data.shape) == \
+            (cap, dim)
+    finally:
+        eng.close()
+
+
+def test_hot_row_refusals():
+    from mxnet_tpu.serving import InferenceEngine
+    with pytest.raises(MXNetError, match='capacity|worst'):
+        InferenceEngine(_pred_module(), max_batch=8, quantize=False,
+                        hot_rows=8)
+    with pytest.raises(MXNetError, match='nope'):
+        InferenceEngine(_pred_module(), max_batch=8, quantize=False,
+                        hot_rows={'nope': 64})
+
+
+# ---------------------------------------------------------------------------
+# satellite op contracts
+# ---------------------------------------------------------------------------
+
+def test_embedding_clips_out_of_range_ids():
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    ids = nd.array(np.array([-3, 0, 3, 9], dtype=np.float32))
+    out = nd.Embedding(ids, w, input_dim=4, output_dim=3).asnumpy()
+    np.testing.assert_array_equal(out[0], w.asnumpy()[0])   # clip low
+    np.testing.assert_array_equal(out[3], w.asnumpy()[3])   # clip high
+
+
+def test_take_unknown_mode_raises():
+    a = nd.array(np.arange(6, dtype=np.float32))
+    idx = nd.array(np.array([0, 5], dtype=np.float32))
+    assert nd.take(a, idx, mode='clip').shape == (2,)
+    with pytest.raises(MXNetError, match="mode"):
+        nd.take(a, idx, mode='raise')
+
+
+def test_backward_gather_nd_accumulates_duplicates():
+    """scatter_nd keeps the reference's last-wins on duplicate indices;
+    _backward_gather_nd (alias scatter_nd_acc) ADDS — the conformance
+    split a sparse gradient path depends on."""
+    data = nd.array(np.array([1.0, 2.0, 4.0], dtype=np.float32))
+    idx = nd.array(np.array([[1, 1, 2]], dtype=np.float32))
+    acc = nd._backward_gather_nd(data, idx, shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(acc, [0.0, 3.0, 4.0, 0.0])
+    alias = nd.scatter_nd_acc(data, idx, shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(alias, acc)
+    last = nd.scatter_nd(data, idx, shape=(4,)).asnumpy()
+    assert last[1] in (1.0, 2.0) and last[2] == 4.0 and last[0] == 0.0
+
+
+def test_sparse_sgd_update_ops():
+    V, D, R = 10, 4, 6
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(V, D).astype(np.float32)
+    uids = np.array([1, 3, 5, 7, V, V], dtype=np.int32)  # padded tail
+    rows = rng.randn(R, D).astype(np.float32)
+    gd = np.zeros((V, D), np.float32)
+    gd[uids[:4]] = rows[:4]
+    w = nd.array(w0.copy())
+    nd.sparse_sgd_update(w, nd.array(uids), nd.array(rows), out=w,
+                         lr=0.1, wd=0.0, rescale_grad=0.5)
+    wref = nd.array(w0.copy())
+    nd.sgd_update(wref, nd.array(gd), out=wref, lr=0.1, wd=0.0,
+                  rescale_grad=0.5)
+    np.testing.assert_array_equal(w.asnumpy(), wref.asnumpy())
+
+    # momentum, every row touched: matches dense sgd_mom_update
+    uids_all = np.arange(V, dtype=np.int32)
+    rows_all = rng.randn(V, D).astype(np.float32)
+    w = nd.array(w0.copy())
+    m = nd.zeros((V, D))
+    wref = nd.array(w0.copy())
+    mref = nd.zeros((V, D))
+    for _ in range(3):
+        nd.sparse_sgd_mom_update(w, nd.array(uids_all),
+                                 nd.array(rows_all), m, out=w,
+                                 lr=0.1, wd=0.01, momentum=0.9)
+        nd.sgd_mom_update(wref, nd.array(rows_all), mref, out=wref,
+                          lr=0.1, wd=0.01, momentum=0.9)
+    np.testing.assert_allclose(w.asnumpy(), wref.asnumpy(), atol=1e-6)
+    np.testing.assert_allclose(m.asnumpy(), mref.asnumpy(), atol=1e-6)
+
+    # lazy: untouched row 0 frozen (weight AND momentum)
+    w = nd.array(w0.copy())
+    m = nd.zeros((V, D))
+    nd.sparse_sgd_mom_update(w, nd.array(uids), nd.array(rows), m,
+                             out=w, lr=0.1, momentum=0.9)
+    np.testing.assert_array_equal(m.asnumpy()[0], np.zeros(D))
+    np.testing.assert_array_equal(w.asnumpy()[0], w0[0])
+    assert np.abs(m.asnumpy()[3]).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# typed refusals: compositions the sparse tier rejects
+# ---------------------------------------------------------------------------
+
+def test_ema_refuses_sparse_tables():
+    net = _make_net(True)
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1})
+    fused = gluon.fuse_step(net, _LOSS, tr, ema_decay=0.99)
+    x, y = _batches(1)[0]
+    # the plan (and the refusal) materializes at the first dispatch
+    with pytest.raises(MXNetError, match='ema_decay'):
+        fused(x, y)
+
+
+def test_pipeline_refuses_sparse_tables():
+    ctxs = [mx.cpu(i) for i in range(4)]
+    net = _make_net(True, ctxs=ctxs)
+    tr = gluon.Trainer(net.collect_params(), 'sgd',
+                       {'learning_rate': 0.1})
+    with pytest.raises(MXNetError, match='pipeline'):
+        gluon.fuse_step(net, _LOSS, tr, pipeline=(2, 2))

@@ -52,6 +52,14 @@ def main():
                         'residency; one bench.py child that spawns '
                         'its own virtual CPU mesh when needed) '
                         'instead of the model-family sweep')
+    p.add_argument('--embed', action='store_true',
+                   help='run the BENCH_EMBED sparse-embedding A/B '
+                        '(dense vs touched-rows-only gradients across '
+                        'uniform/zipf/repeat id distributions, parity '
+                        'and zero-recompile gated, plus the '
+                        '2x-virtual-device table-sharding child; one '
+                        'bench.py child) instead of the model-family '
+                        'sweep')
     p.add_argument('--ckpt', action='store_true',
                    help='run the BENCH_CKPT elastic-checkpoint '
                         'overhead A/B (no-checkpoint vs async cadence '
@@ -83,12 +91,14 @@ def main():
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
     if args.gluon or args.overlap or args.bucket or args.pipe or \
-            args.ckpt or args.serve_fleet or args.int8 or args.loop:
+            args.ckpt or args.serve_fleet or args.int8 or args.loop \
+            or args.embed:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
                      else ('bucket', 'BENCH_BUCKET') if args.bucket
                      else ('pipe', 'BENCH_PIPE') if args.pipe
                      else ('ckpt', 'BENCH_CKPT') if args.ckpt
+                     else ('embed', 'BENCH_EMBED') if args.embed
                      else ('int8', 'BENCH_INT8') if args.int8
                      else ('loop', 'BENCH_LOOP') if args.loop
                      else ('serve-fleet', 'BENCH_FLEET'))
