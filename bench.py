@@ -1654,6 +1654,12 @@ def fleet_bench():
           ContinuousEngine vs the same engine in convoy mode
           (admission only into an empty batch): throughput best-of-N,
           gated on BIT-parity of the continuous outputs vs solo runs.
+      (b2) **chunked ticks** — the tick_chunk ladder (K=1/4/16 per
+          dispatch, its own slot count since the engine rejects
+          K > slots): throughput best-of-N per rung, gated on
+          BIT-parity of every chunked run vs the K=1 baseline and on
+          zero steady-state compiles; reports the dispatch-count drop
+          (ticks per XLA dispatch at the top rung).
       (c) **registry paging** — evict/re-warm cycles under a byte
           budget that fits one model: steady-state exec_cache miss
           delta must be ZERO.
@@ -1665,7 +1671,8 @@ def fleet_bench():
     SLO arm's measured p99 sits well under it, the single-knob arm's
     well over), BENCH_FLEET_GLOBAL_WAIT_US (60000 — the single knob,
     tuned for bulk fill), BENCH_FLEET_SEQS (24),
-    BENCH_FLEET_SLOTS (4).
+    BENCH_FLEET_SLOTS (4), BENCH_FLEET_CHUNKS ('1,4,16'),
+    BENCH_FLEET_CHUNK_SLOTS (max rung), BENCH_FLEET_CHUNK_LEN (48).
     """
     import threading
     import urllib.request
@@ -1857,6 +1864,60 @@ def fleet_bench():
         if s > convoy_sps:
             convoy_sps, convoy_st = s, st
 
+    # -- (b2) chunk ladder: K ticks per XLA dispatch -------------------
+    chunks_env = os.environ.get('BENCH_FLEET_CHUNKS', '1,4,16')
+    ladder = [max(1, int(t)) for t in chunks_env.split(',')
+              if t.strip()]
+    chunk_slots = int(os.environ.get('BENCH_FLEET_CHUNK_SLOTS',
+                                     max([slots] + ladder)))
+    chunk_len = int(os.environ.get('BENCH_FLEET_CHUNK_LEN', 48))
+    cseqs = [rs.randn(chunk_len, sdim).astype(np.float32)
+             for _ in range(n_seqs)]
+
+    def chunk_pass(K):
+        engine = ContinuousEngine(cell, arg_params=cp,
+                                  data_shape=(sdim,),
+                                  state_shapes={'h': (shid,)},
+                                  state_outputs={'h': 1},
+                                  slots=chunk_slots, tick_chunk=K)
+        out = [None] * len(cseqs)
+        ts = [threading.Thread(
+            target=lambda i=i: out.__setitem__(i,
+                                               engine.infer(cseqs[i])))
+            for i in range(len(cseqs))]
+        tic = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        elapsed = time.time() - tic
+        st = engine.stats()
+        engine.close()
+        assert st['compiles_after_warmup'] == 0, \
+            'chunked engine compiled mid-flight (K=%d)' % K
+        return out, len(cseqs) / elapsed, st
+
+    chunk_sps = {}
+    chunk_st = {}
+    chunk_parity = True
+    ref_out = None
+    for K in ladder:
+        best_s, best_st = 0.0, None
+        for _ in range(passes):
+            out, s, st = chunk_pass(K)
+            if K == ladder[0] and ref_out is None:
+                ref_out = out       # K=1 leads the ladder: baseline
+            chunk_parity = chunk_parity and all(
+                all(np.array_equal(a, b)
+                    for a, b in zip(out[i], ref_out[i]))
+                for i in range(len(cseqs)))
+            if s > best_s:
+                best_s, best_st = s, st
+        chunk_sps[K] = best_s
+        chunk_st[K] = best_st
+    k_top, k_base = ladder[-1], ladder[0]
+    top_st = chunk_st[k_top]
+
     # -- (c) registry paging: evict/re-warm at zero compiles -----------
     reg = ModelRegistry(budget_bytes=1)      # forces single residency
     reg.register('m1', loader=fast_loader, max_batch=4, max_wait_us=0)
@@ -1899,6 +1960,20 @@ def fleet_bench():
         'cont_bit_parity': bool(cont_bit_parity),
         'cont_compiles_after_warmup':
             cont_st['compiles_after_warmup'],
+        'chunk_slots': chunk_slots,
+        'chunk_seq_len': chunk_len,
+        'chunk_seqs_per_s': {str(k): round(v, 2)
+                             for k, v in chunk_sps.items()},
+        'chunk_speedup': round(chunk_sps[k_top] / chunk_sps[k_base], 3)
+        if chunk_sps[k_base] else None,
+        'chunk_bit_parity': bool(chunk_parity),
+        'chunk_dispatches_per_tick_drop': round(
+            top_st['ticks'] / top_st['chunks'], 2)
+        if top_st['chunks'] else None,
+        'chunk_boundary_wait_ms': top_st['boundary_wait_ms'],
+        'chunk_lone_fast_path': bool(top_st['lone_fast_path']),
+        'chunk_compiles_after_warmup':
+            top_st['compiles_after_warmup'],
         'evict_rewarm_cycles': cycles,
         'evictions': evictions,
         'evict_rewarm_compiles': rewarm_misses,

@@ -331,6 +331,36 @@ def serve_step_key(sig, input_names=(), quant=None, embed=None):
         (() if embed is None else (('hotrow',) + tuple(embed),))
 
 
+def cont_step_key(sig, kind, data_name, state_names, state_out_idx,
+                  chunk=None, width=None):
+    """Cache key of one continuous-batching tick program
+    (serving_fleet.ContinuousEngine).  `sig` is the cell executor's
+    graph signature: it fingerprints the jaxpr AND the slots-wide
+    bind shapes, so fp/int8 cells and different slot counts already
+    never alias.  `kind` separates the program families —
+    'cont_step' (the single-tick baseline), 'cont_chunk_step' (K
+    ticks per dispatch via lax.scan), 'cont_lone_step' (the
+    narrow lone-request rung, which dynamic-slices a `width`-row
+    window of state out of the full buffers) — and `chunk` is the
+    scan length K for the chunked kinds: a K=4 program's
+    (K, slots)-leading input shapes must never alias a K=16
+    program's, and neither may alias the unchunked tick.  `width`
+    is the lone rung's batch width (1 or 2 — some backends lower a
+    batch-1 cell with different rounding than the wide program, so
+    the engine ladders the rung up to the narrowest bitwise-clean
+    width): a width-1 program's shapes must never alias a
+    width-2's.  With every degree of freedom in the key, a
+    re-created engine (same cell, slots, K) warms every program
+    from cache at zero XLA compiles."""
+    key = (sig, kind, data_name, tuple(state_names),
+           tuple(int(i) for i in state_out_idx))
+    if chunk is not None:
+        key += (('chunk', int(chunk)),)
+    if width is not None:
+        key += (('lone_width', int(width)),)
+    return key
+
+
 def gluon_step_key(fingerprint, step_key, mode, k, placement):
     """Cache key of one fused Gluon whole-train-step program
     (gluon/fused.py).  `fingerprint` is the blake2b hash of the step

@@ -448,10 +448,16 @@ class ReplicaServer(object):
 
     (tests may pass {'name': ..., 'loader': callable} instead of a
     prefix).  Models register lazily — weights load on first use, so
-    a replica boots fast and warms from the persistent/exec cache."""
+    a replica boots fast and warms from the persistent/exec cache.
+
+    `tick_chunk` in a spec forwards to the registry (loader=
+    sequence models only): a ContinuousEngine loader receives it and
+    runs K ticks per dispatch, so a supervisor hot-swap lands on a
+    chunked engine whose export/admit sequence migration halts at a
+    chunk boundary (ContinuousEngine docs)."""
 
     _ENGINE_KEYS = ('max_batch', 'max_wait_us', 'batch_buckets',
-                    'est_bytes')
+                    'est_bytes', 'tick_chunk')
 
     def __init__(self, models=(), budget_bytes=None, host='127.0.0.1',
                  port=0, index=0, max_inflight=None):

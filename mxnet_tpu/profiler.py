@@ -537,21 +537,35 @@ _FLEET = {
     'fleet_http_requests': 0,
     'fleet_http_429': 0,         # backpressure surfaced to a client
     'fleet_resident_bytes': 0,   # gauge: registry-resident weight bytes
-    'cont_ticks': 0,             # continuous-batcher step dispatches
+    'cont_ticks': 0,             # continuous-batcher timesteps run
     'cont_active_row_ticks': 0,  # slot-ticks doing real sequence work
     'cont_slot_ticks': 0,        # slot-ticks available (ticks x slots)
     'cont_admitted': 0,
     'cont_retired': 0,
+    'cont_chunks_dispatched': 0,    # K-tick scan dispatches (PERF r20)
+    'cont_chunk_ticks': 0,          # timesteps run inside those chunks
+    'cont_boundary_wait_ms': 0.0,   # est. queue wait behind slots
+                                    # freed mid-chunk (masked until the
+                                    # chunk boundary)
+    'cont_lone_fast_path': 0,       # 1-slot-rung dispatches (lone
+                                    # active request skipped the
+                                    # full-slots program)
+    'cont_exact_fill_admits': 0,    # chunk stagings that skipped the
+                                    # pad memset (every slot active
+                                    # for all K ticks)
 }
 
 
 def add_fleet_stats(resident_bytes=None, **deltas):
     """Accumulate fleet serving-tier counters (resident_bytes is a
-    GAUGE — set, not added; everything else adds)."""
+    GAUGE — set, not added; everything else adds — counters seeded
+    as floats, e.g. cont_boundary_wait_ms, accumulate fractional
+    deltas instead of truncating)."""
     with _STATE['lock']:
         for k, v in deltas.items():
-            _FLEET['fleet_' + k if 'fleet_' + k in _FLEET
-                   else k] += int(v)
+            key = 'fleet_' + k if 'fleet_' + k in _FLEET else k
+            _FLEET[key] += float(v) if isinstance(_FLEET[key], float) \
+                else int(v)
         if resident_bytes is not None:
             _FLEET['fleet_resident_bytes'] = int(resident_bytes)
 
@@ -989,6 +1003,14 @@ def summary(print_out=True):
                     fl['fleet_http_requests'], fl['fleet_http_429'],
                     fl['fleet_resident_bytes'], fl['cont_ticks'],
                     fl['cont_utilization']))
+    lines.append('  cont_chunks_dispatched=%d cont_chunk_ticks=%d '
+                 'cont_boundary_wait_ms=%.3f cont_lone_fast_path=%d '
+                 'cont_exact_fill_admits=%d'
+                 % (fl['cont_chunks_dispatched'],
+                    fl['cont_chunk_ticks'],
+                    fl['cont_boundary_wait_ms'],
+                    fl['cont_lone_fast_path'],
+                    fl['cont_exact_fill_admits']))
     fs = fleet_supervisor_stats()
     lines.append('  fleet_supervisor_replica_spawns=%d '
                  'fleet_supervisor_replica_restarts=%d '
@@ -1087,7 +1109,7 @@ def clear():
         for k in _DIST:
             _DIST[k] = type(_DIST[k])()
         for k in _FLEET:
-            _FLEET[k] = 0
+            _FLEET[k] = type(_FLEET[k])()
         for k in _FLEET_SUP:
             _FLEET_SUP[k] = 0
         for k in _QUANT:

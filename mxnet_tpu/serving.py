@@ -72,6 +72,87 @@ def _env_int(name, default):
         return default
 
 
+TICK_CHUNK_KNOB = 'MXNET_TPU_SERVE_TICK_CHUNK'
+
+
+def chunk_for_deadline(deadline_ms, tick_ms_hint, slots=None):
+    """SLO-derived default tick chunk, the continuous-batching analog
+    of SLO.wait_us(): a chunk of K ticks quantizes admission to chunk
+    boundaries, so a queued request can wait up to (K-1) extra ticks
+    behind a slot that freed mid-chunk.  Spend the same
+    MXNET_TPU_SERVE_WAIT_FRACTION of the deadline budget on that
+    boundary wait that the coalescer spends on its batch hold:
+    (K-1) * tick_ms_hint <= fraction * deadline_ms, clamped to
+    [1, slots] (see resolve_tick_chunk for why slots caps K)."""
+    try:
+        frac = float(os.environ.get('MXNET_TPU_SERVE_WAIT_FRACTION',
+                                    '') or 0.25)
+    except ValueError:
+        frac = 0.25
+    tick_ms = max(float(tick_ms_hint), 1e-9)
+    k = 1 + int(float(deadline_ms) * frac / tick_ms)
+    if slots is not None:
+        k = min(k, int(slots))
+    return max(1, k)
+
+
+def resolve_tick_chunk(tick_chunk, slots=None, slo=None,
+                       tick_ms_hint=None):
+    """THE parser for the chunked-tick knob — ContinuousEngine,
+    ModelRegistry.register and the ReplicaServer wire spec all route
+    through here so 'unchunked' means one thing everywhere.  Returns
+    the resolved chunk length K (1 = the literal unchunked tick loop).
+
+    Resolution order: explicit `tick_chunk` (0/'off'/1 = unchunked),
+    else the MXNET_TPU_SERVE_TICK_CHUNK env knob, else an SLO
+    deadline + per-tick service hint derive K (chunk_for_deadline),
+    else 1.  K > slots is rejected typed: admission quantizes to
+    chunk boundaries, so one chunk can strand up to (K-1) freed
+    slot-ticks per retiring slot — with K <= slots a queued request's
+    extra boundary wait stays under one batch-width of ticks, the
+    queue-semantics bound the shed estimator assumes."""
+    v = tick_chunk
+    if v is None:
+        v = os.environ.get(TICK_CHUNK_KNOB, '').strip() or None
+    if v is None:
+        if slo is not None and getattr(slo, 'deadline_ms', None) \
+                and tick_ms_hint:
+            return chunk_for_deadline(slo.deadline_ms, tick_ms_hint,
+                                      slots)
+        return 1
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ('', '0', 'off', 'none', 'false'):
+            return 1
+        try:
+            v = int(s)
+        except ValueError:
+            raise MXNetError(
+                '%s: tick_chunk=%r is not a tick count (use an '
+                'integer K, or 0/off/1 for the unchunked loop)'
+                % (TICK_CHUNK_KNOB, tick_chunk))
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        raise MXNetError(
+            '%s: tick_chunk=%r is not a tick count (use an integer '
+            'K, or 0/off/1 for the unchunked loop)'
+            % (TICK_CHUNK_KNOB, tick_chunk))
+    if v < 0:
+        raise MXNetError('%s: tick_chunk=%d must be >= 0'
+                         % (TICK_CHUNK_KNOB, v))
+    if v in (0, 1):
+        return 1
+    if slots is not None and v > int(slots):
+        raise MXNetError(
+            '%s: tick_chunk=%d > slots=%d — admission quantizes to '
+            'chunk boundaries, so a chunk longer than the slot count '
+            'can strand more than one full batch-width of freed '
+            'slot-ticks behind a single boundary; keep K <= slots'
+            % (TICK_CHUNK_KNOB, v, int(slots)))
+    return v
+
+
 # per-engine latency window: enough samples for stable p99 at test/
 # smoke traffic volumes, bounded so a long-lived engine stays O(1)
 _LOCAL_LAT_CAP = 4096
@@ -1137,7 +1218,10 @@ class InferenceEngine(object):
         # fill 0.96+): every element gets written by a request row, so
         # skip the pad memset — and with a single such request its
         # canonicalized (contiguous) arrays ARE the batch: stage them
-        # directly, no assembly copy at all
+        # directly, no assembly copy at all.  (Both shortcuts are
+        # ported to the continuous batcher's chunk staging:
+        # serving_fleet.ContinuousEngine's exact-fill / lone-request
+        # fast paths.)
         exact = rows == bucket and all(r.free_shapes == entry
                                        for r in reqs)
         if exact and len(reqs) == 1:

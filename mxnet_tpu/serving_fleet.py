@@ -69,7 +69,8 @@ from . import profiler
 from . import quantization
 from .base import MXNetError
 from .quantization import QuantConfig
-from .serving import InferenceEngine, _env_int
+from .serving import (InferenceEngine, _env_int, _quiet_donation,
+                      resolve_tick_chunk)
 
 __all__ = ['Overloaded', 'BudgetExceeded', 'SLO', 'ModelRegistry',
            'ContinuousEngine', 'HttpFront']
@@ -200,10 +201,11 @@ class _ModelEntry(object):
     __slots__ = ('name', 'loader', 'slo', 'engine_kwargs', 'pinned',
                  'lock', 'engine', 'holder', 'bytes', 'last_used',
                  'est_bytes', 'dead', 'quantize', 'page_dtype',
-                 'paged', 'paged_bytes')
+                 'paged', 'paged_bytes', 'tick_chunk')
 
     def __init__(self, name, loader, slo, engine_kwargs, pinned,
-                 est_bytes=None, quantize=None, page_dtype=None):
+                 est_bytes=None, quantize=None, page_dtype=None,
+                 tick_chunk=None):
         self.name = name
         self.loader = loader
         self.slo = slo
@@ -211,6 +213,7 @@ class _ModelEntry(object):
         self.pinned = pinned
         self.quantize = quantize        # QuantConfig (live int8 engine)
         self.page_dtype = page_dtype    # QuantConfig (evicted image)
+        self.tick_chunk = tick_chunk    # forwarded to a cont loader
         self.paged = None               # quantized host weight image
         self.paged_bytes = 0
         self.lock = threading.Lock()    # serializes load vs evict
@@ -298,12 +301,21 @@ class ModelRegistry(object):
     def register(self, name, loader=None, prefix=None, epoch=0,
                  input_shapes=None, source=None, slo=None,
                  est_bytes=None, quantize=None, page_dtype=None,
-                 **engine_kwargs):
+                 tick_chunk=None, **engine_kwargs):
         """Register a model spec (nothing loads until first use).
         Exactly one of `loader` / `prefix` / `source`.  `engine_kwargs`
         forward to InferenceEngine (max_batch, batch_buckets,
         free_dim_buckets, ...); `max_wait_us` defaults to the SLO's
-        deadline-derived hold instead of the global knob.  `est_bytes`
+        deadline-derived hold instead of the global knob.
+
+        `tick_chunk` (loader= sequence models only) forwards to the
+        loader as a keyword — a ContinuousEngine loader passes it
+        through so the engine runs K ticks per dispatch
+        (chunk-boundary admission; see ContinuousEngine docs).  It is
+        parsed HERE by the shared resolve_tick_chunk parser
+        (0/'off'/1 = unchunked), so a malformed value fails typed at
+        register time, not at first use; the engine re-parses against
+        its slot count (K > slots is rejected there).  `est_bytes`
         pre-sizes the model for budget enforcement BEFORE its first
         load (prefix= models default to the checkpoint param-file
         size).  est_bytes is the FP32-EQUIVALENT size: with quantize=
@@ -334,6 +346,17 @@ class ModelRegistry(object):
         if sum(given) != 1:
             raise MXNetError('register(%r): exactly one of loader= / '
                              'prefix= / source= required' % name)
+        if tick_chunk is not None:
+            if loader is None:
+                raise MXNetError(
+                    'register(%r): tick_chunk= applies to loader= '
+                    'sequence models (a loader accepting tick_chunk= '
+                    'and returning a ContinuousEngine); prefix=/'
+                    'source= models serve through the request '
+                    'coalescer, which has no tick loop' % name)
+            if resolve_tick_chunk(tick_chunk) == 1:
+                tick_chunk = None       # 0/'off'/1: the loader's own
+                                        # default (unchunked) applies
         quantize = QuantConfig.resolve(quantize)
         page_dtype = QuantConfig.resolve(page_dtype)
         if quantize is None and page_dtype is None:
@@ -399,7 +422,8 @@ class ModelRegistry(object):
         entry = _ModelEntry(name, loader, slo or SLO(),
                             dict(engine_kwargs), pinned,
                             est_bytes=est_bytes, quantize=quantize,
-                            page_dtype=page_dtype)
+                            page_dtype=page_dtype,
+                            tick_chunk=tick_chunk)
         with self._lock:
             if self._closed:
                 raise MXNetError('ModelRegistry is closed')
@@ -460,7 +484,8 @@ class ModelRegistry(object):
                 return ent.engine
             obj = self._page_in(ent)    # quantized host image, if any
             if obj is None:
-                obj = ent.loader()
+                obj = ent.loader() if ent.tick_chunk is None \
+                    else ent.loader(tick_chunk=ent.tick_chunk)
             if hasattr(obj, 'infer'):   # engine-like (ContinuousEngine
                 eng, holder = obj, obj  # or a pre-built engine)
                 nbytes = int(obj.resident_bytes()) \
@@ -953,6 +978,37 @@ class ContinuousEngine(object):
     only into an EMPTY batch, everyone runs to the longest admitted
     length (what a naive sequence batcher does).
 
+    **Chunked ticks** (`tick_chunk=K` / MXNET_TPU_SERVE_TICK_CHUNK,
+    PERF round 20): one donated dispatch runs K ticks as a lax.scan
+    over the fixed slots batch — the same per-tick math (the
+    in-graph reset applies before the chunk's first tick; a
+    continuing slot's `where(False, init, state)` is the identity),
+    so chunked answers stay BIT-identical to the unchunked loop
+    while per-tick dispatch overhead amortizes K-fold, exactly as
+    `steps_per_dispatch` did for training.  The cost is quantized
+    admission/retire: slots free only at chunk BOUNDARIES, so a slot
+    whose sequence ends mid-chunk stays masked (zero inputs, outputs
+    discarded host-side) for up to K-1 ticks while the next request
+    waits — that boundary latency is counted
+    (stats()['boundary_wait_ms'], profiler cont_boundary_wait_ms),
+    K is capped at `slots` (resolve_tick_chunk rejects more, typed),
+    and an SLO deadline + tick_ms_hint derive a default K the same
+    way SLO.wait_us() derives the coalescer hold.  `tick_chunk=1`
+    (the default) IS the literal unchunked loop — byte-for-byte the
+    same dispatch path, the parity baseline.
+
+    Two request-shaped fast paths (ported from the coalescer's
+    exact-fill / lone-request staging shortcuts) ride on chunked
+    mode: a LONE active request runs a narrow rung (the full-width
+    program is skipped; the rung dynamic-slices its slot's state in
+    graph, at width 1 or — where the backend rounds batch-1 gemms
+    differently — width 2, and is enabled only when its warmup probe
+    is BIT-equal to the full program: stats()['lone_fast_path'] /
+    ['lone_fast_path_width']), and an
+    exact-fill chunk (every slot active for the full K ticks) skips
+    the staging memset.  Both are counted (cont_lone_fast_path /
+    cont_exact_fill_admits).
+
     **Hot-swap sequence migration** (PERF round 18): `export_state()`
     halts the tick loop at a boundary and hands every accepted
     request — in-flight slot state + positions + partial outputs, and
@@ -985,12 +1041,23 @@ class ContinuousEngine(object):
     max_queue : int
         Backlog cap in REQUESTS: beyond it, infer() sheds with
         `Overloaded` (default MXNET_TPU_SERVE_MAX_QUEUE_ROWS).
+    tick_chunk : int or str, optional
+        Ticks per dispatch (serving.resolve_tick_chunk: explicit
+        value, else MXNET_TPU_SERVE_TICK_CHUNK, else the SLO-derived
+        default, else 1; 0/'off'/1 = the literal unchunked loop;
+        K > slots rejected typed).
+    slo : SLO, optional / tick_ms_hint : float, optional
+        Together derive the default chunk when neither tick_chunk=
+        nor the env knob is set: the largest K whose worst-case
+        boundary wait (K-1)*tick_ms_hint fits in WAIT_FRACTION of
+        the SLO deadline (serving.chunk_for_deadline).
     """
 
     def __init__(self, symbol, arg_params=None, aux_params=None,
                  data_name='data', data_shape=None, state_shapes=None,
                  state_outputs=None, slots=None, ctx=None,
-                 init_states=None, convoy=False, max_queue=None):
+                 init_states=None, convoy=False, max_queue=None,
+                 tick_chunk=None, slo=None, tick_ms_hint=None):
         from .context import cpu
         if data_shape is None or not state_shapes or not state_outputs:
             raise MXNetError('ContinuousEngine needs data_shape, '
@@ -1005,6 +1072,8 @@ class ContinuousEngine(object):
         self.max_queue = int(max_queue if max_queue is not None else
                              _env_int('MXNET_TPU_SERVE_MAX_QUEUE_ROWS',
                                       4096))
+        self.tick_chunk = resolve_tick_chunk(
+            tick_chunk, self.slots, slo=slo, tick_ms_hint=tick_ms_hint)
         self._data_name = data_name
         self._data_shape = tuple(int(d) for d in data_shape)
         self._state_names = sorted(state_shapes)
@@ -1054,6 +1123,11 @@ class ContinuousEngine(object):
                     'co-resident sequences' % (i, tuple(o.shape),
                                                self.slots))
         jax.block_until_ready(outs)
+        self._chunk_step = None
+        self._lone_step = None
+        self._lone_width = 0
+        if self.tick_chunk > 1:
+            self._warm_chunk_programs(init_states)
         self._warm_snapshot = exec_cache.stats()
         # request plumbing
         self._cond = threading.Condition()
@@ -1064,9 +1138,15 @@ class ContinuousEngine(object):
         # engine-local counters
         self._lock = threading.Lock()
         self._ticks = 0
+        self._chunks = 0                # dispatches (== ticks at K=1)
         self._active_row_ticks = 0
         self._admitted = 0
         self._retired = 0
+        self._boundary_wait_ms = 0.0    # est. queue wait behind slots
+                                        # freed mid-chunk (masked until
+                                        # the boundary)
+        self._lone_hits = 0             # 1-slot rung dispatches
+        self._exact_fill = 0            # staging-memset skips
         self._close_lock = threading.Lock()
         self._loop = threading.Thread(target=self._tick_loop,
                                       name='mxtpu-cont-batch',
@@ -1083,6 +1163,72 @@ class ContinuousEngine(object):
     def _aux(self):
         ex = self._ex
         return tuple(ex.aux_dict[n]._data for n in ex.aux_dict)
+
+    def _warm_chunk_programs(self, init_states):
+        """Build + warm the K-tick scan program and the lone-request
+        rung, and gate the rung on a BIT-equality probe against the
+        full-width program: a 1-row gemm may round differently from
+        the same row inside the slots-wide gemm on some backends
+        (XLA CPU strength-reduces the batch-1 dot), and the rung must
+        never trade bitwise parity for speed.  The probe ladders the
+        rung width — try 1, then 2 (per-row gemm math is stable from
+        batch 2 up, so the wider rung usually recovers parity at
+        still a fraction of the full program) — and enables the first
+        width that matches bit-for-bit; if none does (or the rung
+        would not shrink the program, width >= slots), the rung is
+        disabled and lone requests run the full program, costing
+        nothing but the skipped shortcut."""
+        import jax
+        jnp = jax.numpy
+        K = self.tick_chunk
+        ex = self._ex
+        self._chunk_step = _make_cont_chunk_step(
+            ex, self._data_name, self._state_names,
+            self._state_out_idx, init_states, K)
+        n = int(np.prod((K, self.slots) + self._data_shape))
+        probe = ((np.arange(n, dtype=np.float64) % 13) / 8.0 - 0.75)
+        probe = probe.reshape(
+            (K, self.slots) + self._data_shape).astype(self._dtype)
+
+        def zstates():
+            return tuple(
+                jnp.zeros(ex.arg_dict[s].shape,
+                          np.dtype(ex.arg_dict[s].dtype))
+                for s in self._state_names)
+
+        reset = jnp.ones((self.slots,), np.bool_)
+        with _quiet_donation():         # CPU can't alias the donated
+            fouts, fsts = self._chunk_step(     # state buffers: noise
+                jnp.asarray(probe), reset, zstates(),
+                self._weights(), self._aux(), self._rng)
+        for w in (1, 2):
+            if w >= self.slots:
+                break
+            cand = _make_cont_lone_step(
+                ex, self._data_name, self._state_names,
+                self._state_out_idx, init_states, K, w)
+            lxs = np.zeros((K, w) + self._data_shape, self._dtype)
+            lxs[:, 0] = probe[:, 0]     # lane 0 = the full prog's slot 0
+            lreset = np.zeros((w,), np.bool_)
+            lreset[0] = True
+            with _quiet_donation():
+                louts, lsts = cand(
+                    jnp.asarray(lxs), jnp.asarray(lreset),
+                    np.int32(0), np.int32(0), zstates(),
+                    self._weights(), self._aux(), self._rng)
+            lone_ok = all(
+                np.array_equal(np.asarray(f)[:, :1],
+                               np.asarray(l)[:, :1])
+                for f, l in zip(fouts, louts))
+            lone_ok = lone_ok and all(
+                np.array_equal(np.asarray(a)[0], np.asarray(b)[0])
+                for a, b in zip(fsts, lsts))
+            if lone_ok:
+                self._lone_step = cand
+                self._lone_width = w
+                break
+        # the probe calls consumed (donated) only their own zero
+        # buffers — self._states is untouched and still pristine
 
     # -- public API -----------------------------------------------------
     def infer(self, seq):
@@ -1128,15 +1274,20 @@ class ContinuousEngine(object):
         return _ContRequest(a)
 
     def stats(self):
-        """Engine-local continuous-batching counters: ticks (step
-        dispatches), slot utilization (active row-ticks / slot-ticks
-        — 1.0 means every slot of every dispatch advanced a real
-        sequence), admit/retire totals, and the zero-compile check
-        relative to construction."""
+        """Engine-local continuous-batching counters: ticks
+        (timesteps advanced — at tick_chunk=1 also the dispatch
+        count), chunks (XLA dispatches: ticks/K), slot utilization
+        (active row-ticks / slot-ticks — 1.0 means every slot of
+        every tick advanced a real sequence), admit/retire totals,
+        the chunk-boundary latency estimate and fast-path hit
+        counters, and the zero-compile check relative to
+        construction."""
         with self._lock:
             ticks = self._ticks
             out = {
                 'ticks': ticks,
+                'chunks': self._chunks,
+                'tick_chunk': self.tick_chunk,
                 'active_row_ticks': self._active_row_ticks,
                 'slot_ticks': ticks * self.slots,
                 'utilization': (self._active_row_ticks /
@@ -1145,6 +1296,11 @@ class ContinuousEngine(object):
                 'retired': self._retired,
                 'slots': self.slots,
                 'convoy': self.convoy,
+                'boundary_wait_ms': round(self._boundary_wait_ms, 3),
+                'lone_fast_path_hits': self._lone_hits,
+                'exact_fill_admits': self._exact_fill,
+                'lone_fast_path': self._lone_step is not None,
+                'lone_fast_path_width': self._lone_width,
             }
         now = exec_cache.stats()
         snap = self._warm_snapshot
@@ -1327,7 +1483,6 @@ class ContinuousEngine(object):
                       if r is not None]
             if not active:
                 continue
-            x = np.zeros((self.slots,) + self._data_shape, self._dtype)
             reset = np.zeros((self.slots,), np.bool_)
             mig = []
             for i in admitted:
@@ -1347,40 +1502,152 @@ class ContinuousEngine(object):
                     for k, n in enumerate(self._state_names):
                         bufs[k][i] = st[n]
                 self._states = tuple(jnp.asarray(b) for b in bufs)
-            for i, r in active:
-                x[i] = r.seq[r.t]
-            try:
-                outs, self._states = self._step(
-                    jnp.asarray(x), jnp.asarray(reset), self._states,
-                    self._weights(), self._aux(), self._rng)
-                np_outs = [np.asarray(o) for o in outs]
-            except Exception as e:      # surface to every co-resident
-                with self._cond:
-                    for i, r in active:
-                        r.error = e
-                        r.event.set()
-                        self._active[i] = None
-                continue
-            retired = 0
-            for i, r in active:
-                for k, o in enumerate(np_outs):
-                    r.ys[k].append(o[i].copy())
-                r.t += 1
-                if r.t >= r.length:
-                    r.outputs = [np.stack(rows) for rows in r.ys]
+            if self.tick_chunk == 1:
+                self._tick_once(active, admitted, reset, jnp)
+            else:
+                self._chunk_once(active, admitted, reset, jnp)
+
+    def _tick_once(self, active, admitted, reset, jnp):
+        """One timestep for every slot — the LITERAL unchunked
+        dispatch path (tick_chunk=1, the parity baseline chunked mode
+        A/Bs against)."""
+        x = np.zeros((self.slots,) + self._data_shape, self._dtype)
+        for i, r in active:
+            x[i] = r.seq[r.t]
+        try:
+            outs, self._states = self._step(
+                jnp.asarray(x), jnp.asarray(reset), self._states,
+                self._weights(), self._aux(), self._rng)
+            np_outs = [np.asarray(o) for o in outs]
+        except Exception as e:          # surface to every co-resident
+            with self._cond:
+                for i, r in active:
+                    r.error = e
                     r.event.set()
-                    retired += 1
-                    with self._cond:
-                        self._active[i] = None
-            with self._lock:
-                self._ticks += 1
-                self._active_row_ticks += len(active)
-                self._admitted += len(admitted)
-                self._retired += retired
-            profiler.add_fleet_stats(
-                cont_ticks=1, cont_active_row_ticks=len(active),
-                cont_slot_ticks=self.slots,
-                cont_admitted=len(admitted), cont_retired=retired)
+                    self._active[i] = None
+            return
+        retired = 0
+        for i, r in active:
+            for k, o in enumerate(np_outs):
+                r.ys[k].append(o[i].copy())
+            r.t += 1
+            if r.t >= r.length:
+                r.outputs = [np.stack(rows) for rows in r.ys]
+                r.event.set()
+                retired += 1
+                with self._cond:
+                    self._active[i] = None
+        with self._lock:
+            self._ticks += 1
+            self._chunks += 1
+            self._active_row_ticks += len(active)
+            self._admitted += len(admitted)
+            self._retired += retired
+        profiler.add_fleet_stats(
+            cont_ticks=1, cont_active_row_ticks=len(active),
+            cont_slot_ticks=self.slots,
+            cont_admitted=len(admitted), cont_retired=retired)
+
+    def _chunk_once(self, active, admitted, reset, jnp):
+        """K timesteps for every slot in ONE donated dispatch
+        (tick_chunk=K): per-slot inputs for this chunk are staged as
+        (K, slots)+data_shape, the scan program applies the admission
+        reset before tick 0 and stacks (K, slots, ...) outputs, and
+        each request's own min(K, remaining) rows are sliced out
+        host-side.  A slot whose sequence ends mid-chunk stays MASKED
+        (zero inputs, outputs discarded) until the boundary — those
+        wasted slot-ticks are priced into boundary_wait_ms when
+        requests were actually waiting.  Fast paths: a lone active
+        request runs the narrow rung (batch = the probe-gated rung
+        width); a chunk with every slot active for all K ticks skips
+        the staging memset (np.empty)."""
+        K = self.tick_chunk
+        ns = [min(K, r.length - r.t) for _, r in active]
+        lone = len(active) == 1 and self._lone_step is not None
+        exact = False
+        lane = 0
+        t0 = time.perf_counter()
+        try:
+            if lone:
+                i, r = active[0]
+                n = ns[0]
+                W = self._lone_width
+                start = min(i, self.slots - W)
+                lane = i - start        # request's lane in the window
+                if n == K and W == 1:
+                    # exact-fill staging: the request's own contiguous
+                    # rows ARE the chunk — a reshaped view, no copy
+                    xs = r.seq[r.t:r.t + K].reshape(
+                        (K, 1) + self._data_shape)
+                else:
+                    xs = np.zeros((K, W) + self._data_shape,
+                                  self._dtype)
+                    xs[:n, lane] = r.seq[r.t:r.t + n]
+                lreset = np.zeros((W,), np.bool_)
+                lreset[lane] = reset[i]
+                outs, self._states = self._lone_step(
+                    jnp.asarray(xs), jnp.asarray(lreset),
+                    np.int32(start), np.int32(lane), self._states,
+                    self._weights(), self._aux(), self._rng)
+            else:
+                exact = len(active) == self.slots and \
+                    all(n == K for n in ns)
+                xs = (np.empty if exact else np.zeros)(
+                    (K, self.slots) + self._data_shape, self._dtype)
+                for (i, r), n in zip(active, ns):
+                    xs[:n, i] = r.seq[r.t:r.t + n]
+                outs, self._states = self._chunk_step(
+                    jnp.asarray(xs), jnp.asarray(reset), self._states,
+                    self._weights(), self._aux(), self._rng)
+            np_outs = [np.asarray(o) for o in outs]
+        except Exception as e:          # surface to every co-resident
+            with self._cond:
+                for i, r in active:
+                    r.error = e
+                    r.event.set()
+                    self._active[i] = None
+            return
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        retired = 0
+        wasted = 0                      # masked slot-ticks behind the
+        for (i, r), n in zip(active, ns):   # boundary (retire < K)
+            col = lane if lone else i
+            for k, o in enumerate(np_outs):
+                for t in range(n):
+                    r.ys[k].append(np.array(o[t, col]))
+            r.t += n
+            if r.t >= r.length:
+                r.outputs = [np.stack(rows) for rows in r.ys]
+                r.event.set()
+                retired += 1
+                wasted += K - n
+                with self._cond:
+                    self._active[i] = None
+        with self._cond:
+            waiting = len(self._queue)
+        wait_ms = 0.0
+        if wasted and waiting:
+            # the boundary-latency estimate: slot-ticks burned masked
+            # while requests queued, priced at this chunk's measured
+            # per-tick wall time — the cost of quantized admission
+            wait_ms = wasted * wall_ms / K
+        with self._lock:
+            self._ticks += K
+            self._chunks += 1
+            self._active_row_ticks += sum(ns)
+            self._admitted += len(admitted)
+            self._retired += retired
+            self._boundary_wait_ms += wait_ms
+            self._lone_hits += int(lone)
+            self._exact_fill += int(exact)
+        profiler.add_fleet_stats(
+            cont_ticks=K, cont_active_row_ticks=sum(ns),
+            cont_slot_ticks=K * self.slots,
+            cont_admitted=len(admitted), cont_retired=retired,
+            cont_chunks_dispatched=1, cont_chunk_ticks=K,
+            cont_lone_fast_path=int(lone),
+            cont_exact_fill_admits=int(exact),
+            cont_boundary_wait_ms=wait_ms)
 
     # -- lifecycle ------------------------------------------------------
     def close(self, timeout=30):
@@ -1443,8 +1710,9 @@ def _make_cont_step(ex, data_name, state_names, state_out_idx,
              if i not in set(state_out_idx)]
     key = None
     if ex._sig is not None and not init_states:
-        key = (ex._sig, 'cont_step', data_name, tuple(state_names),
-               tuple(state_out_idx))
+        key = exec_cache.cont_step_key(ex._sig, 'cont_step',
+                                       data_name, state_names,
+                                       state_out_idx)
         fn = exec_cache.get(key)
         if fn is not None:
             return fn
@@ -1470,6 +1738,156 @@ def _make_cont_step(ex, data_name, state_names, state_out_idx,
                 tuple(outs[i] for i in state_out_idx))
 
     fn = exec_cache.TimedJit(jax.jit(step))
+    if key is not None:
+        exec_cache.put(key, fn)
+    return fn
+
+
+def _cont_cell_plumbing(ex, data_name, state_names, state_out_idx,
+                        init_states):
+    """Shared argument plumbing for the chunked cont programs: the
+    cell executor's positional layout, the non-state output indices,
+    and the admission-init values (zeros unless init_states bakes
+    constants in — which also disables exec_cache sharing, same rule
+    as the single-tick program)."""
+    import jax
+    jnp = jax.numpy
+    names = list(ex.arg_dict)
+    data_pos = names.index(data_name)
+    state_pos = [names.index(s) for s in state_names]
+    skip = set(state_names) | {data_name}
+    other_pos = [i for i, n in enumerate(names) if n not in skip]
+    y_idx = [i for i in range(ex._n_outputs)
+             if i not in set(state_out_idx)]
+    inits = None
+    if init_states:
+        inits = [jnp.asarray(np.asarray(init_states[s]))
+                 for s in state_names]
+    return (len(names), data_pos, state_pos, other_pos, y_idx, inits)
+
+
+def _make_cont_chunk_step(ex, data_name, state_names, state_out_idx,
+                          init_states, chunk):
+    """The chunked tick program: K timesteps for every slot as ONE
+    donated dispatch — `lax.scan` over the (K, slots)-leading input
+    chunk, with the admission reset (`where(reset, init, state)`)
+    applied before the first tick and the per-tick outputs stacked
+    (K, slots, ...) for host-side per-request slicing.  Each scan
+    iteration is the SAME math as the single-tick program (a
+    continuing slot's where(False, ...) there is the identity), so
+    chunked serving stays bit-identical to the unchunked loop while
+    dispatch overhead amortizes K-fold.  The state buffers are
+    donated: the engine only ever keeps the returned ones.  Cached
+    process-wide under exec_cache.cont_step_key (which carries K; the
+    executor signature already carries the slots-wide shapes and any
+    quantization), zeros-init only."""
+    import jax
+    jnp = jax.numpy
+    (n_args, data_pos, state_pos, other_pos, y_idx,
+     inits) = _cont_cell_plumbing(ex, data_name, state_names,
+                                  state_out_idx, init_states)
+    key = None
+    if ex._sig is not None and not init_states:
+        key = exec_cache.cont_step_key(ex._sig, 'cont_chunk_step',
+                                       data_name, state_names,
+                                       state_out_idx, chunk=chunk)
+        fn = exec_cache.get(key)
+        if fn is not None:
+            return fn
+    raw = ex.raw_forward
+
+    def chunk_step(xs, reset, state_vals, weight_vals, aux_vals, rng):
+        def tick(states, x):
+            merged = [None] * n_args
+            merged[data_pos] = x
+            for i, v in zip(state_pos, states):
+                merged[i] = v
+            for i, v in zip(other_pos, weight_vals):
+                merged[i] = v
+            outs, _ = raw(tuple(merged), aux_vals, rng)
+            return (tuple(outs[i] for i in state_out_idx),
+                    tuple(outs[i] for i in y_idx))
+
+        states0 = []
+        for k, v in enumerate(state_vals):
+            mask = reset.reshape((-1,) + (1,) * (v.ndim - 1))
+            init = inits[k] if inits is not None else \
+                jnp.zeros((), v.dtype)
+            states0.append(jnp.where(mask, init, v))
+        final_states, ys = jax.lax.scan(tick, tuple(states0), xs)
+        return ys, final_states
+
+    fn = exec_cache.TimedJit(jax.jit(chunk_step, donate_argnums=(2,)))
+    if key is not None:
+        exec_cache.put(key, fn)
+    return fn
+
+
+def _make_cont_lone_step(ex, data_name, state_names, state_out_idx,
+                         init_states, chunk, width):
+    """The lone-request rung: when exactly one slot is active, skip
+    the full-`slots` program and run its K ticks at batch `width` —
+    the serving analog of the coalescer's lone-request staging
+    shortcut, except the program SHAPE shrinks too.  A `width`-row
+    window of state starting at `start` is dynamic-sliced out of the
+    full buffers IN graph; the request lives in lane `lane` of that
+    window (both host-computed: start = min(slot, slots - width)),
+    and only the request's final row is written back — the padding
+    lanes run on zero inputs and their evolved state is discarded, so
+    the engine's state invariants (export_state, later full-width
+    chunks) are untouched.  Width is usually 1; some backends lower a
+    batch-1 cell with different rounding than the wide program, so
+    the engine ladders to width 2 (per-row gemm math is stable from
+    batch 2 up) and enables whichever width first passes its
+    build-time bitwise-parity probe against the full program
+    (ContinuousEngine._warm_chunk_programs).  Cached under its own
+    cont_step_key kind (carrying K and width) so it never aliases the
+    full-width chunk program or a different-width rung."""
+    import jax
+    jnp = jax.numpy
+    (n_args, data_pos, state_pos, other_pos, y_idx,
+     inits) = _cont_cell_plumbing(ex, data_name, state_names,
+                                  state_out_idx, init_states)
+    key = None
+    if ex._sig is not None and not init_states:
+        key = exec_cache.cont_step_key(ex._sig, 'cont_lone_step',
+                                       data_name, state_names,
+                                       state_out_idx, chunk=chunk,
+                                       width=width)
+        fn = exec_cache.get(key)
+        if fn is not None:
+            return fn
+    raw = ex.raw_forward
+
+    def lone_step(xs, reset, start, lane, state_vals, weight_vals,
+                  aux_vals, rng):
+        def tick(states, x):
+            merged = [None] * n_args
+            merged[data_pos] = x
+            for i, v in zip(state_pos, states):
+                merged[i] = v
+            for i, v in zip(other_pos, weight_vals):
+                merged[i] = v
+            outs, _ = raw(tuple(merged), aux_vals, rng)
+            return (tuple(outs[i] for i in state_out_idx),
+                    tuple(outs[i] for i in y_idx))
+
+        rows = []
+        for k, v in enumerate(state_vals):
+            win = jax.lax.dynamic_slice_in_dim(v, start, width, axis=0)
+            mask = reset.reshape((-1,) + (1,) * (win.ndim - 1))
+            init = inits[k] if inits is not None else \
+                jnp.zeros((), win.dtype)
+            rows.append(jnp.where(mask, init, win))
+        final_rows, ys = jax.lax.scan(tick, tuple(rows), xs)
+        new_states = tuple(
+            jax.lax.dynamic_update_slice_in_dim(
+                v, jax.lax.dynamic_slice_in_dim(r, lane, 1, axis=0),
+                start + lane, axis=0)
+            for v, r in zip(state_vals, final_rows))
+        return ys, new_states
+
+    fn = exec_cache.TimedJit(jax.jit(lone_step, donate_argnums=(4,)))
     if key is not None:
         exec_cache.put(key, fn)
     return fn

@@ -8,6 +8,15 @@ over localhost, continuous-batch admit/retire bit-parity vs solo runs
 (and its deterministic tick win over convoy batching), the per-engine
 counter scoping satellite, and close()-vs-eviction safety.  All
 models CPU-sized.
+
+Chunked continuous serving (ISSUE 17): tick_chunk=K bit-parity vs the
+unchunked loop (retire mid-chunk + re-admit), chunk-boundary admission
+quantization + the boundary_wait_ms estimate, chunked/unchunked
+program-family non-aliasing at zero recompiles, the lone-request /
+exact-fill fast-path counters, the shared knob parser
+(MXNET_TPU_SERVE_TICK_CHUNK, K > slots typed reject), the SLO-derived
+default K, registry tick_chunk= forwarding, and the cont_chunk*
+profiler flow.
 """
 import json
 import threading
@@ -22,7 +31,8 @@ import mxnet_tpu as mx
 from mxnet_tpu import exec_cache, model as model_mod, nd, profiler, sym
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.predictor import Predictor
-from mxnet_tpu.serving import InferenceEngine
+from mxnet_tpu.serving import (TICK_CHUNK_KNOB, InferenceEngine,
+                               chunk_for_deadline, resolve_tick_chunk)
 from mxnet_tpu.serving_fleet import (SLO, BudgetExceeded,
                                      ContinuousEngine, HttpFront,
                                      ModelRegistry, Overloaded)
@@ -663,6 +673,197 @@ def test_continuous_close_rejects_new_and_drains():
     with pytest.raises(MXNetError, match='closed'):
         eng.infer(_seqs([2])[0])
     eng.close()                          # idempotent
+
+
+# ---------------------------------------------------------------------------
+# chunked continuous serving (tick_chunk=K)
+# ---------------------------------------------------------------------------
+
+def test_chunked_matches_unchunked_bitwise():
+    # lengths NOT multiples of K and more sequences than slots: slots
+    # retire mid-chunk (masked to the boundary) and re-admit — the
+    # K-tick scan program must stay BIT-identical to the unchunked
+    # tick loop, while dispatching K timesteps per XLA call
+    seqs = _seqs([3, 9, 2, 6, 4], seed=4)
+    with _cont(slots=2) as eng:
+        ref = eng.infer_many(seqs)
+    with _cont(slots=4, tick_chunk=4) as eng:
+        got = eng.infer_many(seqs)
+        st = eng.stats()
+    for a, b in zip(ref, got):
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+    assert st['tick_chunk'] == 4
+    assert st['ticks'] == 4 * st['chunks']
+    assert st['compiles_after_warmup'] == 0
+
+
+def test_chunk_admit_quantization_and_boundary_wait():
+    # 4 slots, K=4, lengths [2, 6, 4, 4, 4] submitted atomically.
+    # Chunk 1 admits the first four; seq0 retires after 2 ticks but
+    # its freed slot stays masked to the boundary while seq4 waits in
+    # the queue — those 2 stranded slot-ticks are priced into
+    # boundary_wait_ms.  Chunk 2 admits seq4 and retires everything:
+    # 8 ticks in 2 dispatches, deterministic
+    seqs = _seqs([2, 6, 4, 4, 4], seed=8)
+    with _cont(slots=4, tick_chunk=4) as eng:
+        res = eng.infer_many(seqs)
+        st = eng.stats()
+    with _cont(slots=2) as eng:
+        ref = eng.infer_many(seqs)
+    for a, b in zip(ref, res):
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+    assert st['chunks'] == 2 and st['ticks'] == 8
+    assert st['admitted'] == 5 and st['retired'] == 5
+    assert st['boundary_wait_ms'] > 0
+
+
+def test_chunked_recreated_engine_zero_compiles():
+    with _cont(slots=4, tick_chunk=4) as eng:
+        eng.infer(_seqs([6])[0])
+    before = exec_cache.stats()['misses']
+    with _cont(slots=4, tick_chunk=4) as eng:
+        eng.infer(_seqs([6])[0])
+        assert eng.stats()['compiles_after_warmup'] == 0
+    assert exec_cache.stats()['misses'] == before
+
+
+def test_chunked_programs_never_alias_unchunked():
+    # same cell + slot count at K=1 vs K=4: distinct exec_cache
+    # program families.  With both warmed, re-creating EITHER flavor
+    # hits its own cached programs — no cross-aliasing, no recompiles,
+    # and the two engines still agree bit-for-bit
+    with _cont(slots=4) as eng:
+        eng.infer(_seqs([5])[0])
+    with _cont(slots=4, tick_chunk=4) as eng:
+        eng.infer(_seqs([5])[0])
+    before = exec_cache.stats()['misses']
+    with _cont(slots=4) as eng:
+        a = eng.infer(_seqs([5])[0])
+    with _cont(slots=4, tick_chunk=4) as eng:
+        b = eng.infer(_seqs([5])[0])
+    assert exec_cache.stats()['misses'] == before
+    for u, v in zip(a, b):
+        assert np.array_equal(u, v)
+
+
+def test_chunk_lone_and_exact_fill_fast_paths():
+    # the two request-shaped shortcuts ported from the coalescer: a
+    # LONE active request runs the narrow probe-gated rung, an
+    # exact-fill chunk (every slot active all K ticks) skips the
+    # staging memset — both counted, both bit-identical
+    with _cont(slots=4, tick_chunk=4) as eng:
+        st0 = eng.stats()
+        assert st0['lone_fast_path'], \
+            'lone rung disabled (probe failed at widths 1 and 2)'
+        assert st0['lone_fast_path_width'] in (1, 2)
+        exact_seqs = _seqs([8] * 4, seed=9)
+        res = eng.infer_many(exact_seqs)     # 2 exact-fill chunks
+        lone_seq = _seqs([8], seed=10)[0]
+        lone_res = eng.infer(lone_seq)       # 2 lone chunks
+        st = eng.stats()
+    assert st['exact_fill_admits'] == 2
+    assert st['lone_fast_path_hits'] == 2
+    with _cont(slots=2) as eng:
+        ref = eng.infer_many(exact_seqs)
+        lone_ref = eng.infer(lone_seq)
+    for a, b in zip(ref, res):
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+    for u, v in zip(lone_ref, lone_res):
+        assert np.array_equal(u, v)
+
+
+def test_tick_chunk_knob_parse_and_reject(monkeypatch):
+    monkeypatch.delenv(TICK_CHUNK_KNOB, raising=False)
+    assert resolve_tick_chunk(None) == 1
+    for off in (0, '0', 'off', 'none', 'false', '', 1, '1'):
+        assert resolve_tick_chunk(off) == 1
+    assert resolve_tick_chunk(4, slots=8) == 4
+    assert resolve_tick_chunk('6', slots=8) == 6
+    monkeypatch.setenv(TICK_CHUNK_KNOB, '4')
+    assert resolve_tick_chunk(None, slots=8) == 4
+    monkeypatch.setenv(TICK_CHUNK_KNOB, 'off')
+    assert resolve_tick_chunk(None, slots=8) == 1
+    monkeypatch.delenv(TICK_CHUNK_KNOB)
+    with pytest.raises(MXNetError, match=TICK_CHUNK_KNOB):
+        resolve_tick_chunk('garbage')
+    with pytest.raises(MXNetError, match='K <= slots'):
+        resolve_tick_chunk(8, slots=4)
+    with pytest.raises(MXNetError, match='>= 0'):
+        resolve_tick_chunk(-2)
+    # the engine routes through the same parser against its slots
+    with pytest.raises(MXNetError, match=TICK_CHUNK_KNOB):
+        _cont(slots=2, tick_chunk=5)
+    # ...including the env knob
+    monkeypatch.setenv(TICK_CHUNK_KNOB, '2')
+    with _cont(slots=2) as eng:
+        assert eng.stats()['tick_chunk'] == 2
+
+
+def test_tick_chunk_slo_derived_default(monkeypatch):
+    monkeypatch.delenv(TICK_CHUNK_KNOB, raising=False)
+    monkeypatch.delenv('MXNET_TPU_SERVE_WAIT_FRACTION', raising=False)
+    # spend the SLO wait fraction (0.25) of the deadline on boundary
+    # ticks: K = 1 + int(40 * 0.25 / 1.0), capped at the slot count
+    assert chunk_for_deadline(40.0, 1.0) == 11
+    assert chunk_for_deadline(40.0, 1.0, slots=4) == 4
+    assert resolve_tick_chunk(None, slots=4, slo=SLO(deadline_ms=40.0),
+                              tick_ms_hint=1.0) == 4
+    # no per-tick service hint -> no derivation -> unchunked
+    assert resolve_tick_chunk(None, slots=4,
+                              slo=SLO(deadline_ms=40.0)) == 1
+    with _cont(slots=4, slo=SLO(deadline_ms=40.0),
+               tick_ms_hint=1.0) as eng:
+        assert eng.stats()['tick_chunk'] == 4
+
+
+def test_registry_forwards_tick_chunk():
+    seen = {}
+
+    def cont_loader(tick_chunk=None):
+        seen['tick_chunk'] = tick_chunk
+        return _cont(slots=4, tick_chunk=tick_chunk)
+
+    with ModelRegistry() as reg:
+        reg.register('seq', loader=cont_loader, tick_chunk=4)
+        eng = reg.engine('seq')
+        assert seen['tick_chunk'] == 4
+        assert eng.stats()['tick_chunk'] == 4
+        # 0/'off'/1 resolve to unchunked at register time: the loader
+        # is called WITHOUT the kwarg (its own default applies)
+        reg.register('seq2', loader=cont_loader, tick_chunk='off')
+        reg.engine('seq2')
+        assert seen['tick_chunk'] is None
+        with pytest.raises(MXNetError, match='tick_chunk'):
+            reg.register('ckpt', prefix='/nonexistent/model',
+                         tick_chunk=4)
+        with pytest.raises(MXNetError, match=TICK_CHUNK_KNOB):
+            reg.register('bad', loader=cont_loader,
+                         tick_chunk='garbage')
+
+
+def test_chunk_profiler_counters_flow():
+    profiler.clear()
+    with _cont(slots=4, tick_chunk=4) as eng:
+        eng.infer_many(_seqs([6, 6], seed=11))
+    fs = profiler.fleet_stats()
+    assert fs['cont_chunks_dispatched'] >= 2
+    assert fs['cont_chunk_ticks'] == 4 * fs['cont_chunks_dispatched']
+    assert isinstance(fs['cont_boundary_wait_ms'], float)
+    for key in ('cont_lone_fast_path', 'cont_exact_fill_admits'):
+        assert key in fs
+    text = profiler.summary(print_out=False)
+    assert 'cont_chunks_dispatched' in text
+    assert 'cont_boundary_wait_ms' in text
+    profiler.clear()
+    # type-preserving clear: the float-seeded counter must keep
+    # accumulating fractional ms after a reset
+    assert profiler.fleet_stats()['cont_boundary_wait_ms'] == 0.0
+    profiler.add_fleet_stats(cont_boundary_wait_ms=0.5)
+    assert profiler.fleet_stats()['cont_boundary_wait_ms'] == 0.5
+    profiler.clear()
 
 
 # ---------------------------------------------------------------------------

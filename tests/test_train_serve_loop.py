@@ -371,11 +371,14 @@ def _seqs(lens, seed=0):
     return [rs.randn(L, CDIM).astype(np.float32) for L in lens]
 
 
-def _swap_run(seqs, drop=False, new_seed=3, min_ticks=4):
+def _swap_run(seqs, drop=False, new_seed=3, min_ticks=4, a_kw=None,
+              b_kw=None):
     """Submit `seqs` to engine A, hot-swap mid-flight into a fresh
     engine (seeded `new_seed`), return the completed outputs + the
-    export payload."""
-    eng_a = _cont(slots=2)
+    export payload (with each request's position AT export stashed
+    under 't_at_export' — the live objects mutate as engine B runs
+    them)."""
+    eng_a = _cont(**dict({'slots': 2}, **(a_kw or {})))
     res = [None] * len(seqs)
     ts = [threading.Thread(target=lambda i=i:
                            res.__setitem__(i, eng_a.infer(seqs[i])))
@@ -390,7 +393,9 @@ def _swap_run(seqs, drop=False, new_seed=3, min_ticks=4):
         exported = eng_a.export_state()
     finally:
         os.environ.pop('MXNET_TPU_FAULT_SWAP_DROP_STATE', None)
-    eng_b = _cont(slots=2, seed=new_seed)
+    exported['t_at_export'] = [r.t for r in exported['requests']]
+    eng_b = _cont(**dict({'slots': 2, 'seed': new_seed},
+                         **(b_kw or {})))
     migrated = eng_b.admit_state(exported,
                                  model_changed=new_seed != 3)
     for t in ts:
@@ -487,6 +492,42 @@ def test_swap_rejects_incompatible_engine_and_closed_source():
     finally:
         bad.close()
     eng_a.close()
+
+
+def test_swap_chunked_halts_at_chunk_boundary_bit_identical():
+    profiler.clear()
+    # chunked engines on BOTH sides of the swap (K=4): the tick loop
+    # halts only at chunk boundaries, so every exported in-flight
+    # position is a multiple of K — and the migrated run stays
+    # bit-identical to a never-swapped unchunked reference
+    seqs = _seqs([400, 250], seed=9)
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, migrated = _swap_run(
+        seqs, a_kw=dict(slots=4, tick_chunk=4),
+        b_kw=dict(slots=4, tick_chunk=4), min_ticks=8)
+    assert migrated >= 1
+    assert exported['t_at_export']
+    assert all(t % 4 == 0 for t in exported['t_at_export'])
+    for i in range(len(seqs)):
+        for a, b in zip(res[i], solo[i]):
+            assert np.array_equal(a, b), \
+                'sequence %d diverged across the chunked swap' % i
+
+
+def test_swap_chunked_to_unchunked_engine_bit_identical():
+    # the migration payload is tick-config agnostic: a chunked
+    # engine's export admits into an UNCHUNKED replacement and the
+    # answers stay bit-identical (the replacement just resumes the
+    # state rows one tick at a time)
+    seqs = _seqs([400], seed=12)
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, migrated = _swap_run(
+        seqs, a_kw=dict(slots=4, tick_chunk=4), min_ticks=8)
+    assert migrated >= 1
+    for a, b in zip(res[0], solo[0]):
+        assert np.array_equal(a, b)
 
 
 # ---------------------------------------------------------------------------

@@ -69,7 +69,9 @@ def main():
                    help='run the BENCH_FLEET fleet serving-tier smoke '
                         '(SLO vs single-knob batching through the '
                         'HTTP front, continuous vs convoy sequence '
-                        'batching, registry evict/re-warm zero-compile '
+                        'batching, the tick_chunk K=1/4/16 ladder '
+                        'with bitwise-parity + zero-compile gates, '
+                        'registry evict/re-warm zero-compile '
                         'check; one bench.py child) instead of the '
                         'model-family sweep')
     p.add_argument('--loop', action='store_true',
