@@ -1181,6 +1181,58 @@ def overlap_bench():
             sorted(net_i.collect_params().items()),
             sorted(net_e.collect_params().items())))
     cm = profiler.comm_stats()
+
+    # -- host-hiding A/B (PERF round 21): bounded step-ahead ------------
+    # step_ahead=1 returns with the dispatch still in flight (the host
+    # stages + enqueues step t+1 behind it; the block on step t's loss
+    # is the backpressure); step_ahead=0 blocks on every step's loss
+    # before returning — the serialized baseline.  The depth changes
+    # only WHEN the host waits, never what is computed, so the
+    # per-step loss curves must match BIT for BIT.  Measured with the
+    # profiler OFF (a synced dispatch would serialize both arms).
+    ahead_steps = int(os.environ.get('BENCH_OVERLAP_AHEAD_STEPS',
+                                     steps))
+
+    def make_single(seed, step_ahead):
+        net = nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(layers):
+                net.add(nn.Dense(hidden, activation='relu'))
+            net.add(nn.Dense(classes))
+        net.initialize()
+        net(mx.nd.zeros((batch, dim)))
+        prs = np.random.RandomState(seed)
+        for _, p in sorted(net.collect_params().items()):
+            p.set_data(mx.nd.array(
+                (prs.rand(*p.shape).astype(np.float32) - 0.5) * 0.2))
+        tr = gluon.Trainer(net.collect_params(), 'sgd',
+                           dict(opt_params))
+        return gluon.fuse_step(net, loss_fn, tr,
+                               step_ahead=step_ahead)
+
+    def loss_curve(fs, n):
+        curves = [fs(x, y) for _ in range(n)]
+        return [c.asnumpy().copy() for c in curves]
+
+    fs_a1 = make_single(2, 1)
+    fs_a0 = make_single(2, 0)
+    curve_a1 = loss_curve(fs_a1, 2)     # warm outside the clock
+    curve_a0 = loss_curve(fs_a0, 2)
+    best_ahead = {'ahead1': 0.0, 'ahead0': 0.0}
+    for _ in range(passes):
+        for name, fs in (('ahead1', fs_a1), ('ahead0', fs_a0)):
+            tic = time.time()
+            curve = loss_curve(fs, ahead_steps)
+            best_ahead[name] = max(best_ahead[name],
+                                   ahead_steps / (time.time() - tic))
+            if name == 'ahead1':
+                curve_a1 = curve
+            else:
+                curve_a0 = curve
+    step_parity = len(curve_a1) == len(curve_a0) and all(
+        np.array_equal(a, b) for a, b in zip(curve_a1, curve_a0))
+    ov = profiler.overlap_stats()
+
     print(json.dumps({
         'metric': 'overlap_reduce',
         'value': round(best['interleaved'], 2),
@@ -1196,6 +1248,15 @@ def overlap_bench():
         'steps_per_pass': steps, 'passes': passes,
         'parity_max_abs_diff': max_diff,
         'parity_ok': bool(max_diff < 1e-5),
+        'step_ahead1_sps': round(best_ahead['ahead1'], 2),
+        'step_ahead0_sps': round(best_ahead['ahead0'], 2),
+        'step_ahead_speedup': round(
+            best_ahead['ahead1'] / max(best_ahead['ahead0'], 1e-9), 3),
+        'step_ahead_steps': ahead_steps,
+        'step_ahead_loss_bit_parity': bool(step_parity),
+        'overlap_train_steps': ov['overlap_train_steps'],
+        'overlap_dispatch_wait_ms': round(
+            ov['overlap_dispatch_wait_ms'], 3),
     }))
 
 
@@ -1874,12 +1935,13 @@ def fleet_bench():
     cseqs = [rs.randn(chunk_len, sdim).astype(np.float32)
              for _ in range(n_seqs)]
 
-    def chunk_pass(K):
+    def chunk_pass(K, stage_ahead=0, slo=None):
         engine = ContinuousEngine(cell, arg_params=cp,
                                   data_shape=(sdim,),
                                   state_shapes={'h': (shid,)},
                                   state_outputs={'h': 1},
-                                  slots=chunk_slots, tick_chunk=K)
+                                  slots=chunk_slots, tick_chunk=K,
+                                  stage_ahead=stage_ahead, slo=slo)
         out = [None] * len(cseqs)
         ts = [threading.Thread(
             target=lambda i=i: out.__setitem__(i,
@@ -1894,7 +1956,7 @@ def fleet_bench():
         st = engine.stats()
         engine.close()
         assert st['compiles_after_warmup'] == 0, \
-            'chunked engine compiled mid-flight (K=%d)' % K
+            'chunked engine compiled mid-flight (K=%s)' % (K,)
         return out, len(cseqs) / elapsed, st
 
     chunk_sps = {}
@@ -1917,6 +1979,36 @@ def fleet_bench():
         chunk_st[K] = best_st
     k_top, k_base = ladder[-1], ladder[0]
     top_st = chunk_st[k_top]
+
+    # -- (b3) double-buffered staging A/B at identical K ---------------
+    # same workload, same K: stage_ahead=1 stages + enqueues chunk t+1
+    # while chunk t executes (the serial ladder above, stage_ahead=0,
+    # is the PR-17 baseline); gated on bit-parity vs the K=1 reference
+    staged_sps, staged_st = 0.0, None
+    staged_parity = True
+    for _ in range(passes):
+        out, s, st = chunk_pass(k_top, stage_ahead=1)
+        staged_parity = staged_parity and all(
+            all(np.array_equal(a, b)
+                for a, b in zip(out[i], ref_out[i]))
+            for i in range(len(cseqs)))
+        if s > staged_sps:
+            staged_sps, staged_st = s, st
+
+    # -- (b4) tick_chunk='auto': EMA-adapted K on the warmed rungs -----
+    auto_sps, auto_st = 0.0, None
+    auto_parity = True
+    auto_deadline = float(os.environ.get('BENCH_FLEET_AUTO_DEADLINE_MS',
+                                         200))
+    for _ in range(passes):
+        out, s, st = chunk_pass('auto', stage_ahead=1,
+                                slo=SLO(deadline_ms=auto_deadline))
+        auto_parity = auto_parity and all(
+            all(np.array_equal(a, b)
+                for a, b in zip(out[i], ref_out[i]))
+            for i in range(len(cseqs)))
+        if s > auto_sps:
+            auto_sps, auto_st = s, st
 
     # -- (c) registry paging: evict/re-warm at zero compiles -----------
     reg = ModelRegistry(budget_bytes=1)      # forces single residency
@@ -1974,6 +2066,24 @@ def fleet_bench():
         'chunk_lone_fast_path': bool(top_st['lone_fast_path']),
         'chunk_compiles_after_warmup':
             top_st['compiles_after_warmup'],
+        'staged_seqs_per_s': round(staged_sps, 2),
+        'staged_speedup_vs_serial': round(
+            staged_sps / chunk_sps[k_top], 3)
+        if chunk_sps[k_top] else None,
+        'staged_bit_parity': bool(staged_parity),
+        'staged_chunks': staged_st['staged_chunks'],
+        'stage_overlap_ms': staged_st['stage_overlap_ms'],
+        'staged_boundary_wait_ms': staged_st['boundary_wait_ms'],
+        'staged_compiles_after_warmup':
+            staged_st['compiles_after_warmup'],
+        'auto_seqs_per_s': round(auto_sps, 2),
+        'auto_bit_parity': bool(auto_parity),
+        'auto_steady_k': auto_st['tick_chunk'],
+        'auto_k_decisions': auto_st['auto_k_decisions'],
+        'auto_tick_ms_ema': auto_st['tick_ms_ema'],
+        'auto_deadline_ms': auto_deadline,
+        'auto_compiles_after_warmup':
+            auto_st['compiles_after_warmup'],
         'evict_rewarm_cycles': cycles,
         'evictions': evictions,
         'evict_rewarm_compiles': rewarm_misses,

@@ -1015,6 +1015,30 @@ class CheckpointManager(object):
             return True
         return False
 
+    def will_act(self, steps=1):
+        """Would the NEXT `step_end(steps=steps)` act — commit a
+        preemption/stop unwind, or take a cadence checkpoint?  The
+        drain predicate for overlapped training loops: deferred work
+        (queued metric folds, callback backlogs) only needs flushing
+        when the coming boundary actually CONSUMES it, so the async
+        pipeline stays unbroken across the common no-op steps.
+        Conservative by design: a True may still end in a skipped
+        async save (writer busy), which costs one early drain, never
+        a checkpoint that saw half-folded state."""
+        if self._preempt.is_set() or self._stop_exc is not None:
+            return True
+        if self.every_n_steps is not None:
+            nxt = self._step + int(steps)
+            if nxt - (self._last_save_step or 0) >= \
+                    int(self.every_n_steps) and \
+                    nxt != self._last_save_step:
+                return True
+        if self.every_n_secs is not None and \
+                time.monotonic() - self._last_save_time >= \
+                float(self.every_n_secs):
+            return True
+        return False
+
     def step_end(self, epoch=0, batches_in_epoch=0, batch_size=0,
                  steps=1, metric=None, rung=None, target=None):
         """Per-step bookkeeping hook (Module.fit and gluon FusedStep

@@ -56,8 +56,10 @@ Bench: BENCH_GLUON=1 and BENCH_OVERLAP=1 in bench.py.  Docs:
 docs/PERF.md rounds 10-11.
 """
 import hashlib
+import os
 import re
 import time
+from collections import deque
 
 import numpy as np
 
@@ -79,9 +81,32 @@ from ..parallel import zero as zero_mod
 from . import block as block_mod
 
 
+def resolve_step_ahead(step_ahead=None):
+    """How many donated train dispatches may be IN FLIGHT behind the
+    host (MXNET_TPU_TRAIN_STEP_AHEAD, default 1): XLA dispatch is
+    async, so the host can stage + enqueue step t+1 while step t's
+    result is still computing — this bound is the backpressure that
+    keeps it from running unboundedly ahead (donated-buffer chains
+    grow with every un-drained step).  0 = block on every step's loss
+    before returning (the serialized parity baseline the overlap A/B
+    gates against).  The depth changes only WHEN the host waits,
+    never what is computed — loss curves are bit-identical at any
+    depth."""
+    if step_ahead is not None:
+        return max(0, int(step_ahead))
+    raw = (os.environ.get('MXNET_TPU_TRAIN_STEP_AHEAD', '') or '') \
+        .strip().lower()
+    if raw in ('0', 'off', 'none', 'false'):
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 1
+
+
 def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
               ema_decay=None, interleave=None, checkpoint=None,
-              pipeline=None):
+              pipeline=None, step_ahead=None):
     """Build (and register on `trainer`) a FusedStep compiling the
     whole train step for `net` into one donated XLA dispatch.
 
@@ -145,6 +170,12 @@ def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
     stage weights live only on their pipe row during training (see
     PipelinedStep.sync_params).
 
+    step_ahead: bound on the async-dispatch pipeline depth — how many
+    fused dispatches may be in flight before the host blocks on the
+    oldest one's loss (None = MXNET_TPU_TRAIN_STEP_AHEAD, default 1;
+    0 = serialized, bit-identical either way — see
+    resolve_step_ahead).
+
     After this call `trainer.step_fused(batch_size, *args)` also runs
     the fused step."""
     from ..parallel import pipeline as pipe_mod
@@ -160,7 +191,8 @@ def fuse_step(net, loss, trainer, mesh=None, zero=None, metric=None,
         return PipelinedStep(net, loss, trainer, spec, zero=zero)
     return FusedStep(net, loss, trainer, mesh=mesh, zero=zero,
                      metric=metric, ema_decay=ema_decay,
-                     interleave=interleave, checkpoint=checkpoint)
+                     interleave=interleave, checkpoint=checkpoint,
+                     step_ahead=step_ahead)
 
 
 class FusedStep:
@@ -171,8 +203,10 @@ class FusedStep:
 
     def __init__(self, net, loss, trainer, mesh=None, zero=None,
                  metric=None, ema_decay=None, interleave=None,
-                 checkpoint=None):
+                 checkpoint=None, step_ahead=None):
         self._checkpoint = checkpoint
+        self._step_ahead = resolve_step_ahead(step_ahead)
+        self._inflight = deque()     # loss futures of enqueued steps
         self._ckpt_resume_tried = False
         self._net = net
         self._loss = loss
@@ -914,6 +948,22 @@ class FusedStep:
             # that IS the drain) and raises Preempted
             self._checkpoint.step_end(steps=k, batch_size=batch_size,
                                       metric=self._metric, target=self)
+        if not synced:
+            # bounded async-dispatch depth: the returned losses are
+            # FUTURES, so the host is free to stage + enqueue the next
+            # dispatch while this one computes — but only step_ahead
+            # deep, or it runs unboundedly ahead of the device.  The
+            # timed block on the OLDEST loss is the backpressure (and
+            # the measured overlap window); a profiler-synced dispatch
+            # already blocked above.
+            self._inflight.append(loss_out)
+            while len(self._inflight) > self._step_ahead:
+                tw = time.perf_counter()
+                jax.block_until_ready(self._inflight.popleft())
+                profiler.add_overlap_stats(
+                    dispatch_wait_ms=(time.perf_counter() - tw) * 1e3)
+        profiler.add_overlap_stats(train_steps=k,
+                                   steps_ahead=len(self._inflight))
         ctx = self._ctxs[0]
         out = [nd.NDArray(v, ctx) for v in loss_out]
         return jtu.tree_unflatten(self._loss_treedef, out)

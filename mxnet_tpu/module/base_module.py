@@ -309,7 +309,56 @@ class BaseModule:
                     _ckpt_step):
         """The epoch loop body of fit() (split out so fit can disarm
         its signal handlers in one finally regardless of how the loop
-        exits — normal completion, Preempted, or an error)."""
+        exits — normal completion, Preempted, or an error).
+
+        Overlapped metric pipeline: XLA dispatch is async, but the
+        reference loop's per-batch `update_metric` materializes the
+        step's outputs — a host sync that re-serializes every step.
+        When the module can snapshot (labels, output futures) without
+        syncing (Module.metric_snapshot) and no monitor is installed,
+        the fold + batch_end_callback DEFER by up to
+        MXNET_TPU_TRAIN_STEP_AHEAD batches (gluon
+        resolve_step_ahead; 0 restores the serialized loop), so step
+        t+1's donated dispatch enqueues while step t computes.  The
+        queue drains before anything that CONSUMES the metric — a
+        checkpoint boundary that will act (CheckpointManager.
+        will_act), the peer-death preempt path, and the epoch-end
+        log — so every observable value is bit-identical to the
+        serialized loop, later."""
+        import os
+        from .. import profiler
+        from ..gluon.fused import resolve_step_ahead
+        from collections import deque
+        env_set = bool((os.environ.get('MXNET_TPU_TRAIN_STEP_AHEAD')
+                        or '').strip())
+        ahead = 0
+        if monitor is None and hasattr(self, 'metric_snapshot') and \
+                (batch_end_callback is None or env_set):
+            # with a batch_end_callback installed the deferral SHIFTS
+            # when the callback observes the metric (and when a
+            # callback-requested preemption lands) by up to `ahead`
+            # batches — reference semantics by default, opt in with
+            # the env knob
+            ahead = resolve_step_ahead()
+        pending = deque()               # (labels, preds, epoch, nbatch)
+
+        def _fold_one():
+            labels, preds, ep, nb = pending.popleft()
+            tw = time.perf_counter()
+            eval_metric.update_dict(labels, preds)
+            profiler.add_overlap_stats(
+                deferred_metric_folds=1,
+                dispatch_wait_ms=(time.perf_counter() - tw) * 1e3)
+            if batch_end_callback is not None:
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=ep, nbatch=nb,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
+
+        def _drain():
+            while pending:
+                _fold_one()
+
         for epoch in range(begin_epoch, num_epoch):
             epoch_start = time.time()
             eval_metric.reset()
@@ -338,19 +387,42 @@ class BaseModule:
                         self.forward_backward(data_batch)
                         self.update()
                     except MXNetError:
+                        _drain()        # preempt commit reads metric
                         self._peer_death_preempt(checkpoint, _ckpt_step,
                                                  nbatch, epoch)
                         raise
-                    self.update_metric(eval_metric, data_batch.label)
+                    snap = self.metric_snapshot(data_batch.label) \
+                        if ahead else None
+                    if snap is None:
+                        self.update_metric(eval_metric,
+                                           data_batch.label)
                     if monitor is not None:
                         monitor.toc_print()
-                    if batch_end_callback is not None:
-                        _fire(batch_end_callback,
-                              BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                            eval_metric=eval_metric,
-                                            locals=locals()))
+                    if snap is None:
+                        if batch_end_callback is not None:
+                            _fire(batch_end_callback,
+                                  BatchEndParam(epoch=epoch,
+                                                nbatch=nbatch,
+                                                eval_metric=eval_metric,
+                                                locals=locals()))
+                    else:
+                        pending.append(snap + (epoch, nbatch))
+                        while len(pending) > ahead:
+                            _fold_one()
+                        profiler.add_overlap_stats(
+                            train_steps=1,
+                            steps_ahead=len(pending))
+                    if checkpoint is not None and \
+                            checkpoint.will_act(1):
+                        # the coming boundary consumes the metric
+                        # (best-tracking in save / the preemption
+                        # commit): flush the deferred folds so the
+                        # snapshot sees exactly the serialized loop's
+                        # state
+                        _drain()
                     _ckpt_step(nbatch + 1, 1, epoch)
 
+            _drain()                    # epoch boundary logs the metric
             for name, val in eval_metric.get_name_value():
                 self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
             self.logger.info('Epoch[%d] Time cost=%.3f', epoch,

@@ -885,6 +885,30 @@ class Module(BaseModule):
         self._materialize_fused()
         self._exec_group.update_metric(eval_metric, labels)
 
+    def metric_snapshot(self, labels):
+        """Capture this step's (labels, prediction futures) for a
+        DEFERRED metric fold (fit's overlapped train loop): the
+        executor reassigns `.outputs` to fresh NDArrays on every
+        dispatch and in-place NDArray writes swap the underlying
+        buffer rather than mutate it, so the captured refs keep this
+        step's exact values while later steps enqueue — folding them
+        after N more dispatches reads bit-identical data to a
+        synchronous update_metric, without the per-step host sync.
+        Returns (labels_dict, preds_dict) for
+        `eval_metric.update_dict`, or None when a deferred fused step
+        is still pending (its outputs do not exist yet) — callers
+        fall back to the synchronous path."""
+        if self._pending_fused:
+            return None
+        eg = self._exec_group
+        outs = eg.executor.outputs
+        if not outs:
+            return None
+        preds = dict(zip(self._symbol.list_outputs(), list(outs)))
+        if isinstance(labels, (list, tuple)):
+            labels = dict(zip(eg.label_names, list(labels)))
+        return labels, preds
+
     # -- optimizer states --------------------------------------------------
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized

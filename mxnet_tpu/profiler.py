@@ -187,15 +187,19 @@ _EMBED = {
     'hotrow_misses': 0,
     'hotrow_evictions': 0,
     'hotrow_resident_bytes': 0,      # gauge, not cumulative
+    'hotrow_prefetched': 0,          # rows paged ahead of demand
+    'hotrow_prefetch_hits': 0,       # prefetched rows later demanded
 }
 
 
 def add_embed_stats(steps=0, dispatches=0, lookups=0, unique_rows=0,
                     touched_bytes=0, dense_equiv_bytes=0, max_rung=0,
-                    hits=0, misses=0, evictions=0, resident_bytes=None):
+                    hits=0, misses=0, evictions=0, prefetched=0,
+                    prefetch_hits=0, resident_bytes=None):
     """Accumulate sparse-embedding counters (the fused step feeds one
     call per sparse dispatch; the serving hot-row cache feeds
-    hits/misses/evictions per batch and the resident-bytes gauge)."""
+    hits/misses/evictions per batch, prefetched/prefetch_hits from
+    the queued-request speculation, and the resident-bytes gauge)."""
     with _STATE['lock']:
         _EMBED['embed_steps'] += int(steps)
         _EMBED['embed_dispatches'] += int(dispatches)
@@ -208,6 +212,8 @@ def add_embed_stats(steps=0, dispatches=0, lookups=0, unique_rows=0,
         _EMBED['hotrow_hits'] += int(hits)
         _EMBED['hotrow_misses'] += int(misses)
         _EMBED['hotrow_evictions'] += int(evictions)
+        _EMBED['hotrow_prefetched'] += int(prefetched)
+        _EMBED['hotrow_prefetch_hits'] += int(prefetch_hits)
         if resident_bytes is not None:
             _EMBED['hotrow_resident_bytes'] = int(resident_bytes)
 
@@ -553,6 +559,10 @@ _FLEET = {
     'cont_exact_fill_admits': 0,    # chunk stagings that skipped the
                                     # pad memset (every slot active
                                     # for all K ticks)
+    'cont_staged_chunks': 0,        # chunks built in the shadow buffer
+                                    # while the previous dispatch ran
+    'cont_stage_overlap_ms': 0.0,   # host staging wall hidden behind
+                                    # an in-flight chunk dispatch
 }
 
 
@@ -674,6 +684,51 @@ def loop_stats():
     summary() and dump_profile's 'loop' metadata lane)."""
     with _STATE['lock']:
         return dict(_LOOP)
+
+
+# host-hiding counters (PERF round 21): the overlap layer across both
+# hot paths — bounded-depth train-step pipelining (gluon.FusedStep /
+# Module.fit's deferred metric drain), the continuous batcher's
+# shadow-buffer chunk staging, and the adaptive tick-chunk chooser.
+# Gauges: overlap_steps_ahead (current in-flight train-step depth),
+# overlap_auto_k (the chunk length the adaptive chooser last picked).
+_OVERLAP = {
+    'overlap_train_steps': 0,        # steps run through the pipeline
+    'overlap_steps_ahead': 0,        # gauge: in-flight depth now
+    'overlap_dispatch_wait_ms': 0.0,  # host blocked draining the
+                                      # oldest in-flight step
+    'overlap_deferred_metric_folds': 0,  # fit metric updates run at
+                                         # drain time, not per batch
+    'overlap_stage_chunks': 0,       # serving chunks staged ahead
+    'overlap_stage_overlap_ms': 0.0,  # staging wall hidden behind an
+                                      # in-flight chunk dispatch
+    'overlap_auto_k_decisions': 0,   # adaptive chooser changed K
+    'overlap_auto_k': 0,             # gauge: current auto-chosen K
+}
+
+
+def add_overlap_stats(steps_ahead=None, auto_k=None, **deltas):
+    """Accumulate host-hiding counters (steps_ahead and auto_k are
+    GAUGES — set, not added; everything else adds — float-seeded keys
+    accumulate fractional deltas).  Keys arrive without the overlap_
+    prefix (train_steps=1, dispatch_wait_ms=0.4, stage_chunks=1,
+    auto_k_decisions=1, ...)."""
+    with _STATE['lock']:
+        for k, v in deltas.items():
+            key = 'overlap_' + k
+            _OVERLAP[key] += float(v) \
+                if isinstance(_OVERLAP[key], float) else int(v)
+        if steps_ahead is not None:
+            _OVERLAP['overlap_steps_ahead'] = int(steps_ahead)
+        if auto_k is not None:
+            _OVERLAP['overlap_auto_k'] = int(auto_k)
+
+
+def overlap_stats():
+    """Snapshot of the host-hiding counters (also merged into
+    summary() and dump_profile's 'overlap' metadata lane)."""
+    with _STATE['lock']:
+        return dict(_OVERLAP)
 
 
 # self-healing fleet-supervisor counters (fleet_supervisor.FleetRouter +
@@ -812,6 +867,8 @@ def dump_profile():
                    'args': quant_stats()})
     events.append({'ph': 'M', 'name': 'loop', 'pid': 0,
                    'args': loop_stats()})
+    events.append({'ph': 'M', 'name': 'overlap', 'pid': 0,
+                   'args': overlap_stats()})
     with _STATE['lock']:
         records = list(_STATE['records'])
     for name, cat, ts, dur, tid in records:
@@ -1061,6 +1118,20 @@ def summary(print_out=True):
                  % (lp['loop_swap_migrated_slots'],
                     lp['loop_swap_dropped_slots'],
                     lp['loop_swap_divergent_slots']))
+    ov = overlap_stats()
+    lines.append('  overlap_train_steps=%d overlap_steps_ahead=%d '
+                 'overlap_dispatch_wait_ms=%.3f '
+                 'overlap_deferred_metric_folds=%d'
+                 % (ov['overlap_train_steps'],
+                    ov['overlap_steps_ahead'],
+                    ov['overlap_dispatch_wait_ms'],
+                    ov['overlap_deferred_metric_folds']))
+    lines.append('  overlap_stage_chunks=%d overlap_stage_overlap_ms'
+                 '=%.3f overlap_auto_k_decisions=%d overlap_auto_k=%d'
+                 % (ov['overlap_stage_chunks'],
+                    ov['overlap_stage_overlap_ms'],
+                    ov['overlap_auto_k_decisions'],
+                    ov['overlap_auto_k']))
     text = '\n'.join(lines)
     if print_out:
         print(text)
@@ -1116,6 +1187,8 @@ def clear():
             _QUANT[k] = type(_QUANT[k])()
         for k in _LOOP:
             _LOOP[k] = 0
+        for k in _OVERLAP:
+            _OVERLAP[k] = type(_OVERLAP[k])()
         _BUCKET_RUNGS.clear()
         del _SERVE_LAT[:]
         _SERVE_LAT_POS[0] = 0

@@ -110,7 +110,14 @@ def resolve_tick_chunk(tick_chunk, slots=None, slo=None,
     chunk boundaries, so one chunk can strand up to (K-1) freed
     slot-ticks per retiring slot — with K <= slots a queued request's
     extra boundary wait stays under one batch-width of ticks, the
-    queue-semantics bound the shed estimator assumes."""
+    queue-semantics bound the shed estimator assumes.
+
+    tick_chunk='auto' (explicit or via the env knob) returns the
+    literal string 'auto': ContinuousEngine then re-derives K each
+    chunk from the live tick-time EMA against the SLO deadline
+    (chunk_for_deadline), quantized to its warmed rung ladder.  It
+    requires an SLO with a deadline — without one there is nothing
+    to derive K against, rejected typed here."""
     v = tick_chunk
     if v is None:
         v = os.environ.get(TICK_CHUNK_KNOB, '').strip() or None
@@ -124,6 +131,16 @@ def resolve_tick_chunk(tick_chunk, slots=None, slo=None,
         s = v.strip().lower()
         if s in ('', '0', 'off', 'none', 'false'):
             return 1
+        if s == 'auto':
+            if slo is None or not getattr(slo, 'deadline_ms', None):
+                raise MXNetError(
+                    "%s: tick_chunk='auto' needs an SLO deadline — "
+                    'the adaptive chunker re-derives K from the live '
+                    'tick-time EMA against slo.deadline_ms '
+                    '(chunk_for_deadline); pass an SLO with '
+                    'deadline_ms or use a fixed integer K'
+                    % TICK_CHUNK_KNOB)
+            return 'auto'
         try:
             v = int(s)
         except ValueError:
@@ -441,6 +458,19 @@ class InferenceEngine(object):
             hot_rows = _env_int('MXNET_TPU_SERVE_HOT_ROWS', 0) or None
         if hot_rows:
             self._setup_hotrows(hot_rows)
+        # queued-request hot-row prefetch: how many waiting requests
+        # the dispatcher peeks at after enqueuing a batch, paging
+        # their embedding ids in while the device runs (0/off = no
+        # speculation; docs/SERVING.md knob table)
+        pf = os.environ.get('MXNET_TPU_SERVE_HOTROW_PREFETCH',
+                            '').strip().lower()
+        if pf in ('0', 'off', 'none', 'false'):
+            self._hotrow_peek = 0
+        else:
+            try:
+                self._hotrow_peek = int(pf) if pf else 8
+            except ValueError:
+                self._hotrow_peek = 8
         if warmup:
             self.warmup()
         self._dispatcher = threading.Thread(
@@ -768,7 +798,7 @@ class InferenceEngine(object):
         ladder at log2(C) shapes instead of one per miss count."""
         import jax
         out = list(host)
-        ev_batch = miss_batch = hit_batch = 0
+        ev_batch = miss_batch = hit_batch = pf_batch = 0
         for st in self._hotrows.values():
             per_k = []
             for k in st.ids_idx:
@@ -793,6 +823,7 @@ class InferenceEngine(object):
                     else:
                         v = next(victims)   # guaranteed: cap >= |uniq|
                         slots_new.append(st.resident.pop(v))
+                        st.prefetched.discard(v)
                         st.evictions += 1
                         ev_batch += 1
                 rung = 1
@@ -815,6 +846,12 @@ class InferenceEngine(object):
             for u in uniq_l:
                 if u in st.resident:
                     st.resident.move_to_end(u)
+                    if u in st.prefetched:
+                        # a speculatively paged row got demanded —
+                        # the prefetch hid this page-in's latency
+                        st.prefetched.discard(u)
+                        st.prefetch_hits += 1
+                        pf_batch += 1
             for u, s in zip(missing, slots_new):
                 st.resident[u] = s
             st.hits += hits
@@ -834,10 +871,79 @@ class InferenceEngine(object):
                 off += n
         profiler.add_embed_stats(
             hits=hit_batch, misses=miss_batch, evictions=ev_batch,
+            prefetch_hits=pf_batch,
             resident_bytes=sum(
                 st.capacity * st.dim * st.host.dtype.itemsize
                 for st in self._hotrows.values()))
         return out
+
+    def _hotrow_prefetch(self, peek):
+        """Dispatcher-thread-only, same single-consumer discipline as
+        _hotrow_remap: page the ids of still-queued requests into the
+        hot buffer WHILE the just-enqueued dispatch runs, so the rows
+        are demand hits by the time those requests coalesce.  Never
+        evicts for a guess beyond the LRU half of the cache (a
+        speculative miss must not wipe the working set), and the
+        page-in is the same functional .at[].set — an in-flight
+        dispatch keeps reading its own captured buffer."""
+        import jax
+        for st in self._hotrows.values():
+            ids = []
+            for inputs in peek:
+                for k in st.ids_idx:
+                    a = np.asarray(inputs[k])
+                    ii = a.astype(np.int64) if a.dtype.kind in 'iu' \
+                        else np.rint(a).astype(np.int64)
+                    np.clip(ii, 0, st.vocab - 1, out=ii)
+                    ids.append(ii.ravel())
+            if not ids:
+                continue
+            uniq = np.unique(np.concatenate(ids)).tolist()
+            missing = [u for u in uniq if u not in st.resident]
+            curset = set(uniq)
+            # evictable = resident rows no queued request wants;
+            # unlike the demand path there is NO capacity guarantee
+            # here, so the budget is explicit: all free slots, at
+            # most half the cache via eviction, and never more
+            # victims than actually exist
+            evictable = [u for u in st.resident if u not in curset]
+            budget = min(max(len(st.free), st.capacity // 2),
+                         len(st.free) + len(evictable))
+            missing = missing[:budget]
+            if not missing:
+                continue
+            victims = iter(evictable)
+            slots_new = []
+            for _u in missing:
+                if st.free:
+                    slots_new.append(st.free.pop())
+                else:
+                    v = next(victims)
+                    slots_new.append(st.resident.pop(v))
+                    st.prefetched.discard(v)
+                    st.evictions += 1
+            rung = 1
+            while rung < len(missing):
+                rung *= 2
+            pad = rung - len(missing)
+            rows = st.host[np.asarray(missing, np.int64)]
+            slots_arr = np.asarray(slots_new + [st.capacity] * pad,
+                                   np.int32)
+            if pad:
+                rows = np.concatenate(
+                    [rows, np.zeros((pad, st.dim), rows.dtype)])
+            dev = self._ctx.jax_device()
+            st.arg._data = _page_fn()(
+                st.arg._data, jax.device_put(slots_arr, dev),
+                jax.device_put(rows, dev))
+            # prefetched rows enter at the LRU end: an untouched
+            # speculation is the first thing demand paging reclaims
+            for u, s in zip(missing, slots_new):
+                st.resident[u] = s
+                st.resident.move_to_end(u, last=False)
+                st.prefetched.add(u)
+                st.prefetch_rows += 1
+            profiler.add_embed_stats(prefetched=len(missing))
 
     def resident_bytes(self):
         """Bytes the engine's weights/aux actually hold resident
@@ -1088,6 +1194,8 @@ class InferenceEngine(object):
                     'hit_rate': st.hits / tot if tot else 0.0,
                     'resident_bytes': st.capacity * st.dim * item,
                     'table_bytes': st.vocab * st.dim * item,
+                    'prefetch_rows': st.prefetch_rows,
+                    'prefetch_hits': st.prefetch_hits,
                 }
             out['hot_rows'] = hr
         snap = self._warm_snapshot
@@ -1190,10 +1298,19 @@ class InferenceEngine(object):
                 # batcher exists to absorb)
                 depth = self._n_queued
                 reqs, rows = self._coalesce_locked(entry)
+                # snapshot the still-waiting heads while the lock is
+                # held: their input tuples are frozen at submit time,
+                # so the references stay valid after release — the
+                # dispatcher prefetches their hot rows behind the
+                # batch it is about to enqueue
+                peek = None
+                if self._hotrows and self._hotrow_peek:
+                    peek = [r.inputs for q in self._queues.values()
+                            for r in q][:self._hotrow_peek]
             if not reqs:
                 continue
             try:
-                self._launch(entry, reqs, rows, depth, rng)
+                self._launch(entry, reqs, rows, depth, rng, peek)
             except Exception as e:               # surface per-request
                 with self._lock:            # rows never reached the
                     self._inflight_rows -= rows  # completion thread
@@ -1205,7 +1322,7 @@ class InferenceEngine(object):
             self._inflight.append(None)
             self._inflight_cond.notify_all()
 
-    def _launch(self, entry, reqs, rows, depth, rng):
+    def _launch(self, entry, reqs, rows, depth, rng, peek=None):
         """Assemble the padded host batch, stage H2D, enqueue the
         dispatch.  Runs in the dispatcher thread; the bounded in-flight
         queue means batch N+1 stages/dispatches while the completion
@@ -1248,6 +1365,11 @@ class InferenceEngine(object):
             dvals = tuple(mxio.stage_to_device(host,
                                                device=self._ctx))
             outs = self._run(prog, dvals, rng)   # async dispatch
+        if peek:
+            # the dispatch above is in flight — page the waiting
+            # requests' rows in behind it (functional page-in, so the
+            # running program keeps its own buffer alive)
+            self._hotrow_prefetch(peek)
         offs = []
         off = 0
         for r in reqs:
@@ -1408,7 +1530,8 @@ class _HotRowTable(object):
     only by the dispatcher thread (and read by stats())."""
     __slots__ = ('name', 'ids_idx', 'vocab', 'dim', 'capacity', 'host',
                  'arg', 'resident', 'free', 'hits', 'misses',
-                 'evictions')
+                 'evictions', 'prefetched', 'prefetch_hits',
+                 'prefetch_rows')
 
     def __init__(self, name, ids_idx, vocab, dim, capacity, host, arg):
         self.name = name
@@ -1423,6 +1546,9 @@ class _HotRowTable(object):
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.prefetched = set()         # paged-ahead ids not yet hit
+        self.prefetch_hits = 0
+        self.prefetch_rows = 0
 
 
 _PAGE_FN = None

@@ -70,10 +70,15 @@ from . import quantization
 from .base import MXNetError
 from .quantization import QuantConfig
 from .serving import (InferenceEngine, _env_int, _quiet_donation,
-                      resolve_tick_chunk)
+                      chunk_for_deadline, resolve_tick_chunk)
 
 __all__ = ['Overloaded', 'BudgetExceeded', 'SLO', 'ModelRegistry',
            'ContinuousEngine', 'HttpFront']
+
+# tick_chunk='auto' EMA smoothing: one chunk's measured per-tick wall
+# folds in at this weight, so K re-derives from a few recent chunks
+# without chasing single-dispatch jitter
+_TICK_EMA_ALPHA = 0.25
 
 
 def _env_float(name, default):
@@ -354,7 +359,14 @@ class ModelRegistry(object):
                     'and returning a ContinuousEngine); prefix=/'
                     'source= models serve through the request '
                     'coalescer, which has no tick loop' % name)
-            if resolve_tick_chunk(tick_chunk) == 1:
+            if isinstance(tick_chunk, str) and \
+                    tick_chunk.strip().lower() == 'auto':
+                # forwarded unresolved: only the engine has the SLO
+                # deadline the adaptive chooser derives K against
+                # (resolve_tick_chunk rejects auto-without-deadline
+                # typed at construction)
+                tick_chunk = 'auto'
+            elif resolve_tick_chunk(tick_chunk) == 1:
                 tick_chunk = None       # 0/'off'/1: the loader's own
                                         # default (unchunked) applies
         quantize = QuantConfig.resolve(quantize)
@@ -936,7 +948,7 @@ class ModelRegistry(object):
 
 class _ContRequest(object):
     __slots__ = ('seq', 'length', 't', 'ys', 'event', 'outputs',
-                 'error', 't_enq', 'mig_state')
+                 'error', 't_enq', 'mig_state', 'staged_t')
 
     def __init__(self, seq):
         self.seq = seq
@@ -948,6 +960,39 @@ class _ContRequest(object):
         self.error = None
         self.t_enq = time.perf_counter()
         self.mig_state = None           # migrated cell state (hot-swap)
+        self.staged_t = 0               # position incl. staged chunks
+                                        # (t advances at PROCESS time;
+                                        # staged_t at STAGING time)
+
+
+class _StagedChunk(object):
+    """The shadow buffer: one chunk's host staging prepared AHEAD of
+    (or concurrently with) the device executing earlier chunks.
+    Retire/admit decisions are DETERMINISTIC — a slot frees when its
+    request's staged position reaches its own length, never a device
+    output — so admit rows, the reset mask and the per-row retire
+    bookkeeping can all be computed before the previous dispatch
+    returns.  Carries its own K: the adaptive chooser may move
+    tick_chunk between stagings."""
+    __slots__ = ('K', 'xs', 'reset', 'rows', 'admits', 'mig', 'lone',
+                 'lane', 'start', 'exact', 'outs', 'error', 't_disp',
+                 'waiting')
+
+    def __init__(self, K):
+        self.K = K
+        self.waiting = 0                # queue depth at staging time
+        self.xs = None                  # host (K, width, ...) inputs
+        self.reset = None               # host admission-reset mask
+        self.rows = ()                  # (slot, request, n) per row
+        self.admits = ()                # (slot, request) fresh admits
+        self.mig = ()                   # (slot, state dict) hot-swap
+        self.lone = False
+        self.lane = 0
+        self.start = 0
+        self.exact = False
+        self.outs = None                # dispatched output futures
+        self.error = None               # dispatch-time exception
+        self.t_disp = 0.0
 
 
 class ContinuousEngine(object):
@@ -1057,7 +1102,8 @@ class ContinuousEngine(object):
                  data_name='data', data_shape=None, state_shapes=None,
                  state_outputs=None, slots=None, ctx=None,
                  init_states=None, convoy=False, max_queue=None,
-                 tick_chunk=None, slo=None, tick_ms_hint=None):
+                 tick_chunk=None, slo=None, tick_ms_hint=None,
+                 stage_ahead=None):
         from .context import cpu
         if data_shape is None or not state_shapes or not state_outputs:
             raise MXNetError('ContinuousEngine needs data_shape, '
@@ -1072,8 +1118,46 @@ class ContinuousEngine(object):
         self.max_queue = int(max_queue if max_queue is not None else
                              _env_int('MXNET_TPU_SERVE_MAX_QUEUE_ROWS',
                                       4096))
-        self.tick_chunk = resolve_tick_chunk(
+        tk = resolve_tick_chunk(
             tick_chunk, self.slots, slo=slo, tick_ms_hint=tick_ms_hint)
+        self._auto = tk == 'auto'
+        self._rungs = ()
+        self._deadline_ms = None
+        self._tick_ms_ema = None        # live per-tick wall EMA (auto)
+        self._auto_decisions = 0
+        if self._auto:
+            # adaptive K: re-derive chunk_for_deadline from the live
+            # tick-time EMA, quantized DOWN to a warmed pow-2 rung so
+            # a K change never compiles
+            self._deadline_ms = float(slo.deadline_ms)
+            rungs, r = [], 1
+            while r < self.slots:
+                rungs.append(r)
+                r *= 2
+            rungs.append(self.slots)
+            self._rungs = tuple(sorted(set(rungs)))
+            if tick_ms_hint:
+                self._tick_ms_ema = float(tick_ms_hint)
+                self.tick_chunk = self._quantize_k(chunk_for_deadline(
+                    self._deadline_ms, tick_ms_hint, self.slots))
+            else:
+                self.tick_chunk = 1     # no hint: start small, the
+                                        # EMA raises K at run time
+        else:
+            self.tick_chunk = tk
+        # double-buffered chunk staging depth (0 = the serialized
+        # stage->dispatch->drain loop, the parity baseline)
+        if stage_ahead is None:
+            s = os.environ.get('MXNET_TPU_SERVE_STAGE_AHEAD',
+                               '').strip().lower()
+            if s in ('0', 'off', 'none', 'false'):
+                stage_ahead = 0
+            else:
+                try:
+                    stage_ahead = int(s) if s else 1
+                except ValueError:
+                    stage_ahead = 1
+        self._stage_ahead = max(0, int(stage_ahead))
         self._data_name = data_name
         self._data_shape = tuple(int(d) for d in data_shape)
         self._state_names = sorted(state_shapes)
@@ -1123,11 +1207,17 @@ class ContinuousEngine(object):
                     'co-resident sequences' % (i, tuple(o.shape),
                                                self.slots))
         jax.block_until_ready(outs)
-        self._chunk_step = None
-        self._lone_step = None
-        self._lone_width = 0
-        if self.tick_chunk > 1:
-            self._warm_chunk_programs(init_states)
+        self._chunk_steps = {}          # K -> chunked scan program
+        self._lone_steps = {}           # K -> (lone rung fn, width)
+        if self._auto:
+            # warm EVERY rung at construction: the adaptive chooser
+            # moves K at run time and steady state must stay at zero
+            # compiles.  Rung 1 is a length-1 scan chunk, so every
+            # auto K shares one dispatch path (and one cache kind).
+            for k in self._rungs:
+                self._warm_chunk_programs(init_states, k)
+        elif self.tick_chunk > 1:
+            self._warm_chunk_programs(init_states, self.tick_chunk)
         self._warm_snapshot = exec_cache.stats()
         # request plumbing
         self._cond = threading.Condition()
@@ -1147,6 +1237,14 @@ class ContinuousEngine(object):
                                         # the boundary)
         self._lone_hits = 0             # 1-slot rung dispatches
         self._exact_fill = 0            # staging-memset skips
+        self._staged_chunks = 0         # chunks built in the shadow
+                                        # buffer behind a live dispatch
+        self._stage_overlap_ms = 0.0    # staging wall hidden that way
+        self._sview = None              # staged slot view (staged loop
+                                        # only): slot occupancy incl.
+                                        # staged-but-unprocessed chunks
+        self._last_done = None          # last chunk-completion stamp
+                                        # (auto-K per-tick estimation)
         self._close_lock = threading.Lock()
         self._loop = threading.Thread(target=self._tick_loop,
                                       name='mxtpu-cont-batch',
@@ -1164,7 +1262,7 @@ class ContinuousEngine(object):
         ex = self._ex
         return tuple(ex.aux_dict[n]._data for n in ex.aux_dict)
 
-    def _warm_chunk_programs(self, init_states):
+    def _warm_chunk_programs(self, init_states, K):
         """Build + warm the K-tick scan program and the lone-request
         rung, and gate the rung on a BIT-equality probe against the
         full-width program: a 1-row gemm may round differently from
@@ -1180,9 +1278,8 @@ class ContinuousEngine(object):
         nothing but the skipped shortcut."""
         import jax
         jnp = jax.numpy
-        K = self.tick_chunk
         ex = self._ex
-        self._chunk_step = _make_cont_chunk_step(
+        self._chunk_steps[K] = _make_cont_chunk_step(
             ex, self._data_name, self._state_names,
             self._state_out_idx, init_states, K)
         n = int(np.prod((K, self.slots) + self._data_shape))
@@ -1198,7 +1295,7 @@ class ContinuousEngine(object):
 
         reset = jnp.ones((self.slots,), np.bool_)
         with _quiet_donation():         # CPU can't alias the donated
-            fouts, fsts = self._chunk_step(     # state buffers: noise
+            fouts, fsts = self._chunk_steps[K](  # state buffers: noise
                 jnp.asarray(probe), reset, zstates(),
                 self._weights(), self._aux(), self._rng)
         for w in (1, 2):
@@ -1224,11 +1321,19 @@ class ContinuousEngine(object):
                 np.array_equal(np.asarray(a)[0], np.asarray(b)[0])
                 for a, b in zip(fsts, lsts))
             if lone_ok:
-                self._lone_step = cand
-                self._lone_width = w
+                self._lone_steps[K] = (cand, w)
                 break
         # the probe calls consumed (donated) only their own zero
         # buffers — self._states is untouched and still pristine
+
+    def _quantize_k(self, k):
+        """Largest warmed rung <= k (rung 1 always exists), so the
+        adaptive chooser only ever lands on a compiled program."""
+        best = self._rungs[0]
+        for r in self._rungs:
+            if r <= k:
+                best = r
+        return best
 
     # -- public API -----------------------------------------------------
     def infer(self, seq):
@@ -1284,6 +1389,7 @@ class ContinuousEngine(object):
         construction."""
         with self._lock:
             ticks = self._ticks
+            lone = self._lone_steps.get(self.tick_chunk)
             out = {
                 'ticks': ticks,
                 'chunks': self._chunks,
@@ -1299,8 +1405,15 @@ class ContinuousEngine(object):
                 'boundary_wait_ms': round(self._boundary_wait_ms, 3),
                 'lone_fast_path_hits': self._lone_hits,
                 'exact_fill_admits': self._exact_fill,
-                'lone_fast_path': self._lone_step is not None,
-                'lone_fast_path_width': self._lone_width,
+                'lone_fast_path': lone is not None,
+                'lone_fast_path_width': lone[1] if lone else 0,
+                'stage_ahead': self._stage_ahead,
+                'staged_chunks': self._staged_chunks,
+                'stage_overlap_ms': round(self._stage_overlap_ms, 3),
+                'auto_tick_chunk': self._auto,
+                'tick_ms_ema': round(self._tick_ms_ema, 4)
+                if self._tick_ms_ema is not None else 0.0,
+                'auto_k_decisions': self._auto_decisions,
             }
         now = exec_cache.stats()
         snap = self._warm_snapshot
@@ -1311,8 +1424,14 @@ class ContinuousEngine(object):
 
     def backlog_rows(self):
         with self._cond:
+            # the staged view supersedes _active when the staged loop
+            # runs: a request admitted into an in-flight chunk is
+            # neither queued nor (yet) in _active, but it IS backlog
+            slots_src = self._sview if self._sview is not None \
+                else self._active
             return len(self._queue) + \
-                sum(1 for s in self._active if s is not None)
+                sum(1 for s in slots_src
+                    if s is not None and not s.event.is_set())
 
     def service_estimate(self):
         return None                     # per-tick model: no batch EMA
@@ -1449,6 +1568,15 @@ class ContinuousEngine(object):
     def _tick_loop(self):
         import jax
         jnp = jax.numpy
+        if self._stage_ahead and (self._auto or self.tick_chunk > 1):
+            self._staged_loop(jnp)
+        else:
+            self._serial_loop(jnp)
+
+    def _serial_loop(self, jnp):
+        """The unbuffered stage->dispatch->drain loop: the parity
+        baseline double-buffered staging (stage_ahead=0 forces it)
+        is gated against, and the only path at fixed tick_chunk=1."""
         while True:
             admitted = []
             with self._cond:
@@ -1502,9 +1630,12 @@ class ContinuousEngine(object):
                     for k, n in enumerate(self._state_names):
                         bufs[k][i] = st[n]
                 self._states = tuple(jnp.asarray(b) for b in bufs)
-            if self.tick_chunk == 1:
+            if self.tick_chunk == 1 and not self._auto:
                 self._tick_once(active, admitted, reset, jnp)
             else:
+                # auto mode always dispatches through the chunk
+                # programs (rung 1 is a length-1 scan), so a K move
+                # never switches dispatch paths
                 self._chunk_once(active, admitted, reset, jnp)
 
     def _tick_once(self, active, admitted, reset, jnp):
@@ -1563,7 +1694,9 @@ class ContinuousEngine(object):
         the staging memset (np.empty)."""
         K = self.tick_chunk
         ns = [min(K, r.length - r.t) for _, r in active]
-        lone = len(active) == 1 and self._lone_step is not None
+        lone_ent = self._lone_steps.get(K) if len(active) == 1 \
+            else None
+        lone = lone_ent is not None
         exact = False
         lane = 0
         t0 = time.perf_counter()
@@ -1571,7 +1704,7 @@ class ContinuousEngine(object):
             if lone:
                 i, r = active[0]
                 n = ns[0]
-                W = self._lone_width
+                W = lone_ent[1]
                 start = min(i, self.slots - W)
                 lane = i - start        # request's lane in the window
                 if n == K and W == 1:
@@ -1585,7 +1718,7 @@ class ContinuousEngine(object):
                     xs[:n, lane] = r.seq[r.t:r.t + n]
                 lreset = np.zeros((W,), np.bool_)
                 lreset[lane] = reset[i]
-                outs, self._states = self._lone_step(
+                outs, self._states = lone_ent[0](
                     jnp.asarray(xs), jnp.asarray(lreset),
                     np.int32(start), np.int32(lane), self._states,
                     self._weights(), self._aux(), self._rng)
@@ -1596,7 +1729,7 @@ class ContinuousEngine(object):
                     (K, self.slots) + self._data_shape, self._dtype)
                 for (i, r), n in zip(active, ns):
                     xs[:n, i] = r.seq[r.t:r.t + n]
-                outs, self._states = self._chunk_step(
+                outs, self._states = self._chunk_steps[K](
                     jnp.asarray(xs), jnp.asarray(reset), self._states,
                     self._weights(), self._aux(), self._rng)
             np_outs = [np.asarray(o) for o in outs]
@@ -1648,6 +1781,280 @@ class ContinuousEngine(object):
             cont_lone_fast_path=int(lone),
             cont_exact_fill_admits=int(exact),
             cont_boundary_wait_ms=wait_ms)
+        if self._auto:
+            self._auto_update(wall_ms, K)
+
+    # -- double-buffered chunk staging (PERF round 21) ------------------
+    def _staged_loop(self, jnp):
+        """The pipelined tick loop: stage chunk t+1 into the shadow
+        buffer and ENQUEUE its dispatch while chunk t's results are
+        still in flight, then drain t's outputs — the boundary cost
+        drops to a buffer swap, and the host staging wall is hidden
+        behind device compute (cont_stage_overlap_ms).  Depth is
+        1 + stage_ahead dispatches in flight (default 2: classic
+        double buffering).  Chunk answers are BIT-identical to the
+        serialized loop: staging consumes only host-known state
+        (positions, queue order, the request's own input rows), and
+        the dispatched programs are the very same ones."""
+        with self._cond:
+            # rebuild the staged view from canonical slots (non-empty
+            # after an export_state undo restarted the loop)
+            self._sview = list(self._active)
+        inflight = deque()
+        depth = 1 + self._stage_ahead
+        while True:
+            with self._cond:
+                while not self._closed and not self._halt and \
+                        not self._queue and \
+                        all(s is None for s in self._sview) and \
+                        not inflight:
+                    self._cond.wait()
+                if self._halt:
+                    break
+                if self._closed and not self._queue and \
+                        all(s is None for s in self._sview) and \
+                        not inflight:
+                    break
+            while len(inflight) < depth:
+                t0 = time.perf_counter()
+                busy = bool(inflight)   # a dispatch is on the device
+                chunk = self._stage_next(jnp)
+                if chunk is None:
+                    break
+                self._dispatch_staged(chunk, jnp)
+                inflight.append(chunk)
+                if busy:
+                    dt = (time.perf_counter() - t0) * 1e3
+                    with self._lock:
+                        self._staged_chunks += 1
+                        self._stage_overlap_ms += dt
+                    profiler.add_fleet_stats(cont_staged_chunks=1,
+                                             cont_stage_overlap_ms=dt)
+                    profiler.add_overlap_stats(stage_chunks=1,
+                                               stage_overlap_ms=dt)
+            if inflight:
+                self._process_staged(inflight.popleft(), jnp)
+        # halt (export_state): DRAIN the pipeline atomically — every
+        # dispatched chunk completes and folds into positions/partial
+        # outputs/states before the loop exits, so the export sees one
+        # consistent chunk boundary.  Nothing is ever staged without
+        # being dispatched in the same step, so there is no discarded
+        # shadow state to unwind.
+        while inflight:
+            self._process_staged(inflight.popleft(), jnp)
+
+    def _stage_next(self, jnp):
+        """Admission + host staging for the NEXT chunk against the
+        staged slot view.  Retires are deterministic — a slot frees
+        when its request's STAGED position reaches the sequence
+        length, no device output needed — so this runs correctly
+        while earlier chunks are still executing.  Returns the filled
+        shadow buffer, or None when no slot would be active."""
+        with self._cond:
+            if self._halt:
+                return None
+            view = self._sview
+            for i in range(self.slots):
+                r = view[i]
+                if r is not None and r.staged_t >= r.length:
+                    view[i] = None      # frees at the staged boundary
+            can_admit = any(s is None for s in view) \
+                if not self.convoy else all(s is None for s in view)
+            admits = []
+            if can_admit:
+                for i in range(self.slots):
+                    if view[i] is None and self._queue:
+                        req = self._queue.popleft()
+                        req.staged_t = req.t
+                        if req.ys is None:
+                            req.ys = [[] for _ in self._y_idx]
+                        view[i] = req
+                        admits.append((i, req))
+            active = [(i, r) for i, r in enumerate(view)
+                      if r is not None]
+            waiting = len(self._queue)
+        if not active:
+            return None
+        K = self.tick_chunk
+        reset = np.zeros((self.slots,), np.bool_)
+        mig = []
+        for i, req in admits:
+            if req.mig_state is not None:
+                mig.append((i, req.mig_state))
+                req.mig_state = None
+            else:
+                reset[i] = True
+        ns = [min(K, r.length - r.staged_t) for _, r in active]
+        ch = _StagedChunk(K)
+        ch.mig = mig
+        ch.admits = admits
+        ch.waiting = waiting
+        lone_ent = self._lone_steps.get(K) if len(active) == 1 \
+            else None
+        if lone_ent is not None:
+            i, r = active[0]
+            n = ns[0]
+            W = lone_ent[1]
+            start = min(i, self.slots - W)
+            lane = i - start
+            if n == K and W == 1:
+                xs = r.seq[r.staged_t:r.staged_t + K].reshape(
+                    (K, 1) + self._data_shape)
+            else:
+                xs = np.zeros((K, W) + self._data_shape, self._dtype)
+                xs[:n, lane] = r.seq[r.staged_t:r.staged_t + n]
+            lreset = np.zeros((W,), np.bool_)
+            lreset[lane] = reset[i]
+            ch.lone, ch.lane, ch.start = True, lane, start
+            ch.xs, ch.reset = xs, lreset
+        else:
+            exact = len(active) == self.slots and \
+                all(n == K for n in ns)
+            xs = (np.empty if exact else np.zeros)(
+                (K, self.slots) + self._data_shape, self._dtype)
+            for (i, r), n in zip(active, ns):
+                xs[:n, i] = r.seq[r.staged_t:r.staged_t + n]
+            ch.exact = exact
+            ch.xs, ch.reset = xs, reset
+        ch.rows = [(i, r, n) for (i, r), n in zip(active, ns)]
+        for _i, r, n in ch.rows:
+            r.staged_t += n
+        return ch
+
+    def _dispatch_staged(self, ch, jnp):
+        """Enqueue the staged chunk's dispatch.  The states argument
+        is the PREVIOUS chunk's output futures — XLA executes in
+        submission order, so this lands on the device queue right
+        behind it with no host sync.  A dispatch-call exception is
+        parked on the chunk and surfaced at process time."""
+        try:
+            if ch.mig:
+                # hot-swap re-admission rows must be host-written into
+                # the canonical buffers: materializing blocks on any
+                # in-flight chunk first — rare, swap-time only
+                bufs = [np.array(s) for s in self._states]
+                for i, st in ch.mig:
+                    for k, n in enumerate(self._state_names):
+                        bufs[k][i] = st[n]
+                self._states = tuple(jnp.asarray(b) for b in bufs)
+            ch.t_disp = time.perf_counter()
+            if ch.lone:
+                ent = self._lone_steps[ch.K]
+                ch.outs, self._states = ent[0](
+                    jnp.asarray(ch.xs), jnp.asarray(ch.reset),
+                    np.int32(ch.start), np.int32(ch.lane),
+                    self._states, self._weights(), self._aux(),
+                    self._rng)
+            else:
+                ch.outs, self._states = self._chunk_steps[ch.K](
+                    jnp.asarray(ch.xs), jnp.asarray(ch.reset),
+                    self._states, self._weights(), self._aux(),
+                    self._rng)
+        except Exception as e:
+            ch.error = e
+
+    def _process_staged(self, ch, jnp):
+        """Drain one dispatched chunk: block on its outputs, slice
+        per-request rows, advance CANONICAL positions, retire, and
+        fold the counters — the same bookkeeping as the serialized
+        loop, shifted one pipeline stage later."""
+        try:
+            if ch.error is not None:
+                raise ch.error
+            np_outs = [np.asarray(o) for o in ch.outs]
+        except Exception as e:          # surface to every co-resident
+            with self._cond:
+                for i, r, _n in ch.rows:
+                    r.error = e
+                    r.event.set()
+                    self._active[i] = None
+                    if self._sview[i] is r:
+                        self._sview[i] = None
+            # a failed async chunk poisons its donated-state outputs:
+            # rebuild zero state so the next admission (in-graph
+            # reset) starts clean
+            self._states = tuple(
+                jnp.zeros(self._ex.arg_dict[s].shape,
+                          np.dtype(self._ex.arg_dict[s].dtype))
+                for s in self._state_names)
+            return
+        K = ch.K
+        now = time.perf_counter()
+        wall_ms = (now - ch.t_disp) * 1e3
+        retired = 0
+        wasted = 0
+        for i, r, n in ch.rows:
+            col = ch.lane if ch.lone else i
+            for k, o in enumerate(np_outs):
+                for t in range(n):
+                    r.ys[k].append(np.array(o[t, col]))
+            r.t += n
+            if r.t >= r.length:
+                r.outputs = [np.stack(rows) for rows in r.ys]
+                r.event.set()
+                retired += 1
+                wasted += K - n
+                with self._cond:
+                    self._active[i] = None
+                    if self._sview[i] is r:
+                        self._sview[i] = None
+            else:
+                with self._cond:
+                    self._active[i] = r
+        wait_ms = 0.0
+        if wasted and ch.waiting:
+            # priced against the STAGING-time queue depth: the
+            # pipeline may have admitted the waiter into the next
+            # staged chunk already, but it still waited behind these
+            # masked slot-ticks
+            wait_ms = wasted * wall_ms / K
+        ns_sum = sum(n for _i, _r, n in ch.rows)
+        with self._lock:
+            self._ticks += K
+            self._chunks += 1
+            self._active_row_ticks += ns_sum
+            self._admitted += len(ch.admits)
+            self._retired += retired
+            self._boundary_wait_ms += wait_ms
+            self._lone_hits += int(ch.lone)
+            self._exact_fill += int(ch.exact)
+        profiler.add_fleet_stats(
+            cont_ticks=K, cont_active_row_ticks=ns_sum,
+            cont_slot_ticks=K * self.slots,
+            cont_admitted=len(ch.admits), cont_retired=retired,
+            cont_chunks_dispatched=1, cont_chunk_ticks=K,
+            cont_lone_fast_path=int(ch.lone),
+            cont_exact_fill_admits=int(ch.exact),
+            cont_boundary_wait_ms=wait_ms)
+        if self._auto:
+            # a pipelined chunk's dispatch->done wall includes the
+            # previous chunk's remaining device time; the completion-
+            # to-completion delta is the honest per-chunk estimate
+            # when the pipeline is busy, and the raw wall when idle —
+            # take the smaller
+            last = self._last_done
+            est = wall_ms if last is None else \
+                min(wall_ms, (now - last) * 1e3)
+            self._auto_update(est, K)
+        self._last_done = now
+
+    def _auto_update(self, wall_ms, K):
+        """Fold one chunk's measured wall into the per-tick EMA and
+        re-derive K against the SLO deadline (tick_chunk='auto'),
+        quantized DOWN to the warmed rung ladder so steady state
+        performs zero compiles.  Runs on the tick-loop thread only."""
+        tick_ms = wall_ms / K
+        ema = self._tick_ms_ema
+        self._tick_ms_ema = tick_ms if ema is None else \
+            _TICK_EMA_ALPHA * tick_ms + (1 - _TICK_EMA_ALPHA) * ema
+        new_k = self._quantize_k(chunk_for_deadline(
+            self._deadline_ms, self._tick_ms_ema, self.slots))
+        if new_k != self.tick_chunk:
+            self.tick_chunk = new_k
+            with self._lock:
+                self._auto_decisions += 1
+            profiler.add_overlap_stats(auto_k=new_k,
+                                       auto_k_decisions=1)
 
     # -- lifecycle ------------------------------------------------------
     def close(self, timeout=30):

@@ -389,6 +389,55 @@ def test_fit_preempt_resume_bit_parity(tmp_path):
     mgr2.close()
 
 
+def test_checkpoint_will_act_predicts_cadence(tmp_path):
+    # will_act(k) is the overlapped-fit drain predicate: it must say
+    # True exactly when the NEXT step_end would take a cadence
+    # checkpoint or commit a pending preemption — never on the
+    # common no-op steps that keep the async pipeline unbroken
+    mgr = elastic.CheckpointManager(str(tmp_path), every_n_steps=4,
+                                    async_=False)
+    assert not mgr.will_act(1)          # step 0 -> 1: not due
+    mgr._step = 3
+    assert mgr.will_act(1)              # 3 -> 4: cadence fires
+    mgr._step = 0
+    mgr.request_preempt()
+    assert mgr.will_act(1)              # pending preempt always acts
+    mgr.close()
+
+
+def test_fit_deferred_metric_pipeline_parity(tmp_path, monkeypatch):
+    # overlapped fit (MXNET_TPU_TRAIN_STEP_AHEAD): metric folds and
+    # batch_end_callbacks defer up to `ahead` batches behind the
+    # dispatches.  Depth changes only WHEN the host folds, never what
+    # is folded — the per-batch metric log and final params must
+    # match the serialized run exactly, including across a blocking
+    # checkpoint cadence where will_act() drains the pipeline to a
+    # consistent step boundary first
+    log_a, log_b = {}, {}
+    monkeypatch.setenv('MXNET_TPU_TRAIN_STEP_AHEAD', '0')
+    a = mx.mod.Module(_mlp_symbol())
+    mgr_a = elastic.CheckpointManager(str(tmp_path / 'a'),
+                                      every_n_steps=4, async_=False)
+    _fit(a, ckpt=mgr_a, log=log_a)
+    mgr_a.close()
+    monkeypatch.setenv('MXNET_TPU_TRAIN_STEP_AHEAD', '2')
+    profiler.clear()
+    b = mx.mod.Module(_mlp_symbol())
+    mgr_b = elastic.CheckpointManager(str(tmp_path / 'b'),
+                                      every_n_steps=4, async_=False)
+    _fit(b, ckpt=mgr_b, log=log_b)
+    mgr_b.close()
+    assert log_a == log_b
+    _assert_params_equal(a, b)
+    ov = profiler.overlap_stats()
+    assert ov['overlap_train_steps'] >= 1
+    assert ov['overlap_deferred_metric_folds'] >= 1
+    # both cadences actually checkpointed through the drain
+    assert elastic.list_checkpoints(str(tmp_path / 'a'))
+    assert elastic.list_checkpoints(str(tmp_path / 'b'))
+    profiler.clear()
+
+
 def test_preempt_during_validation_not_swallowed(tmp_path):
     """A signal landing AFTER the epoch's last step (during
     validation) must still commit a final checkpoint and raise — not

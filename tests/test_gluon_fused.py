@@ -15,6 +15,7 @@ not bitwise.  The fused path itself is bitwise deterministic
 """
 import os
 import tempfile
+from collections import deque
 
 import numpy as np
 import pytest
@@ -356,6 +357,46 @@ def test_fused_counters_and_summary():
         events = json.load(f)['traceEvents']
     meta = [e for e in events if e.get('name') == 'gluon_fused']
     assert meta and meta[0]['args']['gluon_fused_steps'] == 4
+
+
+def test_step_ahead_loss_bit_parity_and_counters(monkeypatch):
+    # bounded in-flight depth (overlapped train-step I/O) changes
+    # only WHEN the host waits on a dispatch, never what's computed:
+    # loss curves at step_ahead=1 must be bitwise identical to the
+    # serialized step_ahead=0 run, with the pipeline visible in the
+    # overlap_* counters
+    from mxnet_tpu.gluon.fused import resolve_step_ahead
+    monkeypatch.delenv('MXNET_TPU_TRAIN_STEP_AHEAD', raising=False)
+    assert resolve_step_ahead() == 1            # default: 1 ahead
+    assert resolve_step_ahead(3) == 3           # explicit arg wins
+    for off in ('0', 'off', 'none', 'false'):
+        monkeypatch.setenv('MXNET_TPU_TRAIN_STEP_AHEAD', off)
+        assert resolve_step_ahead() == 0
+    monkeypatch.setenv('MXNET_TPU_TRAIN_STEP_AHEAD', '2')
+    assert resolve_step_ahead() == 2
+    monkeypatch.delenv('MXNET_TPU_TRAIN_STEP_AHEAD')
+
+    batches = _batches(k=4)
+    curves, params = {}, {}
+    for ahead in (0, 1):
+        profiler.clear()
+        net = _make_net(3)
+        fs = gluon.fuse_step(
+            net, _LOSS,
+            gluon.Trainer(net.collect_params(), 'sgd', dict(OPT_MOM)),
+            step_ahead=ahead)
+        curves[ahead] = [fs(x, y).asnumpy().copy() for x, y in batches]
+        params[ahead] = _pvals(net)
+        ov = profiler.overlap_stats()
+        assert ov['overlap_train_steps'] == len(batches)
+        assert ov['overlap_steps_ahead'] == ahead   # gauge at depth
+        if ahead == 0:
+            assert fs._inflight == deque()          # fully drained
+    for a, b in zip(curves[0], curves[1]):
+        assert np.array_equal(a, b)
+    for a, b in zip(params[0], params[1]):
+        assert np.array_equal(a, b)
+    profiler.clear()
 
 
 def test_step_fused_entry_and_unsupported_optimizer():

@@ -17,6 +17,13 @@ exact-fill fast-path counters, the shared knob parser
 (MXNET_TPU_SERVE_TICK_CHUNK, K > slots typed reject), the SLO-derived
 default K, registry tick_chunk= forwarding, and the cont_chunk*
 profiler flow.
+
+Host-hiding (ISSUE 18): double-buffered chunk staging bit-parity vs
+the serialized loop at identical K (sequential + concurrent clients),
+the MXNET_TPU_SERVE_STAGE_AHEAD knob, tick_chunk='auto' (typed reject
+without an SLO deadline, EMA convergence onto a warmed rung at zero
+compiles, zero-miss engine re-creation across an initial-K change,
+registry 'auto' passthrough), and the overlap_* profiler family.
 """
 import json
 import threading
@@ -863,6 +870,147 @@ def test_chunk_profiler_counters_flow():
     assert profiler.fleet_stats()['cont_boundary_wait_ms'] == 0.0
     profiler.add_fleet_stats(cont_boundary_wait_ms=0.5)
     assert profiler.fleet_stats()['cont_boundary_wait_ms'] == 0.5
+    profiler.clear()
+
+
+# ---------------------------------------------------------------------------
+# double-buffered chunk staging (stage_ahead) + tick_chunk='auto'
+# ---------------------------------------------------------------------------
+
+def test_staged_chunks_bit_parity_vs_serialized():
+    # stage_ahead=1 pipelines chunk t+1's staging+dispatch behind
+    # chunk t's in-flight execution; stage_ahead=0 is the PR-17
+    # serialized stage->dispatch->drain loop.  Identical K: answers
+    # must stay bitwise equal — sequential, AND under concurrent
+    # clients racing admission into staged chunks
+    seqs = _seqs([3, 9, 2, 6, 4], seed=4)
+    with _cont(slots=4, tick_chunk=4, stage_ahead=0) as eng:
+        ref = eng.infer_many(seqs)
+        st0 = eng.stats()
+    with _cont(slots=4, tick_chunk=4, stage_ahead=1) as eng:
+        got = eng.infer_many(seqs)
+        res = [None] * len(seqs)
+        ts = [threading.Thread(target=lambda i=i:
+                               res.__setitem__(i, eng.infer(seqs[i])))
+              for i in range(len(seqs))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        st1 = eng.stats()
+    assert st0['stage_ahead'] == 0 and st0['staged_chunks'] == 0
+    assert st1['stage_ahead'] == 1 and st1['staged_chunks'] >= 1
+    assert st1['stage_overlap_ms'] >= 0.0
+    assert st1['compiles_after_warmup'] == 0
+    for a, b in zip(ref, got):
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+    for i in range(len(seqs)):
+        for a, b in zip(res[i], ref[i]):
+            assert np.array_equal(a, b)
+
+
+def test_stage_ahead_env_knob(monkeypatch):
+    # MXNET_TPU_SERVE_STAGE_AHEAD: 'off' forces the serialized loop,
+    # an integer sets the shadow-buffer depth; answers identical
+    seqs = _seqs([6, 6], seed=5)
+    monkeypatch.setenv('MXNET_TPU_SERVE_STAGE_AHEAD', 'off')
+    with _cont(slots=4, tick_chunk=4) as eng:
+        a = eng.infer_many(seqs)
+        st = eng.stats()
+        assert st['stage_ahead'] == 0 and st['staged_chunks'] == 0
+    monkeypatch.setenv('MXNET_TPU_SERVE_STAGE_AHEAD', '2')
+    with _cont(slots=4, tick_chunk=4) as eng:
+        b = eng.infer_many(seqs)
+        st = eng.stats()
+        assert st['stage_ahead'] == 2 and st['staged_chunks'] >= 1
+    for x, y in zip(a, b):
+        for u, v in zip(x, y):
+            assert np.array_equal(u, v)
+
+
+def test_tick_chunk_auto_requires_deadline():
+    # 'auto' without an SLO deadline has nothing to derive K against:
+    # typed reject at parse time, at construction, and for a
+    # deadline-less (priority-only) SLO
+    with pytest.raises(MXNetError, match="'auto' needs an SLO"):
+        resolve_tick_chunk('auto', slots=4)
+    with pytest.raises(MXNetError, match="'auto' needs an SLO"):
+        _cont(slots=4, tick_chunk='auto')
+    with pytest.raises(MXNetError, match="'auto' needs an SLO"):
+        _cont(slots=4, tick_chunk='auto', slo=SLO(priority=1))
+
+
+def test_tick_chunk_auto_converges_to_rung_zero_compiles():
+    # hintless auto starts at K=1; the first chunk's tick-time EMA
+    # against a generous deadline re-derives K onto the top warmed
+    # rung (chunk_for_deadline caps at slots) and stays — every rung
+    # is warmed at construction, so the climb never compiles.  The
+    # mixed K=1-then-K=4 run stays bit-identical to fixed K
+    seqs = _seqs([8, 8, 8, 8], seed=6)
+    with _cont(slots=4, tick_chunk=4) as eng:
+        ref = eng.infer_many(seqs)
+    with _cont(slots=4, tick_chunk='auto',
+               slo=SLO(deadline_ms=200.0)) as eng:
+        got = eng.infer_many(seqs)
+        st = eng.stats()
+    assert st['auto_tick_chunk'] is True
+    assert st['tick_chunk'] == 4, \
+        'EMA did not climb onto the slot rung: %r' % (st,)
+    assert st['auto_k_decisions'] >= 1
+    assert st['tick_ms_ema'] > 0.0
+    assert st['compiles_after_warmup'] == 0
+    for a, b in zip(ref, got):
+        for u, v in zip(a, b):
+            assert np.array_equal(u, v)
+
+
+def test_auto_recreated_engine_zero_compiles_across_k_change():
+    # the warmed rung ladder is exec_cache-backed: a re-created auto
+    # engine — even one whose tick_ms_hint starts it on a DIFFERENT
+    # initial K than the hintless climb — warms at zero cache misses
+    kw = dict(slots=4, tick_chunk='auto', slo=SLO(deadline_ms=200.0))
+    with _cont(**kw) as eng:
+        eng.infer(_seqs([8])[0])
+    before = exec_cache.stats()['misses']
+    with _cont(tick_ms_hint=0.5, **kw) as eng:   # starts at K=4
+        eng.infer(_seqs([8])[0])
+        assert eng.stats()['compiles_after_warmup'] == 0
+    assert exec_cache.stats()['misses'] == before
+
+
+def test_registry_forwards_auto_tick_chunk():
+    # registry passes the literal 'auto' through unresolved — only
+    # the engine holds the SLO deadline the chooser derives against
+    seen = {}
+
+    def cont_loader(tick_chunk=None):
+        seen['tick_chunk'] = tick_chunk
+        return _cont(slots=4, tick_chunk=tick_chunk,
+                     slo=SLO(deadline_ms=200.0))
+
+    with ModelRegistry() as reg:
+        reg.register('seq', loader=cont_loader, tick_chunk='auto')
+        eng = reg.engine('seq')
+        assert seen['tick_chunk'] == 'auto'
+        assert eng.stats()['auto_tick_chunk'] is True
+
+
+def test_overlap_profiler_counters_flow():
+    # the overlap_* family: staged chunks + auto-K decisions land in
+    # overlap_stats(), summary() and the dump_profile 'overlap' lane
+    profiler.clear()
+    with _cont(slots=4, tick_chunk='auto', stage_ahead=1,
+               slo=SLO(deadline_ms=200.0)) as eng:
+        eng.infer_many(_seqs([8, 8, 8, 8], seed=7))
+    ov = profiler.overlap_stats()
+    assert ov['overlap_stage_chunks'] >= 1
+    assert ov['overlap_auto_k_decisions'] >= 1
+    assert ov['overlap_auto_k'] == 4            # gauge: last choice
+    assert isinstance(ov['overlap_stage_overlap_ms'], float)
+    text = profiler.summary(print_out=False)
+    assert 'overlap_stage_chunks' in text
+    assert 'overlap_auto_k' in text
     profiler.clear()
 
 

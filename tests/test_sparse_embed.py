@@ -372,6 +372,55 @@ def test_hot_row_cache_parity_counters_eviction():
         eng.close()
 
 
+def test_hot_row_prefetch_hits_and_budget(monkeypatch):
+    # queued-request speculation: the dispatcher pages still-waiting
+    # requests' rows in behind the in-flight dispatch, so by the time
+    # they coalesce the demand path hits.  Budget discipline: never
+    # evict beyond the LRU half of the cache for a guess
+    from mxnet_tpu import profiler
+    from mxnet_tpu.serving import InferenceEngine
+    vocab, dim, cap = 200, 8, 48
+    rng = np.random.RandomState(6)
+    b1 = rng.randint(0, 100, size=(8, 4)).astype(np.float32)
+    b2 = rng.randint(100, 120, size=(8, 4)).astype(np.float32)
+    ref = InferenceEngine(_pred_module(vocab, dim), max_batch=8,
+                          quantize=False)
+    want = ref.predict(b2)
+    ref.close()
+    profiler.clear()
+    eng = InferenceEngine(_pred_module(vocab, dim), max_batch=8,
+                          quantize=False, hot_rows=cap)
+    try:
+        assert eng._hotrow_peek == 8         # default peek depth
+        eng.predict(b1)                      # demand-warm the cache
+        st0 = eng.stats()['hot_rows']['emb_weight']
+        # what the dispatcher does with the still-queued heads' input
+        # tuples while the b1 dispatch is in flight
+        eng._hotrow_prefetch([(b2,)])
+        st1 = eng.stats()['hot_rows']['emb_weight']
+        assert st1['prefetch_rows'] > st0['prefetch_rows']
+        got = eng.predict(b2)                # demand is now all hits
+        st2 = eng.stats()['hot_rows']['emb_weight']
+        assert st2['prefetch_hits'] > 0
+        assert st2['misses'] == st1['misses']   # zero demand misses
+        assert st2['resident'] <= cap
+        np.testing.assert_allclose(want, got, atol=1e-5)
+        es = profiler.embed_stats()
+        assert es['hotrow_prefetched'] >= st1['prefetch_rows']
+        assert es['hotrow_prefetch_hits'] >= st2['prefetch_hits']
+    finally:
+        eng.close()
+        profiler.clear()
+    # the peek knob: 'off' disables speculation entirely
+    monkeypatch.setenv('MXNET_TPU_SERVE_HOTROW_PREFETCH', 'off')
+    eng = InferenceEngine(_pred_module(vocab, dim), max_batch=8,
+                          quantize=False, hot_rows=cap)
+    try:
+        assert eng._hotrow_peek == 0
+    finally:
+        eng.close()
+
+
 def test_hot_row_refusals():
     from mxnet_tpu.serving import InferenceEngine
     with pytest.raises(MXNetError, match='capacity|worst'):

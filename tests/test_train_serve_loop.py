@@ -515,6 +515,32 @@ def test_swap_chunked_halts_at_chunk_boundary_bit_identical():
                 'sequence %d diverged across the chunked swap' % i
 
 
+def test_swap_mid_stage_drains_shadow_chunks_bit_identical():
+    profiler.clear()
+    # double-buffered staging (stage_ahead=2 here: up to two shadow
+    # chunks queued behind the in-flight dispatch) with an
+    # export_state landing mid-stage: the halt must DRAIN every
+    # in-flight staged chunk to a consistent boundary — never discard
+    # a shadow buffer whose admissions/slot-resets are already
+    # recorded.  Evidence: every exported position is a chunk
+    # boundary, zero lost sequences, and the migrated run stays
+    # bit-identical to a never-swapped reference
+    seqs = _seqs([400, 250, 30], seed=13)
+    with _cont(slots=2) as ref:
+        solo = ref.infer_many(seqs)
+    res, exported, migrated = _swap_run(
+        seqs, a_kw=dict(slots=4, tick_chunk=4, stage_ahead=2),
+        b_kw=dict(slots=4, tick_chunk=4, stage_ahead=1), min_ticks=8)
+    assert migrated >= 1
+    assert all(t % 4 == 0 for t in exported['t_at_export'])
+    # the drill actually exercised the pipelined loop on both sides
+    assert profiler.fleet_stats()['cont_staged_chunks'] >= 1
+    for i in range(len(seqs)):
+        for a, b in zip(res[i], solo[i]):
+            assert np.array_equal(a, b), \
+                'sequence %d diverged across the mid-stage swap' % i
+
+
 def test_swap_chunked_to_unchunked_engine_bit_identical():
     # the migration payload is tick-config agnostic: a chunked
     # engine's export admits into an UNCHUNKED replacement and the

@@ -36,8 +36,11 @@ def main():
                         'smoke (one bench.py child) instead of the '
                         'model-family sweep')
     p.add_argument('--overlap', action='store_true',
-                   help='run the BENCH_OVERLAP gradient-reduction '
-                        'schedule A/B (one bench.py child; spawns '
+                   help='run the BENCH_OVERLAP host-hiding A/B suite '
+                        '(gradient-reduction schedule A/B plus the '
+                        'overlapped train-step arm: step_ahead=1 vs '
+                        'serialized dispatch with a bitwise loss-curve '
+                        'parity gate; one bench.py child that spawns '
                         'its own virtual CPU mesh when needed) '
                         'instead of the model-family sweep')
     p.add_argument('--bucket', action='store_true',
@@ -71,6 +74,8 @@ def main():
                         'HTTP front, continuous vs convoy sequence '
                         'batching, the tick_chunk K=1/4/16 ladder '
                         'with bitwise-parity + zero-compile gates, '
+                        'the double-buffered staging A/B at identical '
+                        'K and the tick_chunk=auto steady-state arm, '
                         'registry evict/re-warm zero-compile '
                         'check; one bench.py child) instead of the '
                         'model-family sweep')
