@@ -65,6 +65,11 @@ BENCH_EMBED=1 (sparse embedding A/B: dense vs touched-rows-only
 BENCH_CKPT=1 (elastic-checkpoint overhead A/B: no-checkpoint vs
 async cadence vs blocking cadence, ckpt_* counters + bit-parity
 gate — see ckpt_bench() for the BENCH_CKPT_* knobs),
+BENCH_DELTA=1 (incremental delta-checkpoint + weight-delta push A/B:
+    full-every-commit vs incremental chain commit bytes on an
+    embedding workload, chain-replay resume parity, sparse delta
+    applied to a live engine bitwise vs full reload, dense int8 delta
+    parity-gated — see delta_bench() for the BENCH_DELTA_* knobs),
 BENCH_WARM=0 (skip the warm-start child process),
 MXNET_TPU_PERSISTENT_CACHE_DIR (defaulted by the bench to a tempdir
 cache so warm starts are exercised; set empty to disable),
@@ -824,6 +829,230 @@ def ckpt_bench():
         'parity_ok': bool(max_diff == 0.0),
     }))
     for d in ckdirs.values():
+        shutil.rmtree(d, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# BENCH_DELTA=1: incremental delta checkpoints + weight-delta push channel
+# ---------------------------------------------------------------------------
+
+def delta_bench():
+    """BENCH_DELTA=1: measure the weight-delta channel (mxnet_tpu/
+    delta.py, PERF round 22) on the workload it exists for — an
+    embedding-dominated model where each step touches a few hundred
+    table rows out of tens of thousands.  Two arms, ONE JSON line:
+
+    * ckpt arm: twin modules train on the SAME batches, one under a
+      full-every-commit CheckpointManager, one under
+      CheckpointManager(incremental=K) (K touched-rows deltas between
+      full bases).  Headline = full-arm commit bytes / incremental-arm
+      commit bytes (acceptance wants >= 5x).  A resume gate then
+      replays the newest delta CHAIN (load_newest_intact: base + K
+      deltas) and requires the restored params bitwise-equal to the
+      live module.
+    * push/engine arm: the newest DELTA commit exports through
+      export_serving_checkpoint (chain replay inside the export path),
+      boots an InferenceEngine, then (1) a sparse touched-rows delta
+      applies at zero re-warm compiles with outputs bitwise-identical
+      to a full reload of the new state, and (2) a dense int8 delta
+      built from RANDOM perturbations: a tight parity_tol draws a
+      typed DeltaParityError with NOTHING mutated (outputs bit-equal
+      before/after the refusal), the default tol applies and reports
+      the measured rel_err.
+
+    Plain SGD (momentum=0, wd=0) keeps untouched rows bit-identical
+    between steps — the property the touched-rows encoder keys on;
+    momentum or weight decay would smear every row every step and the
+    honest answer there is the full base (the encoder falls back on
+    its own via the sparse_frac cutoff).  Both managers run
+    async_=False so the two arms commit at every step
+    deterministically (no in-flight skips).  Knobs: BENCH_DELTA_VOCAB
+    (20000), BENCH_DELTA_DIM (64), BENCH_DELTA_BATCH (256),
+    BENCH_DELTA_HOT (512 — ids draw from a hot pool this big),
+    BENCH_DELTA_STEPS (14, one commit per step), BENCH_DELTA_INCR
+    (6 -> chain full,d1..d6,full,d1..)."""
+    import shutil
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import delta as delta_mod
+    from mxnet_tpu import elastic, profiler
+    from mxnet_tpu import sym as S
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.serving import InferenceEngine, \
+        export_serving_checkpoint
+
+    vocab = int(os.environ.get('BENCH_DELTA_VOCAB', 20000))
+    dim = int(os.environ.get('BENCH_DELTA_DIM', 64))
+    batch = int(os.environ.get('BENCH_DELTA_BATCH', 256))
+    hot = int(os.environ.get('BENCH_DELTA_HOT', 512))
+    steps = int(os.environ.get('BENCH_DELTA_STEPS', 14))
+    incr = int(os.environ.get('BENCH_DELTA_INCR', 6))
+    classes = 10
+
+    def head_sym():
+        ids = S.Variable('data')
+        emb = S.Embedding(ids, input_dim=vocab, output_dim=dim,
+                          name='emb')
+        return S.FullyConnected(emb, name='out', num_hidden=classes)
+
+    def make_module(seed):
+        net = S.SoftmaxOutput(head_sym(), name='softmax')
+        mod = mx.mod.Module(net)
+        mod.bind(data_shapes=[mx.io.DataDesc('data', (batch,))],
+                 label_shapes=[mx.io.DataDesc('softmax_label',
+                                              (batch,))])
+        mx.random.seed(seed)
+        mod.init_params(initializer=mx.init.Xavier())
+        mod.init_optimizer(optimizer='sgd',
+                           optimizer_params={'learning_rate': 0.1,
+                                             'momentum': 0.0,
+                                             'wd': 0.0})
+        return mod
+
+    rs = np.random.RandomState(0)
+    pool = rs.choice(vocab, size=hot, replace=False)
+    batches = [mx.io.DataBatch(
+        data=[mx.nd.array(pool[rs.randint(0, hot, size=batch)]
+                          .astype(np.float32))],
+        label=[mx.nd.array((rs.rand(batch) * classes)
+                           .astype(np.float32))])
+        for _ in range(steps)]
+
+    def run_arm(mod, mgr):
+        before = profiler.ckpt_stats()['ckpt_bytes']
+        tic = time.time()
+        for s, b in enumerate(batches):
+            mod.forward_backward(b)
+            mod.update()
+            mgr.step_end(epoch=0, batches_in_epoch=s + 1,
+                         batch_size=batch)
+        mod.get_params()        # host-fetch barrier
+        dt = time.time() - tic
+        return profiler.ckpt_stats()['ckpt_bytes'] - before, dt
+
+    profiler.clear()
+    mod_full = make_module(1)
+    mod_incr = make_module(1)
+    dirs = {'full': tempfile.mkdtemp(prefix='bench_delta_f_'),
+            'incr': tempfile.mkdtemp(prefix='bench_delta_i_'),
+            'push': tempfile.mkdtemp(prefix='bench_delta_p_')}
+    mgr_full = elastic.CheckpointManager(dirs['full'],
+                                         every_n_steps=1,
+                                         async_=False)
+    mgr_full.attach(mod_full)
+    mgr_incr = elastic.CheckpointManager(dirs['incr'],
+                                         every_n_steps=1,
+                                         async_=False,
+                                         incremental=incr)
+    mgr_incr.attach(mod_incr)
+
+    bytes_full, dt_full = run_arm(mod_full, mgr_full)
+    d0 = profiler.delta_stats()
+    bytes_incr, dt_incr = run_arm(mod_incr, mgr_incr)
+    d1 = profiler.delta_stats()
+    ratio = bytes_full / max(1.0, float(bytes_incr))
+
+    # resume gate: the newest commit must be a DELTA (the chain tail),
+    # and replaying base + chain must land bitwise on the live params
+    res = elastic.load_newest_intact(dirs['incr'])
+    assert res is not None, 'incremental arm left no intact checkpoint'
+    _man, arrays, tail_dir = res
+    from_delta = os.path.basename(tail_dir).startswith('delta-')
+    pa, _ = mod_incr.get_params()
+    resume_ok = all(np.array_equal(arrays['param:%s' % n],
+                                   pa[n].asnumpy()) for n in pa)
+
+    # --- push/engine arm: export FROM the delta commit, then apply
+    # live deltas to the resident engine ---
+    prefix = os.path.join(dirs['push'], 'serve')
+    export_serving_checkpoint(tail_dir, head_sym(), prefix)
+    full_params_bytes = os.path.getsize(prefix + '-0000.params')
+    eng = InferenceEngine(
+        Predictor.from_checkpoint(prefix, 0, {'data': (4,)}),
+        max_batch=4, max_wait_us=0)
+    x = pool[:4].astype(np.float32)
+
+    def ref_out(state):
+        args = {k[4:]: mx.nd.array(v) for k, v in state.items()
+                if k.startswith('arg:')}
+        auxs = {k[4:]: mx.nd.array(v) for k, v in state.items()
+                if k.startswith('aux:')}
+        ref = Predictor(symbol=head_sym(), arg_params=args,
+                        aux_params=auxs, input_shapes={'data': (4,)})
+        return ref.forward(data=mx.nd.array(x))[0].asnumpy()
+
+    # (1) sparse touched-rows delta -> bitwise parity vs full reload
+    rs2 = np.random.RandomState(1)
+    state = eng._resident_host_state()
+    new_state = dict(state)
+    tbl = state['arg:emb_weight'].copy()
+    rows = rs2.choice(vocab, size=64, replace=False)
+    tbl[rows] += (rs2.randn(64, dim) * 0.05).astype(tbl.dtype)
+    new_state['arg:emb_weight'] = tbl
+    ent, meta, _ = delta_mod.make_delta(
+        state, new_state, seq=1,
+        base_fp=delta_mod.fingerprint(state),
+        config=delta_mod.DeltaConfig(dense='raw'))
+    eng.apply_delta(dict(ent), meta,
+                    expect_fp=delta_mod.fingerprint(state))
+    sparse_ok = np.array_equal(np.asarray(eng.predict(x)),
+                               ref_out(new_state))
+
+    # (2) dense int8 delta: tight tol -> typed refusal, nothing
+    # mutated; default tol -> applies, rel_err measured
+    base2 = eng._resident_host_state()
+    new2 = dict(base2)
+    w = base2['arg:out_weight'].copy()
+    w += (rs2.randn(*w.shape) * 0.05).astype(w.dtype)
+    new2['arg:out_weight'] = w
+    ent2, meta2, _ = delta_mod.make_delta(
+        base2, new2, seq=1,
+        base_fp=delta_mod.fingerprint(base2),
+        config=delta_mod.DeltaConfig(dense='int8', min_dense=1))
+    before = np.asarray(eng.predict(x)).copy()
+    refused = False
+    try:
+        eng.apply_delta(dict(ent2), meta2,
+                        expect_fp=delta_mod.fingerprint(base2),
+                        parity_tol=1e-12)
+    except delta_mod.DeltaParityError:
+        refused = True
+    untouched = np.array_equal(np.asarray(eng.predict(x)), before)
+    eng.apply_delta(dict(ent2), meta2,
+                    expect_fp=delta_mod.fingerprint(base2))
+    int8_moved = not np.array_equal(np.asarray(eng.predict(x)), before)
+
+    mgr_full.close()
+    mgr_incr.close()
+    print(json.dumps({
+        'metric': 'delta_channel',
+        'value': round(ratio, 2),
+        'unit': 'x_fewer_commit_bytes',
+        'ratio_ok': bool(ratio >= 5.0),
+        'full_commit_bytes': int(bytes_full),
+        'incr_commit_bytes': int(bytes_incr),
+        'commits_per_arm': steps, 'incremental': incr,
+        'delta_commits': int(d1['delta_committed'] -
+                             d0['delta_committed']),
+        'delta_fallback_rebases': int(d1['delta_rebases'] -
+                                      d0['delta_rebases']),
+        'full_arm_s': round(dt_full, 2),
+        'incr_arm_s': round(dt_incr, 2),
+        'resume_from_delta_chain': bool(from_delta),
+        'resume_parity_ok': bool(resume_ok),
+        'push_sparse_wire_bytes': int(meta['bytes']),
+        'push_full_params_bytes': int(full_params_bytes),
+        'push_sparse_ratio': round(full_params_bytes /
+                                   max(1.0, float(meta['bytes'])), 2),
+        'push_sparse_bitwise_ok': bool(sparse_ok),
+        'push_int8_wire_bytes': int(meta2['bytes']),
+        'push_int8_rel_err': round(float(meta2['rel_err']), 6),
+        'push_int8_tight_tol_refused': bool(refused),
+        'push_int8_refusal_left_engine_untouched': bool(untouched),
+        'push_int8_applied': bool(int8_moved),
+        'vocab': vocab, 'dim': dim, 'batch': batch, 'hot': hot,
+    }))
+    for d in dirs.values():
         shutil.rmtree(d, ignore_errors=True)
 
 
@@ -2878,6 +3107,9 @@ def _bench_main():
         return
     if os.environ.get('BENCH_CKPT', '') == '1':
         ckpt_bench()   # async elastic checkpoint overhead A/B
+        return
+    if os.environ.get('BENCH_DELTA', '') == '1':
+        delta_bench()   # incremental delta checkpoints + delta push
         return
     if os.environ.get('BENCH_EMBED', '') == '1':
         embed_bench()   # dense vs touched-rows-only embedding training

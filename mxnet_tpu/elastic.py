@@ -71,6 +71,8 @@ _CKPT_MAGIC = b'MXTPUCKv1\n'
 _CKPT_END = b'MXTPUCKEND'
 _MANIFEST = 'manifest.json'
 _STEP_DIR = 'step-%08d'
+_DELTA_DIR = 'delta-%08d'
+_DELTA_FILE = 'delta-r00000.bin'
 FORMAT_VERSION = 1
 
 
@@ -788,9 +790,27 @@ def list_checkpoints(directory):
     return sorted(steps, reverse=True)
 
 
-def _load_one(ckpt_dir):
-    """(manifest, arrays) for one checkpoint dir; raises MXNetError on
-    any validation failure (torn manifest, missing shard, checksum)."""
+def list_deltas(directory):
+    """Step numbers of the DELTA checkpoint dirs under `directory`
+    that have a manifest, newest first (chain integrity is only
+    established at load)."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for n in names:
+        if n.startswith('delta-'):
+            try:
+                s = int(n[6:])
+            except ValueError:
+                continue
+            if os.path.isfile(os.path.join(directory, n, _MANIFEST)):
+                steps.append(s)
+    return sorted(steps, reverse=True)
+
+
+def _read_manifest(ckpt_dir):
     mpath = os.path.join(ckpt_dir, _MANIFEST)
     try:
         with open(mpath, 'r') as f:
@@ -801,6 +821,13 @@ def _load_one(ckpt_dir):
     if manifest.get('format') != FORMAT_VERSION:
         raise MXNetError('checkpoint %s has unsupported format %r'
                          % (ckpt_dir, manifest.get('format')))
+    return manifest
+
+
+def _load_one(ckpt_dir):
+    """(manifest, arrays) for one checkpoint dir; raises MXNetError on
+    any validation failure (torn manifest, missing shard, checksum)."""
+    manifest = _read_manifest(ckpt_dir)
     arrays = {}
     for fname in manifest.get('files', []):
         fpath = os.path.join(ckpt_dir, fname)
@@ -812,19 +839,82 @@ def _load_one(ckpt_dir):
     return manifest, arrays
 
 
+def _load_delta_chain(directory, step):
+    """(manifest, arrays) reconstructed for the delta checkpoint at
+    `step`: load its full base, then replay every delta in the chain
+    in order.  Raises MXNetError (DeltaChainError is one) on any break
+    — a torn base or delta payload, a fingerprint mismatch, a missing
+    chain member — so load_newest_intact falls back past it the same
+    way it falls back past a torn full checkpoint."""
+    from . import delta as delta_mod
+    tip_dir = os.path.join(directory, _DELTA_DIR % step)
+    tip = _read_manifest(tip_dir)
+    dm = tip.get('delta') or {}
+    base_step = dm.get('base_step')
+    chain = dm.get('chain') or []
+    if base_step is None or not chain or chain[-1] != step:
+        raise MXNetError('delta checkpoint %s has a malformed chain '
+                         'record' % tip_dir)
+    base_dir = os.path.join(directory, _STEP_DIR % int(base_step))
+    base_manifest, state = _load_one(base_dir)
+    fp = base_manifest.get('fp') or delta_mod.fingerprint(state)
+    for s in chain:
+        ddir = os.path.join(directory, _DELTA_DIR % int(s))
+        man = tip if int(s) == int(step) else _read_manifest(ddir)
+        meta = man.get('delta') or {}
+        arrays = {}
+        for fname in man.get('files', []):
+            fpath = os.path.join(ddir, fname)
+            if not os.path.isfile(fpath):
+                raise MXNetError('delta checkpoint %s is missing '
+                                 'payload %s' % (ddir, fname))
+            arrays.update(read_shard_file(fpath))
+        state = delta_mod.apply_delta(state, meta, arrays,
+                                      expect_fp=fp)
+        fp = meta.get('new_fp')
+    return tip, state
+
+
+def load_state(ckpt_dir):
+    """(manifest, arrays) for a committed checkpoint dir of EITHER
+    kind — a full `step-*` dir loads directly, a `delta-*` dir replays
+    its chain from the base.  The mode-portable entry point callers
+    (the push channel's serving export) use so they never care which
+    role a commit happened to get."""
+    norm = os.path.normpath(ckpt_dir)
+    base = os.path.basename(norm)
+    if base.startswith('delta-'):
+        return _load_delta_chain(os.path.dirname(norm), int(base[6:]))
+    return _load_one(ckpt_dir)
+
+
 def load_newest_intact(directory, validate=None):
     """(manifest, arrays, ckpt_dir) of the newest checkpoint that
     validates end-to-end, falling back past torn/incomplete ones
-    (counted in profiler ckpt_torn_fallbacks).  None when the
-    directory holds no intact checkpoint.  `validate(manifest,
-    arrays)` may run extra pre-acceptance checks — an MXNetError it
-    raises falls back the same way (restore() assembly-validates the
-    optimizer here, BEFORE any target mutation)."""
+    (counted in profiler ckpt_torn_fallbacks).  Full and delta commits
+    compete by step number; a delta candidate replays base + chain and
+    a break anywhere (torn delta payload, reaped base, fingerprint
+    mismatch) falls back to the next-newest candidate — which is
+    exactly the newest intact base+prefix, since every chain prefix is
+    itself a committed delta checkpoint.  None when the directory
+    holds no intact checkpoint.  `validate(manifest, arrays)` may run
+    extra pre-acceptance checks — an MXNetError it raises falls back
+    the same way (restore() assembly-validates the optimizer here,
+    BEFORE any target mutation)."""
     from . import profiler
-    for step in list_checkpoints(directory):
-        ckpt_dir = os.path.join(directory, _STEP_DIR % step)
+    cands = sorted([(s, 'full') for s in list_checkpoints(directory)]
+                   + [(s, 'delta') for s in list_deltas(directory)],
+                   reverse=True)
+    for step, kind in cands:
+        if kind == 'full':
+            ckpt_dir = os.path.join(directory, _STEP_DIR % step)
+        else:
+            ckpt_dir = os.path.join(directory, _DELTA_DIR % step)
         try:
-            manifest, arrays = _load_one(ckpt_dir)
+            if kind == 'full':
+                manifest, arrays = _load_one(ckpt_dir)
+            else:
+                manifest, arrays = _load_delta_chain(directory, step)
             if validate is not None:
                 validate(manifest, arrays)
             return manifest, arrays, ckpt_dir
@@ -832,12 +922,20 @@ def load_newest_intact(directory, validate=None):
             logging.warning('elastic: skipping checkpoint %s: %s',
                             ckpt_dir, e)
             profiler.add_ckpt_stats(torn_fallbacks=1)
+            if kind == 'delta':
+                profiler.add_delta_stats(fallbacks=1)
     return None
 
 
 # ---------------------------------------------------------------------------
 # CheckpointManager
 # ---------------------------------------------------------------------------
+
+class _DeltaFallback(Exception):
+    """Internal: a delta-role commit can't extend the chain (no
+    resident base, shape/name change, encoder refusal) — the writer
+    falls back to a full base in the same commit slot."""
+
 
 class CheckpointManager(object):
     """Async, sharded, crash-safe checkpoints with cadence, retention,
@@ -866,11 +964,25 @@ class CheckpointManager(object):
     raises is logged and training continues (a broken push path must
     never take the training run down with it).  docs/ELASTIC.md has
     the commit->push->canary->verdict state machine.
+
+    incremental: K > 0 turns on INCREMENTAL checkpointing — K delta
+    commits (`delta-NNNNNNNN/` dirs holding only what changed since
+    the previous commit: touched table rows, dense diffs) between full
+    bases.  delta_config: a delta.DeltaConfig (default keeps dense
+    diffs raw/exact, so chain replay at resume is bit-identical to a
+    full checkpoint).  Ignored on real multi-process runs.
+
+    on_verdict: optional callable(verdict, consecutive_rollbacks=N)
+    the attached CheckpointPusher fires for every canary verdict.
+    When set, the pusher's consecutive-rollback limit DOESN'T raise
+    RollbackStop — the hook owns the response instead (LrBackoff cuts
+    the learning rate and lets training continue).
     """
 
     def __init__(self, directory, every_n_steps=None, every_n_secs=None,
                  keep=3, async_=True, rank=None, world=None,
-                 deadline=30.0, on_commit=None):
+                 deadline=30.0, on_commit=None, incremental=None,
+                 delta_config=None, on_verdict=None):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.every_n_steps = every_n_steps
@@ -912,7 +1024,24 @@ class CheckpointManager(object):
         self._resumed = None
         self._lock = threading.Lock()
         self.on_commit = on_commit
+        self.on_verdict = on_verdict
         self._stop_exc = None
+        # incremental (delta) checkpointing: K delta commits between
+        # full bases.  Gated OFF on real multi-process runs — deltas
+        # are computed against a process-local chain state, which a
+        # per-rank shard split does not carry.  The default delta
+        # config keeps dense diffs RAW (exact), so a chain replay is
+        # bit-identical to a full checkpoint — the kill/resume parity
+        # contract survives incremental mode unchanged.
+        self.incremental = max(0, int(incremental or 0))
+        self._delta_cfg = None
+        if self.incremental:
+            from . import delta as delta_mod
+            self._delta_cfg = delta_mod.DeltaConfig.resolve(
+                delta_config, dense='raw')
+        self._chain = None       # writer-thread chain state (no lock:
+        self._commit_seq = 0     # only touched under self._lock / save)
+        self.retain_refs = None  # callable -> steps the fleet pins
 
     # -- target ------------------------------------------------------------
     def attach(self, target):
@@ -1143,8 +1272,20 @@ class CheckpointManager(object):
             'time': time.time(),
         }
         snap_ms = (time.perf_counter() - t0) * 1e3
-        step_dir = os.path.join(self.directory, _STEP_DIR % self._step)
-        job = (dict(manifest), list(entries), step_dir, snap_ms)
+        # incremental mode: every (K+1)-th commit is a full base, the
+        # K between are deltas against the writer's chain state.  The
+        # role is decided HERE (calling thread) so the dir path this
+        # save returns is the one that commits; the writer still falls
+        # back to a full base when the chain can't extend (first
+        # commit, post-restore, shape/name change, failed base write).
+        role = 'full'
+        if self.incremental > 0 and not self._multiprocess():
+            if self._commit_seq % (self.incremental + 1) != 0:
+                role = 'delta'
+            self._commit_seq += 1
+        dir_fmt = _DELTA_DIR if role == 'delta' else _STEP_DIR
+        step_dir = os.path.join(self.directory, dir_fmt % self._step)
+        job = (dict(manifest), list(entries), step_dir, snap_ms, role)
         self._last_save_step = self._step
         self._last_save_time = time.monotonic()
         if sync:
@@ -1246,7 +1387,7 @@ class CheckpointManager(object):
             logging.warning('elastic: checkpoint barrier failed: %s', e)
 
     def _write_checkpoint(self, manifest, entries, step_dir, snap_ms,
-                          background):
+                          role='full', background=False):
         """Materialize the snapshot to host and commit it: per-rank
         self-checksummed shard files first, manifest last (temp +
         os.replace each) — the manifest IS the commit point.  Fault
@@ -1265,15 +1406,30 @@ class CheckpointManager(object):
             time.sleep(delay / 1e3)
         with self._lock:
             self._write_checkpoint_locked(manifest, entries, step_dir,
-                                          snap_ms, background)
+                                          snap_ms, role, background)
 
     def _write_checkpoint_locked(self, manifest, entries, step_dir,
-                                 snap_ms, background):
+                                 snap_ms, role, background):
         from . import profiler
         t0 = time.perf_counter()
         if fault_knob('WRITE_FAIL') is not None:
             raise MXNetError('injected host write failure '
                              '(MXNET_TPU_FAULT_WRITE_FAIL)')
+        if role == 'delta':
+            try:
+                return self._write_delta_locked(manifest, entries,
+                                                step_dir, snap_ms,
+                                                background)
+            except _DeltaFallback as e:
+                # chain can't extend — write a full base instead (and
+                # under the full dir name; the caller's returned delta
+                # path simply never commits, like a skipped save)
+                logging.info('elastic: delta commit at step %d '
+                             'infeasible (%s) — writing a full base',
+                             manifest['step'], e)
+                profiler.add_delta_stats(rebases=1)
+                step_dir = os.path.join(self.directory,
+                                        _STEP_DIR % manifest['step'])
         os.makedirs(step_dir, exist_ok=True)
         lead = 0
         if self._multiprocess():
@@ -1320,11 +1476,25 @@ class CheckpointManager(object):
                 os.path.join(step_dir, fname), by_rank[r])
             total_bytes += nbytes
         manifest['files'] = files
+        new_chain = None
+        if self.incremental > 0 and not self._multiprocess():
+            # this full commit becomes the chain base for the next K
+            # delta commits: keep its state resident on the writer and
+            # stamp its fingerprint into the manifest BEFORE the
+            # commit point (chain replay at resume re-checks it)
+            from . import delta as delta_mod
+            state = {n: np.asarray(a) for n, a in entries}
+            manifest['fp'] = delta_mod.fingerprint(state)
+            new_chain = {'fp': manifest['fp'],
+                         'base_step': manifest['step'],
+                         'seq': 0, 'chain': [], 'state': state}
         self._barrier()     # all ranks' shards durable before commit
         if self.rank == lead:
             with atomic_file(os.path.join(step_dir, _MANIFEST),
                              mode='w') as f:
                 json.dump(manifest, f)
+        if new_chain is not None:
+            self._chain = new_chain
         if fault_knob('TORN_CKPT') is not None and by_rank:
             # simulate a crash mid-write on a store without atomic
             # rename: truncate the newest shard file IN PLACE after
@@ -1363,30 +1533,141 @@ class CheckpointManager(object):
                         'elastic: on_commit hook failed for %s '
                         '(training continues)', step_dir)
 
+    def _write_delta_locked(self, manifest, entries, delta_dir,
+                            snap_ms, background):
+        """Commit a DELTA checkpoint: one payload file of the state's
+        diff against the writer's resident chain state (touched rows
+        for tables, raw/int8 diffs for dense params — see delta.py),
+        then the manifest (kind='delta', carrying the chain record:
+        base step, base/new fingerprints, sequence number and the full
+        member list) via the same temp+replace commit point.  The
+        resident chain advances only past a committed delta — a write
+        that dies anywhere leaves the chain (and every already-
+        committed prefix) intact."""
+        from . import profiler
+        from . import delta as delta_mod
+        t0 = time.perf_counter()
+        chain = self._chain
+        if chain is None:
+            raise _DeltaFallback('no resident chain base')
+        current = {n: np.asarray(a) for n, a in entries}
+        try:
+            d_entries, meta, new_state = delta_mod.make_delta(
+                chain['state'], current, seq=chain['seq'] + 1,
+                base_fp=chain['fp'], config=self._delta_cfg)
+        except MXNetError as e:
+            raise _DeltaFallback(str(e))
+        os.makedirs(delta_dir, exist_ok=True)
+        nbytes, _crc = write_shard_file(
+            os.path.join(delta_dir, _DELTA_FILE), d_entries)
+        manifest['kind'] = 'delta'
+        manifest['files'] = [_DELTA_FILE]
+        manifest['delta'] = dict(
+            meta, base_step=chain['base_step'],
+            chain=list(chain['chain']) + [manifest['step']])
+        with atomic_file(os.path.join(delta_dir, _MANIFEST),
+                         mode='w') as f:
+            json.dump(manifest, f)
+        chain['state'] = new_state
+        chain['fp'] = meta['new_fp']
+        chain['seq'] = meta['seq']
+        chain['chain'] = list(manifest['delta']['chain'])
+        if fault_knob('TORN_CKPT') is not None:
+            victim = os.path.join(delta_dir, _DELTA_FILE)
+            if os.path.isfile(victim):
+                sz = os.path.getsize(victim)
+                with open(victim, 'r+b') as f:
+                    f.truncate(max(1, sz // 2))
+                logging.warning('elastic: MXNET_TPU_FAULT_TORN_CKPT '
+                                'truncated %s', victim)
+        commit_ms = (time.perf_counter() - t0) * 1e3
+        profiler.add_ckpt_stats(
+            snapshots=1, bytes=nbytes,
+            async_overlap_ms=commit_ms if background else 0.0,
+            commit_ms=commit_ms + snap_ms)
+        profiler.add_delta_stats(
+            committed=1, bytes=meta['bytes'],
+            full_bytes=meta['full_bytes'], chain_len=meta['seq'])
+        self._prune()
+        hook = self.on_commit
+        if hook is not None:
+            try:
+                hook(delta_dir, dict(manifest))
+            except Exception:
+                logging.exception(
+                    'elastic: on_commit hook failed for %s '
+                    '(training continues)', delta_dir)
+
     def _prune(self):
-        steps = list_checkpoints(self.directory)
+        """Retention, chain-aware: keep the newest `keep` COMMITS of
+        either kind, then close over chains — a kept (or fleet-pinned,
+        or live-chain) delta pins its base and every chain
+        predecessor, so replaying any survivor always works.  The old
+        rule counted only full `step-*` dirs, which let a base slide
+        out of the window while deltas chained on it were still
+        retained — every one of them silently unloadable."""
+        fulls = list_checkpoints(self.directory)
+        deltas = list_deltas(self.directory)
+        commits = sorted([(s, 'full') for s in fulls]
+                         + [(s, 'delta') for s in deltas],
+                         reverse=True)
+        keep_steps = {s for s, _k in commits[:self.keep]}
+        if self.retain_refs is not None:
+            # steps the fleet still references (queued / in-flight
+            # pushes — the PR 14 rule).  Contained: if we can't tell
+            # what's pinned, deleting anything is the wrong call
+            try:
+                keep_steps.update(int(s) for s in self.retain_refs())
+            except Exception:
+                logging.exception('elastic: retain_refs failed — '
+                                  'skipping this prune')
+                return
+        if self._chain is not None:
+            # the writer's LIVE chain: its base and members must
+            # survive even when newer commits push them out of the
+            # window (the next delta still extends this chain)
+            keep_steps.add(self._chain['base_step'])
+            keep_steps.update(self._chain['chain'])
+        delta_set = set(deltas)
+        for s in list(keep_steps):
+            if s not in delta_set:
+                continue
+            try:
+                dm = _read_manifest(os.path.join(
+                    self.directory, _DELTA_DIR % s)).get('delta') or {}
+            except MXNetError:
+                continue
+            if dm.get('base_step') is not None:
+                keep_steps.add(int(dm['base_step']))
+            keep_steps.update(int(c) for c in dm.get('chain') or [])
         doomed = [os.path.join(self.directory, _STEP_DIR % s)
-                  for s in steps[self.keep:]]
-        # orphans: step dirs a SIGKILL left without a manifest (shard
+                  for s in fulls if s not in keep_steps]
+        doomed += [os.path.join(self.directory, _DELTA_DIR % s)
+                   for s in deltas if s not in keep_steps]
+        # orphans: dirs a SIGKILL left without a manifest (shard
         # files and atomic_file temps committed, commit point never
         # reached).  They can never become valid, and a resumed run's
         # step numbers may never realign to overwrite them — so any
-        # manifest-less dir OLDER than the newest real checkpoint is
+        # manifest-less dir OLDER than the newest real commit is
         # garbage (newer ones might be a write in flight; left alone)
-        newest = steps[0] if steps else None
-        valid = set(steps)
+        newest = commits[0][0] if commits else None
+        valid = set(fulls)
         try:
             names = os.listdir(self.directory)
         except OSError:
             names = []
         for n in names:
-            if not n.startswith('step-'):
+            if n.startswith('step-'):
+                base, known = n[5:], valid
+            elif n.startswith('delta-'):
+                base, known = n[6:], delta_set
+            else:
                 continue
             try:
-                s = int(n[5:])
+                s = int(base)
             except ValueError:
                 continue
-            if s not in valid and newest is not None and s < newest:
+            if s not in known and newest is not None and s < newest:
                 doomed.append(os.path.join(self.directory, n))
         for d in doomed:
             try:
@@ -1431,9 +1712,11 @@ class CheckpointManager(object):
 
     # -- resume ------------------------------------------------------------
     def resumable(self):
-        """True when the directory holds at least one checkpoint (its
-        integrity is only established by restore())."""
-        return bool(list_checkpoints(self.directory))
+        """True when the directory holds at least one checkpoint —
+        full or delta (its integrity is only established by
+        restore())."""
+        return bool(list_checkpoints(self.directory)
+                    or list_deltas(self.directory))
 
     def restore(self, target=None, metric=None):
         """Restore the newest INTACT checkpoint into the target
@@ -1471,9 +1754,89 @@ class CheckpointManager(object):
         self._last_save_step = info.step
         self._last_save_time = time.monotonic()
         self._resumed = info
+        # the restored state is not the writer's chain state — the
+        # first post-resume commit starts a fresh full base
+        self._chain = None
+        self._commit_seq = 0
         profiler.add_ckpt_stats(restores=1)
         logging.info('elastic: resumed from %s (%r)', ckpt_dir, info)
         return info
+
+
+# ---------------------------------------------------------------------------
+# LrBackoff — canary verdicts as a training signal
+# ---------------------------------------------------------------------------
+
+class LrBackoff(object):
+    """Turn canary rollbacks into a LEARNING-RATE signal instead of a
+    stop: installed as `CheckpointManager.on_verdict`, it cuts the
+    optimizer's learning rate by `factor` every time the push
+    channel's consecutive-rollback streak reaches a multiple of
+    `after` — a run whose recent steps keep failing canary judgment is
+    probably stepping too hard, and backing off is cheaper than
+    killing it.  The presence of an on_verdict hook also disarms the
+    pusher's RollbackStop (the hook owns the response).
+
+        mgr = CheckpointManager(dir, incremental=4)
+        elastic.LrBackoff(mgr, factor=0.5, after=3)
+        fleet_supervisor.CheckpointPusher(sup, 'm', sym).attach(mgr)
+
+    Works against whatever optimizer the attached target carries:
+    cuts `lr_scheduler.base_lr` when a scheduler drives the lr (the
+    scheduler's own shape is preserved — only its baseline drops),
+    else the optimizer's flat `lr`.  Never below `min_lr`."""
+
+    def __init__(self, manager, factor=0.5, after=3, min_lr=0.0):
+        self.manager = manager
+        self.factor = float(factor)
+        self.after = max(1, int(after))
+        self.min_lr = float(min_lr)
+        self.backoffs = 0
+        manager.on_verdict = self
+
+    def _optimizer(self):
+        t = self.manager._target
+        if t is None:
+            return None
+        try:
+            fu, per_key = _updater_of(t)
+        except Exception:
+            return None
+        for u in (fu, per_key):
+            if u is not None and \
+                    getattr(u, 'optimizer', None) is not None:
+                return u.optimizer
+        tr = None
+        if hasattr(t, '_trainer'):
+            tr = t._trainer
+        elif hasattr(t, '_updaters'):
+            tr = t
+        return getattr(tr, '_optimizer', None) \
+            if tr is not None else None
+
+    def __call__(self, verdict, consecutive_rollbacks=0):
+        n = int(consecutive_rollbacks)
+        if n < self.after or n % self.after != 0:
+            return
+        opt = self._optimizer()
+        if opt is None:
+            logging.warning('elastic: lr backoff due (%d consecutive '
+                            'rollbacks) but no optimizer is reachable '
+                            'from the attached target', n)
+            return
+        sched = getattr(opt, 'lr_scheduler', None)
+        if sched is not None and hasattr(sched, 'base_lr'):
+            new = max(self.min_lr, float(sched.base_lr) * self.factor)
+            sched.base_lr = new
+        else:
+            new = max(self.min_lr, float(opt.lr) * self.factor)
+            opt.lr = new
+        self.backoffs += 1
+        from . import profiler
+        profiler.add_loop_stats(lr_backoffs=1)
+        logging.warning('elastic: canary lr backoff #%d (%d '
+                        'consecutive rollbacks): lr -> %g',
+                        self.backoffs, n, new)
 
 
 # ---------------------------------------------------------------------------

@@ -98,6 +98,7 @@ from collections import deque
 import http.client
 import numpy as np
 
+from . import delta as delta_mod
 from . import profiler
 from .base import MXNetError
 from .elastic import fault_knob
@@ -360,6 +361,8 @@ class _ReplicaHandler(_FleetHandler):
 
       POST /v1/models/<name>:load    {prefix, epoch, input_shapes,...}
       POST /v1/models/<name>:unload
+      POST /v1/models/<name>:delta   {prefix, ..., delta: {base, path,
+                                      meta, parity_tol}}
     """
 
     def do_GET(self):
@@ -399,6 +402,16 @@ class _ReplicaHandler(_FleetHandler):
                     return
                 rs.load_model(mname, spec)
                 self._reply(200, {'status': 'loaded', 'model': mname})
+            elif op == 'delta':
+                try:
+                    spec = json.loads(raw or b'{}')
+                except ValueError as e:
+                    self._reply(400, {'error': 'bad request',
+                                      'detail': str(e)})
+                    return
+                fp = rs.apply_delta(mname, spec)
+                self._reply(200, {'status': 'delta', 'model': mname,
+                                  'fp': fp})
             else:
                 rs.unload_model(mname)
                 self._reply(200, {'status': 'unloaded',
@@ -408,6 +421,16 @@ class _ReplicaHandler(_FleetHandler):
                               'model': mname,
                               'need_bytes': e.need_bytes,
                               'budget_bytes': e.budget_bytes})
+        except (delta_mod.DeltaChainError,
+                delta_mod.DeltaParityError) as e:
+            # typed delta refusal: NOTHING was mutated/registered on
+            # this replica — 409 tells the supervisor (and through it
+            # the pusher) that a FULL push is required
+            self._reply(409, {'error': 'delta refused',
+                              'kind': 'parity' if isinstance(
+                                  e, delta_mod.DeltaParityError)
+                              else 'chain',
+                              'model': mname, 'detail': str(e)})
         except MXNetError as e:
             msg = str(e)
             if 'already registered' in msg:
@@ -423,12 +446,13 @@ class _ReplicaHandler(_FleetHandler):
 
 
 def _admin_model(path):
-    """(name, op) from /v1/models/<name>:load|:unload, else None."""
+    """(name, op) from /v1/models/<name>:load|:unload|:delta, else
+    None."""
     prefix = '/v1/models/'
     if not path.startswith(prefix):
         return None
     rest = path[len(prefix):]
-    for op in ('load', 'unload'):
+    for op in ('load', 'unload', 'delta'):
         suffix = ':' + op
         if rest.endswith(suffix):
             name = rest[:-len(suffix)]
@@ -504,6 +528,72 @@ class ReplicaServer(object):
         if warm:
             self.registry.engine(name)
         return self
+
+    def apply_delta(self, name, spec):
+        """Admit candidate arm `name` by DELTA — the replica side of
+        the pusher's delta channel.  The resident base arm's weights
+        plus the pushed delta payload become the candidate's weights;
+        the full export named by ``spec['prefix']`` is only read for
+        its (tiny) symbol json — the params file is never opened,
+        which is the byte saving.  All of delta.apply_delta's gates
+        run first: a chain break (base fingerprint / crc mismatch) or
+        a lossy-parity refusal raises the typed error with NOTHING
+        registered, and the handler's 409 sends the pusher to its
+        full-push fallback."""
+        from .predictor import Predictor
+        from . import symbol as sym_mod
+        dspec = dict(spec.get('delta') or {})
+        base = dspec.get('base')
+        if not base:
+            raise delta_mod.DeltaChainError(
+                'delta push for %r names no base arm' % name)
+        prefix = spec.get('prefix')
+        if not prefix or not spec.get('input_shapes'):
+            raise delta_mod.DeltaChainError(
+                'delta push for %r needs prefix= and input_shapes= in '
+                'the spec (loader-registered bases take full pushes)'
+                % name)
+        meta = dspec.get('meta') or {}
+        arrays = delta_mod.read_delta_file(str(dspec.get('path')
+                                               or ''))
+        try:
+            eng = self.registry.engine(base)
+        except MXNetError as e:
+            raise delta_mod.DeltaChainError(
+                'delta base arm %r is not resident on replica %d (%s)'
+                % (base, self.index, e))
+        state = eng._resident_host_state()
+        tol = dspec.get('parity_tol')
+        if tol is None:
+            tol = delta_mod.DeltaConfig().parity_tol
+        # expect_fp: the RESIDENT state's true fingerprint — a replica
+        # whose base diverged from the encoder's chain (quantized
+        # resident form, missed promote, fresh respawn mid-chain)
+        # refuses here instead of serving silently wrong weights
+        new_state = delta_mod.apply_delta(
+            state, meta, arrays,
+            expect_fp=delta_mod.fingerprint(state),
+            parity_tol=float(tol))
+        args = {n[len('arg:'):]: v for n, v in new_state.items()
+                if n.startswith('arg:')}
+        auxs = {n[len('aux:'):]: v for n, v in new_state.items()
+                if n.startswith('aux:')}
+        sym = sym_mod.load('%s-symbol.json' % prefix)
+        shapes = {k: tuple(int(d) for d in v)
+                  for k, v in dict(spec['input_shapes']).items()}
+        slo = SLO(deadline_ms=spec.get('deadline_ms'),
+                  priority=int(spec.get('priority', 0) or 0),
+                  service_ms_hint=spec.get('service_ms_hint'))
+        kwargs = {k: spec[k] for k in self._ENGINE_KEYS
+                  if spec.get(k) is not None}
+
+        def loader(_sym=sym, _a=args, _x=auxs, _s=shapes):
+            return Predictor(symbol=_sym, arg_params=_a, aux_params=_x,
+                             input_shapes=_s)
+        self.registry.register(name, loader=loader, slo=slo, **kwargs)
+        self.registry.engine(name)      # warm: never route cold
+        profiler.add_delta_stats(applied=1)
+        return meta.get('new_fp')
 
     def unload_model(self, name):
         self.registry.unregister(name)
@@ -1873,7 +1963,7 @@ class FleetSupervisor(object):
 
     # -- continuous deployment ------------------------------------------
     def push(self, name, prefix, epoch=0, frac=None, mode='canary',
-             tag=None):
+             tag=None, delta=None):
         """Hot-swap `name` to the `prefix`/`epoch` checkpoint behind a
         canary split (or shadow tee): the candidate is loaded on every
         live replica under a versioned arm name, then `frac` of
@@ -1888,7 +1978,16 @@ class FleetSupervisor(object):
         the replica rejoins the pool — the fleet converges to the
         intended model set.  A replica that REFUSES the load (507
         BudgetExceeded, 400) aborts and unwinds: the fleet must never
-        route to an arm only some replicas will serve."""
+        route to an arm only some replicas will serve.
+
+        `delta=` ({path, meta, parity_tol}, built by the
+        CheckpointPusher's delta channel) fans out `:delta` instead of
+        `:load`: each replica builds the candidate from its RESIDENT
+        stable arm plus the delta payload, never opening the full
+        params file.  A 409 refusal (chain break / parity) raises the
+        typed DeltaChainError — the caller's signal to retry as a full
+        push.  The pending spec stays the FULL spec either way, so a
+        respawn mid-push reconciles with a plain `:load`."""
         with self._lock:
             m = self._models.get(name)
             if m is None:
@@ -1910,15 +2009,25 @@ class FleetSupervisor(object):
             # the canary opens so even an instant decision carries it
             spec['tag'] = tag
             self._pending[name] = spec
+            if delta is not None:
+                # the replica applies the delta against the arm it is
+                # CURRENTLY serving for this model — name it here, at
+                # the single point that knows the promoted arm
+                delta = dict(delta)
+                delta.setdefault('base', m.get('serve_name') or name)
+        op = ':delta' if delta is not None else ':load'
+        payload = {k: v for k, v in spec.items()
+                   if k not in ('name', 'tag')}
+        if delta is not None:
+            payload['delta'] = delta
         loaded = []
         try:
             for rep in self.replicas():
                 try:
                     status, _h, body = _http_json(
                         'POST', rep.host, rep.port,
-                        '/v1/models/%s:load' % cand_name,
-                        payload={k: v for k, v in spec.items()
-                                 if k not in ('name', 'tag')},
+                        '/v1/models/%s%s' % (cand_name, op),
+                        payload=payload,
                         timeout=spawn_timeout_s())
                 except (OSError, http.client.HTTPException) as e:
                     # replica unreachable mid-fan-out: if it is DYING,
@@ -1935,6 +2044,11 @@ class FleetSupervisor(object):
                         name, rep.index, e)
                     self._retry_load_async(rep, cand_name, spec)
                     continue
+                if status == 409 and delta is not None:
+                    raise delta_mod.DeltaChainError(
+                        'push(%r): replica %d refused the delta (%s) '
+                        '— full push required' % (name, rep.index,
+                                                  body))
                 if status != 200:
                     raise MXNetError(
                         'push(%r): replica %d refused the candidate '
@@ -2190,7 +2304,27 @@ class CheckpointPusher(object):
       * **export retention** — exported serving prefixes are pruned
         keep-last-2 EXCEPT any the supervisor still references (the
         current serve prefix / a pending candidate: respawned
-        replicas warm from them).
+        replicas warm from them).  The SOURCE checkpoints of queued/
+        in-flight pushes are pinned via the manager's retain_refs
+        hook until their export lands.
+      * **delta channel** — `delta=True` (or MXNET_TPU_LOOP_DELTA=1)
+        ships per-commit weight DELTAS (delta.make_delta, int8 dense
+        diffs + touched-rows, `delta-%08d.bin` next to the exports)
+        once a full push has been promoted: replicas rebuild the
+        candidate from their resident stable arm + the payload and
+        never open the full params file.  The chain only advances on
+        a PROMOTE; any refusal (409 chain/parity), encode failure or
+        rebase-cadence expiry (`delta_rebase`, default
+        MXNET_TPU_LOOP_DELTA_REBASE=16 deltas per full base) falls
+        back to a full push — counted delta_pushes/
+        delta_push_fallbacks (profiler.delta_stats()).  The full
+        serving export is STILL written every push either way:
+        respawns and reconciles always full-load.
+      * **verdict hook** — when the attached manager carries an
+        `on_verdict` callable (e.g. elastic.LrBackoff), every verdict
+        is forwarded to it with the consecutive-rollback count, and
+        the hook REPLACES the RollbackStop at the threshold: the run
+        backs off instead of stopping.
 
     Verdicts: `poll_verdicts()` drains new-since-last-poll (the
     manager's step_end logs them in the training loop's stream);
@@ -2199,7 +2333,8 @@ class CheckpointPusher(object):
 
     def __init__(self, supervisor, model, symbol=None, mode='canary',
                  frac=None, push_dir=None, queue_depth=None,
-                 max_consecutive_rollbacks=None):
+                 max_consecutive_rollbacks=None, delta=None,
+                 delta_rebase=None, delta_config=None):
         import queue as _queue
         import tempfile
         self.supervisor = supervisor
@@ -2216,6 +2351,17 @@ class CheckpointPusher(object):
             max_consecutive_rollbacks = _env_int(
                 'MXNET_TPU_LOOP_MAX_ROLLBACKS', 3)
         self.max_consecutive_rollbacks = int(max_consecutive_rollbacks)
+        if delta is None:
+            delta = _env_int('MXNET_TPU_LOOP_DELTA', 0) != 0
+        self.delta = bool(delta)
+        if delta_rebase is None:
+            delta_rebase = _env_int('MXNET_TPU_LOOP_DELTA_REBASE', 16)
+        self.delta_rebase = max(1, int(delta_rebase))
+        self._delta_cfg = delta_mod.DeltaConfig.resolve(
+            delta_config, dense='int8')
+        self._base = None       # promoted chain {state, fp, seq}
+        self._staged = None     # this push's chain state, pre-verdict
+        self._retained = set()  # steps whose source ckpt we still need
         self._q = _queue.Queue(maxsize=max(1, int(queue_depth)))
         self._lock = threading.Lock()
         self._mgr = None
@@ -2251,6 +2397,11 @@ class CheckpointPusher(object):
             self._chained = prior
         manager.on_commit = self
         self._mgr = manager
+        if getattr(manager, 'retain_refs', None) is None:
+            # incremental managers prune aggressively (deltas are
+            # tiny); pin the source commits of queued/in-flight pushes
+            # until their serving export lands on disk
+            manager.retain_refs = self._retained_steps
         return manager
 
     def __call__(self, step_dir, manifest):
@@ -2283,6 +2434,9 @@ class CheckpointPusher(object):
             profiler.add_loop_stats(push_queue_skipped=1)
             logging.info('loop pusher: skipping commit %s (push queue '
                          'full)', step_dir)
+            return
+        with self._lock:
+            self._retained.add(int(manifest.get('step', 0)))
 
     # -- worker ---------------------------------------------------------
     def _worker_loop(self):
@@ -2312,6 +2466,10 @@ class CheckpointPusher(object):
                 self._record(PushVerdict(
                     'failed', self.model, None,
                     step=manifest.get('step'), error=str(e)))
+            finally:
+                with self._lock:
+                    self._retained.discard(
+                        int(manifest.get('step', 0)))
 
     def _push_one(self, step_dir, manifest):
         from .serving import export_serving_checkpoint
@@ -2343,14 +2501,55 @@ class CheckpointPusher(object):
             # recorded BEFORE the push so a failing push's export is
             # still retention-managed, never orphaned in push_dir
             self._exports.append(prefix)
+        dspec = meta = None
+        if self.delta:
+            dspec, meta = self._encode_delta(step_dir, step)
+        delta_pushed = False
         try:
             # tag= rides the push so the verdict carries the train
-            # step even when the canary decides before push() returns
-            cand = self.supervisor.push(self.model, prefix, epoch=0,
-                                        frac=self.frac, mode=self.mode,
-                                        tag=step)
+            # step even when the canary decides before push() returns.
+            # delta= only when one is going out: stub/legacy
+            # supervisors without the kwarg keep working
+            kw = {'delta': dspec} if dspec is not None else {}
+            try:
+                cand = self.supervisor.push(self.model, prefix,
+                                            epoch=0, frac=self.frac,
+                                            mode=self.mode, tag=step,
+                                            **kw)
+                delta_pushed = dspec is not None
+            except MXNetError as e:
+                if dspec is None:
+                    raise
+                # typed 409 refusal (chain break on a replica, parity
+                # gate) or any delta-path failure: the full export is
+                # already on disk — retry as a plain full push, which
+                # also REBASES the chain on promote
+                profiler.add_delta_stats(push_fallbacks=1)
+                logging.warning(
+                    'loop pusher: delta push of step %d refused (%s) '
+                    '— falling back to a full push', step, e)
+                with self._lock:
+                    if self._staged is not None:
+                        self._staged = dict(self._staged,
+                                            state=self._staged['full'],
+                                            fp=self._staged['full_fp'],
+                                            seq=0)
+                cand = self.supervisor.push(self.model, prefix,
+                                            epoch=0, frac=self.frac,
+                                            mode=self.mode, tag=step)
         finally:
             self._prune_exports()
+        if delta_pushed:
+            full_b = int(meta['full_bytes'])
+            try:
+                full_b = os.path.getsize(prefix + '-0000.params')
+            except OSError:
+                pass
+            profiler.add_delta_stats(pushes=1, bytes=meta['bytes'],
+                                     full_bytes=full_b)
+            logging.info('loop pusher: step %d went out as delta seq '
+                         '%d (%d bytes vs %d full)', step,
+                         meta['seq'], meta['bytes'], full_b)
         with self._lock:
             # fallback correlation for tag-less push paths; bounded —
             # a verdict that raced ahead of this insert (tag already
@@ -2361,6 +2560,63 @@ class CheckpointPusher(object):
         profiler.add_loop_stats(pushes=1)
         logging.info('loop pusher: pushed step %d as %r (mode=%s)',
                      step, cand, self.mode)
+
+    def _encode_delta(self, step_dir, step):
+        """Encode this commit against the fleet's PROMOTED chain state
+        (delta channel).  Returns (delta_spec, meta) when a delta can
+        go out, (None, None) for the full-push legs (no promoted base
+        yet, rebase cadence reached, shape/name-set change).  Either
+        way the would-be chain state is STAGED so the promote verdict
+        can advance it — a full push rebases the chain to seq 0.
+        Never raises: any failure just means 'push full this time'."""
+        from .elastic import write_shard_file
+        from .serving import serving_state
+        try:
+            cur = serving_state(step_dir)
+        except MXNetError as e:
+            logging.warning('loop pusher: cannot read %s for the '
+                            'delta channel (%s) — pushing full',
+                            step_dir, e)
+            with self._lock:
+                self._staged = None
+            return None, None
+        full_fp = delta_mod.fingerprint(cur)
+        with self._lock:
+            base = self._base
+        if base is not None and base['seq'] < self.delta_rebase:
+            try:
+                entries, meta, new_state = delta_mod.make_delta(
+                    base['state'], cur, seq=base['seq'] + 1,
+                    base_fp=base['fp'], config=self._delta_cfg)
+                path = os.path.join(self.push_dir,
+                                    'delta-%08d.bin' % step)
+                write_shard_file(path, entries)
+                with self._lock:
+                    self._staged = {'step': step, 'state': new_state,
+                                    'fp': meta['new_fp'],
+                                    'seq': int(meta['seq']),
+                                    'full': cur, 'full_fp': full_fp}
+                return ({'path': path, 'meta': meta,
+                         'parity_tol': self._delta_cfg.parity_tol},
+                        meta)
+            except MXNetError as e:
+                # shape/dtype/name-set change between commits: the
+                # chain cannot express it — rebase via a full push
+                logging.info('loop pusher: delta encode failed for '
+                             'step %d (%s) — rebasing with a full '
+                             'push', step, e)
+        with self._lock:
+            self._staged = {'step': step, 'state': cur, 'fp': full_fp,
+                            'seq': 0, 'full': cur, 'full_fp': full_fp}
+        return None, None
+
+    def _retained_steps(self):
+        """Steps whose SOURCE checkpoint the pusher still needs (queued
+        or in-flight, not yet exported to the serving format) — wired
+        as the manager's retain_refs so retention cannot prune a
+        commit out from under its own push."""
+        with self._lock:
+            return set(self._retained)
 
     def _prune_exports(self):
         """Keep-last-2 export retention, never deleting a prefix the
@@ -2422,14 +2678,44 @@ class CheckpointPusher(object):
             elif v.kind == 'promoted':
                 self._consec_rb = 0
             consec = self._consec_rb
+            # delta chain state machine: the fleet only ADVANCES on a
+            # promote (a rollback reverts every replica to the stable
+            # arm, so the encoder's base must stay put too)
+            if v.kind == 'promoted':
+                staged = self._staged
+                if staged is not None and (v.step is None or
+                                           staged['step'] == v.step):
+                    self._base = {'state': staged['state'],
+                                  'fp': staged['fp'],
+                                  'seq': staged['seq']}
+                self._staged = None
+            elif v.kind in ('rolled_back', 'failed'):
+                self._staged = None
         profiler.add_loop_stats(
             consecutive_rollbacks=consec,
             verdicts_promoted=1 if v.kind == 'promoted' else 0,
             verdicts_rolled_back=1 if v.kind == 'rolled_back' else 0)
+        hook = getattr(self._mgr, 'on_verdict', None) \
+            if self._mgr is not None else None
+        if hook is not None:
+            try:
+                hook(v, consecutive_rollbacks=consec)
+            except Exception:   # observer must not break the loop
+                logging.exception('loop pusher: manager on_verdict '
+                                  'hook failed')
         if stop_exc is not None and self._mgr is not None:
-            logging.warning('loop pusher: %s — requesting training '
-                            'stop', stop_exc)
-            self._mgr.request_stop(stop_exc)
+            if hook is not None:
+                # an installed verdict hook (elastic.LrBackoff) OWNS
+                # the divergence response: keep training and let it
+                # act instead of stopping the run
+                logging.warning('loop pusher: %d consecutive '
+                                'rollbacks — deferring to the '
+                                "manager's on_verdict hook instead of "
+                                'stopping', consec)
+            else:
+                logging.warning('loop pusher: %s — requesting '
+                                'training stop', stop_exc)
+                self._mgr.request_stop(stop_exc)
 
     # -- trainer-facing surface -----------------------------------------
     def poll_verdicts(self):

@@ -383,6 +383,23 @@ class SparseEmbedPlan:
             total += 2 * int(e['vocab']) * row * (2 if momentum else 1)
         return total
 
+    def delta_bytes(self, rungs, steps=1):
+        """Expected incremental-CHECKPOINT payload of the plan's tables
+        after `steps` commits-worth of touched rows: delta.make_delta
+        encodes a sparse table as touched-rows COO (int32 id + one row
+        per touched id — the same rows the optimizer wrote), so the
+        per-commit checkpoint bytes scale with `rung * steps` (capped
+        at vocab: rows re-touched across steps coalesce into one
+        entry), not with the table.  The full-commit equivalent is
+        table_bytes().  PERF round 22 measures the realized ratio
+        (BENCH_DELTA=1)."""
+        total = 0
+        for e, r in zip(self.entries, rungs):
+            touched = min(int(e['vocab']), int(r) * max(1, int(steps)))
+            row = int(e['dim']) * np.dtype(e['dtype']).itemsize
+            total += touched * (row + np.dtype(np.int32).itemsize)
+        return total
+
 
 def gluon_sparse_plan(params):
     """SparseEmbedPlan over a fused step's ordered Parameter list:

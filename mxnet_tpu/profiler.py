@@ -663,6 +663,7 @@ _LOOP = {
     'loop_swap_migrated_slots': 0,
     'loop_swap_dropped_slots': 0,
     'loop_swap_divergent_slots': 0,
+    'loop_lr_backoffs': 0,
 }
 
 
@@ -684,6 +685,55 @@ def loop_stats():
     summary() and dump_profile's 'loop' metadata lane)."""
     with _STATE['lock']:
         return dict(_LOOP)
+
+
+# weight-delta counters (PERF round 22): the move-only-what-changed
+# layer — incremental checkpoint commits (elastic delta-* dirs), the
+# push channel's delta shipping, and delta page-image updates.
+# delta_committed/applied count delta commits written / deltas applied
+# to a resident state (engine, registry image, chain replay);
+# delta_bytes vs delta_full_bytes is the byte story (what the deltas
+# cost vs what full images would have);  delta_chain_len is a GAUGE of
+# the writer's current chain sequence number (0 right after a full
+# base).  delta_rebases counts delta-role commits that fell back to a
+# full base (no chain / shape change / encoder refusal) plus push-
+# channel rebases;  delta_fallbacks counts resume-time chain breaks
+# skipped past (torn delta payload, reaped base, fingerprint
+# mismatch);  delta_push_fallbacks counts pushes that shipped a FULL
+# image because the replica's resident fingerprint didn't match;
+# delta_parity_refusals counts typed DeltaParityError refusals (gate
+# tripped, nothing mutated).
+_DELTA = {
+    'delta_committed': 0,
+    'delta_applied': 0,
+    'delta_bytes': 0,
+    'delta_full_bytes': 0,
+    'delta_chain_len': 0,       # gauge
+    'delta_rebases': 0,
+    'delta_fallbacks': 0,
+    'delta_pushes': 0,
+    'delta_push_fallbacks': 0,
+    'delta_page_applies': 0,
+    'delta_parity_refusals': 0,
+}
+
+
+def add_delta_stats(chain_len=None, **deltas):
+    """Accumulate weight-delta counters (chain_len is a GAUGE — set,
+    not added; everything else adds).  Keys arrive without the delta_
+    prefix (committed=1, bytes=n, push_fallbacks=1, ...)."""
+    with _STATE['lock']:
+        for k, v in deltas.items():
+            _DELTA['delta_' + k] += int(v)
+        if chain_len is not None:
+            _DELTA['delta_chain_len'] = int(chain_len)
+
+
+def delta_stats():
+    """Snapshot of the weight-delta counters (also merged into
+    summary() and dump_profile's 'delta' metadata lane)."""
+    with _STATE['lock']:
+        return dict(_DELTA)
 
 
 # host-hiding counters (PERF round 21): the overlap layer across both
@@ -867,6 +917,8 @@ def dump_profile():
                    'args': quant_stats()})
     events.append({'ph': 'M', 'name': 'loop', 'pid': 0,
                    'args': loop_stats()})
+    events.append({'ph': 'M', 'name': 'delta', 'pid': 0,
+                   'args': delta_stats()})
     events.append({'ph': 'M', 'name': 'overlap', 'pid': 0,
                    'args': overlap_stats()})
     with _STATE['lock']:
@@ -1114,10 +1166,25 @@ def summary(print_out=True):
                     lp['loop_consecutive_rollbacks']))
     lines.append('  loop_swap_migrated_slots=%d '
                  'loop_swap_dropped_slots=%d '
-                 'loop_swap_divergent_slots=%d'
+                 'loop_swap_divergent_slots=%d loop_lr_backoffs=%d'
                  % (lp['loop_swap_migrated_slots'],
                     lp['loop_swap_dropped_slots'],
-                    lp['loop_swap_divergent_slots']))
+                    lp['loop_swap_divergent_slots'],
+                    lp['loop_lr_backoffs']))
+    dl = delta_stats()
+    lines.append('  delta_committed=%d delta_applied=%d '
+                 'delta_bytes=%d delta_full_bytes=%d '
+                 'delta_chain_len=%d'
+                 % (dl['delta_committed'], dl['delta_applied'],
+                    dl['delta_bytes'], dl['delta_full_bytes'],
+                    dl['delta_chain_len']))
+    lines.append('  delta_rebases=%d delta_fallbacks=%d '
+                 'delta_pushes=%d delta_push_fallbacks=%d '
+                 'delta_page_applies=%d delta_parity_refusals=%d'
+                 % (dl['delta_rebases'], dl['delta_fallbacks'],
+                    dl['delta_pushes'], dl['delta_push_fallbacks'],
+                    dl['delta_page_applies'],
+                    dl['delta_parity_refusals']))
     ov = overlap_stats()
     lines.append('  overlap_train_steps=%d overlap_steps_ahead=%d '
                  'overlap_dispatch_wait_ms=%.3f '
@@ -1187,6 +1254,8 @@ def clear():
             _QUANT[k] = type(_QUANT[k])()
         for k in _LOOP:
             _LOOP[k] = 0
+        for k in _DELTA:
+            _DELTA[k] = 0
         for k in _OVERLAP:
             _OVERLAP[k] = type(_OVERLAP[k])()
         _BUCKET_RUNGS.clear()

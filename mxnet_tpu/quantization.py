@@ -6,7 +6,7 @@ The reference ships `quantize`/`dequantize` contrib ops
 AFFINE map of [min_range, max_range] onto [0, 255]; int8 is SYMMETRIC —
 the representable range is ±max(|min|, |max|) mapped onto ±127 (the
 -128 code is never produced, so negation stays exact).  This module is
-the ONE definition of that math, consumed by three arms:
+the ONE definition of that math, consumed by four arms:
 
   * `ops/contrib_ops.py` quantize/dequantize (capability parity with
     the reference, including the signed `out_type='int8'` mode);
@@ -15,7 +15,11 @@ the ONE definition of that math, consumed by three arms:
     (serving.py / serving_fleet.py);
   * the collective wire format — `dist.allreduce` int8/bf16 bucket
     wire with per-bucket scales and error-feedback residual carry
-    (dist.py / parallel/collectives.py).
+    (dist.py / parallel/collectives.py);
+  * the weight-delta format (delta.py, PERF round 22) — dense
+    checkpoint/push diffs quantized with `symmetric_scale` +
+    `quantize_int8_math`, carrying the SAME error-feedback residual
+    discipline as the wire at checkpoint granularity.
 
 Everything here is numpy/jax-polymorphic where noted: the `*_math`
 helpers take and return whatever array module their input came from

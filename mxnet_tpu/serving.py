@@ -962,6 +962,136 @@ class InferenceEngine(object):
                 total += int(np.prod(s.shape)) * 4
         return total
 
+    # ------------------------------------------------------------------
+    # in-place weight deltas (docs/SERVING.md, the delta push channel)
+    # ------------------------------------------------------------------
+    def _resident_host_state(self):
+        """Flat {'arg:NAME'/'aux:NAME': np.ndarray} view of the
+        resident weights (the serving_state key space).  Quant-live
+        names dequantize back to their original dtype (a LOSSY
+        round-trip — apply_delta exempts them from the crc gate);
+        hot-row tables read the full host copy, not the device
+        cache."""
+        ex = self._base_ex
+        state = {}
+        for prefix, d in (('arg:', ex.arg_dict), ('aux:', ex.aux_dict)):
+            for n, a in d.items():
+                if n in self._input_names:
+                    continue
+                if prefix == 'arg:' and n in self._hotrows:
+                    state[prefix + n] = np.asarray(self._hotrows[n].host)
+                elif prefix == 'arg:' and n in self._quant_names:
+                    codes = np.asarray(a.asnumpy())
+                    s = self._quant_scales[n]
+                    dt = np.dtype(self._quant_orig_dtype.get(
+                        n, 'float32'))
+                    if s is None:       # bf16 swap: plain cast back
+                        state[prefix + n] = codes.astype(dt)
+                    else:
+                        state[prefix + n] = (
+                            codes.astype(np.float32) *
+                            np.asarray(s)).astype(dt)
+                else:
+                    state[prefix + n] = np.asarray(a.asnumpy())
+        return state
+
+    def apply_delta(self, entries, meta, expect_fp=None,
+                    parity_tol=None):
+        """Apply one weight delta (delta.make_delta output / a shipped
+        delta payload) to the RESIDENT weights in place, at ZERO
+        re-warm compiles: _run reads each program's weight arrays
+        fresh per dispatch, so swapping the underlying device buffers
+        updates every rung without touching the program cache.
+
+        All gates run before any mutation (the delta core's staging
+        discipline): a base-fingerprint mismatch or per-entry crc
+        divergence raises DeltaChainError, a lossy delta whose
+        recorded rel_err exceeds `parity_tol` raises DeltaParityError
+        — in every refusal the engine still serves its previous
+        weights bit-for-bit.  Quant-live weights requantize the
+        applied value through the engine's own QuantConfig (codes +
+        scales swap together); hot-row tables update the host copy
+        and invalidate exactly the touched resident rows.
+
+        parity_tol defaults to the engine's QuantConfig.parity_tol
+        (or the DeltaConfig default for fp engines) — pass explicitly
+        to tighten/loosen per call.  Returns the applied meta's
+        new_fp (the resident chain fingerprint after this delta)."""
+        import jax
+        from . import delta as delta_mod
+        if self._closed:
+            raise MXNetError('InferenceEngine is closed')
+        if parity_tol is None:
+            parity_tol = (self._quant.parity_tol
+                          if self._quant is not None
+                          else delta_mod.DeltaConfig().parity_tol)
+        state = self._resident_host_state()
+        lossy = {'arg:' + n for n in self._quant_names}
+        new_state = delta_mod.apply_delta(
+            state, meta, entries, expect_fp=expect_fp,
+            parity_tol=parity_tol, skip_crc=lossy)
+        ex = self._base_ex
+        dev = self._ctx.jax_device()
+        resolved = []
+        for key in meta.get('entries', {}):
+            if key.startswith('arg:'):
+                n, d = key[4:], ex.arg_dict
+            elif key.startswith('aux:'):
+                n, d = key[4:], ex.aux_dict
+            else:
+                raise delta_mod.DeltaChainError(
+                    'delta entry %r is not in the serving key space '
+                    "('arg:'/'aux:')" % key)
+            if n not in d:
+                raise delta_mod.DeltaChainError(
+                    'delta touches %r which this engine does not hold'
+                    % key)
+            resolved.append((key, n, d))
+        for key, n, d in resolved:
+            new = np.asarray(new_state[key])
+            if d is ex.arg_dict and n in self._hotrows:
+                st = self._hotrows[n]
+                st.host = np.ascontiguousarray(
+                    new.astype(st.host.dtype, copy=False))
+                # invalidate exactly the touched resident rows — the
+                # next dispatch that wants them pages the fresh values
+                # in; untouched rows keep serving from cache
+                ids = entries.get(delta_mod._KIND_IDS + key)
+                if ids is None:
+                    st.resident.clear()
+                    st.prefetched.clear()
+                    st.free = list(range(st.capacity))
+                else:
+                    for u in np.asarray(ids).ravel().tolist():
+                        slot = st.resident.pop(int(u), None)
+                        if slot is not None:
+                            st.free.append(slot)
+                        st.prefetched.discard(int(u))
+            elif d is ex.arg_dict and n in self._quant_names:
+                quantized, _ = quantization.quantize_weights(
+                    {n: new}, self._quant)
+                q, s, orig_dt = quantized[n]
+                self._quant_orig_dtype[n] = orig_dt
+                d[n]._data = jax.device_put(q, dev)
+                if s is None:
+                    self._quant_scales[n] = None
+                else:
+                    sb = np.asarray(s, np.float32)
+                    if self._quant.per_channel:
+                        sb = sb.reshape((-1,) + (1,) * (q.ndim - 1))
+                    self._quant_scales[n] = jax.device_put(sb, dev)
+            else:
+                a = d[n]
+                new = new.astype(np.asarray(a.asnumpy()).dtype,
+                                 copy=False)
+                a._data = jax.device_put(new, dev)
+        if self._quant_names:
+            self._quant_scale_vals = tuple(
+                self._quant_scales[n] for n in self._quant_names
+                if self._quant_scales[n] is not None)
+        profiler.add_delta_stats(applied=1)
+        return meta.get('new_fp')
+
     def warmup(self):
         """AOT-compile every ladder rung (batch buckets x free-dim
         buckets) through exec_cache, then snapshot the cache stats —
@@ -1732,27 +1862,53 @@ def export_serving_checkpoint(step_dir, symbol, prefix, epoch=0):
     the net's parameter names for that to bind.  Optimizer state, RNG
     keys and ZeRO momentum shards are dropped: serving needs weights
     only.  The source checkpoint validates end-to-end (checksums,
-    manifest) before anything is written.  Returns `prefix`."""
-    from .elastic import _load_one
+    manifest — a delta-* commit replays its whole chain) before
+    anything is written.  Returns `prefix`."""
+    from .elastic import load_state
     from .model import save_checkpoint
     from . import ndarray as nd
-    _manifest, arrays = _load_one(step_dir)
-    args, auxs = {}, {}
-    for key, v in arrays.items():
-        if key.startswith('param:'):
-            args[key[len('param:'):]] = nd.array(np.asarray(v))
-        elif key.startswith('aux:'):
-            auxs[key[len('aux:'):]] = nd.array(np.asarray(v))
-        elif key.startswith(('gparam:', 'gaux:')):
-            kind, _i, name = key.split(':', 2)
-            dest = auxs if kind == 'gaux' else args
-            dest[name] = nd.array(np.asarray(v))
-        elif key.startswith('gfrozen:'):
-            _k, _i, name = key.split(':', 2)
-            args[name] = nd.array(np.asarray(v))
+    _manifest, arrays = load_state(step_dir)
+    args, auxs = serving_arrays(arrays)
     if not args:
         raise MXNetError(
             'export_serving_checkpoint: %s holds no parameter entries '
             '(is it an elastic checkpoint dir?)' % step_dir)
-    save_checkpoint(prefix, int(epoch), symbol, args, auxs)
+    save_checkpoint(prefix, int(epoch), symbol,
+                    {n: nd.array(a) for n, a in args.items()},
+                    {n: nd.array(a) for n, a in auxs.items()})
     return prefix
+
+
+def serving_arrays(arrays):
+    """(args, auxs) numpy dicts of the WEIGHT entries of one elastic
+    checkpoint's flat array dict — the export_serving_checkpoint
+    entry mapping, split out so the delta push channel can fingerprint
+    and diff serving states without writing a .params file."""
+    args, auxs = {}, {}
+    for key, v in arrays.items():
+        if key.startswith('param:'):
+            args[key[len('param:'):]] = np.asarray(v)
+        elif key.startswith('aux:'):
+            auxs[key[len('aux:'):]] = np.asarray(v)
+        elif key.startswith(('gparam:', 'gaux:')):
+            kind, _i, name = key.split(':', 2)
+            dest = auxs if kind == 'gaux' else args
+            dest[name] = np.asarray(v)
+        elif key.startswith('gfrozen:'):
+            _k, _i, name = key.split(':', 2)
+            args[name] = np.asarray(v)
+    return args, auxs
+
+
+def serving_state(step_dir):
+    """Flat ``{'arg:NAME'/'aux:NAME': np.ndarray}`` serving state of
+    one committed checkpoint dir (full or delta) — the canonical key
+    space the push channel's delta chain speaks: the pusher encodes
+    deltas over it, InferenceEngine.apply_delta resolves the same
+    keys against its resident weights."""
+    from .elastic import load_state
+    _manifest, arrays = load_state(step_dir)
+    args, auxs = serving_arrays(arrays)
+    state = {'arg:' + n: a for n, a in args.items()}
+    state.update({'aux:' + n: a for n, a in auxs.items()})
+    return state

@@ -734,6 +734,93 @@ class ModelRegistry(object):
                           % (ent.name, e))
             return None
 
+    def apply_delta(self, name, entries, meta, expect_fp=None,
+                    parity_tol=None):
+        """Apply one weight delta to a registered model WITHOUT a
+        full reload: a RESIDENT model updates its engine's device
+        weights in place (zero re-warm compiles —
+        InferenceEngine.apply_delta); a paged-out model with a
+        quantized host image updates the IMAGE instead (dequantize ->
+        apply -> requantize per touched weight), so the next page-in
+        already reflects the push without ever re-reading a
+        checkpoint.  All the delta gates apply (typed DeltaChainError
+        / DeltaParityError, nothing mutated on refusal); a model that
+        is neither resident nor imaged raises MXNetError — the caller
+        falls back to a full (re)load.  Returns the delta's new_fp."""
+        from . import delta as delta_mod
+        ent = self._entry(name)
+        with ent.lock:
+            if ent.dead:
+                raise MXNetError('model %r is shutting down' % name)
+            if ent.engine is not None and not ent.engine.closed:
+                if not hasattr(ent.engine, 'apply_delta'):
+                    raise MXNetError(
+                        'model %r is served by %s, which does not '
+                        'take in-place deltas — full reload required'
+                        % (name, type(ent.engine).__name__))
+                fp = ent.engine.apply_delta(entries, meta,
+                                            expect_fp=expect_fp,
+                                            parity_tol=parity_tol)
+                ent.last_used = time.time()
+                return fp
+            if ent.paged is None:
+                raise MXNetError(
+                    'model %r is neither resident nor paged — apply '
+                    'the delta after a load, or full-load instead'
+                    % name)
+            image = ent.paged
+            cfg = ent.page_dtype
+            if parity_tol is None:
+                parity_tol = getattr(cfg, 'parity_tol', None) or \
+                    delta_mod.DeltaConfig().parity_tol
+            state = {}
+            for n, (q, s, dt) in image['quantized'].items():
+                state['arg:' + n] = quantization.dequantize_weight(
+                    q, s, cfg, dtype=np.dtype(dt))
+            for n, a in image['passthrough'].items():
+                state['arg:' + n] = np.asarray(a)
+            for n, a in image['aux'].items():
+                state['aux:' + n] = np.asarray(a)
+            lossy = {'arg:' + n for n in image['quantized']}
+            new_state = delta_mod.apply_delta(
+                state, meta, entries, expect_fp=expect_fp,
+                parity_tol=parity_tol, skip_crc=lossy)
+            plan = []
+            for key in meta.get('entries', {}):
+                n = key[4:]
+                if key.startswith('arg:') and n in image['quantized']:
+                    plan.append((key, n, 'quantized'))
+                elif key.startswith('arg:') and \
+                        n in image['passthrough']:
+                    plan.append((key, n, 'passthrough'))
+                elif key.startswith('aux:') and n in image['aux']:
+                    plan.append((key, n, 'aux'))
+                else:
+                    raise delta_mod.DeltaChainError(
+                        'delta touches %r which the page image of %r '
+                        'does not hold' % (key, name))
+            for key, n, dest in plan:
+                new = np.asarray(new_state[key])
+                if dest == 'quantized':
+                    requant, _pass = quantization.quantize_weights(
+                        {n: new}, cfg)
+                    image['quantized'][n] = requant[n]
+                elif dest == 'passthrough':
+                    image['passthrough'][n] = new
+                else:
+                    image['aux'][n] = new
+            nbytes = quantization.quantized_nbytes(
+                image['quantized'],
+                list(image['passthrough'].values()) +
+                list(image['aux'].values()))
+            with self._lock:
+                self._paged_bytes += int(nbytes) - ent.paged_bytes
+                ent.paged_bytes = int(nbytes)
+            image['nbytes'] = int(nbytes)
+            profiler.add_delta_stats(applied=1, page_applies=1)
+            self._note_quant_gauges()
+            return meta.get('new_fp')
+
     def _note_quant_gauges(self):
         with self._lock:
             n = sum(1 for e in self._entries.values()

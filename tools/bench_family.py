@@ -68,6 +68,16 @@ def main():
                         'overhead A/B (no-checkpoint vs async cadence '
                         'vs blocking cadence; one bench.py child) '
                         'instead of the model-family sweep')
+    p.add_argument('--delta', action='store_true',
+                   help='run the BENCH_DELTA incremental '
+                        'delta-checkpoint / weight-delta push A/B '
+                        '(full-every-commit vs incremental chain '
+                        'commit bytes on an embedding workload, '
+                        'chain-replay resume parity, sparse delta '
+                        'applied to a live engine bitwise vs full '
+                        'reload, dense int8 delta parity-gated; one '
+                        'bench.py child) instead of the model-family '
+                        'sweep')
     p.add_argument('--serve-fleet', action='store_true',
                    help='run the BENCH_FLEET fleet serving-tier smoke '
                         '(SLO vs single-knob batching through the '
@@ -99,12 +109,13 @@ def main():
                             '..', 'bench.py')
     if args.gluon or args.overlap or args.bucket or args.pipe or \
             args.ckpt or args.serve_fleet or args.int8 or args.loop \
-            or args.embed:
+            or args.embed or args.delta:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
                      else ('bucket', 'BENCH_BUCKET') if args.bucket
                      else ('pipe', 'BENCH_PIPE') if args.pipe
                      else ('ckpt', 'BENCH_CKPT') if args.ckpt
+                     else ('delta', 'BENCH_DELTA') if args.delta
                      else ('embed', 'BENCH_EMBED') if args.embed
                      else ('int8', 'BENCH_INT8') if args.int8
                      else ('loop', 'BENCH_LOOP') if args.loop
