@@ -55,6 +55,11 @@ BENCH_INT8=1 (low-precision stack A/B: fp vs int8 serving with parity
     gate + quantized-registry residency/thrash, and the 2-worker
     allreduce wire-format A/B with loss-curve parity and per-mode
     determinism; BENCH_INT8_* knobs),
+BENCH_RING=1 (cross-host gradient transport topology A/B: star
+    coordinator vs peer-to-peer ring reduce-scatter vs ring+async
+    overlap, launcher-spawned workers, rank-0 ingress counter-verified,
+    per-mode bitwise loss determinism, plus the embedding COO-vs-dense
+    wire-bytes arm — see ring_bench() for the BENCH_RING_* knobs),
 BENCH_LOOP=1 (diurnal autoscale drill: open-loop diurnal trace through
     a real autoscaling localhost fleet — scale-up lag, scale-down flap
     count, peak shed rate; see loop_bench() for the BENCH_LOOP_* knobs),
@@ -3021,6 +3026,225 @@ def int8_bench():
     }))
 
 
+# ---------------------------------------------------------------------------
+# BENCH_RING=1: cross-host gradient transport topologies (PERF round 23)
+# — star coordinator vs p2p ring reduce-scatter, async overlap, COO wire
+# ---------------------------------------------------------------------------
+
+def _ring_bench_child():
+    """Worker body of the topology A/B (spawned world× under
+    tools/launch.py with BENCH_RING_CHILD=1): train the same tiny MLP
+    through a dist_sync kvstore under whatever MXNET_TPU_DIST_TOPOLOGY
+    / MXNET_TPU_DIST_OVERLAP the parent set, then run one embedding
+    COO round against one densified dense round of the SAME gradient.
+    EVERY rank prints its own counters as a tagged JSON line — the
+    parent reconstructs rank-0 process ingress from them (under star,
+    every rank's tx lands at the rank-0-process coordinator; under
+    ring, only rank 0's own rx arrives there)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import dist, profiler
+    from mxnet_tpu import sym as S
+
+    rt = dist.initialize()
+    steps = int(os.environ.get('BENCH_RING_STEPS', 12))
+    bsz, dim, classes = 32, 16, 4
+    data = S.Variable('data')
+    h = S.Activation(S.FullyConnected(data, name='fc1', num_hidden=32),
+                     act_type='relu')
+    net = S.SoftmaxOutput(S.FullyConnected(h, name='fc2',
+                                           num_hidden=classes),
+                          name='softmax')
+    mod = mx.mod.Module(net)
+    mod.bind(data_shapes=[mx.io.DataDesc('data', (bsz, dim))],
+             label_shapes=[mx.io.DataDesc('softmax_label', (bsz,))])
+    mx.random.seed(7)
+    mod.init_params(initializer=mx.init.Xavier())
+    kv = mx.kvstore.create('dist_sync')
+    mod.init_optimizer(kvstore=kv, optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.5,
+                                         'momentum': 0.9})
+    feed = np.random.RandomState(100 + rt.rank)   # per-rank dp shard
+    losses = []
+    tic = time.time()
+    for _ in range(steps):
+        x = feed.rand(bsz, dim).astype(np.float32)
+        y = (feed.rand(bsz) * classes).astype(np.float32)
+        batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                label=[mx.nd.array(y)])
+        mod.forward_backward(batch)
+        mod.update()
+        mod.forward(batch, is_train=False)
+        p = mod.get_outputs()[0].asnumpy()
+        losses.append(float(-np.log(np.clip(
+            p[np.arange(bsz), y.astype(int)], 1e-9, 1.0)).mean()))
+    train_s = time.time() - tic
+    kv.barrier()
+    ds = dict(profiler.dist_stats())   # train-phase snapshot
+
+    # -- embedding wire arm: COO round vs densified round, same grad --
+    vocab = int(os.environ.get('BENCH_RING_VOCAB', 4096))
+    edim = int(os.environ.get('BENCH_RING_EDIM', 16))
+    touched = int(os.environ.get('BENCH_RING_TOUCHED', 64))
+    rng = np.random.RandomState(500 + rt.rank)
+    g = np.zeros((vocab, edim), np.float32)
+    g[rng.randint(0, vocab, touched)] = \
+        rng.randn(touched, edim).astype(np.float32)
+    nz = np.flatnonzero(np.any(g != 0.0, axis=1))
+    dist.allreduce_coo(nz, np.ascontiguousarray(g[nz]),
+                       name='bench_coo', vocab=vocab)
+    mid = dict(profiler.dist_stats())
+    dist.allreduce([g], name='bench_dense')
+    end = dict(profiler.dist_stats())
+    coo_bytes = (mid['dist_tx_bytes'] + mid['dist_rx_bytes'] -
+                 ds['dist_tx_bytes'] - ds['dist_rx_bytes'])
+    dense_bytes = (end['dist_tx_bytes'] + end['dist_rx_bytes'] -
+                   mid['dist_tx_bytes'] - mid['dist_rx_bytes'])
+    # ONE os-level write: every rank shares the launcher's stdout pipe
+    # and print()'s separate text/newline writes interleave under
+    # contention (pipe writes under PIPE_BUF are atomic)
+    sys.stdout.write('RINGBENCH ' + json.dumps({
+        'rank': rt.rank,
+        'world': rt.world,
+        'losses': [round(v, 10) for v in losses],
+        'train_s': round(train_s, 3),
+        'tx_bytes': ds['dist_tx_bytes'],
+        'rx_bytes': ds['dist_rx_bytes'],
+        'star_bytes': ds['dist_star_bytes'],
+        'ring_bytes': ds['dist_ring_bytes'],
+        'overlap_ms': round(ds['dist_overlap_ms'], 3),
+        'rounds': ds['dist_allreduce_rounds'],
+        'coo_bytes': coo_bytes,
+        'dense_bytes': dense_bytes,
+    }) + '\n')
+    sys.stdout.flush()
+    kv.barrier()   # nobody tears the ring down mid-round
+    rt.shutdown()
+
+
+def ring_bench():
+    """BENCH_RING=1: measure the cross-host gradient transport
+    topologies (mxnet_tpu/dist.py ring reduce-scatter + all-gather,
+    async overlap handles, sparse COO wire) and emit ONE JSON line
+    covering the four acceptance claims of PERF round 23:
+
+      (a) **rank-0 ingress** — under the star (coordinator) topology
+          every rank's gradient upload lands in rank 0's process:
+          ingress grows O(world x bytes).  Under the ring each rank
+          receives only ~2x bytes x (world-1)/world from its left
+          peer.  Both are reconstructed from the per-rank
+          dist_tx/rx_bytes counters (counter-verified, not inferred)
+          and the ratio must be >= (world-1)/2.
+      (b) **per-mode bitwise determinism** — the ring arm AND the
+          ring+overlap arm repeated must each reproduce their loss
+          curve BIT-identically; star-vs-ring and ring-vs-overlap
+          must agree within BENCH_RING_TOL (summation ORDER differs:
+          star sums in rank order, the batched ring in per-chunk
+          rotation order over one flattened buffer, the overlapped
+          ring per key — at world 2 all three coincide bitwise).
+      (c) **async overlap** — the ring+overlap arm must bank
+          dist_overlap_ms > 0 (optimizer math for key k running while
+          key k+1's bytes are on the wire) while keeping (b).
+      (d) **embedding COO wire** — one sparse embedding gradient
+          crossing as deduped (unique_ids, rows) COO must move >= 10x
+          fewer bytes than the same gradient densified.
+
+    Knobs: BENCH_RING_WORLD (3), BENCH_RING_STEPS (12),
+    BENCH_RING_TOL (1e-3), BENCH_RING_VOCAB / _EDIM / _TOUCHED
+    (4096 / 16 / 64).
+    """
+    world = int(os.environ.get('BENCH_RING_WORLD', 3))
+    tol = float(os.environ.get('BENCH_RING_TOL', 1e-3))
+    launch = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'tools', 'launch.py')
+
+    def arm(topology, overlap=False):
+        env = dict(os.environ, BENCH_RING='1', BENCH_RING_CHILD='1',
+                   JAX_PLATFORMS='cpu')
+        for stale in ('DMLC_PS_ROOT_URI', 'DMLC_PS_ROOT_PORT',
+                      'DMLC_ROLE', 'DMLC_NUM_WORKER',
+                      'DMLC_NUM_SERVER', 'DMLC_WORKER_ID',
+                      'MXNET_TPU_DIST_PORT',
+                      'MXNET_TPU_DIST_RING_PORT',
+                      'MXNET_TPU_DIST_WIRE_DTYPE',
+                      'MXNET_TPU_DIST_OVERLAP',
+                      'MXNET_TPU_DIST_TOPOLOGY'):
+            env.pop(stale, None)
+        env['MXNET_TPU_DIST_TOPOLOGY'] = topology
+        if overlap:
+            env['MXNET_TPU_DIST_OVERLAP'] = '1'
+        proc = subprocess.run(
+            [sys.executable, launch, '-n', str(world), '-s', '0',
+             '--launcher', 'local', sys.executable,
+             os.path.abspath(__file__)],
+            env=env, capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('ring bench child (%s%s) failed rc=%d'
+                               % (topology,
+                                  '+overlap' if overlap else '',
+                                  proc.returncode))
+        ranks = {}
+        for line in proc.stdout.splitlines():
+            if line.startswith('RINGBENCH '):
+                rec = json.loads(line[len('RINGBENCH '):])
+                ranks[rec['rank']] = rec
+        if sorted(ranks) != list(range(world)):
+            sys.stderr.write(proc.stderr)
+            raise RuntimeError('ring bench (%s): got rank lines %s, '
+                               'expected %d ranks'
+                               % (topology, sorted(ranks), world))
+        return [ranks[r] for r in range(world)]
+
+    star = arm('star')
+    ring = arm('ring')
+    ring2 = arm('ring')                   # per-mode determinism
+    ringov = arm('ring', overlap=True)    # async overlap arm
+    ringov2 = arm('ring', overlap=True)   # ...is a mode of its own
+
+    # rank-0 PROCESS ingress: star pushes all land at the coordinator
+    # (rank 0's process) — sum every rank's tx; ring peers talk p2p —
+    # only rank 0's own rx arrives there
+    star_ingress = sum(r['tx_bytes'] for r in star)
+    ring_ingress = ring[0]['rx_bytes']
+    ingress_ratio = star_ingress / max(1, ring_ingress)
+    loss_diff = max(abs(a - b) for a, b in zip(star[0]['losses'],
+                                               ring[0]['losses']))
+    ov_diff = max(abs(a - b) for a, b in zip(ringov[0]['losses'],
+                                             ring[0]['losses']))
+    coo_ratio = ring[0]['dense_bytes'] / max(1, ring[0]['coo_bytes'])
+
+    print(json.dumps({
+        'metric': 'ring_rank0_ingress_ratio',
+        'value': round(ingress_ratio, 2),
+        'unit': 'star_bytes/ring_bytes',
+        'world': world,
+        'steps': len(ring[0]['losses']),
+        'star_rank0_ingress_bytes': star_ingress,
+        'ring_rank0_ingress_bytes': ring_ingress,
+        'ingress_gate': round((world - 1) / 2.0, 2),
+        'ingress_ok': bool(ingress_ratio >= (world - 1) / 2.0),
+        'star_tx_per_rank': star[0]['tx_bytes'],
+        'ring_tx_per_rank': ring[0]['tx_bytes'],
+        'train_s_star': star[0]['train_s'],
+        'train_s_ring': ring[0]['train_s'],
+        'train_s_ring_overlap': ringov[0]['train_s'],
+        'loss_diff_star_vs_ring': round(loss_diff, 9),
+        'loss_parity_ok': bool(loss_diff < tol),
+        'ring_deterministic': bool(ring[0]['losses'] ==
+                                   ring2[0]['losses']),
+        'overlap_deterministic': bool(ringov[0]['losses'] ==
+                                      ringov2[0]['losses']),
+        'loss_diff_ring_vs_overlap': round(ov_diff, 9),
+        'overlap_parity_ok': bool(ov_diff < tol),
+        'overlap_ms': ringov[0]['overlap_ms'],
+        'overlap_ok': bool(ringov[0]['overlap_ms'] > 0),
+        'coo_bytes': ring[0]['coo_bytes'],
+        'dense_bytes': ring[0]['dense_bytes'],
+        'coo_bytes_ratio': round(coo_ratio, 1),
+        'coo_ok': bool(coo_ratio >= 10.0),
+    }))
+
+
 def is_oom(text):
     return 'RESOURCE_EXHAUSTED' in text or 'Out of memory' in text
 
@@ -3077,6 +3301,12 @@ def main():
 def _bench_main():
     if os.environ.get('BENCH_INT8_WIRE_CHILD', '') == '1':
         _int8_wire_child()   # one rank of the wire A/B (under launch.py)
+        return
+    if os.environ.get('BENCH_RING_CHILD', '') == '1':
+        _ring_bench_child()   # one rank of the topology A/B
+        return
+    if os.environ.get('BENCH_RING', '') == '1':
+        ring_bench()   # star vs ring vs ring+overlap, COO wire arm
         return
     if os.environ.get('BENCH_INT8', '') == '1':
         int8_bench()   # low-precision stack: serving/registry/wire

@@ -52,9 +52,20 @@ HMAC/Poly1305-tagged frames, restricted codec — see its trust-boundary
 note); the coordinator is ~the scheduler role of the reference's
 tracker, minus any data-path involvement in SPMD mode.
 
+Topology (MXNET_TPU_DIST_TOPOLOGY): the coordinator-mediated sum above
+is the 'star' — O(world × bytes) ingress at rank 0.  'ring' keeps the
+coordinator for bootstrap/health/rendezvous but moves the gradient
+bytes onto peer-to-peer DCN links: a chunked ring reduce-scatter +
+all-gather (~2 × bytes/world per host) with a FIXED rotation order so
+every rank still decodes identical bytes per mode.  `allreduce_async`
+overlaps the cross-host round with local work (wait at the optimizer
+boundary); `allreduce_coo` ships sparse embedding gradients as deduped
+(unique_ids, rows) pairs on either topology.
+
 Fault injection (tests + dryrun): MXNET_TPU_FAULT_HEARTBEAT_DROP
 suppresses a rank's heartbeats without killing it;
-MXNET_TPU_FAULT_BARRIER_STALL_S makes one rank arrive late;
+MXNET_TPU_FAULT_BARRIER_STALL_S makes one rank arrive late (extends to
+ring hops; MXNET_TPU_FAULT_RING_STALL_S scopes it to rings);
 MXNET_TPU_FAULT_KILL_RANK gates KILL_AT_STEP to one rank.  Counters:
 profiler.dist_stats().  Docs: docs/DIST.md.
 """
@@ -134,6 +145,55 @@ def dead_after_s():
                       5.0 * heartbeat_interval_s())
 
 
+def topology_from_env(explicit=None):
+    """Resolve the cross-host allreduce topology: an explicit API
+    value wins, else MXNET_TPU_DIST_TOPOLOGY, else 'star'.  'star' is
+    the coordinator-mediated sum (rank-order, one ingress point);
+    'ring' is the peer-to-peer chunked reduce-scatter + all-gather
+    (fixed rotation order, ~2 × bytes/world per host).  Every rank
+    must resolve the same value — the ring hop protocol checks and
+    names a mismatch instead of desyncing."""
+    v = explicit if explicit is not None else \
+        os.environ.get('MXNET_TPU_DIST_TOPOLOGY', '')
+    v = str(v).strip().lower()
+    if v in ('', 'star', 'coordinator'):
+        return 'star'
+    if v == 'ring':
+        return 'ring'
+    raise MXNetError("dist topology must be 'star' or 'ring', got %r "
+                     '(MXNET_TPU_DIST_TOPOLOGY)' % (v,))
+
+
+def overlap_active():
+    """True when MXNET_TPU_DIST_OVERLAP=1: the KVStore dist_sync path
+    launches each key's cross-host reduction asynchronously as soon as
+    its mesh-local merge lands (allreduce_async) and waits per key at
+    the optimizer boundary, instead of one blocking batched round."""
+    return os.environ.get('MXNET_TPU_DIST_OVERLAP', '').strip() in \
+        ('1', 'true')
+
+
+def _merge_coo(ids_list, rows_list):
+    """Deterministically merge COO (ids, rows) contributions: rows of
+    duplicate ids are summed in the ORDER GIVEN (stable sort +
+    sequential reduceat — no atomics, no arrival-order dependence), so
+    callers that fix the list order (rank order on star, rotation
+    order on ring) get bitwise-reproducible sums.  Returns
+    (sorted unique int64 ids, float rows) with zero-size handled."""
+    ids = np.concatenate([np.asarray(i, np.int64).ravel()
+                          for i in ids_list]) if ids_list else \
+        np.zeros(0, np.int64)
+    rows = np.concatenate([np.asarray(r) for r in rows_list], axis=0) \
+        if rows_list else np.zeros((0, 0), np.float32)
+    if ids.size == 0:
+        return ids, rows
+    order = np.argsort(ids, kind='stable')
+    ids, rows = ids[order], rows[order]
+    uids, starts = np.unique(ids, return_index=True)
+    out = np.add.reduceat(rows, starts, axis=0)
+    return uids, out.astype(rows.dtype, copy=False)
+
+
 # ---------------------------------------------------------------------------
 # coordinator (the collapsed scheduler/tracker role)
 # ---------------------------------------------------------------------------
@@ -165,6 +225,12 @@ class Coordinator(object):
         # of one stream serialize (ranks block fetching round n before
         # contributing n+1).  LRU-bounded (_WIRE_CODEC_CAP).
         self._wire_codecs = OrderedDict()
+        # ring rendezvous table: rank -> (host, port) of that rank's
+        # peer-to-peer ring listener.  The HOST is the source address
+        # of the rank's control connection — the address peers can
+        # actually reach it at (a rank cannot reliably know its own
+        # externally-visible address behind NAT/multi-homed hosts).
+        self._ring_addrs = {}
         self._stopped = False
         self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -288,8 +354,52 @@ class Coordinator(object):
                                          len(members)))
                 self._cv.wait(min(0.2, deadline - now))
 
+    def _handle_ring_addr(self, rank, port, host):
+        """Register one rank's ring listener endpoint (re-registration
+        overwrites — a rebuilt link may land on a new ephemeral
+        port)."""
+        rank = int(rank)
+        with self._cv:
+            self._ring_addrs[rank] = (str(host), int(port))
+            self._last_seen[rank] = time.monotonic()
+            self._cv.notify_all()
+        return ('ok',)
+
+    def _handle_ring_peers(self, timeout):
+        """Block until EVERY member rank has registered a ring
+        listener, then return the full (rank, host, port) table.  A
+        ring cannot form around a hole, so this fails fast naming dead
+        or absent ranks instead of hanging."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cv:
+            while True:
+                self._scan_dead_locked()
+                members = self._members_locked(live_only=False)
+                dead = sorted(self._dead & members)
+                if dead:
+                    return ('err',
+                            'ring setup failed: rank(s) %s are dead '
+                            '(no heartbeat for > %.1fs) — recover via '
+                            'coordinated elastic restart'
+                            % (dead, self.dead_after))
+                if members <= set(self._ring_addrs):
+                    return ('ok', sorted(
+                        (r, h, p)
+                        for r, (h, p) in self._ring_addrs.items()
+                        if r in members))
+                now = time.monotonic()
+                if now >= deadline:
+                    absent = sorted(members - set(self._ring_addrs))
+                    return ('err',
+                            'ring setup timed out after %.1fs: rank(s)'
+                            ' %s never registered a ring listener — '
+                            'are they running with '
+                            'MXNET_TPU_DIST_TOPOLOGY=ring too?'
+                            % (float(timeout), absent))
+                self._cv.wait(min(0.2, deadline - now))
+
     def _handle_allreduce(self, name, rnd, rank, values, timeout,
-                          wire='fp32', scales=None):
+                          wire='fp32', scales=None, kind='dense'):
         """Host-level sum over live ranks: each rank contributes a
         tuple of arrays for (name, round); the last contributor sums
         (deterministic rank order — every rank receives IDENTICAL
@@ -305,7 +415,7 @@ class Coordinator(object):
         the identical compressed bytes, so per-mode determinism
         holds."""
         rank = int(rank)
-        key = (str(name), int(rnd))
+        key = (str(name), int(rnd), str(kind))
         deadline = time.monotonic() + float(timeout)
         wire = str(wire or 'fp32')
         values = tuple(np.ascontiguousarray(v) for v in values)
@@ -366,7 +476,8 @@ class Coordinator(object):
                     self._cv.release()
                     err = result = None
                     try:
-                        result = self._sum_parts(name, wire, parts)
+                        result = self._sum_parts(name, wire, parts,
+                                                 kind)
                     except Exception as e:   # mismatched shapes etc.
                         err = ('allreduce %r failed to sum: %s'
                                % (name, e))
@@ -394,13 +505,19 @@ class Coordinator(object):
                 self._reduces.pop(key, None)
             return ('ok', result)
 
-    def _sum_parts(self, name, wire, parts):
+    def _sum_parts(self, name, wire, parts, kind='dense'):
         """Rank-order sum of one round's contributions (runs OUTSIDE
         the condition variable — see the summing block).  fp32 rounds
         sum raw arrays; compressed rounds dequantize each rank's
         codes first, sum in float32, and re-quantize the result
-        through the stream's coordinator-side error-feedback codec."""
+        through the stream's coordinator-side error-feedback codec.
+        COO rounds ('allreduce_coo') merge each rank's (uids, rows)
+        pair in rank order via _merge_coo — still one deterministic
+        byte stream every rank fetches."""
         ranks = sorted(parts)
+        if kind == 'coo':
+            return _merge_coo([parts[r][0][0] for r in ranks],
+                              [parts[r][0][1] for r in ranks])
         if wire == 'fp32':
             sums = []
             for i in range(len(parts[ranks[0]][0])):
@@ -432,6 +549,10 @@ class Coordinator(object):
     # -- connection loop ---------------------------------------------------
     def _serve_conn(self, conn):
         try:
+            peer_host = conn.getpeername()[0]
+        except OSError:
+            peer_host = '127.0.0.1'
+        try:
             while True:
                 msg = _recv_msg(conn)
                 op = msg[0]
@@ -450,6 +571,15 @@ class Coordinator(object):
                     reply = self._handle_allreduce(msg[1], msg[2],
                                                    msg[3], msg[4],
                                                    msg[5], *msg[6:8])
+                elif op == 'allreduce_coo':
+                    reply = self._handle_allreduce(
+                        msg[1], msg[2], msg[3], (msg[4], msg[5]),
+                        msg[6], kind='coo')
+                elif op == 'ring_addr':
+                    reply = self._handle_ring_addr(msg[1], msg[2],
+                                                   peer_host)
+                elif op == 'ring_peers':
+                    reply = self._handle_ring_peers(msg[1])
                 elif op == 'bye':
                     reply = self._handle_bye(msg[1])
                 elif op == 'stop':
@@ -508,6 +638,179 @@ class Coordinator(object):
 
 
 # ---------------------------------------------------------------------------
+# ring transport (peer-to-peer DCN links; coordinator does rendezvous only)
+# ---------------------------------------------------------------------------
+
+class _RingLink(object):
+    """One rank's peer-to-peer ring transport: a listener its LEFT
+    neighbor ((rank-1) % world) dials, and an outbound connection to
+    its RIGHT neighbor ((rank+1) % world).  Endpoints rendezvous
+    through the coordinator ('ring_addr'/'ring_peers'); frames ride
+    the kvstore_server codec (length-prefixed, HMAC-tagged), so the
+    DMLC_PS_TOKEN trust boundary is unchanged.  The listener port
+    comes from the tools/launch.py contract
+    (MXNET_TPU_DIST_RING_PORT + rank) when exported, else ephemeral
+    (fine single-host; the rendezvous carries whatever was bound)."""
+
+    def __init__(self, rt, deadline):
+        from .kvstore_server import KVStoreServer
+        self.rank = rt.rank
+        self.world = rt.world
+        self.left_rank = (rt.rank - 1) % rt.world
+        self.right_rank = (rt.rank + 1) % rt.world
+        self.left = self.right = None
+        base = os.environ.get('MXNET_TPU_DIST_RING_PORT', '').strip()
+        port = (int(base) + rt.rank) if base else 0
+        # the listener lives on THIS host (unlike the coordinator's
+        # advertised root address): loopback when the whole job is
+        # loopback, else all interfaces — which demands a real token
+        bind_addr = os.environ.get('DMLC_PS_BIND_URI', '').strip()
+        if not bind_addr and rt.address in ('127.0.0.1', 'localhost'):
+            bind_addr = '127.0.0.1'
+        KVStoreServer._check_bind_policy(bind_addr)
+        self.listener = socket.socket(socket.AF_INET,
+                                      socket.SOCK_STREAM)
+        self.listener.setsockopt(socket.SOL_SOCKET,
+                                 socket.SO_REUSEADDR, 1)
+        try:
+            self.listener.bind((bind_addr, port))
+            self.listener.listen(4)
+            self.port = self.listener.getsockname()[1]
+            self._rendezvous(rt, deadline)
+        except MXNetError:
+            self.close()
+            raise
+        except OSError as e:
+            self.close()
+            raise MXNetError(
+                'ring setup: rank %d could not bind its ring listener '
+                '(port %s): %s — tools/launch.py probes and exports '
+                'MXNET_TPU_DIST_RING_PORT precisely to avoid this'
+                % (rt.rank, port or 'ephemeral', e))
+
+    def _rendezvous(self, rt, deadline):
+        """Register our listener, fetch the full table, then
+        concurrently accept-left and connect-right (every rank does
+        both at once — sequencing would deadlock the cycle)."""
+        rt._rpc('ring_addr', self.rank, self.port)
+        budget = max(1.0, deadline - time.monotonic())
+        peers = rt._rpc('ring_peers', budget, timeout=budget + 15.0)
+        table = {int(r): (str(h), int(p)) for r, h, p in peers}
+        rhost, rport = table[self.right_rank]
+        box = {}
+
+        def accept_left():
+            self.listener.settimeout(0.25)
+            while time.monotonic() < deadline:
+                try:
+                    conn, _ = self.listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError as e:
+                    box['aerr'] = e
+                    return
+                try:
+                    conn.settimeout(
+                        max(1.0, deadline - time.monotonic()))
+                    hello = _recv_msg(conn)
+                    if hello[0] == 'ring_hello' and \
+                            int(hello[1]) == self.left_rank:
+                        conn.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                        _tune_sock_bufs(conn)
+                        conn.settimeout(None)
+                        box['left'] = conn
+                        return
+                    conn.close()    # stray dialer: keep listening
+                except (ConnectionError, OSError, ValueError,
+                        MXNetError):
+                    conn.close()    # bad frame/auth: keep listening
+            box['aerr'] = 'timed out'
+
+        t = threading.Thread(target=accept_left, daemon=True,
+                             name='dist-ring-accept')
+        t.start()
+        delay, last = 0.05, None
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise MXNetError(
+                    'ring setup: rank %d could not connect to right '
+                    'neighbor rank %d at %s:%d (last error: %s)'
+                    % (self.rank, self.right_rank, rhost, rport, last))
+            try:
+                s = socket.create_connection(
+                    (rhost, rport), timeout=min(5.0, max(0.1, budget)))
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                _tune_sock_bufs(s)
+                _send_msg(s, ('ring_hello', self.rank))
+                s.settimeout(None)
+                self.right = s
+                break
+            except OSError as e:
+                last = e
+                time.sleep(min(delay, max(0.0, budget)))
+                delay = min(1.0, delay * 2)
+        t.join(max(0.1, deadline - time.monotonic()))
+        left = box.get('left')
+        if left is None:
+            raise MXNetError(
+                'ring setup: rank %d never heard from left neighbor '
+                'rank %d on its ring listener (port %d): %s'
+                % (self.rank, self.left_rank, self.port,
+                   box.get('aerr', 'timed out')))
+        self.left = left
+
+    def close(self):
+        for s in (self.left, self.right, self.listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self.left = self.right = None
+
+
+class AllreduceHandle(object):
+    """Ticket for one in-flight `allreduce_async` round: `wait()` at
+    the optimizer boundary blocks to the result (re-raising the
+    round's error there, where the caller can act on it) and records
+    the wall time the round overlapped with the caller's other work
+    (profiler `dist_overlap_ms`)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self._t_launch = time.perf_counter()
+        self._t_done = None
+        self._counted = False
+
+    def done(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        from . import profiler
+        t_wait = time.perf_counter()
+        self._event.wait(timeout)
+        if not self._event.is_set():
+            raise MXNetError(
+                'allreduce_async: round still in flight after %.1fs'
+                % float(timeout))
+        if not self._counted:
+            self._counted = True
+            # overlap = time the round ran while the caller was busy
+            # elsewhere: from launch to whichever came first, the
+            # round finishing or the caller showing up to wait
+            profiler.add_dist_stats(overlap_ms=max(
+                0.0, (min(self._t_done, t_wait) - self._t_launch))
+                * 1e3)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+# ---------------------------------------------------------------------------
 # per-process runtime (client + optional embedded coordinator)
 # ---------------------------------------------------------------------------
 
@@ -539,8 +842,23 @@ class DistRuntime(object):
         self._dead_lock = threading.Lock()
         self._watched = weakref.WeakSet()
         self._round = {}              # allreduce name -> round counter
+        self._round_lock = threading.Lock()
         self._wire_codecs = OrderedDict()   # (name, wire, shapes) ->
         self._wire_lock = threading.Lock()  # codec; LRU-bounded
+        # ring transport: built lazily on the first ring round, torn
+        # down (and rebuilt) after any failed round — a failed hop
+        # leaves the lockstep protocol at an unknown position, so the
+        # link must not be reused.  _ring_lock serializes WHOLE rounds
+        # (the hop sequence is stateful).
+        self._ring_link = None
+        self._ring_lock = threading.Lock()
+        # async rounds drain through ONE FIFO worker: rounds must
+        # launch in the same order on every rank (the ring's lockstep
+        # hops and the star's round pairing both key off launch
+        # order), which a pool would scramble
+        self._async_q = None
+        self._async_thread = None
+        self._async_lock = threading.Lock()
         self._hb_interval = heartbeat_interval_s() if hb_interval is None \
             else float(hb_interval)
         self._dead_after = dead_after_s() if dead_after is None \
@@ -814,45 +1132,74 @@ class DistRuntime(object):
                 barrier_wait_ms=(time.perf_counter() - t0) * 1e3)
 
     # -- host-level allreduce (the DCN dp leg) -----------------------------
-    def allreduce(self, arrays, name='grad', timeout=None, wire=None):
-        """Sum `arrays` (list of np.ndarray) across all ranks through
-        the coordinator; every rank receives bit-identical results.
-        Identity at world 1.  Raises (naming ranks) on death/timeout
-        instead of hanging.
+    def _next_round(self, name):
+        with self._round_lock:
+            rnd = self._round[name] = self._round.get(name, 0) + 1
+        return rnd
+
+    def allreduce(self, arrays, name='grad', timeout=None, wire=None,
+                  topology=None):
+        """Sum `arrays` (list of np.ndarray) across all ranks; every
+        rank receives bit-identical results.  Identity at world 1.
+        Raises (naming ranks) on death/timeout instead of hanging.
+
+        `topology` (default MXNET_TPU_DIST_TOPOLOGY, else 'star')
+        picks the transport: 'star' ships every rank's bytes through
+        the rank-0 coordinator which sums in RANK order; 'ring' runs a
+        peer-to-peer chunked reduce-scatter + all-gather summing each
+        chunk in fixed ROTATION order — ~2 × bytes/world per host
+        instead of (world-1) × bytes ingress at rank 0.  Each mode is
+        bitwise-deterministic run-to-run (restart parity needs the
+        SAME topology; at world 2 the two orders coincide, so star and
+        ring agree bitwise there).
 
         `wire` ('int8'/'bf16'; default MXNET_TPU_DIST_WIRE_DTYPE, else
         fp32) compresses the round both directions: contributions go
-        up as int8 codes + per-bucket scales (~1/4 the bytes), the
-        coordinator dequantizes, sums in float32 in rank order, and
-        re-quantizes the result down.  The quantization error is NOT
-        lost: this rank's contribution error and the coordinator's
+        up as int8 codes + per-bucket scales (~1/4 the bytes), sums
+        happen in float32, and the result is re-quantized down.  The
+        quantization error is NOT lost: the contribution error and the
         result error each carry forward as error-feedback residuals
         into the next round of the same stream (same name + shapes),
         so a training run's gradient bias cancels over steps instead
-        of accumulating (docs/DIST.md).  Per mode the results are
-        bitwise-deterministic — every rank decodes the identical
-        compressed bytes.  dist_allreduce_bytes counts the ACTUAL
-        wire payload; quant_wire_bytes_saved and
+        of accumulating (docs/DIST.md).  On the ring, the per-stream
+        codecs quantize each rank's CONTRIBUTION chunks and the owned
+        RESULT chunk; the transient partial sums traveling the
+        reduce-scatter hops use stateless fresh scales.  Per mode the
+        results are bitwise-deterministic — every rank decodes the
+        identical compressed bytes.  dist_tx_bytes / dist_rx_bytes
+        count the ACTUAL wire payload per direction (attributed per
+        topology); quant_wire_bytes_saved and
         quant_error_feedback_norm land in profiler.quant_stats()."""
-        from . import profiler
-        from .quantization import WireCodec, wire_dtype_from_env
+        from .quantization import wire_dtype_from_env
         arrays = [np.asarray(a) for a in arrays]
         if self.world <= 1:
             return arrays
         wire = wire_dtype_from_env(wire)
         timeout = barrier_timeout_s() if timeout is None else \
             float(timeout)
-        rnd = self._round[name] = self._round.get(name, 0) + 1
+        if topology_from_env(topology) == 'ring':
+            return self._ring_round(
+                lambda link, deadline: self._ring_dense(
+                    link, deadline, arrays, name, wire),
+                name, timeout)
+        return self._star_allreduce(arrays, name, timeout, wire)
+
+    def _star_allreduce(self, arrays, name, timeout, wire):
+        """Coordinator-mediated sum (the 'star' topology)."""
+        from . import profiler
+        from .quantization import WireCodec
+        rnd = self._next_round(name)
         if wire == 'fp32':
             out = self._rpc('allreduce', str(name), rnd, self.rank,
                             tuple(arrays), float(timeout),
                             timeout=timeout + 15.0)
-            # actual wire payload BOTH directions (contribution up +
+            # actual wire payload per direction (contribution up +
             # result down), so the compressed modes' byte counters
             # A/B against this one like-for-like
-            profiler.add_dist_stats(
-                allreduce_rounds=1,
-                allreduce_bytes=2 * sum(a.nbytes for a in arrays))
+            nbytes = sum(a.nbytes for a in arrays)
+            profiler.add_dist_stats(allreduce_rounds=1,
+                                    tx_bytes=nbytes, rx_bytes=nbytes,
+                                    topology='star')
             return [np.asarray(v) for v in out]
         ckey = (str(name), wire,
                 tuple((tuple(a.shape), np.dtype(a.dtype).str)
@@ -875,12 +1222,480 @@ class DistRuntime(object):
         with codec.lock:
             ef = codec.residual_norm()
         fp_bytes = sum(a.nbytes for a in arrays)
-        profiler.add_dist_stats(allreduce_rounds=1,
-                                allreduce_bytes=up + down)
+        profiler.add_dist_stats(allreduce_rounds=1, tx_bytes=up,
+                                rx_bytes=down, topology='star')
         profiler.add_quant_stats(
             wire_bytes_saved=max(0, 2 * fp_bytes - up - down),
             error_feedback_norm=ef)
         return dec
+
+    # -- ring topology -----------------------------------------------------
+    def _ring_round(self, fn, name, timeout):
+        """Run one ring collective end-to-end under the ring lock (the
+        hop sequence is stateful lockstep — rounds must not
+        interleave), building the peer links on first use and tearing
+        them down on ANY failure: a failed hop leaves the protocol at
+        an unknown position, so the next round (or the relaunched
+        process) must rebuild from a clean rendezvous."""
+        from . import elastic
+        stall = elastic.ring_stall_s(self.rank)
+        if stall:
+            logging.warning('dist: ring stall fault delaying rank %d '
+                            'by %.1fs', self.rank, stall)
+            time.sleep(stall)
+        with self._ring_lock:
+            deadline = time.monotonic() + float(timeout)
+            if self._ring_link is None:
+                self._ring_link = _RingLink(self, deadline)
+            link = self._ring_link
+            try:
+                return fn(link, deadline)
+            except BaseException:
+                link.close()
+                self._ring_link = None
+                raise
+
+    def _ring_death_verdict(self, name, deadline):
+        """A ring link just broke mid-round.  A reset socket usually
+        means the PEER PROCESS died, and its ECONNRESET beats the
+        coordinator's heartbeat declaration by up to a heartbeat
+        window — so wait the declaration out (bounded by dead_after
+        AND by the round's own deadline) and return the coordinator's
+        verdict.  This keeps the ring's failure contract identical to
+        the star path's: the raised error names the dead rank and
+        `dist.detect_dead()` is already populated when the caller's
+        except-handler runs (the elastic preempt flow depends on
+        that).  Always polls at least once, even past the deadline."""
+        stop = min(deadline, time.monotonic() + self._dead_after + 2.0)
+        while True:
+            try:
+                dead = self.poll_dead()
+            except Exception:
+                return self.dead_ranks()
+            if dead or time.monotonic() >= stop:
+                return dead
+            time.sleep(0.2)
+
+    def _ring_hop(self, link, out_msg, expect, deadline, name):
+        """One lockstep ring hop: ship `out_msg` to the right neighbor
+        while waiting on the left — concurrently, so two large chunks
+        never deadlock both ranks in blocking sends against full
+        socket buffers.  NAMES the stalled or dead neighbor instead of
+        hanging: the heartbeat-fed dead set is polled while waiting,
+        and the deadline converts a silent peer into an MXNetError
+        carrying its rank."""
+        import select
+        send_err = []
+
+        def _send():
+            try:
+                _send_msg(link.right, out_msg)
+            except (ConnectionError, OSError) as e:
+                send_err.append(e)
+
+        t = threading.Thread(target=_send, daemon=True,
+                             name='dist-ring-send')
+        t.start()
+        try:
+            while True:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    raise socket.timeout()
+                dead = self.dead_ranks()
+                if dead:
+                    raise MXNetError(
+                        'ring allreduce %r failed: rank(s) %s are '
+                        'dead — recover via coordinated elastic '
+                        'restart' % (name, sorted(dead)))
+                ready, _, _ = select.select([link.left], [], [],
+                                            min(0.25, budget))
+                if ready:
+                    break
+            link.left.settimeout(
+                max(1.0, deadline - time.monotonic()))
+            msg = _recv_msg(link.left)
+            link.left.settimeout(None)
+        except socket.timeout:
+            raise MXNetError(
+                'ring allreduce %r: no frame from left neighbor rank '
+                '%d within the deadline — it is stalled or dead '
+                '(known dead: %s); recover via coordinated elastic '
+                'restart or raise MXNET_TPU_BARRIER_TIMEOUT_S'
+                % (name, link.left_rank,
+                   sorted(self.dead_ranks()) or 'none yet'))
+        except (ConnectionError, OSError) as e:
+            dead = self._ring_death_verdict(name, deadline)
+            if dead:
+                raise MXNetError(
+                    'ring allreduce %r failed: rank(s) %s are dead '
+                    '(link to left neighbor rank %d reset) — recover '
+                    'via coordinated elastic restart'
+                    % (name, sorted(dead), link.left_rank))
+            raise MXNetError(
+                'ring allreduce %r: lost the link to left neighbor '
+                'rank %d: %s' % (name, link.left_rank, e))
+        finally:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        if send_err:
+            dead = self._ring_death_verdict(name, deadline)
+            if dead:
+                raise MXNetError(
+                    'ring allreduce %r failed: rank(s) %s are dead '
+                    '(send to right neighbor rank %d failed) — '
+                    'recover via coordinated elastic restart'
+                    % (name, sorted(dead), link.right_rank))
+            raise MXNetError(
+                'ring allreduce %r: could not send to right neighbor '
+                'rank %d: %s' % (name, link.right_rank, send_err[0]))
+        if t.is_alive():
+            raise MXNetError(
+                'ring allreduce %r: send to right neighbor rank %d '
+                'stalled past the deadline — it is wedged or dead'
+                % (name, link.right_rank))
+        got = tuple(msg[:len(expect)])
+        if got != tuple(expect):
+            extra = ''
+            if len(expect) >= 5 and got[:4] == tuple(expect)[:4]:
+                extra = (' — every rank must resolve the same '
+                         'MXNET_TPU_DIST_WIRE_DTYPE')
+            raise MXNetError(
+                'ring allreduce %r: protocol desync with left '
+                'neighbor rank %d (got %r, expected %r)%s'
+                % (name, link.left_rank, got, tuple(expect), extra))
+        return msg
+
+    def _ring_dense(self, link, deadline, arrays, name, wire):
+        """Chunked ring reduce-scatter + all-gather.  Arrays group by
+        dtype into flat buffers split into `world` chunks at FIXED
+        divmod boundaries; at reduce-scatter step s each rank sends
+        chunk (rank-s) mod w right and folds the incoming chunk
+        (rank-s-1) mod w as incoming + own, so chunk c's sum always
+        accumulates in rotation order c, c+1, ... — after w-1 steps
+        rank r owns the finished chunk (r+1) mod w.  The all-gather
+        then circulates each owner's ENCODED chunk verbatim (the owner
+        decodes its own encoding), so every rank decodes identical
+        bytes — the PR 9/13 bitwise invariant, per topology mode.
+
+        Compressed wires quantize float groups only (integer groups
+        ride raw): contributions through the per-stream 'ring-up'
+        error-feedback codec, traveling partials with stateless fresh
+        scales (transient — no residual to carry), the owned result
+        chunk through the 'ring-down' codec."""
+        from . import profiler
+        from .quantization import (decode_ring_chunk,
+                                   encode_ring_chunk)
+        rnd = self._next_round('ring:' + str(name))
+        w = self.world
+        comp = wire != 'fp32'
+        gkeys, metas, offs, groups = [], [], {}, {}
+        for a in arrays:
+            k = np.dtype(a.dtype).str
+            if k not in groups:
+                groups[k], offs[k] = [], 0
+                gkeys.append(k)
+            metas.append((k, offs[k], a.size, a.shape, a.dtype))
+            offs[k] += a.size
+            groups[k].append(np.ascontiguousarray(a).ravel())
+        fset, flats = set(), {}
+        for k in gkeys:
+            flat = np.concatenate(groups[k]) if len(groups[k]) > 1 \
+                else groups[k][0]
+            if comp and np.dtype(k).kind == 'f':
+                fset.add(k)
+                flat = flat.astype(np.float32)
+            flats[k] = flat
+
+        def split(flat):
+            out, off = [], 0
+            base, extra = divmod(flat.shape[0], w)
+            for c in range(w):
+                sz = base + (1 if c < extra else 0)
+                out.append(flat[off:off + sz])
+                off += sz
+            return out
+
+        acc = {k: split(flats[k]) for k in gkeys}
+        up_payloads = up_scales = up_codec = None
+        bidx = {}
+        if fset:
+            buckets, pos = [], 0
+            for c in range(w):
+                for k in gkeys:
+                    if k in fset:
+                        bidx[(k, c)] = pos
+                        buckets.append(acc[k][c])
+                        pos += 1
+            ckey = (str(name), 'ring-up', wire,
+                    tuple(b.shape[0] for b in buckets))
+            with self._wire_lock:
+                up_codec = _wire_codec(self._wire_codecs, ckey, wire)
+            with up_codec.lock:
+                up_payloads, up_scales = up_codec.encode(buckets)
+            # accumulate from the DECODED contribution — the same
+            # values every peer decodes, so partial sums match
+            # bitwise across ranks
+            deq = up_codec.decode(up_payloads, up_scales,
+                                  [np.float32] * len(buckets))
+            for (k, c), i in bidx.items():
+                acc[k][c] = deq[i]
+
+        def enc(c, contribution):
+            payloads, scales = [], []
+            for k in gkeys:
+                x = acc[k][c]
+                if k not in fset:
+                    payloads.append(x)
+                    scales.append(None)
+                elif contribution:
+                    i = bidx[(k, c)]
+                    payloads.append(up_payloads[i])
+                    scales.append(float(up_scales[i])
+                                  if wire == 'int8' else None)
+                else:
+                    p, s = encode_ring_chunk(x, wire)
+                    payloads.append(p)
+                    scales.append(s)
+            return tuple(payloads), tuple(scales)
+
+        def dec(payloads, scales):
+            return [decode_ring_chunk(p, s, wire) if k in fset
+                    else np.asarray(p)
+                    for k, p, s in zip(gkeys, payloads, scales)]
+
+        def nbytes(payloads, scales):
+            wireb = sum(np.asarray(p).nbytes for p in payloads) + \
+                4 * sum(1 for s in scales if s is not None)
+            fpb = sum(4 * np.asarray(p).size if k in fset
+                      else np.asarray(p).nbytes
+                      for k, p in zip(gkeys, payloads))
+            return wireb, fpb
+
+        tx = rx = fp_eq = 0
+        for s in range(w - 1):
+            send_idx = (self.rank - s) % w
+            recv_idx = (self.rank - s - 1) % w
+            payloads, scales = enc(send_idx, contribution=(s == 0))
+            msg = self._ring_hop(
+                link, ('rs', str(name), rnd, s, wire, payloads,
+                       scales),
+                ('rs', str(name), rnd, s, wire), deadline, name)
+            b, f = nbytes(payloads, scales)
+            b2, f2 = nbytes(msg[5], msg[6])
+            tx, rx, fp_eq = tx + b, rx + b2, fp_eq + f + f2
+            for k, v in zip(gkeys, dec(msg[5], msg[6])):
+                acc[k][recv_idx] = v + acc[k][recv_idx]
+        own_idx = (self.rank + 1) % w
+        enc_store = [None] * w
+        if fset:
+            fbuckets = [acc[k][own_idx] for k in gkeys if k in fset]
+            dkey = (str(name), 'ring-down', wire,
+                    tuple(b.shape[0] for b in fbuckets))
+            with self._wire_lock:
+                down_codec = _wire_codec(self._wire_codecs, dkey,
+                                         wire)
+            with down_codec.lock:
+                d_payloads, d_scales = down_codec.encode(fbuckets)
+            payloads, scales, i = [], [], 0
+            for k in gkeys:
+                if k in fset:
+                    payloads.append(d_payloads[i])
+                    scales.append(float(d_scales[i])
+                                  if wire == 'int8' else None)
+                    i += 1
+                else:
+                    payloads.append(acc[k][own_idx])
+                    scales.append(None)
+            enc_store[own_idx] = (tuple(payloads), tuple(scales))
+        else:
+            enc_store[own_idx] = enc(own_idx, contribution=False)
+        final = {k: [None] * w for k in gkeys}
+        for k, v in zip(gkeys, dec(*enc_store[own_idx])):
+            final[k][own_idx] = v
+        for s in range(w - 1):
+            send_idx = (self.rank + 1 - s) % w
+            recv_idx = (self.rank - s) % w
+            payloads, scales = enc_store[send_idx]
+            msg = self._ring_hop(
+                link, ('ag', str(name), rnd, s, wire, payloads,
+                       scales),
+                ('ag', str(name), rnd, s, wire), deadline, name)
+            b, f = nbytes(payloads, scales)
+            in_p, in_s = tuple(msg[5]), tuple(msg[6])
+            b2, f2 = nbytes(in_p, in_s)
+            tx, rx, fp_eq = tx + b, rx + b2, fp_eq + f + f2
+            enc_store[recv_idx] = (in_p, in_s)
+            for k, v in zip(gkeys, dec(in_p, in_s)):
+                final[k][recv_idx] = v
+        out_flat = {k: (np.concatenate(final[k]) if w > 1
+                        else final[k][0]) for k in gkeys}
+        out = [np.asarray(out_flat[k][off:off + size].reshape(shape),
+                          dtype=dtype)
+               for k, off, size, shape, dtype in metas]
+        profiler.add_dist_stats(allreduce_rounds=1, tx_bytes=tx,
+                                rx_bytes=rx, topology='ring')
+        if comp:
+            ef = 0.0
+            if up_codec is not None:
+                with up_codec.lock:
+                    ef = up_codec.residual_norm()
+            profiler.add_quant_stats(
+                wire_bytes_saved=max(0, fp_eq - tx - rx),
+                error_feedback_norm=ef)
+        return out
+
+    # -- sparse COO allreduce ----------------------------------------------
+    def allreduce_coo(self, uids, rows, name='embed', vocab=None,
+                      timeout=None, topology=None):
+        """Sparse cross-rank sum: every rank contributes COO
+        (unique_ids, rows) and receives the SORTED union with
+        duplicate ids' rows summed deterministically (rank order on
+        star; rotation order per id-range chunk on ring — each
+        bitwise-reproducible per mode).  The wire carries
+        rows-touched bytes instead of a re-densified (vocab, dim)
+        gradient.  `vocab` (row-id upper bound) is required on the
+        ring topology — it fixes the id-range chunk boundaries.
+        Identity (plus local dedup + sort) at world 1."""
+        from . import profiler
+        uids = np.ascontiguousarray(np.asarray(uids,
+                                               np.int64).ravel())
+        rows = np.ascontiguousarray(np.asarray(rows))
+        if rows.ndim != 2 or rows.shape[0] != uids.shape[0]:
+            raise MXNetError(
+                'allreduce_coo: rows must be (len(uids), dim); got '
+                'ids %r, rows %r' % (uids.shape, rows.shape))
+        uids, rows = _merge_coo([uids], [rows])
+        if self.world <= 1:
+            return uids, rows
+        timeout = barrier_timeout_s() if timeout is None else \
+            float(timeout)
+        if topology_from_env(topology) == 'ring':
+            if vocab is None:
+                raise MXNetError('allreduce_coo on the ring topology '
+                                 'needs vocab= (the id-range chunk '
+                                 'bound)')
+            return self._ring_round(
+                lambda link, deadline: self._ring_coo(
+                    link, deadline, uids, rows, name, int(vocab)),
+                name, timeout)
+        rnd = self._next_round('coo:' + str(name))
+        out = self._rpc('allreduce_coo', str(name), rnd, self.rank,
+                        uids, rows, float(timeout),
+                        timeout=timeout + 15.0)
+        out_ids = np.asarray(out[0], np.int64)
+        out_rows = np.asarray(out[1])
+        profiler.add_dist_stats(
+            allreduce_rounds=1,
+            tx_bytes=uids.nbytes + rows.nbytes,
+            rx_bytes=out_ids.nbytes + out_rows.nbytes,
+            topology='sparse')
+        return out_ids, out_rows
+
+    def _ring_coo(self, link, deadline, uids, rows, name, vocab):
+        """Ring leg of allreduce_coo: chunk by FIXED id ranges
+        (ceil(vocab/world) wide — identical boundaries everywhere),
+        reduce-scatter merging incoming-before-own per range, then
+        all-gather the merged owner ranges verbatim; concatenating
+        the ranges in order rebuilds the same sorted union on every
+        rank."""
+        from . import profiler
+        rnd = self._next_round('coo-ring:' + str(name))
+        w = self.world
+        span = max(1, -(-max(1, int(vocab)) // w))
+        if uids.size and int(uids[-1]) >= vocab:
+            raise MXNetError(
+                'allreduce_coo: id %d outside vocab %d — the ring '
+                'chunking needs every id < vocab'
+                % (int(uids[-1]), vocab))
+        ids_c, rows_c = [], []
+        for c in range(w):
+            m = (uids >= c * span) & (uids < (c + 1) * span)
+            ids_c.append(uids[m])
+            rows_c.append(rows[m])
+        tx = rx = 0
+        for s in range(w - 1):
+            send_idx = (self.rank - s) % w
+            recv_idx = (self.rank - s - 1) % w
+            msg = self._ring_hop(
+                link, ('crs', str(name), rnd, s, ids_c[send_idx],
+                       rows_c[send_idx]),
+                ('crs', str(name), rnd, s), deadline, name)
+            tx += ids_c[send_idx].nbytes + rows_c[send_idx].nbytes
+            in_ids = np.asarray(msg[4], np.int64)
+            in_rows = np.asarray(msg[5])
+            rx += in_ids.nbytes + in_rows.nbytes
+            ids_c[recv_idx], rows_c[recv_idx] = _merge_coo(
+                [in_ids, ids_c[recv_idx]],
+                [in_rows, rows_c[recv_idx]])
+        for s in range(w - 1):
+            send_idx = (self.rank + 1 - s) % w
+            recv_idx = (self.rank - s) % w
+            msg = self._ring_hop(
+                link, ('cag', str(name), rnd, s, ids_c[send_idx],
+                       rows_c[send_idx]),
+                ('cag', str(name), rnd, s), deadline, name)
+            tx += ids_c[send_idx].nbytes + rows_c[send_idx].nbytes
+            in_ids = np.asarray(msg[4], np.int64)
+            in_rows = np.asarray(msg[5])
+            rx += in_ids.nbytes + in_rows.nbytes
+            ids_c[recv_idx], rows_c[recv_idx] = in_ids, in_rows
+        out_ids = np.concatenate(ids_c)
+        out_rows = np.concatenate(rows_c, axis=0)
+        profiler.add_dist_stats(allreduce_rounds=1, tx_bytes=tx,
+                                rx_bytes=rx, topology='sparse')
+        return out_ids, out_rows
+
+    # -- async overlap -----------------------------------------------------
+    def allreduce_async(self, arrays, name='grad', timeout=None,
+                        wire=None, topology=None):
+        """Launch the cross-host sum in the background and return an
+        AllreduceHandle to `wait()` at the optimizer boundary — the
+        DCN analog of GradReducePlan's backward-interleaved reduction.
+        ONE dedicated FIFO worker drains launches, so rounds run in
+        launch order; callers must launch streams in the same order on
+        every rank (both topologies pair rounds by that order — the
+        KVStore overlap path iterates its canonical key order for
+        exactly this reason).  Mixing synchronous allreduce calls from
+        other threads while async rounds are in flight is not
+        supported on the ring topology."""
+        arrays = [np.asarray(a) for a in arrays]
+        handle = AllreduceHandle()
+        if self.world <= 1:
+            handle._result = arrays
+            handle._t_done = time.perf_counter()
+            handle._event.set()
+            return handle
+        self._ensure_async_worker()
+        self._async_q.put((handle, arrays, name, timeout, wire,
+                           topology))
+        return handle
+
+    def _ensure_async_worker(self):
+        import queue
+        with self._async_lock:
+            if self._async_q is None:
+                self._async_q = queue.Queue()
+            if self._async_thread is None or \
+                    not self._async_thread.is_alive():
+                self._async_thread = threading.Thread(
+                    target=self._async_loop, name='dist-async-reduce',
+                    daemon=True)
+                self._async_thread.start()
+
+    def _async_loop(self):
+        while True:
+            item = self._async_q.get()
+            if item is None:
+                return
+            handle, arrays, name, timeout, wire, topology = item
+            try:
+                handle._result = self.allreduce(
+                    arrays, name=name, timeout=timeout, wire=wire,
+                    topology=topology)
+            except BaseException as e:  # delivered at wait()
+                handle._error = e
+            finally:
+                handle._t_done = time.perf_counter()
+                handle._event.set()
 
     # -- teardown ----------------------------------------------------------
     def shutdown(self):
@@ -892,6 +1707,14 @@ class DistRuntime(object):
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5.0)
+        if self._async_q is not None:
+            self._async_q.put(None)     # drains queued rounds first
+            if self._async_thread is not None:
+                self._async_thread.join(timeout=10.0)
+        with self._ring_lock:
+            if self._ring_link is not None:
+                self._ring_link.close()
+                self._ring_link = None
         try:
             self._rpc('bye', self.rank, timeout=5.0)
         except MXNetError:
@@ -1013,13 +1836,40 @@ def barrier(name='user', timeout=None):
     _RUNTIME.barrier(name, timeout=timeout)
 
 
-def allreduce(arrays, name='grad', wire=None):
+def allreduce(arrays, name='grad', wire=None, topology=None):
     """Cross-rank sum (identity before initialize()).  `wire` opts
     into the compressed int8/bf16 bucket wire format (default
-    MXNET_TPU_DIST_WIRE_DTYPE) — see DistRuntime.allreduce."""
+    MXNET_TPU_DIST_WIRE_DTYPE); `topology` picks star vs ring (default
+    MXNET_TPU_DIST_TOPOLOGY) — see DistRuntime.allreduce."""
     if _RUNTIME is None:
         return [np.asarray(a) for a in arrays]
-    return _RUNTIME.allreduce(arrays, name=name, wire=wire)
+    return _RUNTIME.allreduce(arrays, name=name, wire=wire,
+                              topology=topology)
+
+
+def allreduce_async(arrays, name='grad', wire=None, topology=None):
+    """Background cross-rank sum; returns an AllreduceHandle whose
+    wait() yields what allreduce() would have (already-complete before
+    initialize()) — see DistRuntime.allreduce_async."""
+    if _RUNTIME is None:
+        h = AllreduceHandle()
+        h._result = [np.asarray(a) for a in arrays]
+        h._t_done = time.perf_counter()
+        h._event.set()
+        return h
+    return _RUNTIME.allreduce_async(arrays, name=name, wire=wire,
+                                    topology=topology)
+
+
+def allreduce_coo(uids, rows, name='embed', vocab=None, topology=None):
+    """Sparse COO cross-rank sum of (unique_ids, rows) pairs (local
+    dedup + sort before initialize()) — see
+    DistRuntime.allreduce_coo."""
+    if _RUNTIME is None:
+        return _merge_coo([np.asarray(uids, np.int64).ravel()],
+                          [np.asarray(rows)])
+    return _RUNTIME.allreduce_coo(uids, rows, name=name, vocab=vocab,
+                                  topology=topology)
 
 
 def host_span_active():

@@ -166,6 +166,24 @@ def barrier_stall_s(rank):
         return None
 
 
+def ring_stall_s(rank):
+    """Injected late arrival at a ring allreduce round
+    (MXNET_TPU_FAULT_RING_STALL_S, same 'R:SECS' grammar as
+    barrier_stall_s).  Falls back to MXNET_TPU_FAULT_BARRIER_STALL_S —
+    the barrier-stall knob extends to ring hops, so one injection
+    exercises both collective shapes (docs/DIST.md fault table)."""
+    v = fault_knob('RING_STALL_S')
+    if v is None:
+        return barrier_stall_s(rank)
+    try:
+        if ':' in str(v):
+            r, secs = str(v).split(':', 1)
+            return float(secs) if int(r) == int(rank) else None
+        return float(v)
+    except ValueError:
+        return None
+
+
 def num_dead_node():
     """Dead-node count the KVStore facade reports: REAL cross-process
     deaths detected by the dist runtime's heartbeat table, plus any

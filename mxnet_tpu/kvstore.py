@@ -59,6 +59,8 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._zero = zero
+        self._sparse_meta = {}    # key -> vocab (mark_sparse)
+        self._sparse_state = {}   # key -> momentum rows store
         self._is_dist = 'dist' in kv_type
         if 'async' in kv_type and type(self) is KVStore:
             warnings.warn('dist_async without parameter servers has no '
@@ -184,21 +186,146 @@ class KVStore:
         kvstore round as ONE call so dist stores can batch the wire
         protocol (reference: ps-lite batches ZPush/ZPull at the engine
         level, kvstore_dist.h:123-149).  Under the dist runtime's
-        host-allreduce mode every key's cross-host sum rides ONE
-        coordinator round trip per step.  Local semantics are
+        host-allreduce mode every dense key's cross-host sum rides ONE
+        round per step; keys marked sparse (mark_sparse) cross as COO
+        (unique_ids, rows) pairs with rows-only application instead of
+        re-densified (vocab, dim) bytes.  MXNET_TPU_DIST_OVERLAP=1
+        switches dense keys to per-key async rounds waited at each
+        key's update (_push_pull_overlapped).  Local semantics are
         identical to the per-key push/pull loop."""
         from . import dist
         if self._is_dist and dist.host_span_active():
             merged = [self._merge_local(g if isinstance(g, list)
                                         else [g]) for g in grad_lists]
-            merged = self._cross_host_sum(merged)
-            for k, m, o in zip(keys, merged, out_lists):
-                self._push_impl(k, m, _cross_summed=True)
+            # only 2-D grads can ride the rows wire; anything else
+            # marked sparse falls back to the dense round
+            sparse = [str(k) in self._sparse_meta and
+                      getattr(m, 'ndim', 0) == 2
+                      for k, m in zip(keys, merged)]
+            if dist.overlap_active():
+                self._push_pull_overlapped(keys, merged, sparse,
+                                           out_lists)
+                return
+            dense = [m for m, sp in zip(merged, sparse) if not sp]
+            dsummed = iter(self._cross_host_sum(dense))
+            for k, m, sp, o in zip(keys, merged, sparse, out_lists):
+                if sp:
+                    self._apply_sparse_coo(
+                        k, *self._coo_cross_host(k, m))
+                else:
+                    self._push_impl(k, next(dsummed),
+                                    _cross_summed=True)
                 self.pull(k, o)
             return
         for k, g, o in zip(keys, grad_lists, out_lists):
             self.push(k, g)
             self.pull(k, o)
+
+    def _push_pull_overlapped(self, keys, merged, sparse, out_lists):
+        """MXNET_TPU_DIST_OVERLAP=1: launch every dense key's
+        cross-host round up front (the dist runtime's FIFO async
+        worker keeps the launch order identical on every rank) and
+        wait per key at its update — key k's optimizer math runs while
+        key k+1's bytes are still on the wire (profiler
+        dist_overlap_ms).  Still bitwise-deterministic run to run
+        (every per-key round sums in the topology's fixed rank /
+        rotation order), but at world >= 3 under the ring the per-key
+        chunk boundaries differ from the batched round's flattened
+        buffer, so overlapped-vs-batched agree to summation-order
+        tolerance, not bitwise (under star, and at world 2, they
+        coincide exactly).  Sparse keys stay synchronous (their COO
+        rounds are rows-only small)."""
+        import jax.numpy as jnp
+        from . import dist
+        handles = [None if sp else
+                   dist.allreduce_async([m.asnumpy()],
+                                        name='kv_grad:%s' % k)
+                   for k, m, sp in zip(keys, merged, sparse)]
+        for k, m, sp, h, o in zip(keys, merged, sparse, handles,
+                                  out_lists):
+            if sp:
+                self._apply_sparse_coo(k, *self._coo_cross_host(k, m))
+            else:
+                s = h.wait()[0]
+                self._push_impl(k, nd.NDArray(jnp.asarray(s),
+                                              m.context),
+                                _cross_summed=True)
+            self.pull(k, o)
+
+    # -- sparse COO cross-host path (mark_sparse keys) ---------------------
+    def mark_sparse(self, key, vocab):
+        """Declare `key` a sparse-embedding table with `vocab` rows:
+        under the host-span dist path its cross-host gradient crosses
+        the wire as deduped COO (unique_ids, rows) pairs with
+        rows-only far-side application, instead of being re-densified
+        to (vocab, dim) bytes.  Module.init_optimizer marks its
+        sparse_grad Embedding weights automatically
+        (Executor.sparse_diff_positions)."""
+        self._sparse_meta[str(key)] = int(vocab)
+
+    def _coo_cross_host(self, key, merged):
+        """Sparse cross-host leg for one marked key: extract the
+        touched rows host-side — an embedding backward writes only the
+        rows the batch touched, everything else is exact zeros — and
+        sum (unique_ids, rows) pairs across ranks through
+        dist.allreduce_coo.  A touched row whose gradient is all-zero
+        drops out; its update would be a no-op under the lazy sparse
+        semantics anyway (docs/SPARSE.md)."""
+        import numpy as np
+        from . import dist
+        g = merged.asnumpy()
+        nz = np.flatnonzero(np.any(g != 0.0, axis=1))
+        return dist.allreduce_coo(
+            nz, np.ascontiguousarray(g[nz], np.float32),
+            name='kv_grad_coo:%s' % key,
+            vocab=self._sparse_meta[str(key)])
+
+    def _apply_sparse_coo(self, key, uids, rows):
+        """Rows-only application of the cross-host-summed COO
+        gradient: gather the touched rows of the stored weight, run
+        the dense optimizer math on just those rows
+        (parallel.embedding.sparse_row_update — the PR 16 fused-update
+        core), scatter back.  Momentum for sparse keys lives in a
+        per-key rows store with LAZY semantics — state on untouched
+        rows does not decay (docs/SPARSE.md).  Non-SGD or
+        multi-precision optimizers densify ONLY the application; the
+        wire already rode COO."""
+        import numpy as np
+        import jax.numpy as jnp
+        stored = self._store[key]
+        opt_ = self._optimizer
+        sgd_family = (type(opt_).__name__ == 'SGD' and
+                      not getattr(opt_, 'multi_precision', False))
+        if self._updater is None or not sgd_family:
+            dense = np.zeros(stored.shape, np.float32)
+            if uids.size:
+                dense[np.asarray(uids)] = np.asarray(rows)
+            self._push_impl(
+                key, nd.NDArray(jnp.asarray(dense,
+                                            stored._data.dtype),
+                                stored.context),
+                _cross_summed=True)
+            return
+        from .parallel.embedding import sparse_row_update
+        index = self._key_index(key)
+        lr = opt_._get_lr(index)
+        wd = opt_._get_wd(index)
+        opt_._update_count(index)
+        if not uids.size:
+            return
+        mom = float(getattr(opt_, 'momentum', 0.0) or 0.0)
+        m = self._sparse_state.get(key)
+        if m is None:
+            m = jnp.zeros_like(stored._data) if mom != 0.0 \
+                else stored._data     # pass-through when no momentum
+        new_w, new_m = sparse_row_update(
+            stored._data, m, jnp.asarray(np.asarray(uids)),
+            jnp.asarray(np.asarray(rows)), lr, wd, momentum=mom,
+            rescale=float(getattr(opt_, 'rescale_grad', 1.0)),
+            clip=getattr(opt_, 'clip_gradient', None))
+        self._store[key] = nd.NDArray(new_w, stored.context)
+        if mom != 0.0:
+            self._sparse_state[key] = new_m
 
     # -- updater / optimizer ----------------------------------------------
     @property

@@ -365,6 +365,19 @@ class Module(BaseModule):
             self._update_on_kvstore = False
         elif update_on_kvstore:
             kvstore.set_optimizer(self._optimizer)
+            if host_span and hasattr(kvstore, 'mark_sparse'):
+                # sparse_grad tables cross hosts as COO (unique_ids,
+                # rows) pairs instead of re-densified (vocab, dim)
+                # bytes; a config the sparse rewrite refuses just
+                # stays on the dense wire
+                ex = self._exec_group.executor
+                if ex is not None and not ex._grouped:
+                    try:
+                        entries = ex._sparse_embed_entries()
+                    except MXNetError:
+                        entries = ()
+                    for e in entries:
+                        kvstore.mark_sparse(e['weight'], e['vocab'])
         else:
             self._updater = opt_mod.get_updater(optimizer)
         self.optimizer_initialized = True

@@ -423,10 +423,22 @@ def ckpt_stats():
 # heartbeats sent / missed (dropped by fault injection or a lost
 # coordinator), health-checked barrier rounds + the wall time spent
 # waiting in them, real cross-process deaths this process learned of
-# through heartbeat loss, coordinator-mediated gradient allreduce
-# rounds/bytes (the DCN dp leg), and how many elastic relaunches this
-# process is downstream of (the launch.py --elastic supervisor exports
-# MXNET_TPU_DIST_RESTART_COUNT)
+# through heartbeat loss, cross-host gradient allreduce rounds (the
+# DCN dp leg), and how many elastic relaunches this process is
+# downstream of (the launch.py --elastic supervisor exports
+# MXNET_TPU_DIST_RESTART_COUNT).
+#
+# Wire-byte accounting is PER DIRECTION and PER TOPOLOGY so bench arms
+# A/B like-for-like: dist_tx_bytes / dist_rx_bytes are what THIS
+# process actually put on / took off the socket, attributed to the
+# transport that moved them ('star' coordinator round trips, 'ring'
+# neighbor hops, 'sparse' COO rounds on either topology).  The star
+# coordinator's ingress is therefore every peer's tx — rank 0's rx
+# does not count its own coordinator's fan-in (it never crosses a
+# host).  dist_allreduce_bytes stays as the tx+rx total for
+# compatibility with pre-round-23 readers.  dist_overlap_ms is the
+# wall time allreduce_async rounds ran concurrently with the caller
+# (launch -> wait begin, clipped at completion).
 _DIST = {
     'dist_heartbeats_sent': 0,
     'dist_heartbeats_missed': 0,
@@ -435,15 +447,29 @@ _DIST = {
     'dist_dead_hosts_detected': 0,
     'dist_allreduce_rounds': 0,
     'dist_allreduce_bytes': 0,
+    'dist_tx_bytes': 0,
+    'dist_rx_bytes': 0,
+    'dist_star_bytes': 0,
+    'dist_ring_bytes': 0,
+    'dist_sparse_bytes': 0,
+    'dist_overlap_ms': 0.0,
     'dist_restarts': 0,
 }
 
 
 def add_dist_stats(heartbeats_sent=0, heartbeats_missed=0, barriers=0,
                    barrier_wait_ms=0.0, dead_hosts_detected=0,
-                   allreduce_rounds=0, allreduce_bytes=0, restarts=0):
+                   allreduce_rounds=0, allreduce_bytes=0, restarts=0,
+                   tx_bytes=0, rx_bytes=0, topology=None,
+                   overlap_ms=0.0):
     """Accumulate dist-runtime counters (the heartbeat thread, barrier
-    and allreduce paths feed one call per event)."""
+    and allreduce paths feed one call per event).  `tx_bytes` /
+    `rx_bytes` are directional wire bytes; `topology`
+    ('star'/'ring'/'sparse') attributes them to the transport that
+    moved them; allreduce_bytes defaults to tx+rx when directional
+    bytes are given without an explicit total."""
+    if (tx_bytes or rx_bytes) and not allreduce_bytes:
+        allreduce_bytes = int(tx_bytes) + int(rx_bytes)
     with _STATE['lock']:
         _DIST['dist_heartbeats_sent'] += int(heartbeats_sent)
         _DIST['dist_heartbeats_missed'] += int(heartbeats_missed)
@@ -452,6 +478,12 @@ def add_dist_stats(heartbeats_sent=0, heartbeats_missed=0, barriers=0,
         _DIST['dist_dead_hosts_detected'] += int(dead_hosts_detected)
         _DIST['dist_allreduce_rounds'] += int(allreduce_rounds)
         _DIST['dist_allreduce_bytes'] += int(allreduce_bytes)
+        _DIST['dist_tx_bytes'] += int(tx_bytes)
+        _DIST['dist_rx_bytes'] += int(rx_bytes)
+        if topology is not None:
+            _DIST['dist_%s_bytes' % topology] += \
+                int(tx_bytes) + int(rx_bytes)
+        _DIST['dist_overlap_ms'] += float(overlap_ms)
         _DIST['dist_restarts'] += int(restarts)
 
 
@@ -1102,6 +1134,12 @@ def summary(print_out=True):
                     ds['dist_dead_hosts_detected'],
                     ds['dist_allreduce_rounds'],
                     ds['dist_allreduce_bytes'], ds['dist_restarts']))
+    lines.append('  dist_tx_bytes=%d dist_rx_bytes=%d '
+                 'dist_star_bytes=%d dist_ring_bytes=%d '
+                 'dist_sparse_bytes=%d dist_overlap_ms=%.3f'
+                 % (ds['dist_tx_bytes'], ds['dist_rx_bytes'],
+                    ds['dist_star_bytes'], ds['dist_ring_bytes'],
+                    ds['dist_sparse_bytes'], ds['dist_overlap_ms']))
     fl = fleet_stats()
     lines.append('  fleet_loads=%d fleet_evictions=%d '
                  'fleet_shed_requests=%d fleet_http_requests=%d '

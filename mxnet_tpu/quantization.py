@@ -70,6 +70,11 @@ def symmetric_scale(a, axis=None, percentile=None):
     range at that percentile of |a| instead of the max — outliers
     saturate to ±127 rather than widening every other value's
     quantization step (host/np path only)."""
+    if axis is None and getattr(a, 'size', 1) == 0:
+        # an empty bucket (a ring chunk of a tiny buffer split world
+        # ways can be zero-length) has no range: scale 0 round-trips
+        # it exactly like the all-zero case
+        return np.float32(0.0)
     xp = _xp(a)
     if percentile is not None and xp is np:
         if axis is None:
@@ -480,3 +485,37 @@ class WireCodec(object):
     @staticmethod
     def fp32_nbytes(arrays):
         return sum(int(np.prod(a.shape)) * 4 for a in arrays)
+
+
+def encode_ring_chunk(x, wire):
+    """Stateless fresh-scale encode of ONE ring chunk.
+
+    The ring reduce-scatter's intermediate partial sums are transient:
+    a partial leaves the rank once and never re-enters the stream, so
+    there is no residual to carry — error feedback would couple hop k's
+    quantization error into hop k+1's *different* chunk and break the
+    fixed-rotation determinism every rank relies on to decode identical
+    bytes.  Contributions (hop 0) and owner results (all-gather) DO go
+    through per-stream ``WireCodec`` error feedback in ``dist.py``; only
+    the traveling partials use this stateless form.  Returns
+    ``(payload, scale)``; ``scale`` is ``None`` for fp32/bf16.
+    """
+    x = np.asarray(x, np.float32)
+    if wire == 'fp32':
+        return x, None
+    if wire == 'bf16':
+        import ml_dtypes
+        return x.astype(ml_dtypes.bfloat16), None
+    s = symmetric_scale(x)
+    return quantize_int8_math(x, s), float(s)
+
+
+def decode_ring_chunk(payload, scale, wire):
+    """Invert :func:`encode_ring_chunk` back to float32."""
+    p = np.asarray(payload)
+    if wire == 'fp32':
+        return p.astype(np.float32, copy=False)
+    if wire == 'bf16':
+        return p.astype(np.float32)
+    return dequantize_int8_math(p, np.float32(0.0 if scale is None
+                                              else scale))

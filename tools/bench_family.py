@@ -103,13 +103,23 @@ def main():
                         'A/B, plus the 2-worker allreduce wire-format '
                         'A/B with loss-curve parity; one bench.py '
                         'child) instead of the model-family sweep')
+    p.add_argument('--ring', action='store_true',
+                   help='run the BENCH_RING cross-host transport '
+                        'topology A/B (star coordinator vs p2p ring '
+                        'reduce-scatter vs ring+async-overlap across '
+                        'launcher-spawned workers: rank-0 ingress '
+                        'counter-verified, per-mode bitwise loss '
+                        'determinism, dist_overlap_ms gauge, plus the '
+                        'embedding COO-vs-dense wire-bytes arm; one '
+                        'bench.py child) instead of the model-family '
+                        'sweep')
     args = p.parse_args()
 
     bench_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             '..', 'bench.py')
     if args.gluon or args.overlap or args.bucket or args.pipe or \
             args.ckpt or args.serve_fleet or args.int8 or args.loop \
-            or args.embed or args.delta:
+            or args.embed or args.delta or args.ring:
         name, var = (('gluon', 'BENCH_GLUON') if args.gluon
                      else ('overlap', 'BENCH_OVERLAP') if args.overlap
                      else ('bucket', 'BENCH_BUCKET') if args.bucket
@@ -118,6 +128,7 @@ def main():
                      else ('delta', 'BENCH_DELTA') if args.delta
                      else ('embed', 'BENCH_EMBED') if args.embed
                      else ('int8', 'BENCH_INT8') if args.int8
+                     else ('ring', 'BENCH_RING') if args.ring
                      else ('loop', 'BENCH_LOOP') if args.loop
                      else ('serve-fleet', 'BENCH_FLEET'))
         env = dict(os.environ, **{var: '1'})

@@ -121,12 +121,14 @@ def _launch_round(args, command, world, restarts):
     get --elastic-grace seconds to detect the death by heartbeat loss
     and commit their final checkpoints before being SIGTERMed."""
     host = '127.0.0.1'
-    # +2 ports past the servers: base+S for the dist coordinator
-    # (rank 0 binds it) and base+S+1 for jax.distributed's own
-    # coordination service when MXNET_TPU_DIST_JAX=1 derives it as
-    # coordinator port + 1 — both must come out of the probed-free
-    # range, not luck
-    port = args.port or _free_port_range(args.num_servers + 2)
+    # past the servers: base+S for the dist coordinator (rank 0 binds
+    # it), base+S+1 for jax.distributed's own coordination service
+    # when MXNET_TPU_DIST_JAX=1 derives it as coordinator port + 1,
+    # then ONE MORE PER RANK for the ring topology's peer-to-peer
+    # listeners (rank r binds MXNET_TPU_DIST_RING_PORT + r under
+    # MXNET_TPU_DIST_TOPOLOGY=ring) — all probed free up front instead
+    # of failing mid-first-step on a busy port
+    port = args.port or _free_port_range(args.num_servers + 2 + world)
     base_env = dict(os.environ)
     base_env.update({
         'DMLC_PS_ROOT_URI': host,
@@ -134,6 +136,7 @@ def _launch_round(args, command, world, restarts):
         'DMLC_NUM_WORKER': str(world),
         'DMLC_NUM_SERVER': str(args.num_servers),
         'MXNET_TPU_DIST_PORT': str(port + args.num_servers),
+        'MXNET_TPU_DIST_RING_PORT': str(port + args.num_servers + 2),
         'MXNET_TPU_DIST_RESTART_COUNT': str(restarts),
         # a per-job secret even on loopback: frames are then
         # unforgeable by other local users, and the set_optimizer
